@@ -1,0 +1,26 @@
+"""smartcal_tpu — TPU-native framework with the capabilities of
+SarodYatawatta/smart-calibration.
+
+Deep-RL (SAC/TD3/DDPG + PER + hint-constrained ADMM losses) tuning of
+data-processing pipelines — elastic-net regression and radio-interferometric
+calibration/demixing — built JAX/XLA/pallas/pjit-first.  See SURVEY.md at the
+repo root for the reference structural map this build targets.
+
+Subpackages
+-----------
+ops       numerical core: L-BFGS, autodiff/influence tools, calibration
+          kernels (coherency prediction, consensus polynomials, Hessians,
+          solution/residual derivatives), FFT imaging
+envs      gym-style environments as pure (reset, step) function pairs
+rl        SAC / TD3 / DDPG agents, replay buffers (uniform + PER), hints
+models    aux models: transformer classifier, MLP regressor, TSK fuzzy,
+          fuzzy demixing controller
+sim       sky/observation simulators and the in-framework calibration
+          backend (replaces SAGECal/excon/makems)
+parallel  device meshes, distributed learner/actor runtime over collectives
+data      host-side data edge: text sky/cluster/rho/solutions formats,
+          FITS/MS IO gates
+train     CLI drivers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,24 @@
+from .lbfgs import (  # noqa: F401
+    LBFGS_HISTORY_DEFAULT,
+    LBFGSHistory,
+    LBFGSResult,
+    LBFGSState,
+    backtracking_search,
+    history_init,
+    history_push,
+    inv_hessian_mult,
+    lbfgs_init,
+    lbfgs_solve,
+    lbfgs_step,
+    strong_wolfe_cubic,
+    two_loop_direction,
+)
+from .autodiff import (  # noqa: F401
+    cross_derivative,
+    gradient,
+    hessian_vec_prod,
+    influence_matrix,
+    inverse_hessian_vec_prod,
+    jacobian,
+    loss_hvp,
+)

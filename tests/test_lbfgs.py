@@ -1,0 +1,160 @@
+"""Tests for the pure-functional L-BFGS core.
+
+The reference has no tests; its implicit verification is "the elastic-net
+solve converges" (enetenv.py:101-114).  We test convergence on quadratics
+(known closed form), the elastic-net objective, the two-loop recursion
+against an explicit dense BFGS inverse, and jittability.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smartcal_tpu.ops import (
+    history_init,
+    history_push,
+    inv_hessian_mult,
+    lbfgs_init,
+    lbfgs_solve,
+    lbfgs_step,
+    two_loop_direction,
+)
+
+
+def quad_problem(n=10, seed=0):
+    rng = np.random.default_rng(seed)
+    L = rng.normal(size=(n, n)).astype(np.float32)
+    A = L @ L.T + n * np.eye(n, dtype=np.float32)
+    b = rng.normal(size=n).astype(np.float32)
+    x_star = np.linalg.solve(A, b)
+
+    def fun(x):
+        return 0.5 * x @ (jnp.asarray(A) @ x) - jnp.asarray(b) @ x
+
+    return fun, x_star, A, b
+
+
+def test_quadratic_convergence():
+    fun, x_star, _, _ = quad_problem(10)
+    res = lbfgs_solve(fun, jnp.zeros(10), max_iters=100)
+    np.testing.assert_allclose(np.asarray(res.x), x_star, atol=2e-3)
+
+
+def test_rosenbrock():
+    def fun(x):
+        return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2
+                       + (1.0 - x[:-1]) ** 2)
+
+    res = lbfgs_solve(fun, jnp.zeros(4), max_iters=400)
+    np.testing.assert_allclose(np.asarray(res.x), np.ones(4), atol=1e-2)
+
+
+def test_elastic_net_objective():
+    """The reference's actual inner solve (enetenv.py:96-114)."""
+    rng = np.random.default_rng(3)
+    N, M = 20, 20
+    A = rng.normal(size=(N, M)).astype(np.float32)
+    A /= np.linalg.norm(A)
+    x0 = np.zeros(M, dtype=np.float32)
+    x0[:5] = rng.normal(size=5)
+    y = A @ x0 + 0.01 * rng.normal(size=N).astype(np.float32)
+    lam1, lam2 = 1e-3, 1e-3
+    Aj, yj = jnp.asarray(A), jnp.asarray(y)
+
+    def fun(x):
+        err = yj - Aj @ x
+        return (jnp.sum(err ** 2) + lam1 * jnp.sum(x ** 2)
+                + lam2 * jnp.sum(jnp.abs(x)))
+
+    res = lbfgs_solve(fun, jnp.zeros(M), max_iters=200)
+    # compare against scipy-equivalent solve via plain gradient descent proxy:
+    # objective value must beat the zero vector and approach the ridge solution
+    assert float(res.loss) < float(fun(jnp.zeros(M)))
+    ridge = np.linalg.solve(A.T @ A + lam1 * np.eye(M), A.T @ y)
+    assert float(fun(jnp.asarray(ridge))) >= float(res.loss) - 1e-5
+
+
+def test_two_loop_matches_dense_bfgs():
+    """Two-loop recursion == explicitly accumulated inverse-BFGS matrix."""
+    n, m = 6, 4
+    rng = np.random.default_rng(1)
+    hist = history_init(n, m)
+    pairs = []
+    for _ in range(3):
+        s = rng.normal(size=n).astype(np.float32)
+        y = s + 0.1 * rng.normal(size=n).astype(np.float32)
+        if float(np.dot(y, s)) <= 0:
+            y = s
+        pairs.append((s, y))
+        hist = history_push(hist, jnp.asarray(s), jnp.asarray(y), True)
+
+    # dense BFGS: H0 = gamma I, then recursive update oldest->newest
+    s_l, y_l = pairs[-1]
+    gamma = np.dot(y_l, s_l) / np.dot(y_l, y_l)
+    H = gamma * np.eye(n)
+    for s, y in pairs:
+        rho = 1.0 / np.dot(y, s)
+        V = np.eye(n) - rho * np.outer(s, y)
+        H = V @ H @ V.T + rho * np.outer(s, s)
+
+    g = rng.normal(size=n).astype(np.float32)
+    d = two_loop_direction(hist, jnp.asarray(g))
+    np.testing.assert_allclose(np.asarray(d), -H @ g, rtol=1e-4, atol=1e-5)
+
+    # inv_hessian_mult is +H^{-1}q with the same history
+    r = inv_hessian_mult(hist, jnp.asarray(g))
+    np.testing.assert_allclose(np.asarray(r), H @ g, rtol=1e-4, atol=1e-5)
+
+
+def test_inv_hessian_mult_empty_history_identity():
+    hist = history_init(5, 7)
+    q = jnp.arange(5.0)
+    np.testing.assert_allclose(np.asarray(inv_hessian_mult(hist, q)),
+                               np.arange(5.0), rtol=1e-6)
+
+
+def test_curvature_rejection():
+    """Pairs with ys <= 1e-10||s||^2 must not enter memory (lbfgsnew.py:610)."""
+    hist = history_init(4, 3)
+    s = jnp.ones(4)
+    y = -jnp.ones(4)  # ys < 0
+    h2 = history_push(hist, s, y, jnp.dot(y, s) > 1e-10 * jnp.dot(s, s))
+    assert int(h2.count) == 0
+
+
+def test_jit_and_grad_flow():
+    fun, x_star, _, _ = quad_problem(8, seed=5)
+    solve = jax.jit(lambda x0: lbfgs_solve(fun, x0, max_iters=50).x)
+    out = solve(jnp.zeros(8))
+    np.testing.assert_allclose(np.asarray(out), x_star, atol=2e-3)
+
+
+def test_batch_mode_step_decreases_loss():
+    """Stochastic mode: loss over fixed data decreases across step() calls."""
+    rng = np.random.default_rng(7)
+    A = jnp.asarray(rng.normal(size=(50, 10)).astype(np.float32))
+    xtrue = jnp.asarray(rng.normal(size=10).astype(np.float32))
+    y = A @ xtrue
+
+    state = lbfgs_init(jnp.zeros(10))
+    losses = []
+    for i in range(8):
+        # rotate "batches" of rows to exercise the batch-changed path
+        idx = jnp.arange(25) + (i % 2) * 25
+
+        def fun(x, A=A[idx], y=y[idx]):
+            return jnp.mean((A @ x - y) ** 2)
+
+        state, loss = lbfgs_step(fun, state, max_iter=4)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    final = float(jnp.mean((A @ state.x - y) ** 2))
+    assert final < 1e-2 * float(jnp.mean(y ** 2))
+
+
+def test_solve_reports_convergence_on_trivial_problem():
+    res = lbfgs_solve(lambda x: jnp.sum((x - 1.0) ** 2), jnp.zeros(3),
+                      max_iters=100)
+    assert bool(res.converged)
+    assert int(res.n_iters) < 100
