@@ -1,0 +1,80 @@
+"""Headline benchmark: elasticnet SAC env-steps/sec on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload = the reference `elasticnet/main_sac.py` configuration (N=M=20,
+batch 64, mem 1024, 5 steps/episode): every env step runs the full inner
+L-BFGS elastic-net solve + influence eigen-state, and every loop iteration
+also runs the SAC learn step.  Here the whole loop is one jitted lax.scan
+per episode on the TPU.
+
+Baseline = the reference implementation itself (torch, this host's CPU —
+upstream publishes no numbers; see BASELINE.md), measured by
+tools/measure_reference.py with the identical protocol: warm-up until the
+replay buffer reaches batch_size, then time N timed steps.
+"""
+
+import json
+import os
+import time
+
+import jax
+
+from smartcal_tpu.envs import enet
+from smartcal_tpu.rl import replay as rp
+from smartcal_tpu.rl import sac
+from smartcal_tpu.train.enet_sac import make_episode_fn
+
+STEPS_PER_EPISODE = 5
+TIMED_EPISODES = 20  # 100 timed env steps, same as the reference measurement
+FALLBACK_BASELINE = 4.16  # tools/reference_baseline.json, torch CPU
+
+
+def main():
+    env_cfg = enet.EnetConfig(M=20, N=20)
+    agent_cfg = sac.SACConfig(
+        obs_dim=env_cfg.obs_dim, n_actions=2, gamma=0.99, tau=0.005,
+        batch_size=64, mem_size=1024, lr_a=1e-3, lr_c=1e-3,
+        reward_scale=20.0, alpha=0.03)
+
+    key = jax.random.PRNGKey(0)
+    key, k0 = jax.random.split(key)
+    agent_state = sac.sac_init(k0, agent_cfg)
+    buf = rp.replay_init(agent_cfg.mem_size,
+                         rp.transition_spec(env_cfg.obs_dim, 2))
+    episode_fn = make_episode_fn(env_cfg, agent_cfg, STEPS_PER_EPISODE,
+                                 use_hint=False)
+
+    # warm-up: compile + fill the buffer past batch_size so learn() is live
+    while int(buf.cntr) < agent_cfg.batch_size:
+        key, k = jax.random.split(key)
+        agent_state, buf, score = episode_fn(agent_state, buf, k)
+    jax.block_until_ready(score)
+
+    t0 = time.time()
+    for _ in range(TIMED_EPISODES):
+        key, k = jax.random.split(key)
+        agent_state, buf, score = episode_fn(agent_state, buf, k)
+    jax.block_until_ready(score)
+    wall = time.time() - t0
+
+    steps = TIMED_EPISODES * STEPS_PER_EPISODE
+    value = steps / wall
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "tools", "reference_baseline.json")
+    baseline = FALLBACK_BASELINE
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            baseline = json.load(f)["value"]
+
+    print(json.dumps({
+        "metric": "enet_sac_env_steps_per_sec",
+        "value": round(value, 2),
+        "unit": "env-steps/sec/chip",
+        "vs_baseline": round(value / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

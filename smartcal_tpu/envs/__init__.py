@@ -1,0 +1,4 @@
+from .enet import EnetConfig, EnetEnv, EnetState  # noqa: F401
+from .enet import get_hint as enet_get_hint  # noqa: F401
+from .enet import reset as enet_reset  # noqa: F401
+from .enet import step as enet_step  # noqa: F401

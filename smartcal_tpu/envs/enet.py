@@ -1,0 +1,250 @@
+"""Elastic-net hyperparameter-tuning environment, fully on-device.
+
+Re-expresses the reference ``elasticnet/enetenv.py`` gym env as pure
+``(reset, step, hint)`` functions so an entire episode — inner L-BFGS solve,
+influence eigen-state, reward — jit-compiles into one XLA computation and can
+be scanned/vmapped/sharded.  Semantics follow the reference line by line:
+
+* problem: ``min_x ||y - Ax||^2 + rho0 ||x||_2^2 + rho1 ||x||_1``
+  (``enetenv.py:27-28``); action -> rho affine map with out-of-range penalty
+  (``:75-84``); per-step fresh noise at fixed SNR (``:87-90``);
+* inner solve: 20 epochs x ``LBFGSNew(max_iter=10, history_size=7)``
+  (``:101-114``) -> here one :func:`lbfgs_solve` with ``max_iters=200``;
+* influence state (``:117-139``): model Jacobian, mixed derivative
+  d(dL/dx)/dy, per-column inverse-Hessian product reusing the L-BFGS
+  curvature history, ``B = jac @ invH @ d2L``, state = 1 + Re(eig(B));
+* reward ``||y||/||Ax-y|| + min(E)/max(E) + penalty`` (``:149``);
+* reset redraws A and a sparse ground truth with ``Mo ~ U{3..M-1}`` nonzeros
+  at (possibly colliding) random indices (``:163-183``);
+* hint: 5x5 grid search over (lambda1, lambda2) with 2-fold cross-validation
+  (sklearn ``GridSearchCV(cv=2)`` in the reference, ``:229-241``) — here the
+  25 candidate x 2 fold solves run as one ``vmap`` on device.
+
+Eigen-state on TPU: nonsymmetric ``eig`` is host-only in XLA.  The exact
+``B = jac . H^{-1} . (-2 A^T)`` is a product of symmetric matrices when
+``H^{-1}`` is exact (``H = 2 A^T A + 2 rho0 I`` a.e.), so its spectrum is
+real and equals that of the symmetrised ``(B + B^T)/2`` up to the (small)
+asymmetry of the BFGS approximation.  Default ``eig_mode='symmetric'`` uses
+``eigvalsh`` on-device; ``eig_mode='exact'`` calls host ``numpy.linalg.eigvals``
+through ``pure_callback`` for bit-parity studies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops.lbfgs import lbfgs_solve, inv_hessian_mult
+
+LOW = 1e-3   # enetenv.py:21
+HIGH = 1e-1  # enetenv.py:22
+HINT_GRID = (0.001, 0.005, 0.01, 0.05, 0.1)  # enetenv.py:233
+
+
+@dataclasses.dataclass(frozen=True)
+class EnetConfig:
+    M: int = 20                  # parameters (columns)
+    N: int = 20                  # data points (rows)
+    snr: float = 0.1             # ||noise||/||data|| (enetenv.py:48)
+    lbfgs_iters: int = 200       # 20 epochs x max_iter 10 (enetenv.py:101-114)
+    history_size: int = 7
+    eig_mode: str = "symmetric"  # 'symmetric' | 'exact'
+
+    @property
+    def obs_dim(self) -> int:
+        # state vector = concat(eig (N), A.ravel() (N*M)) — enet_sac.py:40
+        return self.N + self.N * self.M
+
+
+class EnetState(NamedTuple):
+    A: jnp.ndarray    # (N, M) normalised design matrix
+    x0: jnp.ndarray   # (M,) sparse ground truth
+    y0: jnp.ndarray   # (N,) noise-free data
+    y: jnp.ndarray    # (N,) last noisy draw
+    x: jnp.ndarray    # (M,) last solution (render/eval)
+
+
+def reset(cfg: EnetConfig, key) -> Tuple[EnetState, jnp.ndarray]:
+    """Draw a new problem (enetenv.py:163-183)."""
+    kA, kMo, kz, kidx = jax.random.split(key, 4)
+    M, N = cfg.M, cfg.N
+    A = jax.random.normal(kA, (N, M), jnp.float32)
+    A = A / jnp.linalg.norm(A)
+
+    Mo = jax.random.randint(kMo, (), 3, M)          # nnz count, U{3..M-1}
+    z = jax.random.normal(kz, (M,), jnp.float32)
+    idx = jax.random.randint(kidx, (M,), 0, M)
+    # only the first Mo draws land; the rest scatter out of bounds (dropped),
+    # duplicates overwrite — same distribution as x0[randint(0,M,Mo)]=z0
+    idx_eff = jnp.where(jnp.arange(M) < Mo, idx, M)
+    x0 = jnp.zeros((M,), jnp.float32).at[idx_eff].set(z, mode="drop")
+
+    y0 = A @ x0
+    st = EnetState(A=A, x0=x0, y0=y0, y=y0, x=jnp.zeros((M,), jnp.float32))
+    obs = jnp.concatenate([jnp.zeros((N,), jnp.float32), A.ravel()])
+    return st, obs
+
+
+def action_to_rho(action):
+    """Affine action->(rho, penalty) map (enetenv.py:75-84): actions in
+    [-1, 1] span [LOW, HIGH]; out-of-range components are clamped with a
+    -0.1 penalty each."""
+    rho_raw = action * (HIGH - LOW) / 2.0 + (HIGH + LOW) / 2.0
+    penalty = (-0.1 * jnp.sum(rho_raw < LOW)
+               - 0.1 * jnp.sum(rho_raw > HIGH)).astype(jnp.float32)
+    return jnp.clip(rho_raw, LOW, HIGH), penalty
+
+
+def _eig_state(cfg: EnetConfig, B: jnp.ndarray) -> jnp.ndarray:
+    if cfg.eig_mode == "exact":
+        def host_eig(b):
+            return np.real(np.linalg.eigvals(np.asarray(b))).astype(np.float32)
+
+        E = jax.pure_callback(
+            host_eig, jax.ShapeDtypeStruct((cfg.N,), jnp.float32), B,
+            vmap_method="sequential")
+    else:
+        E = jnp.linalg.eigvalsh(0.5 * (B + B.T))
+    return 1.0 + E
+
+
+def _solve_and_influence(cfg: EnetConfig, A, y, rho):
+    """Inner solve + influence eigen-state (enetenv.py:96-139)."""
+    M = cfg.M
+
+    def lossfn(x, yv):
+        err = yv - A @ x
+        return (jnp.sum(err ** 2) + rho[0] * jnp.sum(x ** 2)
+                + rho[1] * jnp.sum(jnp.abs(x)))
+
+    res = lbfgs_solve(lambda x: lossfn(x, y), jnp.zeros((M,), jnp.float32),
+                      max_iters=cfg.lbfgs_iters,
+                      history_size=cfg.history_size)
+    x = res.x
+
+    # Jacobian of the model A@x w.r.t. x is A (reference computes it row by
+    # row via backward(), enetenv.py:118 — it is exactly A)
+    jac = A
+    # d(dL/dx)/dy — constant in y for this loss; autodiff keeps generality
+    # (reference evaluates it at y=ones for the same reason, enetenv.py:121-124)
+    ll = jax.jacrev(lambda yv: jax.grad(lossfn)(x, yv))(jnp.ones_like(y))
+    mm = jax.vmap(lambda col: inv_hessian_mult(res.hist, col),
+                  in_axes=1, out_axes=1)(ll)
+    B = jac @ mm
+    EE = _eig_state(cfg, B)
+    return x, EE
+
+
+def step(cfg: EnetConfig, st: EnetState, action, key,
+         keepnoise: bool = False):
+    """One env step (enetenv.py:72-161).
+
+    Returns ``(new_state, obs, reward, done)``; ``done`` is always False as in
+    the reference (episode length is driver-limited).
+    """
+    action = jnp.asarray(action, jnp.float32).reshape(-1)
+    rho, penalty = action_to_rho(action)
+
+    if keepnoise:
+        y = st.y
+    else:
+        n = jax.random.normal(key, (cfg.N,), jnp.float32)
+        y = st.y0 + cfg.snr * jnp.linalg.norm(st.y0) / jnp.linalg.norm(n) * n
+
+    x, EE = _solve_and_influence(cfg, st.A, y, rho)
+
+    obs = jnp.concatenate([EE, st.A.ravel()])
+    final_err = jnp.linalg.norm(st.A @ x - y)
+    reward = (jnp.linalg.norm(y) / final_err
+              + jnp.min(EE) / jnp.max(EE) + penalty)
+
+    new_st = st._replace(y=y, x=x)
+    return new_st, obs, reward, jnp.asarray(False)
+
+
+def get_hint(cfg: EnetConfig, st: EnetState) -> jnp.ndarray:
+    """Grid-search hint mapped back to action space (enetenv.py:229-241).
+
+    2-fold CV over the 5x5 lambda grid: sklearn ``KFold(2)`` splits the rows
+    into first/second half; each candidate trains on one half (L-BFGS solve of
+    the elastic net, as ``SKEnet.fit`` does with scipy L-BFGS-B,
+    ``enetenv.py:263-288``) and scores neg-MSE on the other.  All 50 solves
+    run as one vmap.
+    """
+    N = cfg.N
+    half = N // 2
+    grid = jnp.asarray(
+        [(l1, l2) for l1 in HINT_GRID for l2 in HINT_GRID], jnp.float32)
+
+    fold_test = jnp.stack([
+        jnp.arange(N) < half,      # fold 0: first half tests
+        jnp.arange(N) >= half,     # fold 1: second half tests
+    ])
+
+    def cv_mse(lams, test_mask):
+        l1, l2 = lams[0], lams[1]
+        w = jnp.where(test_mask, 0.0, 1.0)  # train on the complement
+
+        def fun(xv):
+            err = (st.y - st.A @ xv) * w
+            # SKEnet objective (enetenv.py:275-280): lambda1 multiplies the
+            # L1 term, lambda2 the squared L2 term
+            return (jnp.sum(err ** 2) + l2 * jnp.sum(xv ** 2)
+                    + l1 * jnp.sum(jnp.abs(xv)))
+
+        res = lbfgs_solve(fun, jnp.zeros((cfg.M,), jnp.float32),
+                          max_iters=100, history_size=cfg.history_size)
+        pred_err = (st.A @ res.x - st.y) ** 2
+        return jnp.sum(pred_err * test_mask) / jnp.sum(test_mask)
+
+    mses = jax.vmap(lambda lams: jax.vmap(
+        lambda mask: cv_mse(lams, mask))(fold_test))(grid)
+    best = jnp.argmin(jnp.mean(mses, axis=1))
+    lam = grid[best]
+    # inverse of the step() affine map; hint_[0]=lambda1, hint_[1]=lambda2
+    return (lam - (HIGH + LOW) / 2.0) / ((HIGH - LOW) / 2.0)
+
+
+class EnetEnv:
+    """Host-driven gym-like wrapper (reference ``ENetEnv`` interface)."""
+
+    def __init__(self, M: int = 20, N: int = 20, provide_hint: bool = False,
+                 seed: int = 0, eig_mode: str = "symmetric",
+                 lbfgs_iters: int = 200):
+        self.cfg = EnetConfig(M=M, N=N, eig_mode=eig_mode,
+                              lbfgs_iters=lbfgs_iters)
+        self.provide_hint = provide_hint
+        self.key = jax.random.PRNGKey(seed)
+        self._reset = jax.jit(lambda k: reset(self.cfg, k))
+        self._step = jax.jit(
+            lambda st, a, k: step(self.cfg, st, a, k))
+        self._hint = jax.jit(lambda st: get_hint(self.cfg, st))
+        self.state: EnetState = None
+        self.hint = None
+
+    def _next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+    def reset(self):
+        self.state, obs = self._reset(self._next_key())
+        self.hint = None
+        return jax.device_get(obs)
+
+    def step(self, action):
+        self.state, obs, reward, done = self._step(
+            self.state, jnp.asarray(action), self._next_key())
+        out = (jax.device_get(obs), float(reward), bool(done))
+        if self.provide_hint:
+            if self.hint is None:
+                self.hint = jax.device_get(self._hint(self.state))
+            return (*out, self.hint, {})
+        return (*out, {})
+
+    def get_hint(self):
+        return jax.device_get(self._hint(self.state))

@@ -122,19 +122,19 @@ def influence_matrix(model_fn: Callable, params, x, labels,
     x_flat = jnp.ravel(x)
     y_flat = jnp.ravel(labels)
 
-    def loss_flat(p_flat, xf):
-        pred = jnp.ravel(model_fn(unravel(p_flat), xf.reshape(x.shape)))
+    def loss_fn(p, xx):
+        pred = jnp.ravel(model_fn(p, xx))
         return jnp.mean((pred - y_flat) ** 2)
 
     # (P, N) mixed derivative
-    cross = jax.jacfwd(lambda xf: jax.grad(loss_flat)(flat, xf))(x_flat)
+    cross = cross_derivative(loss_fn, params, x)
 
     if hist is not None:
         ihvp = jax.vmap(lambda col: inv_hessian_mult(hist, col),
                         in_axes=1, out_axes=1)(cross)
     else:
         def f_params(p_flat):
-            return loss_flat(p_flat, x_flat)
+            return loss_fn(unravel(p_flat), x_flat.reshape(x.shape))
 
         ihvp = jax.vmap(
             lambda col: inverse_hessian_vec_prod(f_params, flat, col,

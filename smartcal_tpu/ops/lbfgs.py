@@ -227,8 +227,10 @@ def strong_wolfe_cubic(fun: Callable, x: jnp.ndarray, d: jnp.ndarray,
                                jnp.where(gphi_j * (bj - aj) >= 0.0, aj, bj))
             aj_new = jnp.where(cond_shrink, aj, alphaj)
 
-            alphak_new = jnp.where(found, alphak,
-                                   jnp.where(newly_found, alphaj, alphaj))
+            # on termination alphaj is the result; if the loop runs out, the
+            # last trial alphaj is the fallback (reference :486-487) — either
+            # way the tracked alpha is the latest alphaj unless already found
+            alphak_new = jnp.where(found, alphak, alphaj)
             found_new = found | newly_found
             aj_out = jnp.where(found, aj, aj_new)
             bj_out = jnp.where(found, bj, bj_new)
@@ -353,11 +355,11 @@ def lbfgs_solve(fun: Callable, x0: jnp.ndarray, max_iters: int = 200,
     hist0 = history_init(x0.shape[0], history_size, dtype)
 
     def cond(carry):
-        (x, loss, g, hist, it, stop) = carry
+        (x, loss, g, hist, it, stop, diverged) = carry
         return (it < max_iters) & (~stop)
 
     def body(carry):
-        (x, loss, g, hist, it, stop) = carry
+        (x, loss, g, hist, it, stop, diverged) = carry
 
         d = two_loop_direction(hist, g)
 
@@ -381,21 +383,25 @@ def lbfgs_solve(fun: Callable, x0: jnp.ndarray, max_iters: int = 200,
         accept = ys > 1e-10 * sn2
         hist_new = history_push(hist, s, y_new, accept)
 
-        # stopping tests (lbfgsnew.py:725-741)
+        # stopping tests (lbfgsnew.py:725-741); NaN divergence stops the loop
+        # but must not report convergence
         abs_gsum = jnp.sum(jnp.abs(g_new))
+        diverged_new = diverged | jnp.isnan(abs_gsum) | jnp.isnan(loss_new)
         stop_new = (abs_gsum <= tolerance_grad)
         stop_new |= gtd > -tolerance_change
         stop_new |= jnp.sum(jnp.abs(s)) <= tolerance_change
         stop_new |= jnp.abs(loss_new - loss) < tolerance_change
-        stop_new |= jnp.isnan(abs_gsum)
+        stop_new |= diverged_new
 
-        return (x_new, loss_new, g_new, hist_new, it + 1, stop_new)
+        return (x_new, loss_new, g_new, hist_new, it + 1, stop_new,
+                diverged_new)
 
     init = (x0, loss0, g0, hist0, jnp.asarray(0, jnp.int32),
-            jnp.sum(jnp.abs(g0)) <= tolerance_grad)
-    x, loss, g, hist, it, stop = lax.while_loop(cond, body, init)
+            jnp.sum(jnp.abs(g0)) <= tolerance_grad,
+            jnp.isnan(loss0))
+    x, loss, g, hist, it, stop, diverged = lax.while_loop(cond, body, init)
     return LBFGSResult(x=x, loss=loss, grad=g, hist=hist, n_iters=it,
-                       converged=stop)
+                       converged=stop & ~diverged)
 
 
 # ---------------------------------------------------------------------------
@@ -436,7 +442,7 @@ def lbfgs_init(x0: jnp.ndarray, history_size: int = 7,
 
 @functools.partial(jax.jit, static_argnums=(0, 2))
 def lbfgs_step(fun: Callable, state: LBFGSState, max_iter: int = 4,
-               lr: float = 1.0, lm0: float = 1e-6) -> tuple:
+               lm0: float = 1e-6) -> tuple:
     """One stochastic ``step(closure)`` on a (new) batch.
 
     ``fun`` closes over the current batch.  Matches the reference batch mode
@@ -482,7 +488,13 @@ def lbfgs_step(fun: Callable, state: LBFGSState, max_iter: int = 4,
         d = two_loop_direction(hist, g)
         t = backtracking_search(fun, st.x, d, g, alphabar)
         x_new = st.x + t * d
-        loss_new, g_new = value_and_grad(x_new)
+        # skip the post-step re-evaluation on the last inner iteration — in a
+        # stochastic setting the next step() entry re-evaluates on the new
+        # batch anyway (reference lbfgsnew.py:712-716)
+        loss_new, g_new = lax.cond(
+            i < max_iter - 1,
+            lambda _: value_and_grad(x_new),
+            lambda _: (loss, g), operand=None)
 
         st_new = LBFGSState(
             x=x_new, hist=hist, prev_grad=g, prev_d=d, prev_t=t,

@@ -1,0 +1,6 @@
+from .mesh import make_mesh, replicated, sharded_batch  # noqa: F401
+from .trainer import (  # noqa: F401
+    ParallelTrainState,
+    episode_scores,
+    make_parallel_sac,
+)

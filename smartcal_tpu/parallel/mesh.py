@@ -1,0 +1,54 @@
+"""Device-mesh helpers.
+
+The framework's parallel axes (SURVEY.md section 2.4 mapping):
+
+* ``dp``  — data parallel: parallel environment rollouts + learn-batch
+  sharding (replaces the reference's torch-RPC learner/actor fan-out,
+  ``elasticnet/distributed_per_sac.py``).
+* ``fp``  — frequency parallel: consensus-ADMM calibration across frequency
+  sub-bands (replaces sagecal-mpi's MPI ranks, ``calibration/docal.sh:12``);
+  the Z-polynomial consensus update is a ``psum`` over this axis.
+* ``sp``  — sequence/baseline parallel: the time x baseline axis of the
+  influence kernels (the reference chunks it over multiprocessing pools,
+  ``calibration/analysis.py:54-62``).
+
+All collectives ride ICI within a host and DCN across hosts — placement is
+XLA's job once shardings are annotated.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
+
+
+def make_mesh(axis_sizes: Optional[Tuple[int, ...]] = None,
+              axis_names: Sequence[str] = ("dp",),
+              devices=None) -> Mesh:
+    """Build a mesh over the available devices.
+
+    Default: all devices on one ``dp`` axis.  ``axis_sizes`` reshapes the
+    device list (row-major) for multi-axis meshes, e.g.
+    ``make_mesh((4, 2), ("dp", "fp"))``.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if axis_sizes is None:
+        axis_sizes = (len(devices),)
+    n = int(np.prod(axis_sizes))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh wants {n} devices, only {len(devices)} available")
+    dev_array = np.asarray(devices[:n]).reshape(axis_sizes)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sharded_batch(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Leading-axis sharding over ``axis``."""
+    return NamedSharding(mesh, P(axis))

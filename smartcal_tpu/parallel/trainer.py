@@ -1,0 +1,125 @@
+"""Synchronous data-parallel SAC trainer over a device mesh.
+
+TPU-native replacement for the reference's distributed learner/actor runtime
+(``elasticnet/distributed_per_sac.py``): there, a rank-0 Learner holds the
+agent, fires ``rpc_async`` rollouts on remote Actors, ships CPU weight dicts
+out and whole replay buffers back, and serialises ingestion behind a
+``threading.Lock`` (``:44-57,:60-74,:123-146``).
+
+Here the learner/actor split collapses into one SPMD program over a
+``Mesh``:
+
+* a batch of environments lives sharded over the ``dp`` axis (one or more
+  env states per device) — the "actors";
+* agent parameters are replicated; action sampling and env stepping run
+  devicewise with no weight shipping (the broadcast is the sharding);
+* the transition batch scatters into the (replicated) HBM replay buffer —
+  the lock-free equivalent of ``download_replaybuffer``;
+* the learn step consumes a minibatch; XLA inserts the gradient
+  all-reduce over ICI where the batch sharding demands it (the pmap-psum
+  "north star" of BASELINE.json).
+
+Everything is one jitted function of pure pytrees, so the same code runs on
+1 chip, an 8-device virtual CPU mesh (tests), or a real pod slice.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..envs import enet
+from ..rl import replay as rp
+from ..rl import sac
+
+
+class ParallelTrainState(NamedTuple):
+    agent: sac.SACState
+    buf: rp.ReplayState
+    env_states: enet.EnetState      # batched leading axis (n_envs)
+    obs: jnp.ndarray                # (n_envs, obs_dim)
+    hints: jnp.ndarray              # (n_envs, n_actions)
+
+
+def make_parallel_sac(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
+                      mesh: Mesh, n_envs: int, use_hint: bool = False):
+    """Build (init_fn, train_step_fn) with shardings bound to ``mesh``.
+
+    ``n_envs`` must be divisible by the ``dp`` axis size.  One train step =
+    every env advances one step (vmapped, dp-sharded), the transition batch
+    is stored, and one SAC learn step runs.
+    """
+    if n_envs % mesh.shape["dp"] != 0:
+        raise ValueError(f"n_envs={n_envs} not divisible by dp axis "
+                         f"{mesh.shape['dp']}")
+
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P("dp"))
+
+    def init_fn(key) -> ParallelTrainState:
+        k_agent, k_envs = jax.random.split(key)
+        agent = sac.sac_init(k_agent, agent_cfg)
+        buf = rp.replay_init(
+            agent_cfg.mem_size,
+            rp.transition_spec(env_cfg.obs_dim, agent_cfg.n_actions))
+        env_states, obs = jax.vmap(lambda k: enet.reset(env_cfg, k))(
+            jax.random.split(k_envs, n_envs))
+        if use_hint:
+            hints = jax.vmap(lambda s: enet.get_hint(env_cfg, s))(env_states)
+        else:
+            hints = jnp.zeros((n_envs, agent_cfg.n_actions), jnp.float32)
+        st = ParallelTrainState(agent=agent, buf=buf, env_states=env_states,
+                                obs=obs, hints=hints)
+        return jax.device_put(st, _state_shardings(st))
+
+    def _state_shardings(st: ParallelTrainState):
+        return ParallelTrainState(
+            agent=jax.tree_util.tree_map(lambda _: repl, st.agent),
+            buf=jax.tree_util.tree_map(lambda _: repl, st.buf),
+            env_states=jax.tree_util.tree_map(lambda _: shard, st.env_states),
+            obs=shard,
+            hints=shard,
+        )
+
+    def train_step(st: ParallelTrainState, key):
+        k_act, k_env, k_learn = jax.random.split(key, 3)
+
+        # actors: sample + step, devicewise over dp
+        actions = sac.choose_action(agent_cfg, st.agent, st.obs, k_act)
+        env_keys = jax.random.split(k_env, n_envs)
+        env_states, obs2, rewards, dones = jax.vmap(
+            lambda s, a, k: enet.step(env_cfg, s, a, k))(
+            st.env_states, actions, env_keys)
+
+        transitions = {
+            "state": st.obs, "action": actions, "reward": rewards,
+            "new_state": obs2, "done": dones, "hint": st.hints,
+        }
+        buf = rp.replay_add_batch(
+            st.buf, transitions,
+            priority=None if agent_cfg.prioritized else jnp.asarray(1.0))
+
+        agent, buf, metrics = sac.learn(agent_cfg, st.agent, buf, k_learn)
+        metrics["mean_reward"] = jnp.mean(rewards)
+
+        new_st = ParallelTrainState(agent=agent, buf=buf,
+                                    env_states=env_states, obs=obs2,
+                                    hints=st.hints)
+        return new_st, metrics
+
+    dummy = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    shardings = _state_shardings(dummy)
+    train_step_jit = jax.jit(train_step,
+                             in_shardings=(shardings, repl),
+                             out_shardings=(shardings, repl))
+    return init_fn, train_step_jit
+
+
+def episode_scores(metrics_list, steps_per_episode: int):
+    """Aggregate per-step mean rewards into per-episode scores."""
+    rewards = [float(m["mean_reward"]) for m in metrics_list]
+    return [sum(rewards[i:i + steps_per_episode]) / steps_per_episode
+            for i in range(0, len(rewards), steps_per_episode)]

@@ -1,0 +1,22 @@
+from .networks import (  # noqa: F401
+    ImageMetaActor,
+    ImageMetaCritic,
+    InfluenceCNN,
+    MLPActor,
+    MLPCritic,
+    MLPDeterministicActor,
+    gaussian_sample,
+)
+from . import replay  # noqa: F401
+from .replay import (  # noqa: F401
+    ReplayState,
+    replay_add,
+    replay_init,
+    replay_sample_per,
+    replay_sample_uniform,
+    replay_update_priorities,
+    transition_spec,
+)
+from .sac import SACAgent, SACConfig, SACState, sac_init  # noqa: F401
+from .sac import choose_action as sac_choose_action  # noqa: F401
+from .sac import learn as sac_learn  # noqa: F401

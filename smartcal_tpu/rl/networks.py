@@ -1,0 +1,210 @@
+"""Actor/critic networks (flax.linen).
+
+Architectures follow the reference agents so learning dynamics match:
+
+* MLP actor/critic for the elastic-net workload
+  (``elasticnet/enet_sac.py:352-444``): LayerNorm + ELU stacks, state path
+  512->256, action path 128->64 concatenated into the Q head; actor
+  512->256->128 -> (mu, logsigma) with logsigma clamped to [-20, 2].
+* CNN encoder tower for the calibration/demixing workloads
+  (``calibration/calib_sac.py:99-118``, ``demixing_rl/demix_sac.py:381-386``):
+  Conv(1->16->32->32, kernel 5, stride 2) + norm on the 128x128 influence
+  map, merged with a metadata MLP (->128->16).
+
+Weight init mirrors the reference ``init_layer`` (``enet_sac.py:18-21``):
+uniform(+-1/sqrt(out_features)) — note the reference scales by
+``weight.size()[0]`` which for ``torch.nn.Linear`` is the *output* dimension —
+and +-0.003 on final layers.  The reference normalises CNN activations with
+BatchNorm; we use GroupNorm (batch-statistics-free, so the jitted train step
+stays a pure function — no running-stats side state), which is the standard
+JAX-native substitute and behaves identically at batch size O(32).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+LOG_SIG_MIN, LOG_SIG_MAX = -20.0, 2.0
+FINAL_INIT_SCALE = 0.003
+
+
+def _out_dim_uniform(key, shape, dtype=jnp.float32):
+    """uniform(+-1/sqrt(out_features)) for kernels (in, out) and biases (out,)."""
+    sc = 1.0 / jnp.sqrt(jnp.asarray(shape[-1], jnp.float32))
+    return jax.random.uniform(key, shape, dtype, -sc, sc)
+
+
+def _final_uniform(key, shape, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, -FINAL_INIT_SCALE,
+                              FINAL_INIT_SCALE)
+
+
+def _dense(features, final=False):
+    init = _final_uniform if final else _out_dim_uniform
+    return nn.Dense(features, kernel_init=init, bias_init=init)
+
+
+class MLPActor(nn.Module):
+    """Gaussian policy head (reference ``ActorNetwork``, enet_sac.py:407-444)."""
+
+    n_actions: int
+    hidden: Sequence[int] = (512, 256, 128)
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        for h in self.hidden:
+            x = _dense(h)(x)
+            x = nn.LayerNorm()(x)
+            x = nn.elu(x)
+        mu = _dense(self.n_actions, final=True)(x)
+        logsigma = _dense(self.n_actions, final=True)(x)
+        logsigma = jnp.clip(logsigma, LOG_SIG_MIN, LOG_SIG_MAX)
+        return mu, logsigma
+
+
+class MLPCritic(nn.Module):
+    """Two-tower Q network (reference ``CriticNetwork``, enet_sac.py:352-394)."""
+
+    state_hidden: Sequence[int] = (512, 256)
+    action_hidden: Sequence[int] = (128, 64)
+
+    @nn.compact
+    def __call__(self, state, action) -> jnp.ndarray:
+        x = state
+        for h in self.state_hidden:
+            x = _dense(h)(x)
+            x = nn.LayerNorm()(x)
+            x = nn.elu(x)
+        y = action
+        for h in self.action_hidden:
+            y = _dense(h)(y)
+            y = nn.LayerNorm()(y)
+            y = nn.elu(y)
+        z = jnp.concatenate([x, y], axis=-1)
+        return _dense(1, final=True)(z)
+
+
+class MLPDeterministicActor(nn.Module):
+    """Deterministic tanh policy for TD3/DDPG (reference enet_td3.py /
+    enet_ddpg.py actor shape: 512->256->128->n_actions, tanh output)."""
+
+    n_actions: int
+    hidden: Sequence[int] = (512, 256, 128)
+
+    @nn.compact
+    def __call__(self, x) -> jnp.ndarray:
+        for h in self.hidden:
+            x = _dense(h)(x)
+            x = nn.LayerNorm()(x)
+            x = nn.elu(x)
+        return jnp.tanh(_dense(self.n_actions, final=True)(x))
+
+
+class InfluenceCNN(nn.Module):
+    """Conv tower over a (H, W) influence map.
+
+    Reference: Conv2d(1->16->32->32, kernel 5, stride 2) + BatchNorm
+    (``calib_sac.py:99-104``); GroupNorm here (see module docstring).
+    Returns a flat feature vector.
+    """
+
+    channels: Sequence[int] = (16, 32, 32)
+
+    @nn.compact
+    def __call__(self, img) -> jnp.ndarray:
+        # img: (..., H, W) -> add channel axis
+        x = img[..., None]
+        for ch in self.channels:
+            x = nn.Conv(ch, kernel_size=(5, 5), strides=(2, 2))(x)
+            x = nn.GroupNorm(num_groups=min(8, ch))(x)
+            x = nn.elu(x)
+        return x.reshape(*x.shape[:-3], -1)
+
+
+class ImageMetaActor(nn.Module):
+    """CNN(map) + MLP(metadata) -> Gaussian policy.
+
+    Reference calibration/demixing actor (``calib_sac.py:155-199``,
+    ``demix_sac.py:371-430``): the influence-map CNN features and a
+    metadata MLP (->128->16) are merged before the policy head.  When
+    ``use_image=False`` the CNN branch is dropped (the demixing_fuzzy
+    variant, ``demixing_fuzzy/demix_sac.py:96-135``).
+    """
+
+    n_actions: int
+    use_image: bool = True
+    meta_hidden: Sequence[int] = (128, 16)
+    head_hidden: Sequence[int] = (256, 128)
+
+    @nn.compact
+    def __call__(self, img, meta) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        feats = []
+        if self.use_image:
+            feats.append(InfluenceCNN()(img))
+        m = meta
+        for h in self.meta_hidden:
+            m = _dense(h)(m)
+            m = nn.LayerNorm()(m)
+            m = nn.elu(m)
+        feats.append(m)
+        x = jnp.concatenate(feats, axis=-1)
+        for h in self.head_hidden:
+            x = _dense(h)(x)
+            x = nn.LayerNorm()(x)
+            x = nn.elu(x)
+        mu = _dense(self.n_actions, final=True)(x)
+        logsigma = jnp.clip(_dense(self.n_actions, final=True)(x),
+                            LOG_SIG_MIN, LOG_SIG_MAX)
+        return mu, logsigma
+
+
+class ImageMetaCritic(nn.Module):
+    """CNN(map) + MLP(metadata) + MLP(action) -> Q value."""
+
+    use_image: bool = True
+    meta_hidden: Sequence[int] = (128, 16)
+    action_hidden: Sequence[int] = (128, 64)
+    head_hidden: Sequence[int] = (256,)
+
+    @nn.compact
+    def __call__(self, img, meta, action) -> jnp.ndarray:
+        feats = []
+        if self.use_image:
+            feats.append(InfluenceCNN()(img))
+        m = meta
+        for h in self.meta_hidden:
+            m = _dense(h)(m)
+            m = nn.LayerNorm()(m)
+            m = nn.elu(m)
+        feats.append(m)
+        a = action
+        for h in self.action_hidden:
+            a = _dense(h)(a)
+            a = nn.LayerNorm()(a)
+            a = nn.elu(a)
+        feats.append(a)
+        x = jnp.concatenate(feats, axis=-1)
+        for h in self.head_hidden:
+            x = _dense(h)(x)
+            x = nn.LayerNorm()(x)
+            x = nn.elu(x)
+        return _dense(1, final=True)(x)
+
+
+def gaussian_sample(mu, logsigma, key):
+    """Tanh-squashed reparameterised sample + log-prob.
+
+    Reference ``sample_normal`` (enet_sac.py:446-466) with max_action=1:
+    ``a = tanh(z)``, ``log pi = log N(z; mu, sigma) - log(1 - tanh(z)^2 + 1e-6)``.
+    """
+    sigma = jnp.exp(logsigma)
+    z = mu + sigma * jax.random.normal(key, mu.shape, mu.dtype)
+    a = jnp.tanh(z)
+    log_probs = (-0.5 * ((z - mu) / sigma) ** 2 - logsigma
+                 - 0.5 * jnp.log(2.0 * jnp.pi))
+    log_probs = log_probs - jnp.log(1.0 - a ** 2 + 1e-6)
+    return a, jnp.sum(log_probs, axis=-1, keepdims=True)

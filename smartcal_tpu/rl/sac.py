@@ -1,0 +1,307 @@
+"""Soft Actor-Critic as pure jitted functions over a state pytree.
+
+Re-expresses the reference SAC agent (``elasticnet/enet_sac.py:478-658``;
+CNN variants ``calibration/calib_sac.py``, ``demixing_rl/demix_sac.py``):
+
+* the torch modules + per-module Adam optimizers + in-place soft target
+  updates become a :class:`SACState` pytree and one jitted ``learn`` step;
+* the hint-constrained actor loss — augmented-Lagrangian penalty
+  ``0.5 rho_admm g^2 + rho g`` with ``g = max(0, mse(a, hint) - thresh)^2``
+  and dual ascent ``rho += rho_admm g`` every 10 learn calls
+  (``enet_sac.py:600-617``) — is carried in the state (``rho``);
+* optional learned temperature vs. target entropy (``enet_sac.py:506-513,
+  608-613``);
+* replay (uniform or prioritized) lives in HBM and its sample/update fuses
+  into the same jitted step (see :mod:`smartcal_tpu.rl.replay`); the
+  ``mem_cntr < batch_size`` early-return (``enet_sac.py:556-557``) becomes a
+  ``lax.cond`` no-op so the whole trainer can live inside ``lax.scan``.
+
+KLD-vs-MSE hint distance: the calibration/demixing agents measure the
+actor-hint mismatch with a KL divergence on softmaxed vectors
+(``calib_sac.py:361-366``, ``demix_sac.py:636-641``); select with
+``hint_distance='kld'``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from . import replay as rp
+from .networks import MLPActor, MLPCritic, gaussian_sample
+
+
+@dataclasses.dataclass(frozen=True)
+class SACConfig:
+    obs_dim: int
+    n_actions: int
+    gamma: float = 0.99
+    tau: float = 0.005
+    lr_a: float = 1e-3
+    lr_c: float = 1e-3
+    alpha: float = 0.03           # entropy temperature (enet main_sac.py:36)
+    reward_scale: float = 20.0    # reference reward_scale=N
+    batch_size: int = 64
+    mem_size: int = 1024
+    use_hint: bool = False
+    hint_threshold: float = 0.1   # enet_sac.py:514
+    admm_rho: float = 0.01        # enet_sac.py:516
+    hint_distance: str = "mse"    # 'mse' | 'kld'
+    learn_alpha: bool = False
+    alpha_lr: float = 1e-4
+    prioritized: bool = False
+    error_clip: float = 100.0     # PER absolute_error_upper (enet_sac.py:212)
+
+
+class SACState(NamedTuple):
+    actor_params: Any
+    c1_params: Any
+    c2_params: Any
+    t1_params: Any
+    t2_params: Any
+    actor_opt: Any
+    c1_opt: Any
+    c2_opt: Any
+    alpha: jnp.ndarray
+    rho: jnp.ndarray            # hint-constraint dual variable
+    learn_counter: jnp.ndarray
+
+
+def _nets(cfg: SACConfig):
+    return MLPActor(cfg.n_actions), MLPCritic()
+
+
+def sac_init(key, cfg: SACConfig) -> SACState:
+    actor, critic = _nets(cfg)
+    ka, k1, k2 = jax.random.split(key, 3)
+    obs = jnp.zeros((1, cfg.obs_dim))
+    act = jnp.zeros((1, cfg.n_actions))
+    actor_params = actor.init(ka, obs)["params"]
+    c1_params = critic.init(k1, obs, act)["params"]
+    c2_params = critic.init(k2, obs, act)["params"]
+    opt_a = optax.adam(cfg.lr_a)
+    opt_c = optax.adam(cfg.lr_c)
+    return SACState(
+        actor_params=actor_params,
+        c1_params=c1_params,
+        c2_params=c2_params,
+        t1_params=jax.tree_util.tree_map(jnp.copy, c1_params),
+        t2_params=jax.tree_util.tree_map(jnp.copy, c2_params),
+        actor_opt=opt_a.init(actor_params),
+        c1_opt=opt_c.init(c1_params),
+        c2_opt=opt_c.init(c2_params),
+        alpha=jnp.asarray(cfg.alpha, jnp.float32),
+        rho=jnp.asarray(0.0, jnp.float32),
+        learn_counter=jnp.asarray(0, jnp.int32),
+    )
+
+
+def choose_action(cfg: SACConfig, st: SACState, obs, key,
+                  deterministic: bool = False):
+    """Sample an action (reference ``choose_action``, enet_sac.py:547-553)."""
+    actor, _ = _nets(cfg)
+    mu, logsigma = actor.apply({"params": st.actor_params}, obs)
+    if deterministic:
+        return jnp.tanh(mu)
+    a, _ = gaussian_sample(mu, logsigma, key)
+    return a
+
+
+def _hint_gap(cfg: SACConfig, actions, hints):
+    """g = max(0, D(a, hint) - thresh)^2 with D mse or kld.
+
+    MSE form: enet_sac.py:601; KLD form: calib_sac.py:361-366 (softmax both,
+    sum p log p/q)."""
+    if cfg.hint_distance == "kld":
+        p = jax.nn.softmax(hints, axis=-1)
+        q = jax.nn.softmax(actions, axis=-1)
+        d = jnp.mean(jnp.sum(p * (jnp.log(p + 1e-9) - jnp.log(q + 1e-9)),
+                             axis=-1))
+    else:
+        d = jnp.mean((actions - hints) ** 2)
+    return jnp.maximum(0.0, d - cfg.hint_threshold) ** 2
+
+
+def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
+          key) -> Tuple[SACState, rp.ReplayState, dict]:
+    """One SAC learn step, sampling from (and possibly re-prioritising) ``buf``.
+
+    No-op (identity state) while the buffer holds fewer than ``batch_size``
+    transitions, so it can sit unconditionally inside a scanned train loop.
+    """
+    actor, critic = _nets(cfg)
+    opt_a = optax.adam(cfg.lr_a)
+    opt_c = optax.adam(cfg.lr_c)
+
+    def do_learn(args):
+        st, buf, key = args
+        k_samp, k_next, k_pi, k_dual = jax.random.split(key, 4)
+
+        if cfg.prioritized:
+            batch, idx, is_w, buf2 = rp.replay_sample_per(
+                buf, k_samp, cfg.batch_size)
+        else:
+            batch, idx = rp.replay_sample_uniform(buf, k_samp, cfg.batch_size)
+            is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
+
+        s = batch["state"]
+        a = batch["action"]
+        r = cfg.reward_scale * batch["reward"][:, None]
+        s2 = batch["new_state"]
+        done = batch["done"][:, None]
+        hint = batch["hint"]
+
+        # --- target value (enet_sac.py:569-575)
+        mu2, ls2 = actor.apply({"params": st.actor_params}, s2)
+        a2, lp2 = gaussian_sample(mu2, ls2, k_next)
+        q1t = critic.apply({"params": st.t1_params}, s2, a2)
+        q2t = critic.apply({"params": st.t2_params}, s2, a2)
+        min_t = jnp.minimum(q1t, q2t) - st.alpha * lp2
+        y = r + cfg.gamma * jnp.where(done, 0.0, min_t)
+        y = lax.stop_gradient(y)
+
+        # --- critic update (enet_sac.py:577-587)
+        def critic_loss(c1p, c2p):
+            q1 = critic.apply({"params": c1p}, s, a)
+            q2 = critic.apply({"params": c2p}, s, a)
+            if cfg.prioritized:
+                l = rp.per_mse(q1, y, is_w) + rp.per_mse(q2, y, is_w)
+            else:
+                l = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+            return l, (q1, q2)
+
+        (closs, (q1, q2)), (g1, g2) = jax.value_and_grad(
+            critic_loss, argnums=(0, 1), has_aux=True)(st.c1_params,
+                                                       st.c2_params)
+        u1, c1_opt = opt_c.update(g1, st.c1_opt, st.c1_params)
+        c1_params = optax.apply_updates(st.c1_params, u1)
+        u2, c2_opt = opt_c.update(g2, st.c2_opt, st.c2_params)
+        c2_params = optax.apply_updates(st.c2_params, u2)
+
+        # --- actor update with hint ADMM penalty (enet_sac.py:589-605)
+        def actor_loss(ap):
+            mu, ls = actor.apply({"params": ap}, s)
+            acts, lp = gaussian_sample(mu, ls, k_pi)
+            qa = jnp.minimum(critic.apply({"params": c1_params}, s, acts),
+                             critic.apply({"params": c2_params}, s, acts))
+            loss = jnp.mean(st.alpha * lp - qa)
+            if cfg.use_hint:
+                gfun = _hint_gap(cfg, acts, hint)
+                loss = (loss + 0.5 * cfg.admm_rho * gfun * gfun
+                        + st.rho * gfun)
+            return loss
+
+        aloss, ga = jax.value_and_grad(actor_loss)(st.actor_params)
+        ua, actor_opt = opt_a.update(ga, st.actor_opt, st.actor_params)
+        actor_params = optax.apply_updates(st.actor_params, ua)
+
+        # --- dual/temperature updates every 10 learn calls (enet_sac.py:608-617)
+        alpha, rho = st.alpha, st.rho
+        if cfg.use_hint or cfg.learn_alpha:
+            def dual_update(_):
+                mu, ls = actor.apply({"params": actor_params}, s)
+                acts, lp = gaussian_sample(mu, ls, k_dual)
+                new_alpha = alpha
+                new_rho = rho
+                if cfg.learn_alpha:
+                    target_entropy = -float(cfg.n_actions)
+                    new_alpha = jnp.maximum(
+                        0.0, alpha + cfg.alpha_lr
+                        * jnp.mean(target_entropy + lp))
+                if cfg.use_hint:
+                    new_rho = rho + cfg.admm_rho * _hint_gap(cfg, acts, hint)
+                return new_alpha, new_rho
+
+            alpha, rho = lax.cond(st.learn_counter % 10 == 0, dual_update,
+                                  lambda _: (alpha, rho), operand=None)
+
+        # --- PER priority refresh from TD error
+        if cfg.prioritized:
+            td = jnp.abs(q1 - y).squeeze(-1)
+            buf2 = rp.replay_update_priorities(buf2, idx, td, cfg.error_clip)
+
+        # --- soft target update (enet_sac.py:523-542)
+        lerp = lambda t, o: jax.tree_util.tree_map(
+            lambda a_, b_: cfg.tau * a_ + (1.0 - cfg.tau) * b_, o, t)
+        st_new = SACState(
+            actor_params=actor_params,
+            c1_params=c1_params, c2_params=c2_params,
+            t1_params=lerp(st.t1_params, c1_params),
+            t2_params=lerp(st.t2_params, c2_params),
+            actor_opt=actor_opt, c1_opt=c1_opt, c2_opt=c2_opt,
+            alpha=alpha, rho=rho,
+            learn_counter=st.learn_counter + 1,
+        )
+        metrics = {"critic_loss": closs, "actor_loss": aloss,
+                   "alpha": alpha, "rho": rho}
+        return st_new, buf2, metrics
+
+    def no_learn(args):
+        st, buf, _ = args
+        zeros = {"critic_loss": jnp.asarray(0.0), "actor_loss": jnp.asarray(0.0),
+                 "alpha": st.alpha, "rho": st.rho}
+        return st, buf, zeros
+
+    return lax.cond(buf.cntr >= cfg.batch_size, do_learn, no_learn,
+                    (st, buf, key))
+
+
+class SACAgent:
+    """Stateful wrapper with the reference ``Agent`` API
+    (choose_action / store_transition / learn / save_models / load_models)
+    around the pure jitted functions, for host-driven training loops."""
+
+    def __init__(self, cfg: SACConfig, seed: int = 0,
+                 name_prefix: str = ""):
+        self.cfg = cfg
+        self.key = jax.random.PRNGKey(seed)
+        self.key, k0 = jax.random.split(self.key)
+        self.state = sac_init(k0, cfg)
+        self.buffer = rp.replay_init(
+            cfg.mem_size, rp.transition_spec(cfg.obs_dim, cfg.n_actions))
+        self.name_prefix = name_prefix
+        self._choose = jax.jit(
+            lambda st, obs, key: choose_action(cfg, st, obs, key))
+        self._learn = jax.jit(lambda st, buf, key: learn(cfg, st, buf, key))
+        self._add = jax.jit(
+            lambda buf, tr: rp.replay_add(buf, tr,
+                                          priority=None if cfg.prioritized
+                                          else jnp.asarray(1.0)))
+        self.last_metrics = {}
+
+    def _next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+    def choose_action(self, observation):
+        obs = jnp.asarray(observation, jnp.float32)
+        return jax.device_get(self._choose(self.state, obs, self._next_key()))
+
+    def store_transition(self, state, action, reward, state_, done, hint):
+        tr = {"state": state, "action": action, "reward": reward,
+              "new_state": state_, "done": done, "hint": hint}
+        self.buffer = self._add(self.buffer, tr)
+
+    def learn(self):
+        self.state, self.buffer, m = self._learn(self.state, self.buffer,
+                                                 self._next_key())
+        self.last_metrics = m
+
+    def save_models(self, prefix: Optional[str] = None):
+        prefix = prefix if prefix is not None else self.name_prefix
+        with open(f"{prefix}sac_state.pkl", "wb") as f:
+            pickle.dump(jax.device_get(self.state), f)
+        rp.save_replay(self.buffer, f"{prefix}replaymem_sac.pkl")
+
+    def load_models(self, prefix: Optional[str] = None):
+        prefix = prefix if prefix is not None else self.name_prefix
+        with open(f"{prefix}sac_state.pkl", "rb") as f:
+            host = pickle.load(f)
+        self.state = jax.tree_util.tree_map(jnp.asarray, host)
+        self.buffer = rp.load_replay(f"{prefix}replaymem_sac.pkl")

@@ -1,0 +1,167 @@
+"""Elastic-net SAC training driver.
+
+Mirrors ``elasticnet/main_sac.py`` (episode loop, per-step learn, moving
+average of scores, periodic checkpointing) with two execution modes:
+
+* ``--mode fused`` (default): each episode — reset, optional hint grid
+  search, then a ``lax.scan`` over steps where action sampling, env step
+  (L-BFGS solve + influence), replay store and the SAC learn step are one
+  XLA computation.  This is the TPU-native hot path measured by bench.py.
+* ``--mode loop``: host-driven loop through the gym-like wrapper, matching
+  the reference control flow piecewise (useful for debugging).
+
+Usage:
+    python -m smartcal_tpu.train.enet_sac --episodes 1000 --steps 5 --seed 0
+        [--use_hint] [--mode fused|loop]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import pickle
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ..envs import enet
+from ..rl import replay as rp
+from ..rl import sac
+
+
+def make_episode_fn(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
+                    steps: int, use_hint: bool):
+    """Build the jitted one-episode function (reset + scan over steps)."""
+
+    @jax.jit
+    def run_episode(agent_state, buf, key):
+        k_reset, k_scan = jax.random.split(key)
+        env_state, obs = enet.reset(env_cfg, k_reset)
+        hint = (enet.get_hint(env_cfg, env_state) if use_hint
+                else jnp.zeros((agent_cfg.n_actions,), jnp.float32))
+
+        def step_fn(carry, k):
+            agent_state, buf, env_state, obs = carry
+            k_act, k_env, k_learn = jax.random.split(k, 3)
+            action = sac.choose_action(agent_cfg, agent_state, obs, k_act)
+            env_state, obs2, reward, done = enet.step(env_cfg, env_state,
+                                                      action, k_env)
+            tr = {"state": obs, "action": action, "reward": reward,
+                  "new_state": obs2, "done": done, "hint": hint}
+            buf = rp.replay_add(buf, tr,
+                                priority=None if agent_cfg.prioritized
+                                else jnp.asarray(1.0))
+            agent_state, buf, metrics = sac.learn(agent_cfg, agent_state,
+                                                  buf, k_learn)
+            return (agent_state, buf, env_state, obs2), reward
+
+        keys = jax.random.split(k_scan, steps)
+        (agent_state, buf, env_state, _), rewards = jax.lax.scan(
+            step_fn, (agent_state, buf, env_state, obs), keys)
+        return agent_state, buf, jnp.mean(rewards)
+
+    return run_episode
+
+
+def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,
+                M=20, N=20, log_every=1, save_every=500, prefix="",
+                quiet=False):
+    env_cfg = enet.EnetConfig(M=M, N=N)
+    agent_cfg = sac.SACConfig(
+        obs_dim=env_cfg.obs_dim, n_actions=2, gamma=0.99, tau=0.005,
+        batch_size=64, mem_size=1024, lr_a=1e-3, lr_c=1e-3,
+        reward_scale=float(N), alpha=0.03, use_hint=use_hint)
+
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    agent_state = sac.sac_init(k0, agent_cfg)
+    buf = rp.replay_init(agent_cfg.mem_size,
+                         rp.transition_spec(env_cfg.obs_dim, 2))
+    episode_fn = make_episode_fn(env_cfg, agent_cfg, steps, use_hint)
+
+    scores = []
+    t0 = time.time()
+    for i in range(episodes):
+        key, k = jax.random.split(key)
+        agent_state, buf, score = episode_fn(agent_state, buf, k)
+        scores.append(float(score))
+        if not quiet and i % log_every == 0:
+            avg = sum(scores[-100:]) / len(scores[-100:])
+            print(f"episode {i} score {scores[-1]:.2f} average score {avg:.2f}")
+        if save_every and i and i % save_every == 0:
+            _save(agent_state, buf, scores, prefix)
+    wall = time.time() - t0
+    _save(agent_state, buf, scores, prefix)
+    return scores, wall, agent_state, buf
+
+
+def _save(agent_state, buf, scores, prefix):
+    with open(f"{prefix}sac_state.pkl", "wb") as f:
+        pickle.dump(jax.device_get(agent_state), f)
+    rp.save_replay(buf, f"{prefix}replaymem_sac.pkl")
+    with open(f"{prefix}scores.pkl", "wb") as f:
+        pickle.dump(scores, f)
+
+
+def train_loop(seed=0, episodes=1000, steps=5, use_hint=False, M=20, N=20):
+    """Reference-style host loop (main_sac.py:47-76)."""
+    import numpy as np
+
+    env = enet.EnetEnv(M, N, provide_hint=use_hint, seed=seed)
+    agent = sac.SACAgent(sac.SACConfig(
+        obs_dim=env.cfg.obs_dim, n_actions=2, tau=0.005, batch_size=64,
+        mem_size=1024, reward_scale=float(N), alpha=0.03, use_hint=use_hint),
+        seed=seed)
+    scores = []
+    for i in range(episodes):
+        obs = env.reset()
+        score, loop = 0.0, 0
+        done = False
+        while not done and loop < steps:
+            action = agent.choose_action(obs)
+            if use_hint:
+                obs2, reward, done, hint, _ = env.step(action)
+            else:
+                obs2, reward, done, _ = env.step(action)
+                hint = np.zeros_like(action)
+            agent.store_transition(obs, action, reward, obs2, done, hint)
+            score += reward
+            agent.learn()
+            obs = obs2
+            loop += 1
+        scores.append(score / loop)
+        avg = sum(scores[-100:]) / len(scores[-100:])
+        print(f"episode {i} score {scores[-1]:.2f} average score {avg:.2f}")
+    return scores
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="Elastic net regression hyperparameter tuning (SAC, TPU)")
+    p.add_argument("--seed", default=0, type=int)
+    p.add_argument("--episodes", default=1000, type=int)
+    p.add_argument("--steps", default=5, type=int)
+    p.add_argument("--use_hint", action="store_true", default=False)
+    p.add_argument("--mode", default="fused", choices=["fused", "loop"])
+    args = p.parse_args()
+
+    if args.mode == "fused":
+        scores, wall, _, _ = train_fused(
+            seed=args.seed, episodes=args.episodes, steps=args.steps,
+            use_hint=args.use_hint)
+        print(json.dumps({"episodes": args.episodes,
+                          "steps_per_episode": args.steps,
+                          "wall_s": round(wall, 2),
+                          "env_steps_per_sec": round(
+                              args.episodes * args.steps / wall, 2),
+                          "final_avg_score": sum(scores[-100:])
+                          / len(scores[-100:])}))
+    else:
+        train_loop(seed=args.seed, episodes=args.episodes, steps=args.steps,
+                   use_hint=args.use_hint)
+
+
+if __name__ == "__main__":
+    main()

@@ -7,11 +7,23 @@ force the CPU platform with 8 virtual devices before JAX initialises.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# JAX_PLATFORMS=axon (the TPU tunnel) is set globally in this environment and
+# a sitecustomize.py imports jax at interpreter startup, so the env var is
+# already latched into jax.config by the time conftest runs — override through
+# jax.config, before any backend is initialised.  (The axon backend also
+# lacks pure_callback support, which the 'exact' eig mode relies on.)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on the virtual CPU mesh, got " + str(jax.devices()))
+assert jax.device_count() == 8, "expected 8 virtual CPU devices"
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

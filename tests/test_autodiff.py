@@ -108,11 +108,11 @@ def test_influence_matrix_linear_closed_form():
     theta0 = rng.normal(size=N_in).astype(np.float32)
     y = (X @ theta0 + 0.1 * rng.normal(size=M_out)).astype(np.float32)
 
-    def model_fn(p, xx):
-        return jnp.asarray(X) @ p * jnp.mean(xx) * 0 + jnp.asarray(X) @ (p * xx)
-
     # model: m_j = sum_k X_jk p_k x_k  (elementwise-scaled linear model so the
     # input actually enters the graph)
+    def model_fn(p, xx):
+        return jnp.asarray(X) @ (p * xx)
+
     params = jnp.asarray(theta0)
     x_in = jnp.ones(N_in)
 
@@ -130,10 +130,8 @@ def test_influence_matrix_linear_closed_form():
     # cross-check: with exact inverse Hessian, If = J H^{-1} C
     Xn = np.asarray(X, np.float64)
     p_opt = np.asarray(res.x, np.float64)
-    # loss = mean((X (p*x) - y)^2); at x = ones
-    # d/dp: 2/M X^T r where r = X p - y ; H = 2/M X^T X (w.r.t. p, x=1)
+    # loss = mean((X (p*x) - y)^2); at x = ones, H = 2/M X^T X (w.r.t. p)
     H = 2.0 / M_out * Xn.T @ Xn
-    r = Xn @ p_opt - np.asarray(y, np.float64)
     # C[:, i] = d/dx_i (2/M X^T diag(x) ... ) evaluated via autodiff instead:
     def loss_np(p, xx):
         rr = Xn @ (p * xx) - np.asarray(y, np.float64)
@@ -153,7 +151,7 @@ def test_influence_matrix_linear_closed_form():
             gm[j] = (loss_np(pp, xm) - loss_np(pm, xm)) / (2 * eps)
         C[:, i] = (gp - gm) / (2 * eps)
 
-    J = Xn * np.ones((1, N_in)) * p_opt * 0 + Xn @ np.diag(np.ones(N_in))  # dm/dp at x=1 is X
+    # dm/dp at x=1 is X, so If = X H^{-1} C
     want = (Xn @ np.linalg.solve(H, C))
     got = np.asarray(If, np.float64)
     # L-BFGS history is an approximation of H^{-1}; require qualitative match

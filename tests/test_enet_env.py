@@ -1,0 +1,102 @@
+"""Tests for the elastic-net environment (reference enetenv.py semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smartcal_tpu.envs import enet
+
+
+CFG = enet.EnetConfig(M=8, N=8, lbfgs_iters=60)
+
+
+def test_reset_shapes_and_normalisation():
+    st, obs = enet.reset(CFG, jax.random.PRNGKey(0))
+    assert st.A.shape == (8, 8)
+    np.testing.assert_allclose(float(jnp.linalg.norm(st.A)), 1.0, rtol=1e-5)
+    assert obs.shape == (CFG.obs_dim,)
+    # initial eig block is zero
+    np.testing.assert_allclose(np.asarray(obs[:8]), 0.0)
+    # sparse ground truth: between 1 and M-1 nonzeros (collisions allowed),
+    # at least ceil? reference allows duplicates so >=1
+    nnz = int(jnp.sum(st.x0 != 0))
+    assert 1 <= nnz <= 7
+
+
+def test_step_reward_and_obs():
+    st, _ = enet.reset(CFG, jax.random.PRNGKey(1))
+    action = jnp.zeros(2)  # mid-range rho
+    st2, obs, reward, done = enet.step(CFG, st, action, jax.random.PRNGKey(2))
+    assert not bool(done)
+    assert np.isfinite(float(reward))
+    # reward = ||y||/||Ax-y|| + min(EE)/max(EE), no penalty for in-range action
+    assert float(reward) > 0.0
+    EE = np.asarray(obs[:8])
+    assert np.all(np.isfinite(EE))
+    # A block of obs unchanged by step
+    np.testing.assert_allclose(np.asarray(obs[8:]),
+                               np.asarray(st.A.ravel()), rtol=1e-6)
+
+
+def test_out_of_range_action_penalty():
+    st, _ = enet.reset(CFG, jax.random.PRNGKey(3))
+    # mapping: [-1, 1] -> [LOW, HIGH]; out-of-range clamps with -0.1 each
+    rho, pen = enet.action_to_rho(jnp.asarray([0.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(rho),
+                               [(enet.HIGH + enet.LOW) / 2, enet.HIGH],
+                               rtol=1e-5)
+    assert float(pen) == 0.0
+    rho, pen = enet.action_to_rho(jnp.asarray([2.0, -2.0]))
+    np.testing.assert_allclose(np.asarray(rho), [enet.HIGH, enet.LOW],
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(pen), -0.2, atol=1e-6)
+    # and the clamped action still produces a valid (penalised) env step
+    k = jax.random.PRNGKey(4)
+    _, _, r_out, _ = enet.step(CFG, st, jnp.asarray([2.0, -2.0]), k)
+    assert np.isfinite(float(r_out))
+
+
+def test_keepnoise_determinism():
+    st, _ = enet.reset(CFG, jax.random.PRNGKey(5))
+    k = jax.random.PRNGKey(6)
+    st1, _, r1, _ = enet.step(CFG, st, jnp.zeros(2), k)
+    # keepnoise=True reuses st.y: stepping twice from same state is identical
+    st2, _, r2, _ = enet.step(CFG, st1, jnp.zeros(2), k, keepnoise=True)
+    st3, _, r3, _ = enet.step(CFG, st1, jnp.zeros(2), k, keepnoise=True)
+    np.testing.assert_allclose(float(r2), float(r3), rtol=1e-5)
+
+
+def test_eig_modes_agree():
+    """Symmetrised on-device spectrum ~ host exact eig real parts."""
+    cfg_sym = enet.EnetConfig(M=8, N=8, lbfgs_iters=60, eig_mode="symmetric")
+    cfg_ex = enet.EnetConfig(M=8, N=8, lbfgs_iters=60, eig_mode="exact")
+    st, _ = enet.reset(cfg_sym, jax.random.PRNGKey(7))
+    k = jax.random.PRNGKey(8)
+    _, obs_s, r_s, _ = enet.step(cfg_sym, st, jnp.zeros(2), k)
+    _, obs_e, r_e, _ = enet.step(cfg_ex, st, jnp.zeros(2), k)
+    Es = np.sort(np.asarray(obs_s[:8]))
+    Ee = np.sort(np.asarray(obs_e[:8]))
+    np.testing.assert_allclose(Es, Ee, atol=0.05)
+    np.testing.assert_allclose(float(r_s), float(r_e), atol=0.05)
+
+
+def test_hint_in_action_space():
+    st, _ = enet.reset(CFG, jax.random.PRNGKey(9))
+    st, _, _, _ = enet.step(CFG, st, jnp.zeros(2), jax.random.PRNGKey(10))
+    hint = enet.get_hint(CFG, st)
+    assert hint.shape == (2,)
+    h = np.asarray(hint)
+    assert np.all(h >= -1.0 - 1e-6) and np.all(h <= 1.0 + 1e-6)
+    # hint maps back into [LOW, HIGH]
+    lam = h * (enet.HIGH - enet.LOW) / 2 + (enet.HIGH + enet.LOW) / 2
+    assert np.all(lam >= enet.LOW - 1e-6) and np.all(lam <= enet.HIGH + 1e-6)
+
+
+def test_wrapper_gym_interface():
+    env = enet.EnetEnv(M=6, N=6, provide_hint=True, seed=0, lbfgs_iters=40)
+    obs = env.reset()
+    assert obs.shape == (env.cfg.obs_dim,)
+    obs2, reward, done, hint, info = env.step(np.zeros(2))
+    assert obs2.shape == obs.shape
+    assert np.isfinite(reward)
+    assert hint.shape == (2,)

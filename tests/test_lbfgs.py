@@ -153,6 +153,15 @@ def test_batch_mode_step_decreases_loss():
     assert final < 1e-2 * float(jnp.mean(y ** 2))
 
 
+def test_nan_divergence_not_reported_as_converged():
+    """A solve that hits NaN stops but must not claim convergence."""
+    def fun(x):
+        return jnp.sum(jnp.log(x))  # NaN gradient for x <= 0
+
+    res = lbfgs_solve(fun, -jnp.ones(3), max_iters=50)
+    assert not bool(res.converged)
+
+
 def test_solve_reports_convergence_on_trivial_problem():
     res = lbfgs_solve(lambda x: jnp.sum((x - 1.0) ** 2), jnp.zeros(3),
                       max_iters=100)

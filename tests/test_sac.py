@@ -1,0 +1,174 @@
+"""Tests for replay buffers and the SAC agent."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smartcal_tpu.rl import replay as rp
+from smartcal_tpu.rl import sac
+from smartcal_tpu.rl.networks import MLPActor, MLPCritic, gaussian_sample
+
+
+def _spec():
+    return rp.transition_spec(obs_dim=6, n_actions=2)
+
+
+def _tr(i, obs_dim=6):
+    return {"state": np.full(obs_dim, i, np.float32),
+            "new_state": np.full(obs_dim, i + 0.5, np.float32),
+            "action": np.array([i, -i], np.float32),
+            "reward": np.float32(i),
+            "done": False,
+            "hint": np.zeros(2, np.float32)}
+
+
+def test_uniform_ring_and_sample():
+    buf = rp.replay_init(8, _spec())
+    for i in range(5):
+        buf = rp.replay_add(buf, _tr(i), priority=jnp.asarray(1.0))
+    assert int(buf.cntr) == 5
+    batch, idx = rp.replay_sample_uniform(buf, jax.random.PRNGKey(0), 4)
+    # indices must come from filled region and be distinct
+    idxs = np.asarray(idx)
+    assert np.all(idxs < 5)
+    assert len(set(idxs.tolist())) == 4
+    # ring wrap: adding 6 more overwrites oldest
+    for i in range(5, 11):
+        buf = rp.replay_add(buf, _tr(i), priority=jnp.asarray(1.0))
+    assert int(buf.cntr) == 11
+    assert float(buf.data["state"][0][0]) == 8.0  # 8 % 8 == 0 slot
+
+
+def test_per_priorities_and_weights():
+    buf = rp.replay_init(8, _spec())
+    # empty buffer, no error: priority = clip value (reference :239-240)
+    buf = rp.replay_add(buf, _tr(0))
+    assert float(buf.priority[0]) == 100.0
+    buf = rp.replay_add(buf, _tr(1), error=jnp.asarray(0.5))
+    want = (0.5 + rp.PER_EPSILON) ** rp.PER_ALPHA
+    np.testing.assert_allclose(float(buf.priority[1]), want, rtol=1e-5)
+
+    batch, idx, w, buf2 = rp.replay_sample_per(buf, jax.random.PRNGKey(1), 4)
+    assert np.all(np.asarray(idx) < 2)  # only filled slots get sampled
+    assert np.max(np.asarray(w)) <= 1.0 + 1e-6
+    assert float(buf2.beta) > float(buf.beta)
+
+    buf3 = rp.replay_update_priorities(buf2, jnp.asarray([0]),
+                                       jnp.asarray([2.0]))
+    want = (2.0 + rp.PER_EPSILON) ** rp.PER_ALPHA
+    np.testing.assert_allclose(float(buf3.priority[0]), want, rtol=1e-5)
+
+
+def test_per_distribution_matches_priorities():
+    """Stratified cumsum sampling draws high-priority slots more often."""
+    buf = rp.replay_init(8, _spec())
+    pr = [1.0, 1.0, 1.0, 10.0]
+    for i, p in enumerate(pr):
+        buf = rp.replay_add(buf, _tr(i), priority=jnp.asarray(p))
+    counts = np.zeros(8)
+    for s in range(50):
+        _, idx, _, _ = rp.replay_sample_per(buf, jax.random.PRNGKey(s), 4)
+        for j in np.asarray(idx):
+            counts[j] += 1
+    assert counts[3] > counts[0] * 2
+    assert counts[4:].sum() == 0
+
+
+def test_gaussian_sample_logprob():
+    mu = jnp.zeros((1, 2))
+    logsigma = jnp.zeros((1, 2))
+    a, lp = gaussian_sample(mu, logsigma, jax.random.PRNGKey(0))
+    assert a.shape == (1, 2)
+    assert np.all(np.abs(np.asarray(a)) <= 1.0)
+    # analytic check: lp = sum N(z;0,1) logpdf - log(1 - a^2 + eps)
+    z = np.arctanh(np.asarray(a))
+    want = (-0.5 * z ** 2 - 0.5 * np.log(2 * np.pi)
+            - np.log(1 - np.asarray(a) ** 2 + 1e-6)).sum()
+    np.testing.assert_allclose(float(lp[0, 0]), want, rtol=1e-3)
+
+
+def test_sac_learn_updates_and_targets():
+    cfg = sac.SACConfig(obs_dim=6, n_actions=2, batch_size=4, mem_size=16,
+                        reward_scale=1.0)
+    st = sac.sac_init(jax.random.PRNGKey(0), cfg)
+    buf = rp.replay_init(cfg.mem_size, _spec())
+
+    # below batch size: learn must be a no-op
+    st2, buf2, m = sac.learn(cfg, st, buf, jax.random.PRNGKey(1))
+    assert int(st2.learn_counter) == 0
+
+    rng = np.random.default_rng(0)
+    for i in range(8):
+        tr = _tr(i)
+        tr["state"] = rng.normal(size=6).astype(np.float32)
+        tr["new_state"] = rng.normal(size=6).astype(np.float32)
+        buf = rp.replay_add(buf, tr, priority=jnp.asarray(1.0))
+
+    st3, buf3, m = sac.learn(cfg, st, buf, jax.random.PRNGKey(2))
+    assert int(st3.learn_counter) == 1
+    assert np.isfinite(float(m["critic_loss"]))
+    # parameters changed
+    a0 = jax.flatten_util.ravel_pytree(st.actor_params)[0]
+    a1 = jax.flatten_util.ravel_pytree(st3.actor_params)[0]
+    assert float(jnp.linalg.norm(a1 - a0)) > 0
+    # target nets moved toward critics by tau
+    t0 = jax.flatten_util.ravel_pytree(st.t1_params)[0]
+    t1 = jax.flatten_util.ravel_pytree(st3.t1_params)[0]
+    c1 = jax.flatten_util.ravel_pytree(st3.c1_params)[0]
+    np.testing.assert_allclose(np.asarray(t1),
+                               np.asarray(cfg.tau * c1 + (1 - cfg.tau) * t0),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_sac_hint_dual_update():
+    cfg = sac.SACConfig(obs_dim=6, n_actions=2, batch_size=4, mem_size=16,
+                        use_hint=True, hint_threshold=0.0)
+    st = sac.sac_init(jax.random.PRNGKey(0), cfg)
+    buf = rp.replay_init(cfg.mem_size, _spec())
+    rng = np.random.default_rng(1)
+    for i in range(8):
+        tr = _tr(i)
+        tr["state"] = rng.normal(size=6).astype(np.float32)
+        tr["hint"] = np.array([0.9, -0.9], np.float32)
+        buf = rp.replay_add(buf, tr, priority=jnp.asarray(1.0))
+    # learn_counter 0 -> dual update fires on first call (counter % 10 == 0)
+    st2, _, m = sac.learn(cfg, st, buf, jax.random.PRNGKey(3))
+    assert float(st2.rho) > 0.0
+
+
+def test_sac_prioritized_path():
+    cfg = sac.SACConfig(obs_dim=6, n_actions=2, batch_size=4, mem_size=16,
+                        prioritized=True)
+    st = sac.sac_init(jax.random.PRNGKey(0), cfg)
+    buf = rp.replay_init(cfg.mem_size, _spec())
+    for i in range(8):
+        buf = rp.replay_add(buf, _tr(i))
+    st2, buf2, m = sac.learn(cfg, st, buf, jax.random.PRNGKey(4))
+    # priorities of the sampled slots were refreshed away from the initial 100
+    assert int(st2.learn_counter) == 1
+    changed = np.sum(np.asarray(buf2.priority) != np.asarray(buf.priority))
+    assert changed >= 1
+
+
+def test_agent_wrapper_roundtrip(tmp_path):
+    cfg = sac.SACConfig(obs_dim=6, n_actions=2, batch_size=4, mem_size=16)
+    agent = sac.SACAgent(cfg, seed=0)
+    obs = np.ones(6, np.float32)
+    a = agent.choose_action(obs)
+    assert a.shape == (2,)
+    for i in range(6):
+        agent.store_transition(obs, a, 0.5, obs, False, np.zeros(2))
+    agent.learn()
+    assert int(agent.state.learn_counter) == 1
+    import os
+    old = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        agent.save_models()
+        agent2 = sac.SACAgent(cfg, seed=1)
+        agent2.load_models()
+        p1 = jax.flatten_util.ravel_pytree(agent.state.actor_params)[0]
+        p2 = jax.flatten_util.ravel_pytree(agent2.state.actor_params)[0]
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2))
+    finally:
+        os.chdir(old)

@@ -1,0 +1,101 @@
+"""Measure the reference implementation's elasticnet SAC throughput.
+
+The upstream repo publishes no numbers (BASELINE.md), so the baseline is
+produced by running the reference code itself (read-only mount at
+/root/reference) in its `main_sac.py` configuration: N=M=20, batch 64,
+mem 1024, 5 steps/episode, torch CPU (no GPU in this image — the reference
+falls back to CPU automatically).
+
+Protocol (mirrored by bench.py for the TPU build):
+  1. run warm-up env steps until the replay buffer holds >= batch_size
+     transitions (learn() is a no-op before that, enet_sac.py:556-557);
+  2. time `--steps` full loop iterations (choose_action + env.step +
+     store_transition + learn).
+
+Writes the result to stdout and to repo tools/reference_baseline.json.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, "/root/reference/elasticnet")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    np.random.seed(args.seed)
+    torch.manual_seed(args.seed)
+
+    # run in a temp dir: the reference Agent writes checkpoints to ./
+    with tempfile.TemporaryDirectory() as tmp:
+        os.chdir(tmp)
+        from enetenv import ENetEnv
+        from enet_sac import Agent
+
+        N = M = 20
+        env = ENetEnv(M, N, provide_hint=False)
+        agent = Agent(gamma=0.99, batch_size=64, n_actions=2, tau=0.005,
+                      max_mem_size=1024, input_dims=[N + N * M],
+                      lr_a=1e-3, lr_c=1e-3, reward_scale=N, alpha=0.03,
+                      prioritized=False, use_hint=False)
+
+        obs = env.reset()
+        # warm-up: fill the buffer so learn() is active during timing
+        warm = 0
+        t_warm0 = time.time()
+        while agent.replaymem.mem_cntr < 64:
+            action = agent.choose_action(obs)
+            obs2, reward, done, info = env.step(action)
+            agent.store_transition(obs, action, reward, obs2, done,
+                                   np.zeros_like(action))
+            agent.learn()
+            obs = obs2
+            warm += 1
+            if warm % 5 == 0:
+                obs = env.reset()
+        t_warm = time.time() - t_warm0
+
+        t0 = time.time()
+        for i in range(args.steps):
+            action = agent.choose_action(obs)
+            obs2, reward, done, info = env.step(action)
+            agent.store_transition(obs, action, reward, obs2, done,
+                                   np.zeros_like(action))
+            agent.learn()
+            obs = obs2
+            if (i + 1) % 5 == 0:
+                obs = env.reset()
+        wall = time.time() - t0
+
+    result = {
+        "metric": "enet_sac_env_steps_per_sec",
+        "value": round(args.steps / wall, 3),
+        "steps": args.steps,
+        "wall_s": round(wall, 2),
+        "warmup_steps": warm,
+        "warmup_s": round(t_warm, 2),
+        "config": "reference elasticnet main_sac.py (N=M=20, batch 64)",
+        "hardware": "torch CPU (this host)",
+    }
+    print(json.dumps(result))
+    out = os.path.join(repo_root, "tools", "reference_baseline.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+
+
+if __name__ == "__main__":
+    main()
