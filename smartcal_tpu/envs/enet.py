@@ -150,11 +150,11 @@ def step(cfg: EnetConfig, st: EnetState, action, key,
     action = jnp.asarray(action, jnp.float32).reshape(-1)
     rho, penalty = action_to_rho(action)
 
-    if keepnoise:
-        y = st.y
-    else:
-        n = jax.random.normal(key, (cfg.N,), jnp.float32)
-        y = st.y0 + cfg.snr * jnp.linalg.norm(st.y0) / jnp.linalg.norm(n) * n
+    n = jax.random.normal(key, (cfg.N,), jnp.float32)
+    y_fresh = st.y0 + cfg.snr * jnp.linalg.norm(st.y0) / jnp.linalg.norm(n) * n
+    # keepnoise may be a python bool or a traced bool (fused episode loops
+    # keep the first step's draw so the cached hint matches its data)
+    y = jnp.where(jnp.asarray(keepnoise), st.y, y_fresh)
 
     x, EE = _solve_and_influence(cfg, st.A, y, rho)
 
@@ -165,6 +165,14 @@ def step(cfg: EnetConfig, st: EnetState, action, key,
 
     new_st = st._replace(y=y, x=x)
     return new_st, obs, reward, jnp.asarray(False)
+
+
+def draw_noise(cfg: EnetConfig, st: EnetState, key) -> EnetState:
+    """Draw one noisy observation into ``st.y`` (reference ``initsol``'s data
+    draw, enetenv.py:197-202) for subsequent ``keepnoise=True`` steps."""
+    n = jax.random.normal(key, (cfg.N,), jnp.float32)
+    y = st.y0 + cfg.snr * jnp.linalg.norm(st.y0) / jnp.linalg.norm(n) * n
+    return st._replace(y=y)
 
 
 def get_hint(cfg: EnetConfig, st: EnetState) -> jnp.ndarray:
@@ -223,6 +231,8 @@ class EnetEnv:
         self._reset = jax.jit(lambda k: reset(self.cfg, k))
         self._step = jax.jit(
             lambda st, a, k: step(self.cfg, st, a, k))
+        self._step_keep = jax.jit(
+            lambda st, a, k: step(self.cfg, st, a, k, keepnoise=True))
         self._hint = jax.jit(lambda st: get_hint(self.cfg, st))
         self.state: EnetState = None
         self.hint = None
@@ -236,8 +246,13 @@ class EnetEnv:
         self.hint = None
         return jax.device_get(obs)
 
-    def step(self, action):
-        self.state, obs, reward, done = self._step(
+    def initsol(self):
+        """Fix the noise draw for subsequent ``step(..., keepnoise=True)``."""
+        self.state = draw_noise(self.cfg, self.state, self._next_key())
+
+    def step(self, action, keepnoise: bool = False):
+        step_fn = self._step_keep if keepnoise else self._step
+        self.state, obs, reward, done = step_fn(
             self.state, jnp.asarray(action), self._next_key())
         out = (jax.device_get(obs), float(reward), bool(done))
         if self.provide_hint:

@@ -42,6 +42,7 @@ class ParallelTrainState(NamedTuple):
     env_states: enet.EnetState      # batched leading axis (n_envs)
     obs: jnp.ndarray                # (n_envs, obs_dim)
     hints: jnp.ndarray              # (n_envs, n_actions)
+    step_in_episode: jnp.ndarray    # () int32
 
 
 def make_parallel_sac(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
@@ -59,20 +60,33 @@ def make_parallel_sac(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
     repl = NamedSharding(mesh, P())
     shard = NamedSharding(mesh, P("dp"))
 
+    def _fresh_envs(k_envs):
+        """Reset all envs, draw the first noisy observation, compute hints.
+
+        The hint must see the first step's noise draw (reference: get_hint
+        uses self.y set inside step(), enetenv.py:87-90,156-158), so the
+        draw happens here and step 0 of each episode keeps it.
+        """
+        k_reset, k_noise = jax.random.split(k_envs)
+        env_states, obs = jax.vmap(lambda k: enet.reset(env_cfg, k))(
+            jax.random.split(k_reset, n_envs))
+        env_states = jax.vmap(lambda s, k: enet.draw_noise(env_cfg, s, k))(
+            env_states, jax.random.split(k_noise, n_envs))
+        if use_hint:
+            hints = jax.vmap(lambda s: enet.get_hint(env_cfg, s))(env_states)
+        else:
+            hints = jnp.zeros((n_envs, agent_cfg.n_actions), jnp.float32)
+        return env_states, obs, hints
+
     def init_fn(key) -> ParallelTrainState:
         k_agent, k_envs = jax.random.split(key)
         agent = sac.sac_init(k_agent, agent_cfg)
         buf = rp.replay_init(
             agent_cfg.mem_size,
             rp.transition_spec(env_cfg.obs_dim, agent_cfg.n_actions))
-        env_states, obs = jax.vmap(lambda k: enet.reset(env_cfg, k))(
-            jax.random.split(k_envs, n_envs))
-        if use_hint:
-            hints = jax.vmap(lambda s: enet.get_hint(env_cfg, s))(env_states)
-        else:
-            hints = jnp.zeros((n_envs, agent_cfg.n_actions), jnp.float32)
+        env_states, obs, hints = _fresh_envs(k_envs)
         st = ParallelTrainState(agent=agent, buf=buf, env_states=env_states,
-                                obs=obs, hints=hints)
+                                obs=obs, hints=hints, step_in_episode=jnp.asarray(0, jnp.int32))
         return jax.device_put(st, _state_shardings(st))
 
     def _state_shardings(st: ParallelTrainState):
@@ -82,16 +96,19 @@ def make_parallel_sac(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
             env_states=jax.tree_util.tree_map(lambda _: shard, st.env_states),
             obs=shard,
             hints=shard,
+            step_in_episode=repl,
         )
 
     def train_step(st: ParallelTrainState, key):
         k_act, k_env, k_learn = jax.random.split(key, 3)
 
-        # actors: sample + step, devicewise over dp
+        # actors: sample + step, devicewise over dp; step 0 of an episode
+        # keeps the noise drawn at reset (the hint's data)
         actions = sac.choose_action(agent_cfg, st.agent, st.obs, k_act)
         env_keys = jax.random.split(k_env, n_envs)
+        first = st.step_in_episode == 0
         env_states, obs2, rewards, dones = jax.vmap(
-            lambda s, a, k: enet.step(env_cfg, s, a, k))(
+            lambda s, a, k: enet.step(env_cfg, s, a, k, keepnoise=first))(
             st.env_states, actions, env_keys)
 
         transitions = {
@@ -107,15 +124,27 @@ def make_parallel_sac(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
 
         new_st = ParallelTrainState(agent=agent, buf=buf,
                                     env_states=env_states, obs=obs2,
-                                    hints=st.hints)
+                                    hints=st.hints,
+                                    step_in_episode=st.step_in_episode + 1)
         return new_st, metrics
+
+    def reset_envs(st: ParallelTrainState, key):
+        """Start a new episode on every env (host calls this every
+        steps-per-episode train steps, mirroring the reference's per-episode
+        env.reset)."""
+        env_states, obs, hints = _fresh_envs(key)
+        return st._replace(env_states=env_states, obs=obs, hints=hints,
+                           step_in_episode=jnp.asarray(0, jnp.int32))
 
     dummy = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
     shardings = _state_shardings(dummy)
     train_step_jit = jax.jit(train_step,
                              in_shardings=(shardings, repl),
                              out_shardings=(shardings, repl))
-    return init_fn, train_step_jit
+    reset_envs_jit = jax.jit(reset_envs,
+                             in_shardings=(shardings, repl),
+                             out_shardings=shardings)
+    return init_fn, train_step_jit, reset_envs_jit
 
 
 def episode_scores(metrics_list, steps_per_episode: int):

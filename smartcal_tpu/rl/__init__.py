@@ -20,3 +20,9 @@ from .replay import (  # noqa: F401
 from .sac import SACAgent, SACConfig, SACState, sac_init  # noqa: F401
 from .sac import choose_action as sac_choose_action  # noqa: F401
 from .sac import learn as sac_learn  # noqa: F401
+from .td3 import TD3Agent, TD3Config, TD3State, td3_init  # noqa: F401
+from .td3 import choose_action as td3_choose_action  # noqa: F401
+from .td3 import learn as td3_learn  # noqa: F401
+from .ddpg import DDPGAgent, DDPGConfig, DDPGState, ddpg_init  # noqa: F401
+from .ddpg import choose_action as ddpg_choose_action  # noqa: F401
+from .ddpg import learn as ddpg_learn  # noqa: F401

@@ -1,0 +1,199 @@
+"""DDPG as pure jitted functions.
+
+Re-expresses the reference DDPG agent (``elasticnet/enet_ddpg.py``,
+``calibration/calib_ddpg.py``): deterministic actor + single critic with
+target copies, Ornstein-Uhlenbeck exploration noise (``enet_ddpg.py:23-43``)
+carried as functional state, critic loss ``||q - y||^2`` (summed, as the
+reference's ``T.norm(...)**2``, ``:281-284``), actor loss
+``-mean(critic(s, actor(s)))`` (``:291-297``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from . import replay as rp
+from .networks import MLPCritic, MLPDeterministicActor
+
+
+@dataclasses.dataclass(frozen=True)
+class DDPGConfig:
+    obs_dim: int
+    n_actions: int
+    gamma: float = 0.99
+    tau: float = 0.001
+    lr_a: float = 1e-3
+    lr_c: float = 1e-3
+    batch_size: int = 64
+    mem_size: int = 1024
+    ou_sigma: float = 0.15
+    ou_theta: float = 0.2
+    ou_dt: float = 1e-2
+
+
+class OUState(NamedTuple):
+    x_prev: jnp.ndarray
+
+
+def ou_init(n_actions: int) -> OUState:
+    return OUState(x_prev=jnp.zeros((n_actions,), jnp.float32))
+
+
+def ou_sample(cfg: DDPGConfig, st: OUState, key) -> Tuple[jnp.ndarray, OUState]:
+    """One Ornstein-Uhlenbeck draw (enet_ddpg.py:30-35), mu = 0."""
+    x = (st.x_prev - cfg.ou_theta * st.x_prev * cfg.ou_dt
+         + cfg.ou_sigma * jnp.sqrt(cfg.ou_dt)
+         * jax.random.normal(key, st.x_prev.shape))
+    return x, OUState(x_prev=x)
+
+
+class DDPGState(NamedTuple):
+    actor_params: Any
+    critic_params: Any
+    t_actor_params: Any
+    t_critic_params: Any
+    actor_opt: Any
+    critic_opt: Any
+    noise: OUState
+
+
+def _nets(cfg: DDPGConfig):
+    return MLPDeterministicActor(cfg.n_actions), MLPCritic()
+
+
+def ddpg_init(key, cfg: DDPGConfig) -> DDPGState:
+    actor, critic = _nets(cfg)
+    ka, kc = jax.random.split(key)
+    obs = jnp.zeros((1, cfg.obs_dim))
+    act = jnp.zeros((1, cfg.n_actions))
+    actor_params = actor.init(ka, obs)["params"]
+    critic_params = critic.init(kc, obs, act)["params"]
+    copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+    return DDPGState(
+        actor_params=actor_params, critic_params=critic_params,
+        t_actor_params=copy(actor_params),
+        t_critic_params=copy(critic_params),
+        actor_opt=optax.adam(cfg.lr_a).init(actor_params),
+        critic_opt=optax.adam(cfg.lr_c).init(critic_params),
+        noise=ou_init(cfg.n_actions),
+    )
+
+
+def choose_action(cfg: DDPGConfig, st: DDPGState, obs, key
+                  ) -> Tuple[jnp.ndarray, DDPGState]:
+    """actor(obs) + OU noise (enet_ddpg.py:243-249); not clamped, matching
+    the reference (the env clamps/penalises out-of-range actions)."""
+    actor, _ = _nets(cfg)
+    mu = actor.apply({"params": st.actor_params}, obs)
+    n, noise = ou_sample(cfg, st.noise, key)
+    return mu + n, st._replace(noise=noise)
+
+
+def learn(cfg: DDPGConfig, st: DDPGState, buf: rp.ReplayState,
+          key) -> Tuple[DDPGState, rp.ReplayState, dict]:
+    """One DDPG learn step (enet_ddpg.py:251-302)."""
+    actor, critic = _nets(cfg)
+    opt_a, opt_c = optax.adam(cfg.lr_a), optax.adam(cfg.lr_c)
+
+    def do_learn(args):
+        st, buf, key = args
+        batch, _ = rp.replay_sample_uniform(buf, key, cfg.batch_size)
+        s, a = batch["state"], batch["action"]
+        r, s2 = batch["reward"], batch["new_state"]
+        done = batch["done"].astype(jnp.float32)
+
+        ta = actor.apply({"params": st.t_actor_params}, s2)
+        qt = critic.apply({"params": st.t_critic_params}, s2, ta).squeeze(-1)
+        y = (r + cfg.gamma * qt * (1.0 - done))[:, None]
+        y = lax.stop_gradient(y)
+
+        def critic_loss(p):
+            q = critic.apply({"params": p}, s, a)
+            return jnp.sum((q - y) ** 2)  # T.norm(.,2)**2 — summed
+
+        closs, gc = jax.value_and_grad(critic_loss)(st.critic_params)
+        uc, critic_opt = opt_c.update(gc, st.critic_opt, st.critic_params)
+        critic_params = optax.apply_updates(st.critic_params, uc)
+
+        def actor_loss(p):
+            mu = actor.apply({"params": p}, s)
+            return -jnp.mean(critic.apply({"params": critic_params}, s, mu))
+
+        aloss, ga = jax.value_and_grad(actor_loss)(st.actor_params)
+        ua, actor_opt = opt_a.update(ga, st.actor_opt, st.actor_params)
+        actor_params = optax.apply_updates(st.actor_params, ua)
+
+        lerp = lambda t, o: jax.tree_util.tree_map(
+            lambda a_, b_: cfg.tau * a_ + (1.0 - cfg.tau) * b_, o, t)
+        st_new = DDPGState(
+            actor_params=actor_params, critic_params=critic_params,
+            t_actor_params=lerp(st.t_actor_params, actor_params),
+            t_critic_params=lerp(st.t_critic_params, critic_params),
+            actor_opt=actor_opt, critic_opt=critic_opt, noise=st.noise)
+        return st_new, buf, {"critic_loss": closs, "actor_loss": aloss}
+
+    def no_learn(args):
+        st, buf, _ = args
+        return st, buf, {"critic_loss": jnp.asarray(0.0),
+                         "actor_loss": jnp.asarray(0.0)}
+
+    return lax.cond(buf.cntr >= cfg.batch_size, do_learn, no_learn,
+                    (st, buf, key))
+
+
+class DDPGAgent:
+    """Host-driven wrapper with the reference Agent API."""
+
+    def __init__(self, cfg: DDPGConfig, seed: int = 0, name_prefix: str = ""):
+        self.cfg = cfg
+        self.key = jax.random.PRNGKey(seed)
+        self.key, k0 = jax.random.split(self.key)
+        self.state = ddpg_init(k0, cfg)
+        self.buffer = rp.replay_init(
+            cfg.mem_size, rp.transition_spec(cfg.obs_dim, cfg.n_actions))
+        self.name_prefix = name_prefix
+        self._choose = jax.jit(
+            lambda st, obs, key: choose_action(cfg, st, obs, key))
+        self._learn = jax.jit(lambda st, buf, key: learn(cfg, st, buf, key))
+        self._add = jax.jit(
+            lambda buf, tr: rp.replay_add(buf, tr, priority=jnp.asarray(1.0)))
+
+    def _next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+    def choose_action(self, observation):
+        obs = jnp.asarray(observation, jnp.float32)
+        a, self.state = self._choose(self.state, obs, self._next_key())
+        return jax.device_get(a)
+
+    def store_transition(self, state, action, reward, state_, done,
+                         hint=None):
+        tr = {"state": state, "action": action, "reward": reward,
+              "new_state": state_, "done": done,
+              "hint": jnp.zeros((self.cfg.n_actions,), jnp.float32)
+              if hint is None else hint}
+        self.buffer = self._add(self.buffer, tr)
+
+    def learn(self):
+        self.state, self.buffer, _ = self._learn(self.state, self.buffer,
+                                                 self._next_key())
+
+    def save_models(self, prefix: Optional[str] = None):
+        prefix = prefix if prefix is not None else self.name_prefix
+        with open(f"{prefix}ddpg_state.pkl", "wb") as f:
+            pickle.dump(jax.device_get(self.state), f)
+        rp.save_replay(self.buffer, f"{prefix}replaymem_ddpg.pkl")
+
+    def load_models(self, prefix: Optional[str] = None):
+        prefix = prefix if prefix is not None else self.name_prefix
+        with open(f"{prefix}ddpg_state.pkl", "rb") as f:
+            self.state = jax.tree_util.tree_map(jnp.asarray, pickle.load(f))
+        self.buffer = rp.load_replay(f"{prefix}replaymem_ddpg.pkl")

@@ -1,0 +1,89 @@
+"""Elastic-net DDPG training driver (reference ``elasticnet/main_ddpg.py``)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pickle
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ..envs import enet
+from ..rl import ddpg
+from ..rl import replay as rp
+
+
+def make_episode_fn(env_cfg: enet.EnetConfig, cfg: ddpg.DDPGConfig,
+                    steps: int):
+    @jax.jit
+    def run_episode(agent_state, buf, key):
+        k_reset, k_scan = jax.random.split(key)
+        env_state, obs = enet.reset(env_cfg, k_reset)
+
+        def step_fn(carry, k):
+            agent_state, buf, env_state, obs = carry
+            k_act, k_env, k_learn = jax.random.split(k, 3)
+            action, agent_state = ddpg.choose_action(cfg, agent_state, obs,
+                                                     k_act)
+            env_state, obs2, reward, done = enet.step(env_cfg, env_state,
+                                                      action, k_env)
+            tr = {"state": obs, "action": action, "reward": reward,
+                  "new_state": obs2, "done": done,
+                  "hint": jnp.zeros((cfg.n_actions,), jnp.float32)}
+            buf = rp.replay_add(buf, tr, priority=jnp.asarray(1.0))
+            agent_state, buf, _ = ddpg.learn(cfg, agent_state, buf, k_learn)
+            return (agent_state, buf, env_state, obs2), reward
+
+        keys = jax.random.split(k_scan, steps)
+        (agent_state, buf, _, _), rewards = jax.lax.scan(
+            step_fn, (agent_state, buf, env_state, obs), keys)
+        return agent_state, buf, jnp.mean(rewards)
+
+    return run_episode
+
+
+def train_fused(seed=0, episodes=1000, steps=5, M=20, N=20, quiet=False,
+                prefix=""):
+    env_cfg = enet.EnetConfig(M=M, N=N)
+    cfg = ddpg.DDPGConfig(obs_dim=env_cfg.obs_dim, n_actions=2,
+                          batch_size=64, mem_size=1024)
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    agent_state = ddpg.ddpg_init(k0, cfg)
+    buf = rp.replay_init(cfg.mem_size, rp.transition_spec(env_cfg.obs_dim, 2))
+    episode_fn = make_episode_fn(env_cfg, cfg, steps)
+
+    scores = []
+    t0 = time.time()
+    for i in range(episodes):
+        key, k = jax.random.split(key)
+        agent_state, buf, score = episode_fn(agent_state, buf, k)
+        scores.append(float(score))
+        if not quiet:
+            avg = sum(scores[-100:]) / len(scores[-100:])
+            print(f"episode {i} score {scores[-1]:.2f} average score {avg:.2f}")
+    wall = time.time() - t0
+    with open(f"{prefix}scores_ddpg.pkl", "wb") as f:
+        pickle.dump(scores, f)
+    return scores, wall, agent_state, buf
+
+
+def main():
+    p = argparse.ArgumentParser(description="Elastic net DDPG (TPU)")
+    p.add_argument("--seed", default=0, type=int)
+    p.add_argument("--episodes", default=1000, type=int)
+    p.add_argument("--steps", default=5, type=int)
+    args = p.parse_args()
+    scores, wall, _, _ = train_fused(seed=args.seed, episodes=args.episodes,
+                                     steps=args.steps)
+    print(json.dumps({"episodes": args.episodes, "wall_s": round(wall, 2),
+                      "env_steps_per_sec": round(
+                          args.episodes * args.steps / wall, 2),
+                      "final_avg_score": sum(scores[-100:])
+                      / len(scores[-100:])}))
+
+
+if __name__ == "__main__":
+    main()

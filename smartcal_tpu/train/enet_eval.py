@@ -1,0 +1,94 @@
+"""Evaluate a trained elasticnet agent against classic grid search.
+
+Re-expresses the reference evaluation-as-integration-test
+(``elasticnet/enet_eval.py:85-112``): a trained agent picks regularisation
+via RL on fixed-noise episodes; grid search (the env's hint machinery — the
+same 5x5 lambda grid with 2-fold CV the reference runs through sklearn
+``GridSearchCV``) picks its best; both solutions are compared to the ground
+truth by relative L1 error.
+
+    python -m smartcal_tpu.train.enet_eval --games 2 --agent sac_state.pkl
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..envs import enet
+from ..ops.lbfgs import lbfgs_solve
+from ..rl import sac
+
+
+def solve_enet(A, y, lam1, lam2, M):
+    """Plain elastic-net solve at given regularisation (SKEnet.fit path)."""
+    def fun(x):
+        err = y - A @ x
+        return (jnp.sum(err ** 2) + lam2 * jnp.sum(x ** 2)
+                + lam1 * jnp.sum(jnp.abs(x)))
+
+    return lbfgs_solve(fun, jnp.zeros((M,), jnp.float32), max_iters=200).x
+
+
+def evaluate(agent_path: str = "sac_state.pkl", games: int = 2, steps: int = 4,
+             M: int = 20, N: int = 20, seed: int = 0):
+    env_cfg = enet.EnetConfig(M=M, N=N)
+    agent_cfg = sac.SACConfig(obs_dim=env_cfg.obs_dim, n_actions=2)
+    with open(agent_path, "rb") as f:
+        agent_state = jax.tree_util.tree_map(jnp.asarray, pickle.load(f))
+
+    key = jax.random.PRNGKey(seed)
+    results = []
+    for i in range(games):
+        key, k_reset, k_noise = jax.random.split(key, 3)
+        st, obs = enet.reset(env_cfg, k_reset)
+        st = enet.draw_noise(env_cfg, st, k_noise)
+
+        # RL rollout on fixed noise
+        rho = None
+        for _ in range(steps):
+            key, k_act, k_step = jax.random.split(key, 3)
+            action = sac.choose_action(agent_cfg, agent_state, obs, k_act,
+                                       deterministic=True)
+            rho, _ = enet.action_to_rho(action)
+            st, obs, reward, _ = enet.step(env_cfg, st, action, k_step,
+                                           keepnoise=True)
+
+        # grid search on the same data; hint[0]=lambda1 (L1), hint[1]=lambda2
+        # (L2) in the SKEnet objective (enetenv.py:237-239,275-280)
+        hint_action = enet.get_hint(env_cfg, st)
+        lam_grid, _ = enet.action_to_rho(hint_action)
+        x_grid = solve_enet(st.A, st.y, lam_grid[0], lam_grid[1], M)
+
+        x0 = np.asarray(st.x0)
+        rel = lambda x: (np.linalg.norm(x0 - np.asarray(x), 1)
+                         / np.linalg.norm(x0, 1))
+        row = {"game": i,
+               "rl_rho": np.asarray(rho).tolist(),
+               "grid_rho": np.asarray(lam_grid).tolist(),
+               "rl_rel_err": float(rel(st.x)),
+               "grid_rel_err": float(rel(x_grid))}
+        results.append(row)
+        print(f"{i} RL {row['rl_rho'][0]:.4f},{row['rl_rho'][1]:.4f} "
+              f"GR {row['grid_rho'][0]:.4f},{row['grid_rho'][1]:.4f}")
+        print(f"RL {row['rl_rel_err']:.4f} GR {row['grid_rel_err']:.4f}")
+    return results
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--agent", default="sac_state.pkl")
+    p.add_argument("--games", default=2, type=int)
+    p.add_argument("--steps", default=4, type=int)
+    p.add_argument("--seed", default=0, type=int)
+    args = p.parse_args()
+    evaluate(agent_path=args.agent, games=args.games, steps=args.steps,
+             seed=args.seed)
+
+
+if __name__ == "__main__":
+    main()

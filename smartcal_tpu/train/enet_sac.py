@@ -37,17 +37,23 @@ def make_episode_fn(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
 
     @jax.jit
     def run_episode(agent_state, buf, key):
-        k_reset, k_scan = jax.random.split(key)
+        k_reset, k_noise, k_scan = jax.random.split(key, 3)
         env_state, obs = enet.reset(env_cfg, k_reset)
+        # the episode hint is computed from the FIRST step's noisy draw
+        # (reference: step() draws noise, then get_hint uses self.y,
+        # enetenv.py:87-90,156-158) — draw it now, reuse it on step 0
+        env_state = enet.draw_noise(env_cfg, env_state, k_noise)
         hint = (enet.get_hint(env_cfg, env_state) if use_hint
                 else jnp.zeros((agent_cfg.n_actions,), jnp.float32))
 
-        def step_fn(carry, k):
+        def step_fn(carry, inp):
+            k, first = inp
             agent_state, buf, env_state, obs = carry
             k_act, k_env, k_learn = jax.random.split(k, 3)
             action = sac.choose_action(agent_cfg, agent_state, obs, k_act)
             env_state, obs2, reward, done = enet.step(env_cfg, env_state,
-                                                      action, k_env)
+                                                      action, k_env,
+                                                      keepnoise=first)
             tr = {"state": obs, "action": action, "reward": reward,
                   "new_state": obs2, "done": done, "hint": hint}
             buf = rp.replay_add(buf, tr,
@@ -58,8 +64,9 @@ def make_episode_fn(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
             return (agent_state, buf, env_state, obs2), reward
 
         keys = jax.random.split(k_scan, steps)
+        first = jnp.arange(steps) == 0
         (agent_state, buf, env_state, _), rewards = jax.lax.scan(
-            step_fn, (agent_state, buf, env_state, obs), keys)
+            step_fn, (agent_state, buf, env_state, obs), (keys, first))
         return agent_state, buf, jnp.mean(rewards)
 
     return run_episode

@@ -1,0 +1,118 @@
+"""Elastic-net TD3 training driver (reference ``elasticnet/main_td3.py``:
+prioritized replay + hint-constrained adaptive-ADMM actor updates,
+1000 episodes x 4 steps, warmup 100)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pickle
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ..envs import enet
+from ..rl import replay as rp
+from ..rl import td3
+
+
+def make_episode_fn(env_cfg: enet.EnetConfig, cfg: td3.TD3Config,
+                    steps: int, use_hint: bool):
+    @jax.jit
+    def run_episode(agent_state, buf, key):
+        k_reset, k_noise, k_scan = jax.random.split(key, 3)
+        env_state, obs = enet.reset(env_cfg, k_reset)
+        # hint from the first step's noisy draw (see enet_sac.make_episode_fn)
+        env_state = enet.draw_noise(env_cfg, env_state, k_noise)
+        hint = (enet.get_hint(env_cfg, env_state) if use_hint
+                else jnp.zeros((cfg.n_actions,), jnp.float32))
+
+        def step_fn(carry, inp):
+            k, first = inp
+            agent_state, buf, env_state, obs = carry
+            k_act, k_env, k_learn = jax.random.split(k, 3)
+            action, agent_state = td3.choose_action(cfg, agent_state, obs,
+                                                    k_act)
+            env_state, obs2, reward, done = enet.step(env_cfg, env_state,
+                                                      action, k_env,
+                                                      keepnoise=first)
+            tr = {"state": obs, "action": action, "reward": reward,
+                  "new_state": obs2, "done": done, "hint": hint}
+            pri = td3.store_priority(cfg, reward)
+            buf = rp.replay_add(buf, tr,
+                                priority=jnp.asarray(1.0) if pri is None
+                                else pri)
+            agent_state, buf, _ = td3.learn(cfg, agent_state, buf, k_learn)
+            return (agent_state, buf, env_state, obs2), reward
+
+        keys = jax.random.split(k_scan, steps)
+        first = jnp.arange(steps) == 0
+        (agent_state, buf, _, _), rewards = jax.lax.scan(
+            step_fn, (agent_state, buf, env_state, obs), (keys, first))
+        return agent_state, buf, jnp.mean(rewards)
+
+    return run_episode
+
+
+def train_fused(seed=0, episodes=1000, steps=4, use_hint=True,
+                prioritized=True, M=20, N=20, quiet=False, save_every=500,
+                prefix=""):
+    env_cfg = enet.EnetConfig(M=M, N=N)
+    cfg = td3.TD3Config(
+        obs_dim=env_cfg.obs_dim, n_actions=2, gamma=0.99, tau=0.005,
+        batch_size=64, mem_size=1024, lr_a=1e-3, lr_c=1e-3,
+        update_actor_interval=2, warmup=100, noise=0.1,
+        prioritized=prioritized, use_hint=use_hint, admm_rho=1.0)
+
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    agent_state = td3.td3_init(k0, cfg)
+    buf = rp.replay_init(cfg.mem_size, rp.transition_spec(env_cfg.obs_dim, 2))
+    episode_fn = make_episode_fn(env_cfg, cfg, steps, use_hint)
+
+    scores = []
+    t0 = time.time()
+    for i in range(episodes):
+        key, k = jax.random.split(key)
+        agent_state, buf, score = episode_fn(agent_state, buf, k)
+        scores.append(float(score))
+        if not quiet:
+            avg = sum(scores[-100:]) / len(scores[-100:])
+            print(f"episode {i} score {scores[-1]:.2f} average score {avg:.2f}")
+        if save_every and i and i % save_every == 0:
+            _save(agent_state, buf, scores, prefix)
+    wall = time.time() - t0
+    _save(agent_state, buf, scores, prefix)
+    return scores, wall, agent_state, buf
+
+
+def _save(agent_state, buf, scores, prefix):
+    with open(f"{prefix}td3_state.pkl", "wb") as f:
+        pickle.dump(jax.device_get(agent_state), f)
+    rp.save_replay(buf, f"{prefix}replaymem_td3.pkl")
+    with open(f"{prefix}scores_td3.pkl", "wb") as f:
+        pickle.dump(scores, f)
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="Elastic net TD3 + PER + hint-ADMM (TPU)")
+    p.add_argument("--seed", default=0, type=int)
+    p.add_argument("--episodes", default=1000, type=int)
+    p.add_argument("--steps", default=4, type=int)
+    p.add_argument("--no_hint", action="store_true", default=False)
+    p.add_argument("--no_per", action="store_true", default=False)
+    args = p.parse_args()
+    scores, wall, _, _ = train_fused(
+        seed=args.seed, episodes=args.episodes, steps=args.steps,
+        use_hint=not args.no_hint, prioritized=not args.no_per)
+    print(json.dumps({"episodes": args.episodes, "wall_s": round(wall, 2),
+                      "env_steps_per_sec": round(
+                          args.episodes * args.steps / wall, 2),
+                      "final_avg_score": sum(scores[-100:])
+                      / len(scores[-100:])}))
+
+
+if __name__ == "__main__":
+    main()

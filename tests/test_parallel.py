@@ -21,8 +21,8 @@ def test_parallel_sac_step_8_devices():
     env_cfg = enet.EnetConfig(M=6, N=6, lbfgs_iters=8)
     agent_cfg = sac.SACConfig(obs_dim=env_cfg.obs_dim, n_actions=2,
                               batch_size=16, mem_size=64)
-    init_fn, train_step = make_parallel_sac(env_cfg, agent_cfg, mesh,
-                                            n_envs=8)
+    init_fn, train_step, reset_envs = make_parallel_sac(
+        env_cfg, agent_cfg, mesh, n_envs=8)
     st = init_fn(jax.random.PRNGKey(0))
     # env states are actually sharded over dp
     shard_names = {s for s in
@@ -37,6 +37,14 @@ def test_parallel_sac_step_8_devices():
     assert int(st.agent.learn_counter) == 2  # learn active once cntr>=16
     assert np.isfinite(float(metrics["mean_reward"]))
     assert np.isfinite(float(metrics["critic_loss"]))
+
+    # episode boundary: reset draws fresh problems, step counter back to 0
+    A_before = np.asarray(st.env_states.A)
+    st = reset_envs(st, jax.random.PRNGKey(9))
+    assert int(st.step_in_episode) == 0
+    assert not np.allclose(np.asarray(st.env_states.A), A_before)
+    st, metrics = train_step(st, jax.random.PRNGKey(10))
+    assert np.isfinite(float(metrics["mean_reward"]))
 
 
 def test_graft_entry():

@@ -1,0 +1,159 @@
+"""Tests for TD3 (warmup, delayed actor, hint-ADMM, PER) and DDPG (OU noise)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smartcal_tpu.rl import ddpg, td3
+from smartcal_tpu.rl import replay as rp
+
+
+def _spec(obs_dim=6, n_actions=2):
+    return rp.transition_spec(obs_dim, n_actions)
+
+
+def _fill(buf, n, obs_dim=6, hint_val=0.0, seed=0):
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        tr = {"state": rng.normal(size=obs_dim).astype(np.float32),
+              "new_state": rng.normal(size=obs_dim).astype(np.float32),
+              "action": rng.uniform(-1, 1, 2).astype(np.float32),
+              "reward": np.float32(rng.normal()),
+              "done": False,
+              "hint": np.full(2, hint_val, np.float32)}
+        buf = rp.replay_add(buf, tr, priority=jnp.asarray(1.0))
+    return buf
+
+
+def test_td3_warmup_then_actor():
+    cfg = td3.TD3Config(obs_dim=6, n_actions=2, warmup=3, noise=0.1)
+    st = td3.td3_init(jax.random.PRNGKey(0), cfg)
+    obs = jnp.ones(6)
+    # during warmup actions are pure noise; after, actor mean + noise
+    a1, st = td3.choose_action(cfg, st, obs, jax.random.PRNGKey(1))
+    assert int(st.time_step) == 1
+    assert np.all(np.abs(np.asarray(a1)) <= 1.0)
+    for i in range(5):
+        a, st = td3.choose_action(cfg, st, obs, jax.random.PRNGKey(2 + i))
+    assert int(st.time_step) == 6
+    # post warmup, deterministic part repeats for the same obs: variance of
+    # actions across keys should be the noise scale, not the warmup scale
+    assert np.all(np.abs(np.asarray(a)) <= 1.0)
+
+
+def test_td3_learn_and_delayed_actor():
+    cfg = td3.TD3Config(obs_dim=6, n_actions=2, batch_size=4, mem_size=16,
+                        update_actor_interval=2)
+    st = td3.td3_init(jax.random.PRNGKey(0), cfg)
+    buf = rp.replay_init(cfg.mem_size, _spec())
+    buf = _fill(buf, 8)
+
+    flat = lambda p: jax.flatten_util.ravel_pytree(p)[0]
+    a0 = flat(st.actor_params)
+    st1, buf, _ = td3.learn(cfg, st, buf, jax.random.PRNGKey(1))
+    # counter=1: critics updated, actor NOT (interval 2)
+    assert int(st1.learn_counter) == 1
+    np.testing.assert_allclose(np.asarray(flat(st1.actor_params)),
+                               np.asarray(a0))
+    assert float(jnp.linalg.norm(flat(st1.c1_params) - flat(st.c1_params))) > 0
+    st2, buf, _ = td3.learn(cfg, st1, buf, jax.random.PRNGKey(2))
+    # counter=2: actor updates now
+    assert float(jnp.linalg.norm(flat(st2.actor_params) - a0)) > 0
+
+
+def test_td3_hint_admm_pulls_towards_hint():
+    """With a strong hint constraint the ADMM inner loop should move the
+    actor towards the hint more than the unconstrained update does."""
+    cfg_h = td3.TD3Config(obs_dim=6, n_actions=2, batch_size=8, mem_size=32,
+                          update_actor_interval=1, use_hint=True,
+                          admm_rho=100.0, n_admm=5, lr_a=1e-2)
+    cfg_n = td3.TD3Config(obs_dim=6, n_actions=2, batch_size=8, mem_size=32,
+                          update_actor_interval=1, use_hint=False, lr_a=1e-2)
+    st = td3.td3_init(jax.random.PRNGKey(0), cfg_h)
+    buf = rp.replay_init(32, _spec())
+    buf = _fill(buf, 16, hint_val=0.8)
+
+    actor = td3.MLPDeterministicActor(2)
+    obs = jnp.asarray(np.random.default_rng(3).normal(size=(8, 6)),
+                      jnp.float32)
+
+    d_init = float(jnp.mean(
+        (actor.apply({"params": st.actor_params}, obs) - 0.8) ** 2))
+    st_h, st_n = st, st
+    for i in range(10):
+        st_h, _, _ = td3.learn(cfg_h, st_h, buf, jax.random.PRNGKey(10 + i))
+        st_n, _, _ = td3.learn(cfg_n, st_n, buf, jax.random.PRNGKey(10 + i))
+    ah = actor.apply({"params": st_h.actor_params}, obs)
+    an = actor.apply({"params": st_n.actor_params}, obs)
+    d_h = float(jnp.mean((ah - 0.8) ** 2))
+    d_n = float(jnp.mean((an - 0.8) ** 2))
+    assert d_h < d_init, (d_h, d_init)
+    assert d_h < d_n, (d_h, d_n)
+    assert d_h < 0.1
+
+
+def test_td3_per_priority_refresh():
+    cfg = td3.TD3Config(obs_dim=6, n_actions=2, batch_size=4, mem_size=16,
+                        prioritized=True)
+    st = td3.td3_init(jax.random.PRNGKey(0), cfg)
+    buf = rp.replay_init(cfg.mem_size, _spec())
+    buf = _fill(buf, 8)
+    st1, buf1, _ = td3.learn(cfg, st, buf, jax.random.PRNGKey(5))
+    assert np.sum(np.asarray(buf1.priority) != np.asarray(buf.priority)) >= 1
+
+
+def test_td3_store_priority_from_reward():
+    cfg = td3.TD3Config(obs_dim=6, n_actions=2, prioritized=True)
+    p = td3.store_priority(cfg, jnp.asarray(2.0))
+    want = (2.0 + rp.PER_EPSILON) ** rp.PER_ALPHA
+    np.testing.assert_allclose(float(p), want, rtol=1e-5)
+    assert td3.store_priority(
+        td3.TD3Config(obs_dim=6, n_actions=2, prioritized=False),
+        jnp.asarray(2.0)) is None
+
+
+def test_ou_noise_autocorrelation():
+    cfg = ddpg.DDPGConfig(obs_dim=6, n_actions=2)
+    st = ddpg.ou_init(2)
+    xs = []
+    key = jax.random.PRNGKey(0)
+    for i in range(200):
+        key, k = jax.random.split(key)
+        x, st = ddpg.ou_sample(cfg, st, k)
+        xs.append(np.asarray(x))
+    xs = np.stack(xs)
+    # OU process: successive samples are strongly correlated (mean-reverting
+    # random walk), unlike white noise
+    c = np.corrcoef(xs[:-1, 0], xs[1:, 0])[0, 1]
+    assert c > 0.9
+
+
+def test_ddpg_learn_updates():
+    cfg = ddpg.DDPGConfig(obs_dim=6, n_actions=2, batch_size=4, mem_size=16)
+    st = ddpg.ddpg_init(jax.random.PRNGKey(0), cfg)
+    buf = rp.replay_init(cfg.mem_size, _spec())
+    buf = _fill(buf, 8)
+    flat = lambda p: jax.flatten_util.ravel_pytree(p)[0]
+    st1, _, m = ddpg.learn(cfg, st, buf, jax.random.PRNGKey(1))
+    assert float(jnp.linalg.norm(flat(st1.actor_params)
+                                 - flat(st.actor_params))) > 0
+    assert float(jnp.linalg.norm(flat(st1.critic_params)
+                                 - flat(st.critic_params))) > 0
+    assert np.isfinite(float(m["critic_loss"]))
+    # target nets interpolated by tau
+    t1 = flat(st1.t_critic_params)
+    want = cfg.tau * flat(st1.critic_params) + (1 - cfg.tau) * flat(
+        st.t_critic_params)
+    np.testing.assert_allclose(np.asarray(t1), np.asarray(want), rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_ddpg_agent_wrapper():
+    cfg = ddpg.DDPGConfig(obs_dim=6, n_actions=2, batch_size=4, mem_size=16)
+    agent = ddpg.DDPGAgent(cfg, seed=0)
+    obs = np.ones(6, np.float32)
+    a = agent.choose_action(obs)
+    assert a.shape == (2,)
+    for _ in range(6):
+        agent.store_transition(obs, a, 0.1, obs, False)
+    agent.learn()
