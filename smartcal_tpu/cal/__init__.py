@@ -1,0 +1,10 @@
+"""Calibration math layer: coordinates, coherency prediction, consensus
+polynomials, residual Hessians / solution derivatives / influence kernels,
+and the log-likelihood-ratio detector.
+
+This is the TPU-native re-expression of the reference's
+``calibration/calibration_tools.py`` (numpy/torch twin loops) as batched
+einsum/segment-sum kernels that XLA can tile onto the MXU.
+"""
+
+from smartcal_tpu.cal import coords, consensus, coherency, kernels, skyio  # noqa: F401

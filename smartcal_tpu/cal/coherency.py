@@ -1,0 +1,126 @@
+"""Sky -> visibility coherency prediction (the in-framework replacement for
+SAGECal's ``sagecal_gpu`` prediction step).
+
+Parity targets: ``calibration/calibration_tools.py:215-464``
+(skytocoherencies, skytocoherencies_torch, skytocoherencies_uvw).
+
+Design: the reference loops over sources in python, each adding one DFT term
+to its cluster's coherency.  Here the sky is a struct-of-arrays over sources
+and the whole prediction is ONE einsum-shaped kernel:
+    phase (S, T) -> flux-scaled complex exponentials -> segment-sum to (K, T).
+Per-source work is a (S, T) outer product — large, batched, bf16-friendly —
+exactly what the MXU wants; the python-level source loop is gone.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+C_LIGHT = 2.99792458e8
+
+
+class SkyArrays:
+    """Struct-of-arrays sky model (host-built, device-consumed).
+
+    Fields (S sources):
+      lmn       (S, 3) direction cosines (l, m, n-1) about the phase center
+      flux_coef (S, 4) [log sI at f0, sp1, sp2, sp3] spectral log-polynomial
+      f0        (S,)   reference frequency per source
+      gauss     (S, 3) [major, minor, pa]; zeros for point sources
+      is_gauss  (S,)   bool
+      cluster   (S,)   cluster id in [0, K)
+    """
+
+    def __init__(self, lmn, flux_coef, f0, gauss, is_gauss, cluster, n_clusters):
+        self.lmn = jnp.asarray(lmn, jnp.float32)
+        self.flux_coef = jnp.asarray(flux_coef, jnp.float32)
+        self.f0 = jnp.asarray(f0, jnp.float32)
+        self.gauss = jnp.asarray(gauss, jnp.float32)
+        self.is_gauss = jnp.asarray(is_gauss, bool)
+        self.cluster = jnp.asarray(cluster, jnp.int32)
+        self.n_clusters = int(n_clusters)
+
+
+@partial(jax.jit, static_argnames=("n_clusters", "smear"))
+def _predict(uvw_scaled, lmn, flux_coef, f0, gauss, is_gauss, cluster,
+             n_clusters, freq, smear=False, fdelta_over_freq=0.0):
+    """Core kernel.  uvw_scaled: (T, 3) already multiplied by 2*pi*f/c."""
+    uu, vv, ww = uvw_scaled[:, 0], uvw_scaled[:, 1], uvw_scaled[:, 2]
+    l, m, n = lmn[:, 0], lmn[:, 1], lmn[:, 2]
+
+    # spectral power law: sI = exp(log sI0 + sp1*fr + sp2*fr^2 + sp3*fr^3)
+    fr = jnp.log(freq / f0)                               # (S,)
+    log_si = (flux_coef[:, 0] + flux_coef[:, 1] * fr
+              + flux_coef[:, 2] * fr ** 2 + flux_coef[:, 3] * fr ** 3)
+    si = jnp.exp(log_si)
+
+    # (S, T) phase
+    phase = l[:, None] * uu[None, :] + m[:, None] * vv[None, :] \
+        + n[:, None] * ww[None, :]
+    amp = si[:, None]
+
+    if smear:
+        # bandwidth smearing, numpy sinc normalization:
+        # |sinc(phase * 0.5 * fdelta / pi)| with np.sinc(x) = sin(pi x)/(pi x)
+        amp = amp * jnp.abs(jnp.sinc(phase * 0.5 * fdelta_over_freq / jnp.pi))
+
+    # Gaussian envelope (reference skytocoherencies_uvw:434-452): project
+    # uv onto the source plane, rotate by position angle, scale axes.
+    # NOTE reference quirk kept for parity: acos() is applied to the n-EXCESS
+    # (sqrt(1-l^2-m^2) - 1, near 0), not the true direction cosine (near 1),
+    # so phi ~ -pi/2 near the phase center (calibration_tools.py:436).
+    phi = -jnp.arccos(jnp.clip(n, -1.0, 1.0))
+    xi = -jnp.arctan2(-l, m)
+    cxi, sxi = jnp.cos(xi), jnp.sin(xi)
+    cphi, sphi = jnp.cos(phi), jnp.sin(phi)
+    eX = 2.0 * gauss[:, 0]
+    eY = 2.0 * gauss[:, 1]
+    cpa, spa = jnp.cos(gauss[:, 2]), jnp.sin(gauss[:, 2])
+    uup = (cxi[:, None] * uu[None, :] - (cphi * sxi)[:, None] * vv[None, :]
+           + (sphi * sxi)[:, None] * ww[None, :])
+    vvp = (sxi[:, None] * uu[None, :] + (cphi * cxi)[:, None] * vv[None, :]
+           - (sphi * cxi)[:, None] * ww[None, :])
+    uut = eX[:, None] * (cpa[:, None] * uup - spa[:, None] * vvp)
+    vvt = eY[:, None] * (spa[:, None] * uup + cpa[:, None] * vvp)
+    envelope = 0.5 * jnp.pi * jnp.exp(-(uut * uut + vvt * vvt))
+    amp = amp * jnp.where(is_gauss[:, None], envelope, 1.0)
+
+    # split-real output (see cal/creal.py: no complex dtypes on device)
+    xx = jnp.stack([amp * jnp.cos(phase), amp * jnp.sin(phase)], axis=-1)
+    per_cluster = jax.ops.segment_sum(xx, cluster, num_segments=n_clusters)
+
+    T = uvw_scaled.shape[0]
+    C = jnp.zeros((n_clusters, T, 4, 2), dtype=jnp.float32)
+    C = C.at[:, :, 0, :].set(per_cluster)
+    C = C.at[:, :, 3, :].set(per_cluster)
+    return C
+
+
+def predict_coherencies_sr(uu, vv, ww, sky: SkyArrays, freq,
+                           smear=False, fdelta=180e3):
+    """Split-real coherencies C (K, T, 4, 2) for uvw (meters) at ``freq``.
+
+    XX = YY = sum over cluster sources of sI(f) * exp(i(ul+vm+wn))
+    [* smear * gaussian envelope]; XY = YX = 0.
+    Reference: skytocoherencies_uvw, calibration_tools.py:371-464.
+    This is the device API — chain it into the influence kernels
+    (cal/kernels.py ``*_sr``) without host round-trips.
+    """
+    scale = 2.0 * np.pi * freq / C_LIGHT
+    uvw = jnp.stack([jnp.asarray(uu), jnp.asarray(vv), jnp.asarray(ww)],
+                    axis=-1).astype(jnp.float32) * np.float32(scale)
+    return _predict(uvw, sky.lmn, sky.flux_coef, sky.f0, sky.gauss,
+                    sky.is_gauss, sky.cluster, sky.n_clusters,
+                    jnp.float32(freq), smear=smear,
+                    fdelta_over_freq=float(fdelta / freq) if smear else 0.0)
+
+
+def predict_coherencies(uu, vv, ww, sky: SkyArrays, freq,
+                        smear=False, fdelta=180e3):
+    """Complex host-edge wrapper: returns C (K, T, 4) complex64."""
+    C = predict_coherencies_sr(uu, vv, ww, sky, freq, smear=smear,
+                               fdelta=fdelta)
+    C = np.asarray(C)
+    return (C[..., 0] + 1j * C[..., 1]).astype(np.complex64)

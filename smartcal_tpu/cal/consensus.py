@@ -1,0 +1,80 @@
+"""Consensus-ADMM polynomial constraint matrices.
+
+Parity targets: ``calibration/calibration_tools.py:524-585`` (Bpoly,
+consensus_poly).  The reference materialises F (2N x 2N) and P (2N*Ne x 2N)
+with explicit krons; both are kron products with the identity, so we compute
+the small Ne-dimensional cores and expand only on request — the ADMM update
+itself (see smartcal_tpu/cal/solver.py) uses the cores directly, which is the
+shape XLA wants (small dense matmuls batched over direction/station axes).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def bernstein_basis(x, n):
+    """Bernstein basis of order ``n`` evaluated at points ``x`` in [0,1].
+
+    Returns (len(x), n+1): column r holds C(n,r) x^r (1-x)^(n-r).
+    Reference: calibration_tools.py:524-547 (Bpoly).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    r = jnp.arange(n + 1, dtype=jnp.float32)
+    # binomial via log-gamma: C(n,r) = n! / (r! (n-r)!)
+    logc = (jax.lax.lgamma(jnp.asarray(n, jnp.float32) + 1.0)
+            - jax.lax.lgamma(r + 1.0)
+            - jax.lax.lgamma(jnp.asarray(n, jnp.float32) - r + 1.0))
+    xx = x[:, None]
+    # guard 0^0 = 1 at the endpoints
+    px = jnp.where(r == 0, 1.0, xx ** r)
+    p1x = jnp.where(r == n, 1.0, (1.0 - xx) ** (n - r))
+    return jnp.exp(logc)[None, :] * px * p1x
+
+
+def poly_basis(freqs, f0, n_terms, polytype=0):
+    """Frequency basis B (Nf x Ne): ordinary ((f-f0)/f0)^j or Bernstein.
+    Reference: calibration_tools.py:559-568."""
+    freqs = jnp.asarray(freqs, jnp.float32)
+    if polytype == 0:
+        ff = (freqs - f0) / f0
+        j = jnp.arange(n_terms, dtype=jnp.float32)
+        return ff[:, None] ** j[None, :]
+    ff = (freqs - freqs.min()) / (freqs.max() - freqs.min())
+    return bernstein_basis(ff, n_terms - 1)
+
+
+@partial(jax.jit, static_argnames=("n_terms", "polytype"))
+def consensus_cores(freqs, f0, n_terms, polytype=0, rho=0.0, alpha=0.0):
+    """Small-core form of the consensus constraint.
+
+    Returns (Bfull, Bi, fscale) where
+      * Bfull: (Nf, Ne) frequency basis,
+      * Bi: (Ne, Ne) = pinv(rho * sum_f b_f b_f^T + alpha I),
+      * fscale: (Nf,) with fscale[f] = 1 - rho * b_f Bi b_f^T — the scalar
+        that the reference's dense F = fscale * I_2N encodes
+        (calibration_tools.py:578-583 notes F "is diagonal scalar").
+    """
+    bfull = poly_basis(freqs, f0, n_terms, polytype)
+    bi_raw = rho * (bfull.T @ bfull) + alpha * jnp.eye(n_terms)
+    bi = jnp.linalg.pinv(bi_raw)
+    fscale = 1.0 - rho * jnp.einsum("fi,ij,fj->f", bfull, bi, bfull)
+    return bfull, bi, fscale
+
+
+def consensus_poly(n_terms, n_stations, freqs, f0, fidx, polytype=0,
+                   rho=0.0, alpha=0.0):
+    """Dense (F, P) with the reference's exact shapes, for golden tests and
+    API parity: F (2N x 2N), P (2N*Ne x 2N).
+    Reference: calibration_tools.py:551-585.
+
+    F = (1 - rho b_f Bi b_f^T) I_2N;  P = kron(Bi b_f^T, I_2N).
+    """
+    bfull, bi, fscale = consensus_cores(
+        jnp.asarray(freqs, jnp.float32), f0, n_terms, polytype, rho, alpha)
+    eye2n = jnp.eye(2 * n_stations, dtype=jnp.float32)
+    f_mat = fscale[fidx] * eye2n
+    p_core = bi @ bfull[fidx][:, None]          # (Ne, 1)
+    p_mat = jnp.kron(p_core, eye2n)             # (2N*Ne, 2N)
+    return f_mat, p_mat

@@ -1,0 +1,111 @@
+"""Spherical-astronomy coordinate transforms, vectorized in jnp.
+
+Parity targets (reference): ``calibration/calibration_tools.py:6-84``
+(radectolm, lmtoradec, radToRA, radToDec).  The reference operates on python
+scalars with ``math``; here every function maps over arrays so a whole sky
+model transforms in one fused XLA op.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def radectolm(ra, dec, ra0, dec0):
+    """Direction cosines (l, m, n-1) of sources (ra, dec) about phase center
+    (ra0, dec0).  Reference: calibration_tools.py:6-16.
+
+    Returns (l, m, n) where n = sqrt(1-l^2-m^2) - 1 (the reference's
+    convention: n is the *excess* path, so the phase term is u*l+v*m+w*n).
+    """
+    ra = jnp.asarray(ra)
+    dec = jnp.asarray(dec)
+    # reference quirk: if dec0 < 0 <= dec, wrap dec0 by 2pi (no-op for sin/cos
+    # but kept for bit-parity of the branch in the scalar original)
+    dec0 = jnp.where((dec0 < 0.0) & (dec >= 0.0), dec0 + 2.0 * jnp.pi, dec0)
+    l = jnp.sin(ra - ra0) * jnp.cos(dec)
+    m = -(jnp.cos(ra - ra0) * jnp.cos(dec) * jnp.sin(dec0)
+          - jnp.cos(dec0) * jnp.sin(dec))
+    n = jnp.sqrt(jnp.maximum(1.0 - l * l - m * m, 0.0)) - 1.0
+    return l, m, n
+
+
+def lmtoradec(l, m, ra0, dec0):
+    """Inverse of radectolm (small-field approximation).
+    Reference: calibration_tools.py:19-40."""
+    l = jnp.asarray(l)
+    m = jnp.asarray(m)
+    sind0 = jnp.sin(dec0)
+    cosd0 = jnp.cos(dec0)
+    d0 = m ** 2 * sind0 ** 2 + l ** 2 - 2.0 * m * cosd0 * sind0
+    sind = jnp.sqrt(jnp.abs(sind0 ** 2 - d0))
+    cosd = jnp.sqrt(jnp.abs(cosd0 ** 2 + d0))
+    sind = jnp.where(sind0 > 0, jnp.abs(sind), -jnp.abs(sind))
+    dec = jnp.arctan2(sind, cosd)
+    ra = jnp.where(
+        l != 0.0,
+        jnp.arctan2(-l, cosd0 - m * sind0),
+        jnp.arctan2(1e-10, cosd0 - m * sind0)) + ra0
+    return ra, dec
+
+
+def rad_to_ra(rad):
+    """Radians -> (hr, min, sec).  Reference: calibration_tools.py:43-61.
+    Host-side helper (returns python floats)."""
+    rad = float(rad)
+    if rad < 0:
+        rad += 2 * np.pi
+    v = rad * 12.0 / np.pi
+    hr = int(np.floor(v))
+    v = (v - hr) * 60
+    mins = int(np.floor(v))
+    sec = (v - mins) * 60
+    return hr % 24, mins % 60, sec
+
+
+def rad_to_dec(rad):
+    """Radians -> (deg, min, sec).  Reference: calibration_tools.py:64-84."""
+    rad = float(rad)
+    mult = -1 if rad < 0 else 1
+    v = abs(rad) * 180.0 / np.pi
+    deg = int(np.floor(v))
+    v = (v - deg) * 60
+    mins = int(np.floor(v))
+    sec = (v - mins) * 60
+    return mult * (deg % 180), mins % 60, sec
+
+
+def hms_to_rad(h, m, s):
+    """(hr, min, sec) -> radians (RA convention)."""
+    return (h + m / 60.0 + s / 3600.0) * np.pi / 12.0
+
+
+def dms_to_rad(d, m, s):
+    """(deg, min, sec) -> radians (Dec convention).  Sign carried by d."""
+    sign = -1.0 if d < 0 else 1.0
+    return sign * (abs(d) + m / 60.0 + s / 3600.0) * np.pi / 180.0
+
+
+def angular_separation(ra1, dec1, ra2, dec2):
+    """Great-circle separation (rad) via the haversine form (stable for
+    small separations).  Replaces casacore ``measures.separation``
+    (reference influence_tools.py:16-80) with pure math."""
+    sdlat = jnp.sin(0.5 * (dec2 - dec1))
+    sdlon = jnp.sin(0.5 * (ra2 - ra1))
+    a = sdlat ** 2 + jnp.cos(dec1) * jnp.cos(dec2) * sdlon ** 2
+    return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+
+
+def azel_from_radec(ra, dec, lst, lat):
+    """Azimuth/elevation of (ra, dec) for local sidereal time ``lst`` and
+    geodetic latitude ``lat`` (all radians).  Replaces the casacore AZEL
+    measures conversion (reference influence_tools.py:83-159) with the
+    standard hour-angle formulae."""
+    ha = lst - ra
+    sin_el = (jnp.sin(dec) * jnp.sin(lat)
+              + jnp.cos(dec) * jnp.cos(lat) * jnp.cos(ha))
+    el = jnp.arcsin(jnp.clip(sin_el, -1.0, 1.0))
+    az = jnp.arctan2(
+        -jnp.cos(dec) * jnp.sin(ha),
+        jnp.sin(dec) * jnp.cos(lat) - jnp.cos(dec) * jnp.sin(lat) * jnp.cos(ha))
+    az = jnp.where(az < 0, az + 2 * jnp.pi, az)
+    return az, el

@@ -1,0 +1,96 @@
+"""Split-real complex arithmetic: complex tensors as float32 (..., 2) planes.
+
+Why: TPUs have no native complex ALU — XLA lowers complex ops to real pairs,
+and the axon TPU backend's complex lowering is unreliable (intermittent
+UNIMPLEMENTED compile errors observed on hardware, 2026-07-29; see
+cal/kernels.py).  Representing complex data as explicit real/imag planes is
+also the genuinely TPU-native layout: a complex contraction becomes four real
+einsums that tile straight onto the MXU, with no lowering surprises.
+
+Convention: last axis length 2 = [real, imag].  All helpers are jit-safe.
+``split``/``fuse`` are HOST-side (numpy) so device buffers never hold a
+complex dtype.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def split(x):
+    """numpy complex -> float32 (..., 2).  Host-side."""
+    x = np.asarray(x)
+    return np.stack([x.real, x.imag], axis=-1).astype(np.float32)
+
+
+def fuse(x):
+    """float32 (..., 2) -> numpy complex64.  Host-side."""
+    x = np.asarray(x)
+    return (x[..., 0] + 1j * x[..., 1]).astype(np.complex64)
+
+
+def conj(a):
+    return jnp.stack([a[..., 0], -a[..., 1]], axis=-1)
+
+
+def mul(a, b):
+    """Elementwise complex multiply (broadcasting)."""
+    ar, ai = a[..., 0], a[..., 1]
+    br, bi = b[..., 0], b[..., 1]
+    return jnp.stack([ar * br - ai * bi, ar * bi + ai * br], axis=-1)
+
+
+def mul_i(a):
+    """Multiply by the imaginary unit: (re, im) -> (-im, re)."""
+    return jnp.stack([-a[..., 1], a[..., 0]], axis=-1)
+
+
+def abs2(a):
+    """|z|^2, real output (drops the pair axis)."""
+    return a[..., 0] ** 2 + a[..., 1] ** 2
+
+
+def einsum(spec, a, b):
+    """Complex einsum over split operands: four real einsums.
+
+    ``spec`` is a two-operand einsum spec over the NON-pair axes; the pair
+    axis rides along implicitly.
+    """
+    ar, ai = a[..., 0], a[..., 1]
+    br, bi = b[..., 0], b[..., 1]
+    rr = jnp.einsum(spec, ar, br)
+    ii = jnp.einsum(spec, ai, bi)
+    ri = jnp.einsum(spec, ar, bi)
+    ir = jnp.einsum(spec, ai, br)
+    return jnp.stack([rr - ii, ri + ir], axis=-1)
+
+
+def matmul(a, b):
+    """Complex matmul over the last two non-pair axes: a (..., M, K, 2) @
+    b (..., K, N, 2) -> (..., M, N, 2)."""
+    ar, ai = a[..., 0], a[..., 1]
+    br, bi = b[..., 0], b[..., 1]
+    rr = ar @ br - ai @ bi
+    im = ar @ bi + ai @ br
+    return jnp.stack([rr, im], axis=-1)
+
+
+def solve(a, b):
+    """Solve complex A x = b in split form via the real 2Nx2N block system
+    [[Ar, -Ai], [Ai, Ar]] [xr; xi] = [br; bi].
+
+    a: (..., N, N, 2), b: (..., N, M, 2) -> (..., N, M, 2).
+    """
+    ar, ai = a[..., 0], a[..., 1]
+    br, bi = b[..., 0], b[..., 1]
+    n = a.shape[-3]
+    top = jnp.concatenate([ar, -ai], axis=-1)
+    bot = jnp.concatenate([ai, ar], axis=-1)
+    abig = jnp.concatenate([top, bot], axis=-2)          # (..., 2N, 2N)
+    bbig = jnp.concatenate([br, bi], axis=-2)            # (..., 2N, M)
+    x = jnp.linalg.solve(abig, bbig)
+    return jnp.stack([x[..., :n, :], x[..., n:, :]], axis=-1)
+
+
+def scale(a, s):
+    """Multiply split-complex ``a`` by real scalar/array ``s``."""
+    return a * jnp.asarray(s)[..., None]

@@ -1,0 +1,330 @@
+"""Residual Hessians, solution/residual derivatives, and the LLR detector.
+
+Parity targets (reference ``calibration/calibration_tools.py``):
+  * Hessianres / Hessianres_torch        :590-676   -> hessian_res
+  * Dsolutions_r / Dsolutions_r_torch    :778-875   -> dsolutions_all
+  * Dsolutions / Dsolutions_torch        :680-775   -> dsolutions (one r)
+  * Dresiduals_r / Dresiduals_r_torch    :1028-1126 -> dresiduals_all
+  * Dresiduals_rk                        :1129-1176 -> dresiduals_all_perdir
+  * log_likelihood_ratio                 :1181-1223 -> log_likelihood_ratio
+
+Shapes follow the reference conventions exactly so the influence engine and
+golden tests line up 1:1:
+  N stations, B = N(N-1)/2 baselines, T timeslots, K directions.
+  R : (2*B*T, 2) complex residuals; sample ck's 2x2 block is R[2ck:2ck+2].
+  C : (K, B*T, 4) coherencies; the 2x2 is C[k,ck].reshape(2,2,order='F').
+  J : (K, 2N, 2) Jones solutions; station p's 2x2 is J[k, 2p:2p+2].
+  Samples are time-major: ck = t*B + b, with baseline b enumerating p<q
+  row-major (p ascending, q ascending within p).
+
+TPU-first design decisions:
+  1. All device math is SPLIT-REAL (see cal/creal.py): complex tensors are
+     float32 (..., 2) planes.  The axon TPU backend's complex lowering is
+     intermittently UNIMPLEMENTED (observed on hardware 2026-07-29), and
+     split-real is the layout XLA maps onto the MXU anyway.  The ``*_sr``
+     functions are the device API (chainable without host round-trips); the
+     plain-named wrappers take/return numpy complex at the host edge.
+  2. The reference's python triple loops over (k, t, p<q) become per-sample
+     4x4 blocks computed as batched einsums + scatter-adds over the baseline
+     axis.
+  3. Where the math is linear in C (Dsolutions/Dresiduals), the time axis is
+     summed BEFORE the kron expansion — an O(T) reduction in kron work the
+     reference does not exploit.
+  4. The per-direction 4N x 4N solves are batched with vmap; all 8
+     perturbation directions r share one factorization per direction k.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smartcal_tpu.cal import creal
+
+EPS_SINGULAR = 1e-12   # reference: EPS in Dsolutions (calibration_tools.py:696)
+EPS_DIV = 1e-12        # reference: EPS in log_likelihood_ratio (:1203)
+
+
+def baseline_indices(n_stations):
+    """(p, q) station indices per baseline, reference loop order
+    ``for p in range(N-1): for q in range(p+1, N)``."""
+    p, q = np.triu_indices(n_stations, 1)
+    return jnp.asarray(p), jnp.asarray(q)
+
+
+def _split_samples_sr(Rs, Cs, n_stations):
+    """Split-real (2BT, 2, 2) / (K, BT, 4, 2) -> time/baseline block form."""
+    B = n_stations * (n_stations - 1) // 2
+    K = Cs.shape[0]
+    T = Cs.shape[1] // B
+    R3 = Rs.reshape(T, B, 2, 2, 2)
+    # order='F' 2x2: swap the matrix axes (pair axis stays last)
+    C5 = jnp.swapaxes(Cs.reshape(K, T, B, 2, 2, 2), -3, -2)
+    return R3, C5, B, T, K
+
+
+def _jones_blocks_sr(Js, n_stations):
+    """(K, 2N, 2, 2) -> (K, N, 2, 2, 2) with [k, p] = J[k, 2p:2p+2]."""
+    K = Js.shape[0]
+    return Js.reshape(K, n_stations, 2, 2, 2)
+
+
+# ---------------------------------------------------------------------------
+# Hessian of the residual
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("n_stations",))
+def hessian_res_sr(Rs, Cs, Js, n_stations):
+    """Residual Hessian H (K, 4N, 4N, 2), averaged over baselines*time.
+
+    Per baseline (p, q) the contribution is
+      off-diag  (p,q): -conj(C) (x) Res          (and its hermitian at (q,p))
+      diag      (p,p): ((C Jq^H)(C Jq^H)^H)^T (x) I2
+      diag      (q,q): ((Jp C)^H (Jp C))^T (x) I2
+    Reference: Hessianres, calibration_tools.py:590-631.
+    """
+    R3, C5, B, T, K = _split_samples_sr(Rs, Cs, n_stations)
+    J4 = _jones_blocks_sr(Js, n_stations)
+    p_idx, q_idx = baseline_indices(n_stations)
+    Jp = J4[:, p_idx]                      # (K, B, 2, 2, 2)
+    Jq = J4[:, q_idx]
+
+    # off-diagonal: sum_t kron(-conj(Ci), Res) -> (K, B, 4, 4, 2)
+    off = -creal.einsum("ktbij,tbuv->kbiujv", creal.conj(C5), R3)
+    off = off.reshape(K, B, 4, 4, 2)
+
+    # diag at p: A1 = Ci Jq^H ; S = sum_t A1 A1^H
+    A1 = creal.einsum("ktbuv,kbwv->ktbuw", C5, creal.conj(Jq))
+    Sp = creal.einsum("ktbuw,ktbvw->kbuv", A1, creal.conj(A1))
+    # diag at q: A2 = Jp Ci ; S = sum_t A2^H A2
+    A2 = creal.einsum("kbuv,ktbvw->ktbuw", Jp, C5)
+    Sq = creal.einsum("ktbuv,ktbuw->kbvw", creal.conj(A2), A2)
+
+    # segment-sum baseline contributions onto stations
+    Dp = jax.ops.segment_sum(jnp.swapaxes(Sp, 0, 1), p_idx,
+                             num_segments=n_stations)    # (N, K, 2, 2, 2)
+    Dq = jax.ops.segment_sum(jnp.swapaxes(Sq, 0, 1), q_idx,
+                             num_segments=n_stations)
+    Dsum = Dp + Dq
+    # kron(S.T, I2)[2i+u, 2j+v] = S[j, i] * delta_uv  (I2 is real)
+    eye2 = jnp.eye(2, dtype=Rs.dtype)
+    diag_blocks = jnp.einsum("nkjiz,uv->nkiujvz", Dsum, eye2).reshape(
+        n_stations, K, 4, 4, 2)
+
+    H = jnp.zeros((K, n_stations, 4, n_stations, 4, 2), dtype=Rs.dtype)
+    off_t = jnp.swapaxes(off, 0, 1)                      # (B, K, 4, 4, 2)
+    H = H.at[:, p_idx, :, q_idx, :, :].add(off_t)
+    herm = creal.conj(jnp.swapaxes(off_t, -3, -2))
+    H = H.at[:, q_idx, :, p_idx, :, :].add(herm)
+    sidx = jnp.arange(n_stations)
+    H = H.at[:, sidx, :, sidx, :, :].add(diag_blocks)
+    N4 = 4 * n_stations
+    return H.reshape(K, N4, N4, 2) / (B * T)
+
+
+def hessian_res(R, C, J, n_stations):
+    """Complex host-edge wrapper (reference Hessianres signature)."""
+    H = hessian_res_sr(creal.split(R), creal.split(C), creal.split(J),
+                       n_stations)
+    return creal.fuse(np.asarray(H))
+
+
+# ---------------------------------------------------------------------------
+# Solution derivatives dJ/dx
+# ---------------------------------------------------------------------------
+
+_J_OF_R = np.asarray([0, 0, 0, 0, 1, 1, 1, 1])
+_V_OF_R = np.asarray([0, 0, 1, 1, 0, 0, 1, 1])
+_ODD_R = np.asarray([False, True] * 4)
+
+
+@partial(jax.jit, static_argnames=("n_stations",))
+def dsolutions_all_sr(Cs, Js, n_stations, Dgs):
+    """dJ/dx for all 8 real perturbation directions r: (8, K, 4N, B, 2).
+
+    For baseline column b (station pair p<q) the RHS column is built from
+    lhs = Jq (sum_t Ci)^H and fillvex_r = kron(lhs^T, I2)[:, r//2] * phase_r
+    (phase 1 for even r, i for odd r) written into rows {2p, 2p+1} and
+    {2N+2p, 2N+2p+1}; then dJ_r = (Dgrad + eps I)^{-1} AdV_r, with all 8 r
+    solved against one factorization per direction.
+    Reference: Dsolutions_r, calibration_tools.py:778-823.
+    """
+    B = n_stations * (n_stations - 1) // 2
+    K = Cs.shape[0]
+    C5 = jnp.swapaxes(Cs.reshape(K, -1, B, 2, 2, 2), -3, -2)
+    Csum = jnp.sum(C5, axis=1)                          # (K, B, 2, 2, 2)
+    J4 = _jones_blocks_sr(Js, n_stations)
+    p_idx, q_idx = baseline_indices(n_stations)
+    Jq = J4[:, q_idx]
+
+    lhs = creal.einsum("kbuv,kbwv->kbuw", Jq, creal.conj(Csum))  # Jq Csum^H
+
+    # fillvex: M = kron(lhs^T, I2); column m = r//2 has entries
+    # M[2i+u, m] = lhs[m//2, i] * delta_{u, m%2}; odd r multiplies by i.
+    lhs_g = lhs[:, :, _J_OF_R, :, :]                    # (K, B, 8, i, 2)
+    delta = jnp.eye(2, dtype=Cs.dtype)[_V_OF_R]         # (8, 2) over u
+    fv = (lhs_g[:, :, :, None, :, :]                    # (K,B,8,u,i,2)
+          * delta[None, None, :, :, None, None])
+    fv = jnp.where(_ODD_R[None, None, :, None, None, None],
+                   creal.mul_i(fv), fv)
+    # reorder to (B, 8, K, i, u, 2) for the scatter
+    vals = jnp.transpose(fv, (1, 2, 0, 4, 3, 5))
+
+    AdV = jnp.zeros((8, K, 2, n_stations, 2, B, 2), dtype=Cs.dtype)
+    bidx = jnp.arange(B)
+    AdV = AdV.at[:, :, :, p_idx, :, bidx, :].add(vals)
+    AdV = AdV.reshape(8, K, 4 * n_stations, B, 2)
+
+    eps_eye = EPS_SINGULAR * jnp.eye(4 * n_stations, dtype=Cs.dtype)
+
+    def solve_k(Dg_k, rhs_k):
+        # rhs_k: (8, 4N, B, 2) -> one solve with 8B columns
+        A = Dg_k.at[..., 0].add(eps_eye)
+        rhs = jnp.moveaxis(rhs_k, 0, 1).reshape(4 * n_stations, 8 * B, 2)
+        x = creal.solve(A, rhs)
+        return jnp.moveaxis(x.reshape(4 * n_stations, 8, B, 2), 1, 0)
+
+    dJ = jax.vmap(solve_k)(Dgs, jnp.swapaxes(AdV, 0, 1))
+    return jnp.swapaxes(dJ, 0, 1)                       # (8, K, 4N, B, 2)
+
+
+def dsolutions_all(C, J, n_stations, Dgrad):
+    """Complex host-edge wrapper.  Returns (8, K, 4N, B) complex."""
+    dJ = dsolutions_all_sr(creal.split(C), creal.split(J), n_stations,
+                           creal.split(Dgrad))
+    return creal.fuse(np.asarray(dJ))
+
+
+def dsolutions(C, J, n_stations, Dgrad, r):
+    """Single-r variant (reference Dsolutions, calibration_tools.py:680-725).
+    Returns (K, 4N, B) complex."""
+    return dsolutions_all(C, J, n_stations, Dgrad)[r]
+
+
+# ---------------------------------------------------------------------------
+# Residual derivatives dR/dx
+# ---------------------------------------------------------------------------
+
+def _dresiduals_blocks_sr(Cs, Js, n_stations, dJs):
+    """Common core: per-direction fillvex blocks (8, K, B, 2, 2, B, 2)."""
+    B = n_stations * (n_stations - 1) // 2
+    K = Cs.shape[0]
+    C5 = jnp.swapaxes(Cs.reshape(K, -1, B, 2, 2, 2), -3, -2)
+    Csum = jnp.sum(C5, axis=1)
+    J4 = _jones_blocks_sr(Js, n_stations)
+    p_idx, q_idx = baseline_indices(n_stations)
+    Jq = J4[:, q_idx]
+    inner = creal.einsum("kbuv,kbwv->kbuw", Csum, creal.conj(Jq))
+    lhs = -jnp.swapaxes(inner, -3, -2)                  # -(C Jq^H)^T
+
+    # dJ rows {2p, 2p+1} and {2N+2p, 2N+2p+1}: view as (8, K, 2, N, 2, B, 2)
+    dJ6 = dJs.reshape(8, K, 2, n_stations, 2, B, 2)
+    rhs = dJ6[:, :, :, p_idx, :, :, :]                  # (8,K,j,B,u,c,2)
+    # fillvex[2i+u, c] = sum_j lhs[i,j] rhs[j, u, c]
+    return creal.einsum("kbij,rkjbuc->rkbiuc", lhs, rhs)
+
+
+def _selfterm():
+    """addself: dVpq_r at rows 4b + r//2, phase by parity: (8, 4, 2) f32."""
+    sel = np.zeros((8, 4, 2), dtype=np.float32)
+    for r in range(8):
+        sel[r, r // 2, r % 2] = 1.0
+    return jnp.asarray(sel)
+
+
+@partial(jax.jit, static_argnames=("n_stations", "addself"))
+def dresiduals_all_sr(Cs, Js, n_stations, dJs, addself=True):
+    """dR (8, 4B, B, 2): residual derivatives summed over directions k,
+    averaged over B*T.  Reference: Dresiduals_r, calibration_tools.py:1028-1075.
+    """
+    B = n_stations * (n_stations - 1) // 2
+    K = Cs.shape[0]
+    T = Cs.shape[1] // B
+    fv = _dresiduals_blocks_sr(Cs, Js, n_stations, dJs).sum(axis=1)
+    dR = fv.reshape(8, 4 * B, B, 2)
+    if addself:
+        sel = _selfterm() * (K * T)                     # (8, 4, 2)
+        bidx = jnp.arange(B)
+        rows = 4 * bidx[:, None] + jnp.arange(4)[None, :]
+        dR = dR.at[:, rows, bidx[:, None], :].add(sel[:, None, :, :])
+    return dR / (B * T)
+
+
+def dresiduals_all(C, J, n_stations, dJ, addself=True):
+    """Complex host-edge wrapper.  Returns (8, 4B, B) complex."""
+    out = dresiduals_all_sr(creal.split(C), creal.split(J), n_stations,
+                            creal.split(dJ), addself=addself)
+    return creal.fuse(np.asarray(out))
+
+
+@partial(jax.jit, static_argnames=("n_stations", "addself"))
+def dresiduals_all_perdir_sr(Cs, Js, n_stations, dJs, addself=True):
+    """dR (8, K, 4B, B, 2): per-direction variant.
+    Reference: Dresiduals_rk, calibration_tools.py:1129-1176."""
+    B = n_stations * (n_stations - 1) // 2
+    T = Cs.shape[1] // B
+    fv = _dresiduals_blocks_sr(Cs, Js, n_stations, dJs)
+    K = fv.shape[1]
+    dR = fv.reshape(8, K, 4 * B, B, 2)
+    if addself:
+        sel = _selfterm() * T
+        bidx = jnp.arange(B)
+        rows = 4 * bidx[:, None] + jnp.arange(4)[None, :]
+        dR = dR.at[:, :, rows, bidx[:, None], :].add(sel[:, None, None, :, :])
+    return dR / (B * T)
+
+
+def dresiduals_all_perdir(C, J, n_stations, dJ, addself=True):
+    """Complex host-edge wrapper.  Returns (8, K, 4B, B) complex."""
+    out = dresiduals_all_perdir_sr(creal.split(C), creal.split(J), n_stations,
+                                   creal.split(dJ), addself=addself)
+    return creal.fuse(np.asarray(out))
+
+
+def dresiduals(C, J, n_stations, dJ_r, addself, r):
+    """Single-r variant (reference Dresiduals, calibration_tools.py:879-925).
+    ``dJ_r`` is the (K, 4N, B) complex slice for this r.  Returns (4B, B)."""
+    dJ_full = np.zeros((8,) + dJ_r.shape, dJ_r.dtype)
+    dJ_full[r] = dJ_r
+    full = dresiduals_all(C, J, n_stations, dJ_full, addself=False)[r]
+    if addself:
+        B = n_stations * (n_stations - 1) // 2
+        K = C.shape[0]
+        T = C.shape[1] // B
+        sel = creal.fuse(np.asarray(_selfterm()))[r] * (K * T) / (B * T)
+        full = np.asarray(full)
+        for b in range(B):
+            full[4 * b:4 * b + 4, b] += sel
+    return full
+
+
+# ---------------------------------------------------------------------------
+# Log-likelihood-ratio detector
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("n_stations",))
+def log_likelihood_ratio_sr(Rs, Cs, Js, n_stations):
+    """Per-direction LLR (K,): (||r+mu||^2 - ||r||^2) / sigma^2 with
+    mu = Jp C Jq^H per sample and sigma^2 estimated from Stokes V of the
+    residual.  Reference: calibration_tools.py:1181-1223."""
+    R3, C5, B, T, K = _split_samples_sr(Rs, Cs, n_stations)
+    J4 = _jones_blocks_sr(Js, n_stations)
+    p_idx, q_idx = baseline_indices(n_stations)
+    Jp = J4[:, p_idx]
+    Jq = J4[:, q_idx]
+
+    tmp = creal.einsum("kbuv,ktbvw->ktbuw", Jp, C5)
+    mu = creal.einsum("ktbuw,kbxw->ktbux", tmp, creal.conj(Jq))
+
+    sV = 0.5 * (R3[..., 0, 1, :] - R3[..., 1, 0, :])
+    sigma2 = jnp.sum(creal.abs2(sV))
+    rn2 = jnp.sum(creal.abs2(R3))
+    rpmu2 = jnp.sum(creal.abs2(R3[None] + mu), axis=(1, 2, 3, 4))
+    return (rpmu2 - rn2) / (sigma2 + EPS_DIV)
+
+
+def log_likelihood_ratio(R, C, J, n_stations):
+    """Complex host-edge wrapper.  Returns (K,) float32."""
+    return np.asarray(log_likelihood_ratio_sr(
+        creal.split(R), creal.split(C), creal.split(J), n_stations))

@@ -1,0 +1,224 @@
+"""Host-side text-format parsers/writers for the calibration data edge.
+
+Parity targets: ``calibration/calibration_tools.py`` readsolutions (:88),
+read_global_solutions (:122), read_spatial_solutions (:162), read_rho (:470),
+read_skycluster (:488), readuvw/writeuvw (:505-522), readcluster (:1228),
+and the sky/cluster parsing embedded in skytocoherencies (:244-282).
+
+These are pure-numpy, vectorized (no per-line python math on the hot fields),
+and only ever run at the host data edge — device code consumes the arrays.
+"""
+
+import numpy as np
+
+from smartcal_tpu.cal.coherency import SkyArrays
+
+
+def _data_lines(path):
+    with open(path) as fh:
+        return [ln for ln in fh
+                if not ln.startswith("#") and len(ln.strip()) > 0]
+
+
+def parse_sky_model(path):
+    """SAGECal LSM sky model -> dict name -> field array (18 floats):
+    [ra_h, ra_m, ra_s, dec_d, dec_m, dec_s, sI, sQ, sU, sV,
+     sp1, sp2, sp3, RM, eX, eY, eP, f0].
+    Gaussian sources are flagged by a leading 'G' in the name
+    (reference calibration_tools.py:419-422)."""
+    out = {}
+    for ln in _data_lines(path):
+        parts = ln.split()
+        out[parts[0]] = np.asarray([float(x) for x in parts[1:19]],
+                                   dtype=np.float64)
+    return out
+
+
+def parse_cluster_file(path):
+    """Cluster file -> list of (cluster_line_order, [source names]).
+    Format per line: cluster_id hybrid name1 name2 ...
+    (reference calibration_tools.py:253-288)."""
+    return [(i, ln.split()[2:]) for i, ln in enumerate(_data_lines(path))]
+
+
+def build_sky_arrays(sky_path, cluster_path, ra0, dec0):
+    """Parse sky + cluster files into a device-ready SkyArrays.
+
+    The flux column stores log(sI); spectral coefficients pass through.
+    Cluster ids follow cluster-file line order, as in the reference.
+    """
+    S = parse_sky_model(sky_path)
+    clusters = parse_cluster_file(cluster_path)
+    rows, cl_ids, names = [], [], []
+    for cid, snames in clusters:
+        for nm in snames:
+            rows.append(S[nm])
+            cl_ids.append(cid)
+            names.append(nm)
+    info = np.stack(rows)                                  # (S, 18)
+    ra = (info[:, 0] + info[:, 1] / 60. + info[:, 2] / 3600.) \
+        * 360. / 24. * np.pi / 180.
+    dec = (info[:, 3] + info[:, 4] / 60. + info[:, 5] / 3600.) * np.pi / 180.
+
+    # direction cosines (vectorized radectolm)
+    dec0v = np.where((dec0 < 0.0) & (dec >= 0.0), dec0 + 2 * np.pi, dec0)
+    l = np.sin(ra - ra0) * np.cos(dec)
+    m = -(np.cos(ra - ra0) * np.cos(dec) * np.sin(dec0v)
+          - np.cos(dec0v) * np.sin(dec))
+    n = np.sqrt(np.maximum(1.0 - l * l - m * m, 0.0)) - 1.0
+
+    flux_coef = np.stack([np.log(info[:, 6]), info[:, 10],
+                          info[:, 11], info[:, 12]], axis=-1)
+    gauss = info[:, [14, 15, 16]]
+    is_gauss = np.asarray([nm.startswith("G") for nm in names])
+    return SkyArrays(
+        lmn=np.stack([l, m, n], axis=-1), flux_coef=flux_coef,
+        f0=info[:, 17], gauss=gauss, is_gauss=is_gauss,
+        cluster=np.asarray(cl_ids), n_clusters=len(clusters))
+
+
+def read_rho(path, n_clusters):
+    """admm rho file: 'id hybrid rho_spectral rho_spatial' per cluster.
+    Returns (rho_spectral, rho_spatial), each (K,) float32.
+    Reference: calibration_tools.py:470-484."""
+    vals = np.asarray([[float(x) for x in ln.split()[:4]]
+                       for ln in _data_lines(path)], dtype=np.float32)
+    assert vals.shape[0] == n_clusters
+    return vals[:, 2].copy(), vals[:, 3].copy()
+
+
+def write_rho(path, rho_spectral, rho_spatial, hybrid=1):
+    """Inverse of read_rho, format per reference calibenv.py:105-114."""
+    with open(path, "w") as fh:
+        fh.write("# id hybrid rho_spectral rho_spatial\n")
+        for i, (rs, rp) in enumerate(zip(rho_spectral, rho_spatial)):
+            fh.write(f"{i + 1} {hybrid} {float(rs)} {float(rp)}\n")
+
+
+def read_skycluster(path, n_rows):
+    """skylmn table: 'cluster_id l m sI sP' -> (M, 5) float32.
+    Reference: calibration_tools.py:488-502."""
+    vals = np.asarray([[float(x) for x in ln.split()[:5]]
+                       for ln in _data_lines(path)[:n_rows]], dtype=np.float32)
+    return vals
+
+
+def read_uvw_visibilities(path):
+    """Text visibilities: u v w xx.re xx.im xy.re xy.im yx.re yx.im
+    yy.re yy.im -> (XX, XY, YX, YY) complex vectors.
+    Reference: readuvw, calibration_tools.py:505-512."""
+    a = np.loadtxt(path, delimiter=" ")
+    return (a[:, 3] + 1j * a[:, 4], a[:, 5] + 1j * a[:, 6],
+            a[:, 7] + 1j * a[:, 8], a[:, 9] + 1j * a[:, 10])
+
+
+def write_uvw_visibilities(path, XX, XY, YX, YY):
+    """Inverse of read_uvw_visibilities (reference writeuvw, :515-522);
+    writes only the 8 visibility columns, one sample per line."""
+    cols = np.stack([XX.real, XX.imag, XY.real, XY.imag,
+                     YX.real, YX.imag, YY.real, YY.imag], axis=-1)
+    with open(path, "w") as fh:
+        for row in cols:
+            fh.write(" ".join(str(x) for x in row) + "\n")
+
+
+def read_solutions(path):
+    """Per-direction Jones solutions text file -> (freq, J).
+
+    Header: 2 comment lines, then 'freq/MHz BW time N ? K'.  Body: Nt lines
+    of 1+K floats; each block of 8N rows is one timeslot, station n's 8
+    values are (J00.re, J00.im, J01.re, J01.im, J10.re, J10.im, J11.re,
+    J11.im).  Returns J (K, 2*N*Nto, 2) complex64.
+    Reference: readsolutions, calibration_tools.py:88-119."""
+    with open(path) as fh:
+        next(fh)
+        next(fh)
+        meta = next(fh).split()
+        freq = float(meta[0]) * 1e6
+        n_stat = int(meta[3])
+        K = int(meta[5])
+        body = np.loadtxt(fh, dtype=np.float32, ndmin=2)
+    a = body[:, 1:1 + K]
+    nto = a.shape[0] // (8 * n_stat)
+    a = a[:nto * 8 * n_stat].reshape(nto, n_stat, 4, 2, K)
+    c = a[:, :, :, 0, :] + 1j * a[:, :, :, 1, :]          # (Nto, N, 4, K)
+    J = np.transpose(c, (3, 0, 1, 2)).reshape(K, 2 * n_stat * nto, 2)
+    return freq, J.astype(np.complex64)
+
+
+def write_solutions(path, freq, J, n_stat, bw_mhz=0.18, t_min=10.0):
+    """Inverse of read_solutions: J (K, 2*N*Nto, 2) -> text file."""
+    K = J.shape[0]
+    nto = J.shape[1] // (2 * n_stat)
+    c = J.reshape(K, nto, n_stat, 2, 2)                    # [k,t,n,i,j]
+    c = np.transpose(c, (1, 2, 3, 4, 0)).reshape(nto, n_stat, 4, K)
+    vals = np.empty((nto, n_stat, 8, K), dtype=np.float32)
+    vals[:, :, 0::2] = c.real
+    vals[:, :, 1::2] = c.imag
+    flat = vals.reshape(nto * n_stat * 8, K)
+    with open(path, "w") as fh:
+        fh.write("# solutions file (smartcal_tpu)\n")
+        fh.write("# freq(MHz) bandwidth(MHz) time_interval(min) stations"
+                 " clusters effective_clusters\n")
+        fh.write(f"{freq / 1e6} {bw_mhz} {t_min} {n_stat} {K} {K}\n")
+        for i, row in enumerate(flat):
+            fh.write(str(i % (8 * n_stat)) + " "
+                     + " ".join(f"{x:.6e}" for x in row) + "\n")
+
+
+def read_global_solutions(path):
+    """Global Z polynomial solutions -> (N, freq, P, K, Z) with Z shaped
+    (Nto, K, 2*P*N, 2) complex64.
+    Reference: read_global_solutions, calibration_tools.py:122-160."""
+    with open(path) as fh:
+        next(fh)
+        next(fh)
+        meta = next(fh).split()
+        freq = float(meta[0]) * 1e6
+        P = int(meta[1])
+        n_stat = int(meta[2])
+        K = int(meta[4])
+        body = np.loadtxt(fh, dtype=np.float32, ndmin=2)
+    a = body[:, 1:1 + K]
+    blk = 8 * P * n_stat
+    nto = a.shape[0] // blk
+    a = a[:nto * blk].reshape(nto, blk, K)
+    c = a[:, 0::2, :] + 1j * a[:, 1::2, :]                # (Nto, 4PN, K)
+    half = 2 * P * n_stat
+    Z = np.empty((nto, K, half, 2), dtype=np.complex64)
+    Z[..., 0] = np.transpose(c[:, :half, :], (0, 2, 1))
+    Z[..., 1] = np.transpose(c[:, half:, :], (0, 2, 1))
+    return n_stat, freq, P, K, Z
+
+
+def read_spatial_solutions(path):
+    """Spatial (spherical-harmonic) Z solutions -> (N, F, thetak, phik, Z)
+    with Z shaped (Nto, 2*F*N, 2*G) complex64.
+    Reference: read_spatial_solutions, calibration_tools.py:162-211."""
+    with open(path) as fh:
+        next(fh)
+        next(fh)
+        next(fh)
+        meta = next(fh).split()
+        F = int(meta[1])
+        G = int(meta[2])
+        n_stat = int(meta[3])
+        thetak = [float(x) for x in next(fh).split()]
+        phik = [float(x) for x in next(fh).split()]
+        body = np.loadtxt(fh, dtype=np.float32, ndmin=2)
+    a = body[:, 1:1 + G]
+    blk = 8 * F * n_stat
+    nto = a.shape[0] // blk
+    a = a[:nto * blk].reshape(nto, blk, G)
+    c = a[:, 0::2, :] + 1j * a[:, 1::2, :]                # (Nto, 4FN, G)
+    half = 2 * F * n_stat
+    Z = np.empty((nto, half, 2 * G), dtype=np.complex64)
+    Z[:, :, 0::2] = c[:, :half, :]
+    Z[:, :, 1::2] = c[:, half:, :]
+    return n_stat, F, thetak, phik, Z
+
+
+def read_cluster_lines(path):
+    """Cluster file -> {order: raw line} for later regeneration of reduced
+    cluster files.  Reference: readcluster, calibration_tools.py:1228-1249."""
+    return {i: ln for i, ln in enumerate(_data_lines(path))}

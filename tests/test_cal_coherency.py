@@ -1,0 +1,201 @@
+"""Tests for coherency prediction, coordinates, and the text data edge."""
+
+import math
+
+import numpy as np
+
+from smartcal_tpu.cal import coherency, coords, skyio
+
+
+def _loop_predict(uu, vv, ww, sky, freq, smear=False, fdelta=180e3):
+    """Per-source loop oracle of the documented prediction math."""
+    scale = 2 * math.pi * freq / coherency.C_LIGHT
+    uu = np.asarray(uu) * scale
+    vv = np.asarray(vv) * scale
+    ww = np.asarray(ww) * scale
+    K = sky.n_clusters
+    C = np.zeros((K, len(uu), 4), np.complex64)
+    for s in range(sky.lmn.shape[0]):
+        l, m, n = np.asarray(sky.lmn[s])
+        coef = np.asarray(sky.flux_coef[s])
+        fr = math.log(freq / float(sky.f0[s]))
+        si = math.exp(coef[0] + coef[1] * fr + coef[2] * fr ** 2
+                      + coef[3] * fr ** 3)
+        phase = uu * l + vv * m + ww * n
+        amp = si * np.ones_like(phase)
+        if smear:
+            amp = amp * np.abs(np.sinc(phase * 0.5 * (fdelta / freq) / np.pi))
+        if bool(sky.is_gauss[s]):
+            # reference quirk: acos of the n-excess (calibration_tools.py:436)
+            phi = -math.acos(n)
+            xi = -math.atan2(-l, m)
+            eX, eY, eP = np.asarray(sky.gauss[s])
+            uup = uu * math.cos(xi) - vv * math.cos(phi) * math.sin(xi) \
+                + ww * math.sin(phi) * math.sin(xi)
+            vvp = uu * math.sin(xi) + vv * math.cos(phi) * math.cos(xi) \
+                - ww * math.sin(phi) * math.cos(xi)
+            uut = 2 * eX * (math.cos(eP) * uup - math.sin(eP) * vvp)
+            vvt = 2 * eY * (math.sin(eP) * uup + math.cos(eP) * vvp)
+            amp = amp * 0.5 * math.pi * np.exp(-(uut ** 2 + vvt ** 2))
+        xx = amp * (np.cos(phase) + 1j * np.sin(phase))
+        C[int(sky.cluster[s]), :, 0] += xx
+    C[:, :, 3] = C[:, :, 0]
+    return C
+
+
+def _random_sky(rng, n_src=6, n_clusters=2, gauss=False):
+    lm = rng.uniform(-0.05, 0.05, size=(n_src, 2))
+    n = np.sqrt(1 - (lm ** 2).sum(-1)) - 1
+    lmn = np.concatenate([lm, n[:, None]], axis=-1)
+    flux = np.stack([np.log(rng.uniform(1, 10, n_src)),
+                     rng.uniform(-1, 0, n_src),
+                     rng.uniform(-0.1, 0.1, n_src),
+                     np.zeros(n_src)], axis=-1)
+    g = np.zeros((n_src, 3))
+    isg = np.zeros(n_src, bool)
+    if gauss:
+        isg[::2] = True
+        g[:, 0] = rng.uniform(1e-4, 1e-3, n_src)
+        g[:, 1] = rng.uniform(1e-4, 1e-3, n_src)
+        g[:, 2] = rng.uniform(0, np.pi, n_src)
+    return coherency.SkyArrays(
+        lmn=lmn, flux_coef=flux, f0=np.full(n_src, 150e6), gauss=g,
+        is_gauss=isg, cluster=rng.integers(0, n_clusters, n_src),
+        n_clusters=n_clusters)
+
+
+class TestPredict:
+    def test_point_sources_match_oracle(self, rng):
+        sky = _random_sky(rng)
+        uu, vv, ww = (rng.uniform(-500, 500, 20) for _ in range(3))
+        got = np.asarray(coherency.predict_coherencies(uu, vv, ww, sky, 140e6))
+        want = _loop_predict(uu, vv, ww, sky, 140e6)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_gaussian_and_smearing(self, rng):
+        sky = _random_sky(rng, gauss=True)
+        uu, vv, ww = (rng.uniform(-500, 500, 16) for _ in range(3))
+        got = np.asarray(coherency.predict_coherencies(
+            uu, vv, ww, sky, 140e6, smear=True))
+        want = _loop_predict(uu, vv, ww, sky, 140e6, smear=True)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_cross_pols_zero(self, rng):
+        sky = _random_sky(rng)
+        uu, vv, ww = (rng.uniform(-500, 500, 8) for _ in range(3))
+        C = np.asarray(coherency.predict_coherencies(uu, vv, ww, sky, 140e6))
+        assert np.all(C[:, :, 1] == 0) and np.all(C[:, :, 2] == 0)
+
+
+class TestCoords:
+    def test_lm_roundtrip(self, rng):
+        """lmtoradec keeps the reference's RA sign convention: it mirrors l
+        (calibration_tools.py:36 uses atan2(-l, ...)), so a roundtrip
+        through radectolm returns (-l, m)."""
+        ra0, dec0 = 1.0, 0.7
+        ra = ra0 + rng.uniform(-0.02, 0.02, 10)
+        dec = dec0 + rng.uniform(-0.02, 0.02, 10)
+        l, m, _ = coords.radectolm(ra, dec, ra0, dec0)
+        ra2, dec2 = coords.lmtoradec(l, m, ra0, dec0)
+        l2, m2, _ = coords.radectolm(ra2, dec2, ra0, dec0)
+        np.testing.assert_allclose(np.asarray(l2), -np.asarray(l), atol=1e-5)
+        # m only roundtrips to the small-field approximation error
+        np.testing.assert_allclose(np.asarray(m2), np.asarray(m), atol=5e-4)
+        np.testing.assert_allclose(np.asarray(dec2), dec, atol=1e-3)
+
+    def test_sexagesimal_roundtrip(self):
+        for rad in [0.3, 1.9, 5.0]:
+            h, m, s = coords.rad_to_ra(rad)
+            assert abs(coords.hms_to_rad(h, m, s) - rad) < 1e-9
+        for rad in [-0.5, 0.2, 1.2]:
+            d, m, s = coords.rad_to_dec(rad)
+            assert abs(coords.dms_to_rad(d, m, s) - rad) < 1e-9
+
+    def test_separation_zero_and_known(self):
+        assert float(coords.angular_separation(1.0, 0.5, 1.0, 0.5)) < 1e-7
+        # pole to equator = pi/2
+        sep = float(coords.angular_separation(0.0, np.pi / 2, 0.0, 0.0))
+        np.testing.assert_allclose(sep, np.pi / 2, rtol=1e-6)
+
+    def test_azel_zenith(self):
+        # source at dec=lat, ha=0 is at zenith
+        lat = 0.9
+        _, el = coords.azel_from_radec(1.0, lat, 1.0, lat)
+        np.testing.assert_allclose(float(el), np.pi / 2, atol=1e-5)
+
+
+class TestSkyIO:
+    def test_sky_cluster_parse_and_build(self, tmp_path, rng):
+        sky = tmp_path / "sky.txt"
+        sky.write_text(
+            "# name h m s d m s sI sQ sU sV sp1 sp2 sp3 RM eX eY eP f0\n"
+            "P1 1 2 3.0 45 10 5.0 2.5 0 0 0 -0.7 0 0 0 0 0 0 150e6\n"
+            "GS1 1 3 4.0 44 20 6.0 1.5 0 0 0 -0.5 0.1 0 0 1e-3 2e-3 0.3 150e6\n"
+            "P2 0 59 0.0 45 0 0.0 4.0 0 0 0 0 0 0 0 0 0 0 140e6\n")
+        clus = tmp_path / "cluster.txt"
+        clus.write_text("# clusters\n1 1 P1 GS1\n3 1 P2\n")
+        ra0 = coords.hms_to_rad(1, 0, 0)
+        dec0 = coords.dms_to_rad(45, 0, 0)
+        arr = skyio.build_sky_arrays(str(sky), str(clus), ra0, dec0)
+        assert arr.n_clusters == 2
+        assert list(np.asarray(arr.cluster)) == [0, 0, 1]
+        assert list(np.asarray(arr.is_gauss)) == [False, True, False]
+        np.testing.assert_allclose(
+            np.asarray(arr.flux_coef[0, 0]), np.log(2.5), rtol=1e-6)
+        # lmn magnitudes are small for near-center sources
+        assert np.all(np.abs(np.asarray(arr.lmn)[:, :2]) < 0.05)
+
+    def test_rho_roundtrip(self, tmp_path):
+        path = tmp_path / "rho.txt"
+        rs = np.asarray([1.5, 20.0, 3.25], np.float32)
+        rp = np.asarray([0.075, 1.0, 0.1625], np.float32)
+        skyio.write_rho(str(path), rs, rp)
+        rs2, rp2 = skyio.read_rho(str(path), 3)
+        np.testing.assert_allclose(rs2, rs)
+        np.testing.assert_allclose(rp2, rp)
+
+    def test_solutions_roundtrip(self, rng):
+        K, N, Nto = 2, 3, 2
+        J = (rng.standard_normal((K, 2 * N * Nto, 2))
+             + 1j * rng.standard_normal((K, 2 * N * Nto, 2))
+             ).astype(np.complex64)
+        import tempfile, os
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "sols.txt")
+            skyio.write_solutions(p, 150e6, J, N)
+            freq, J2 = skyio.read_solutions(p)
+        assert freq == 150e6
+        np.testing.assert_allclose(J2, J, rtol=1e-5, atol=1e-5)
+
+    def test_uvw_visibility_roundtrip(self, tmp_path, rng):
+        T = 12
+        vis = [rng.standard_normal(T) + 1j * rng.standard_normal(T)
+               for _ in range(4)]
+        path = tmp_path / "vis.txt"
+        skyio.write_uvw_visibilities(str(path), *vis)
+        # pad u,v,w columns so read (which expects 11 cols) works
+        lines = path.read_text().strip().split("\n")
+        path.write_text("\n".join("0 0 0 " + ln for ln in lines) + "\n")
+        back = skyio.read_uvw_visibilities(str(path))
+        for a, b in zip(back, vis):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_global_solutions_parse(self, tmp_path, rng):
+        # synthesize a zsol-format file: P=2, N=2, K=2, Nto=1
+        P, N, K, Nto = 2, 2, 2, 1
+        vals = rng.standard_normal((8 * P * N * Nto, K)).astype(np.float32)
+        lines = ["# zsol", "# header",
+                 f"150.0 {P} {N} {K} {K}"]
+        for i, row in enumerate(vals):
+            lines.append(f"{i % (8 * P * N)} " + " ".join(map(str, row)))
+        p = tmp_path / "zsol"
+        p.write_text("\n".join(lines) + "\n")
+        n_stat, freq, P2, K2, Z = skyio.read_global_solutions(str(p))
+        assert (n_stat, P2, K2) == (N, P, K)
+        assert freq == 150e6
+        assert Z.shape == (Nto, K, 2 * P * N, 2)
+        # spot-check the column-major complex packing of direction 0
+        b = vals[:, 0]
+        c = b[0::2] + 1j * b[1::2]
+        np.testing.assert_allclose(Z[0, 0, :, 0], c[:2 * P * N], rtol=1e-6)
+        np.testing.assert_allclose(Z[0, 0, :, 1], c[2 * P * N:], rtol=1e-6)

@@ -1,0 +1,259 @@
+"""Golden tests for the calibration math layer.
+
+Each batched kernel in smartcal_tpu.cal.kernels is checked against a
+straightforward per-sample loop oracle implementing the documented math
+(SURVEY.md section 2.1; the reference's numpy/torch twins are the spec).
+Sizes are tiny (N=4 stations, T=2, K=2) so the oracles stay fast.
+"""
+
+import numpy as np
+import pytest
+
+from smartcal_tpu.cal import consensus, kernels
+
+
+def _mk_problem(rng, N=4, T=2, K=2):
+    B = N * (N - 1) // 2
+    R = (rng.standard_normal((2 * B * T, 2))
+         + 1j * rng.standard_normal((2 * B * T, 2))).astype(np.complex64)
+    C = (rng.standard_normal((K, B * T, 4))
+         + 1j * rng.standard_normal((K, B * T, 4))).astype(np.complex64)
+    J = (rng.standard_normal((K, 2 * N, 2))
+         + 1j * rng.standard_normal((K, 2 * N, 2))).astype(np.complex64)
+    return R, C, J, B, T, K
+
+
+def _pairs(N):
+    return [(p, q) for p in range(N - 1) for q in range(p + 1, N)]
+
+
+def _ci(C, k, ck):
+    return C[k, ck, :].reshape(2, 2, order="F")
+
+
+def _dvpq(r):
+    v = np.zeros(4, np.complex64)
+    v[r // 2] = 1j if r % 2 else 1.0
+    return v
+
+
+def golden_hessian(R, C, J, N):
+    B = N * (N - 1) // 2
+    T = R.shape[0] // (2 * B)
+    K = C.shape[0]
+    H = np.zeros((K, 4 * N, 4 * N), np.complex64)
+    I2 = np.eye(2)
+    for k in range(K):
+        ck = 0
+        for _t in range(T):
+            for p, q in _pairs(N):
+                res = R[2 * ck:2 * ck + 2, :]
+                ci = _ci(C, k, ck)
+                off = np.kron(-ci.conj(), res)
+                H[k, 4 * p:4 * p + 4, 4 * q:4 * q + 4] += off
+                H[k, 4 * q:4 * q + 4, 4 * p:4 * p + 4] += off.conj().T
+                a1 = ci @ J[k, 2 * q:2 * q + 2, :].conj().T
+                H[k, 4 * p:4 * p + 4, 4 * p:4 * p + 4] += np.kron(
+                    (a1 @ a1.conj().T).T, I2)
+                a2 = J[k, 2 * p:2 * p + 2, :] @ ci
+                H[k, 4 * q:4 * q + 4, 4 * q:4 * q + 4] += np.kron(
+                    (a2.conj().T @ a2).T, I2)
+                ck += 1
+    return H / (B * T)
+
+
+def golden_dsolutions(C, J, N, Dgrad, r):
+    B = N * (N - 1) // 2
+    T = C.shape[1] // B
+    K = C.shape[0]
+    dvpq = _dvpq(r)
+    dJ = np.zeros((K, 4 * N, B), np.complex64)
+    for k in range(K):
+        adv = np.zeros((4 * N, B), np.complex64)
+        ck = 0
+        for _t in range(T):
+            for bi, (p, q) in enumerate(_pairs(N)):
+                ci = _ci(C, k, ck)
+                lhs = J[k, 2 * q:2 * q + 2, :] @ ci.conj().T
+                fv = np.kron(lhs.T, np.eye(2)) @ dvpq
+                adv[2 * p:2 * p + 2, bi] += fv[0:2]
+                adv[2 * N + 2 * p:2 * N + 2 * p + 2, bi] += fv[2:4]
+                ck += 1
+        dJ[k] = np.linalg.solve(
+            Dgrad[k] + kernels.EPS_SINGULAR * np.eye(4 * N), adv)
+    return dJ
+
+
+def golden_dresiduals(C, J, N, dJ, addself, r):
+    B = N * (N - 1) // 2
+    T = C.shape[1] // B
+    K = C.shape[0]
+    dvpq = _dvpq(r)
+    dR = np.zeros((4 * B, B), np.complex64)
+    for k in range(K):
+        ck = 0
+        for _t in range(T):
+            for bi, (p, q) in enumerate(_pairs(N)):
+                ci = _ci(C, k, ck)
+                lhs = -(ci @ J[k, 2 * q:2 * q + 2, :].conj().T).T
+                rhs = np.concatenate(
+                    [dJ[k, 2 * p:2 * p + 2, :],
+                     dJ[k, 2 * N + 2 * p:2 * N + 2 * p + 2, :]])
+                fv = np.kron(lhs, np.eye(2)) @ rhs
+                if addself:
+                    fv[:, bi] += dvpq
+                dR[4 * bi:4 * bi + 4, :] += fv
+                ck += 1
+    return dR / (B * T)
+
+
+def golden_llr(R, C, J, N):
+    B = N * (N - 1) // 2
+    T = R.shape[0] // (2 * B)
+    K = C.shape[0]
+    out = np.zeros(K, np.float32)
+    for k in range(K):
+        ck = 0
+        sigma2 = 0.0
+        rv = np.zeros(B * T * 4, np.complex64)
+        mv = np.zeros(B * T * 4, np.complex64)
+        for _t in range(T):
+            for p, q in _pairs(N):
+                res = R[2 * ck:2 * ck + 2, :]
+                sV = 0.5 * (res[0, 1] - res[1, 0])
+                sigma2 += float(np.real(sV * np.conj(sV)))
+                ci = _ci(C, k, ck)
+                model = J[k, 2 * p:2 * p + 2, :] @ ci \
+                    @ J[k, 2 * q:2 * q + 2, :].conj().T
+                rv[4 * ck:4 * ck + 4] = res.ravel()
+                mv[4 * ck:4 * ck + 4] = model.ravel()
+                ck += 1
+        out[k] = (-np.linalg.norm(rv) ** 2 + np.linalg.norm(rv + mv) ** 2) \
+            / (sigma2 + kernels.EPS_DIV)
+    return out
+
+
+class TestHessianRes:
+    def test_matches_loop_oracle(self, rng):
+        R, C, J, B, T, K = _mk_problem(rng)
+        got = np.asarray(kernels.hessian_res(R, C, J, 4))
+        want = golden_hessian(R, C, J, 4)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_hermitian_diag_blocks(self, rng):
+        R, C, J, *_ = _mk_problem(rng, N=3, T=1, K=1)
+        H = np.asarray(kernels.hessian_res(R, C, J, 3))
+        for p in range(3):
+            blk = H[0, 4 * p:4 * p + 4, 4 * p:4 * p + 4]
+            np.testing.assert_allclose(blk, blk.conj().T, atol=1e-5)
+
+
+class TestDsolutions:
+    def test_all_r_match_loop_oracle(self, rng):
+        N = 4
+        R, C, J, B, T, K = _mk_problem(rng, N=N)
+        Dgrad = golden_hessian(R, C, J, N) \
+            + 0.5 * np.eye(4 * N, dtype=np.complex64)[None]
+        got = np.asarray(kernels.dsolutions_all(C, J, N, Dgrad))
+        for r in range(8):
+            want = golden_dsolutions(C, J, N, Dgrad, r)
+            np.testing.assert_allclose(got[r], want, rtol=1e-3, atol=1e-4,
+                                       err_msg=f"r={r}")
+
+    def test_single_r_wrapper(self, rng):
+        N = 3
+        R, C, J, *_ = _mk_problem(rng, N=N, T=1, K=1)
+        Dgrad = golden_hessian(R, C, J, N) \
+            + 0.5 * np.eye(4 * N, dtype=np.complex64)[None]
+        full = np.asarray(kernels.dsolutions_all(C, J, N, Dgrad))
+        one = np.asarray(kernels.dsolutions(C, J, N, Dgrad, 3))
+        np.testing.assert_allclose(one, full[3], atol=1e-6)
+
+
+class TestDresiduals:
+    @pytest.mark.parametrize("addself", [False, True])
+    def test_all_r_match_loop_oracle(self, rng, addself):
+        N = 4
+        R, C, J, B, T, K = _mk_problem(rng, N=N)
+        Dgrad = golden_hessian(R, C, J, N) \
+            + 0.5 * np.eye(4 * N, dtype=np.complex64)[None]
+        dJ = np.asarray(kernels.dsolutions_all(C, J, N, Dgrad))
+        got = np.asarray(kernels.dresiduals_all(C, J, N, dJ, addself=addself))
+        for r in range(8):
+            want = golden_dresiduals(C, J, N, dJ[r], addself, r)
+            np.testing.assert_allclose(got[r], want, rtol=1e-3, atol=1e-4,
+                                       err_msg=f"r={r}")
+
+    def test_perdir_sums_to_total(self, rng):
+        N = 4
+        R, C, J, *_ = _mk_problem(rng, N=N)
+        Dgrad = golden_hessian(R, C, J, N) \
+            + 0.5 * np.eye(4 * N, dtype=np.complex64)[None]
+        dJ = np.asarray(kernels.dsolutions_all(C, J, N, Dgrad))
+        total = np.asarray(kernels.dresiduals_all(C, J, N, dJ, addself=True))
+        perdir = np.asarray(
+            kernels.dresiduals_all_perdir(C, J, N, dJ, addself=True))
+        np.testing.assert_allclose(perdir.sum(axis=1), total,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestLLR:
+    def test_matches_loop_oracle(self, rng):
+        R, C, J, *_ = _mk_problem(rng)
+        got = np.asarray(kernels.log_likelihood_ratio(R, C, J, 4))
+        want = golden_llr(R, C, J, 4)
+        np.testing.assert_allclose(got, want, rtol=1e-3)
+
+    def test_perfect_model_positive(self, rng):
+        """If residual contains the model, LLR should be large/positive."""
+        N, T, K = 3, 2, 1
+        B = N * (N - 1) // 2
+        C = (rng.standard_normal((K, B * T, 4))
+             + 1j * rng.standard_normal((K, B * T, 4))).astype(np.complex64)
+        J = np.tile(np.eye(2, dtype=np.complex64), (K, N, 1))
+        R = np.zeros((2 * B * T, 2), np.complex64)
+        for ck in range(B * T):
+            R[2 * ck:2 * ck + 2, :] = C[0, ck].reshape(2, 2, order="F") \
+                + 0.01 * rng.standard_normal((2, 2))
+        llr = np.asarray(kernels.log_likelihood_ratio(R, C, J, N))
+        assert llr[0] > 0
+
+
+class TestConsensusPoly:
+    def golden(self, Ne, N, freqs, f0, fidx, polytype, rho, alpha):
+        Nf = len(freqs)
+        Bfull = np.zeros((Nf, Ne), np.float32)
+        if polytype == 0:
+            Bfull[:, 0] = 1.0
+            ff = (freqs - f0) / f0
+            for cj in range(1, Ne):
+                Bfull[:, cj] = ff ** cj
+        else:
+            ff = (freqs - freqs.min()) / (freqs.max() - freqs.min())
+            from math import comb
+            for r in range(Ne):
+                Bfull[:, r] = comb(Ne - 1, r) * ff ** r \
+                    * (1 - ff) ** (Ne - 1 - r)
+        Bi = np.zeros((Ne, Ne), np.float32)
+        for cf in range(Nf):
+            Bi += np.outer(Bfull[cf], Bfull[cf])
+        Bi = np.linalg.pinv(rho * Bi + alpha * np.eye(Ne))
+        Bf = np.kron(Bfull[fidx], np.eye(2 * N))
+        P = np.kron(Bi, np.eye(2 * N)) @ Bf.T
+        F = np.eye(2 * N) - rho * (Bf @ P)
+        return F, P
+
+    @pytest.mark.parametrize("polytype", [0, 1])
+    def test_matches_dense_oracle(self, polytype):
+        freqs = np.linspace(120e6, 160e6, 5).astype(np.float32)
+        Ne, N, f0, fidx = 3, 2, 140e6, 2
+        F, P = consensus.consensus_poly(Ne, N, freqs, f0, fidx,
+                                        polytype=polytype, rho=0.7, alpha=0.1)
+        Fg, Pg = self.golden(Ne, N, freqs, f0, fidx, polytype, 0.7, 0.1)
+        np.testing.assert_allclose(np.asarray(F), Fg, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(P), Pg, rtol=1e-4, atol=1e-5)
+
+    def test_bernstein_partition_of_unity(self):
+        x = np.linspace(0, 1, 7).astype(np.float32)
+        y = np.asarray(consensus.bernstein_basis(x, 4))
+        np.testing.assert_allclose(y.sum(axis=1), np.ones(7), rtol=1e-5)
