@@ -33,20 +33,27 @@ def bernstein_basis(x, n):
     return jnp.exp(logc)[None, :] * px * p1x
 
 
-def poly_basis(freqs, f0, n_terms, polytype=0):
+def poly_basis(freqs, f0, n_terms, polytype=0, frange=None):
     """Frequency basis B (Nf x Ne): ordinary ((f-f0)/f0)^j or Bernstein.
-    Reference: calibration_tools.py:559-568."""
+    Reference: calibration_tools.py:559-568.
+
+    ``frange``: (fmin, fmax) normalization interval for the Bernstein basis.
+    REQUIRED when ``freqs`` is a local shard of a distributed frequency axis
+    — the default (local min/max) would give each shard a different basis,
+    corrupting any cross-shard consensus reduction."""
     freqs = jnp.asarray(freqs, jnp.float32)
     if polytype == 0:
         ff = (freqs - f0) / f0
         j = jnp.arange(n_terms, dtype=jnp.float32)
         return ff[:, None] ** j[None, :]
-    ff = (freqs - freqs.min()) / (freqs.max() - freqs.min())
+    fmin, fmax = frange if frange is not None else (freqs.min(), freqs.max())
+    ff = (freqs - fmin) / (fmax - fmin)
     return bernstein_basis(ff, n_terms - 1)
 
 
 @partial(jax.jit, static_argnames=("n_terms", "polytype"))
-def consensus_cores(freqs, f0, n_terms, polytype=0, rho=0.0, alpha=0.0):
+def consensus_cores(freqs, f0, n_terms, polytype=0, rho=0.0, alpha=0.0,
+                    frange=None):
     """Small-core form of the consensus constraint.
 
     Returns (Bfull, Bi, fscale) where
@@ -56,7 +63,7 @@ def consensus_cores(freqs, f0, n_terms, polytype=0, rho=0.0, alpha=0.0):
         that the reference's dense F = fscale * I_2N encodes
         (calibration_tools.py:578-583 notes F "is diagonal scalar").
     """
-    bfull = poly_basis(freqs, f0, n_terms, polytype)
+    bfull = poly_basis(freqs, f0, n_terms, polytype, frange=frange)
     bi_raw = rho * (bfull.T @ bfull) + alpha * jnp.eye(n_terms)
     bi = jnp.linalg.pinv(bi_raw)
     fscale = 1.0 - rho * jnp.einsum("fi,ij,fj->f", bfull, bi, bfull)

@@ -63,7 +63,11 @@ def rad_to_ra(rad):
 
 
 def rad_to_dec(rad):
-    """Radians -> (deg, min, sec).  Reference: calibration_tools.py:64-84."""
+    """Radians -> (deg, min, sec).  Reference: calibration_tools.py:64-84.
+
+    Deviation from the reference: for declinations in (-1, 0) deg the
+    reference's ``mult*(deg%180)`` loses the sign (deg==0); here the sign is
+    carried by the first nonzero field so ``dms_to_rad`` round-trips."""
     rad = float(rad)
     mult = -1 if rad < 0 else 1
     v = abs(rad) * 180.0 / np.pi
@@ -71,7 +75,10 @@ def rad_to_dec(rad):
     v = (v - deg) * 60
     mins = int(np.floor(v))
     sec = (v - mins) * 60
-    return mult * (deg % 180), mins % 60, sec
+    deg, mins = deg % 180, mins % 60
+    if mult < 0 and deg == 0:
+        return 0, -mins, -sec if mins == 0 else sec
+    return mult * deg, mins, sec
 
 
 def hms_to_rad(h, m, s):
@@ -80,9 +87,12 @@ def hms_to_rad(h, m, s):
 
 
 def dms_to_rad(d, m, s):
-    """(deg, min, sec) -> radians (Dec convention).  Sign carried by d."""
-    sign = -1.0 if d < 0 else 1.0
-    return sign * (abs(d) + m / 60.0 + s / 3600.0) * np.pi / 180.0
+    """(deg, min, sec) -> radians (Dec convention).  Sign carried by the
+    first nonzero field (see rad_to_dec for the |dec| < 1 deg case)."""
+    neg = (np.signbit(d) or (d == 0 and (np.signbit(m)
+                                         or (m == 0 and np.signbit(s)))))
+    sign = -1.0 if neg else 1.0
+    return sign * (abs(d) + abs(m) / 60.0 + abs(s) / 3600.0) * np.pi / 180.0
 
 
 def angular_separation(ra1, dec1, ra2, dec2):

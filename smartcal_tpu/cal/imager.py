@@ -1,0 +1,90 @@
+"""Dirty imaging: visibilities -> sky image.
+
+In-framework replacement for the reference's external ``excon`` imager
+(C++, invoked at ``calibration/dosimul.sh:29``, ``docal.sh:15``,
+``doinfluence.sh:8``) and the ``calmean.sh`` FITS averaging script.  The
+RL envs only consume small dirty images (128x128) and their noise
+statistics (``calibenv.py:148-166``), so a deconvolution-free imager is the
+whole requirement.
+
+TPU-first design: instead of scatter-add uv gridding + FFT (sequential
+scatter, complex dtypes), the image is a DIRECT DFT onto the pixel grid —
+two real matmuls of shape (npix^2, nvis): exactly the large, batched,
+bf16-able contraction the MXU is built for, with no complex lowering and no
+data-dependent gather/scatter.  At the envs' scales (~1e4 pixels x ~1e5
+visibilities) this is a few GFLOP — microseconds on the MXU, far below the
+host cost the reference pays to shell out and read FITS back.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+C_LIGHT = 2.99792458e8
+
+
+def pixel_grid(npix, cell):
+    """(npix^2, 2) direction cosines (l, m) of the image pixels; row-major
+    with m varying fastest; centered, north up (m increasing)."""
+    half = npix // 2
+    idx = (jnp.arange(npix) - half).astype(jnp.float32) * cell
+    ll, mm = jnp.meshgrid(idx, idx, indexing="ij")
+    return jnp.stack([ll.ravel(), mm.ravel()], axis=-1)
+
+
+def default_cell(uvw, freq, oversample=3.0):
+    """Pixel size (rad) from the longest projected baseline:
+    cell = 1 / (oversample * 2 * max|uv|_wavelengths)."""
+    uv = np.asarray(uvw)[..., :2] * (float(freq) / C_LIGHT)
+    umax = float(np.max(np.abs(uv)))
+    return 1.0 / (oversample * 2.0 * max(umax, 1.0))
+
+
+@partial(jax.jit, static_argnames=("npix",))
+def dirty_image_sr(uvw, vis, freq, cell, npix=128):
+    """Dirty image (npix, npix) from split-real Stokes visibilities.
+
+    uvw : (R, 3) meters;  vis : (R, 2) split-real complex samples
+    I(l, m) = mean_r Re( V_r exp(i phase) ),  phase = scale (u l + v m)
+    """
+    scale = 2.0 * jnp.pi * freq / C_LIGHT
+    uv = uvw[:, :2] * scale                                # (R, 2)
+    lm = pixel_grid(npix, cell)                            # (P, 2)
+    phase = lm @ uv.T                                      # (P, R) matmul 1
+    # Re(V conj(exp(i phase))): the prediction direction is V ~ exp(+i phase)
+    # (cal/coherency._predict), so imaging applies the conjugate kernel
+    re = jnp.cos(phase) @ vis[:, 0] + jnp.sin(phase) @ vis[:, 1]  # matmul 2
+    img = re / vis.shape[0]
+    return img.reshape(npix, npix)
+
+
+def stokes_i_vis(V):
+    """(T, B, 2, 2, 2) full-pol solver visibilities -> (T*B, 2) Stokes I."""
+    sI = 0.5 * (V[..., 0, 0, :] + V[..., 1, 1, :])
+    return sI.reshape(-1, 2)
+
+
+@partial(jax.jit, static_argnames=("npix",))
+def image_observation_sr(uvw, V, freq, cell, npix=128):
+    """Dirty Stokes-I image of solver-convention visibilities
+    (uvw (T, B, 3), V (T, B, 2, 2, 2))."""
+    return dirty_image_sr(uvw.reshape(-1, 3), stokes_i_vis(V), freq, cell,
+                          npix=npix)
+
+
+def multifreq_image_sr(uvw, V_list, freqs, cell, npix=128):
+    """Average dirty image over frequency sub-bands (the role of
+    ``calmean.sh``'s weighted FITS mean, calibration/calmean.sh:1-100).
+    V_list: (Nf, T, B, 2, 2, 2); uvw shared across sub-bands (meters)."""
+    imgs = jax.vmap(
+        lambda v, f: image_observation_sr(uvw, v, f, cell, npix=npix)
+    )(V_list, jnp.asarray(freqs))
+    return jnp.mean(imgs, axis=0)
+
+
+def image_noise_std(img):
+    """sigma of an image, the env observation statistic
+    (calibenv.py:148-166 reads np.std of FITS data)."""
+    return jnp.std(img)

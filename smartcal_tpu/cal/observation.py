@@ -1,0 +1,207 @@
+"""Synthetic interferometer observations: array geometry -> uvw tracks.
+
+In-framework replacement for the reference's external observation machinery:
+``makems`` + casacore MS tables + LOFAR ANTENNA fixtures
+(``calibration/generate_data.py:930-1000`` creates an MS with makems and
+patches its FIELD table; ``find_valid_target`` at ``generate_data.py:50-105``
+uses casacore ``measures`` to draw a target above the horizon).  Here the
+whole chain is pure math on arrays: a LOFAR-like station layout, earth
+rotation synthesis for uvw, and spherical-astronomy elevation checks
+(see cal/coords.py) — no MS on disk, no C++ dependency in the hot path.
+
+Conventions (match cal/kernels.py): B = N(N-1)/2 baselines enumerating
+p < q row-major; visibility samples are time-major ck = t*B + b.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from smartcal_tpu.cal import coords
+
+# LOFAR core reference position (superterp), public ITRF values (m) —
+# reference generate_data.py:34-37 (X0, Y0, Z0).
+LOFAR_X0 = 3826896.235
+LOFAR_Y0 = 460979.455
+LOFAR_Z0 = 5064658.203
+LOFAR_LAT = 0.923717  # rad (~52.92 deg), derived from the ITRF position
+OMEGA_EARTH = 7.2921159e-5  # rad/s (sidereal)
+
+# frequency bands (MHz), reference generate_data.py:40-44
+LBA_LOW, LBA_HIGH = 30.0, 70.0
+HBA_LOW, HBA_HIGH = 110.0, 180.0
+
+# approx A-team J2000 coordinates (rad): CasA, CygA, HerA, TauA, VirA —
+# reference generate_data.py:59 (a_team_dirs)
+ATEAM_DIRS = np.asarray([
+    (6.123273, 1.026748),   # CasA
+    (5.233838, 0.710912),   # CygA
+    (4.412048, 0.087195),   # HerA
+    (1.459697, 0.383912),   # TauA
+    (3.276019, 0.216299),   # VirA
+])
+ATEAM_NAMES = ("CasA", "CygA", "HerA", "TauA", "VirA")
+# approx 150 MHz integrated fluxes (Jy), public low-frequency catalog scale
+ATEAM_FLUX = np.asarray([10690.0, 8247.0, 377.0, 1420.0, 1060.0])
+
+
+def host_rng(key, salt=0):
+    """Host-side numpy Generator derived from a JAX PRNG key + a per-consumer
+    salt.  Every host RNG consumer must use a distinct salt, otherwise
+    different draws (sky model, station layout, target, noise) would consume
+    byte-identical bit streams and correlate across subsystems."""
+    k = np.asarray(key, np.uint32).ravel()
+    return np.random.default_rng(np.concatenate([k, [np.uint32(salt)]]))
+
+
+class Observation(NamedTuple):
+    """Geometry + spectral setup of one synthetic observation.
+
+    uvw    : (T, B, 3) float32, meters (baseline p - q convention)
+    freqs  : (Nf,) Hz
+    ra0, dec0 : phase center (rad)
+    lst0   : local sidereal time at the first sample (rad)
+    times  : (T,) seconds from start (integration mid-points)
+    n_stations : static int
+    """
+
+    uvw: jnp.ndarray
+    freqs: jnp.ndarray
+    ra0: float
+    dec0: float
+    lst0: float
+    times: jnp.ndarray
+    n_stations: int
+
+    @property
+    def n_baselines(self) -> int:
+        return self.n_stations * (self.n_stations - 1) // 2
+
+    @property
+    def n_times(self) -> int:
+        return self.uvw.shape[0]
+
+
+def station_layout(key, n_stations: int, core_radius: float = 1500.0,
+                   max_radius: float = 40e3, core_fraction: float = 0.6):
+    """LOFAR-like station positions in local ENU (E, N, U) meters.
+
+    ~``core_fraction`` of stations sit in a dense gaussian core, the rest
+    spiral out with log-uniform radii up to ``max_radius`` (the qualitative
+    LBA/HBA layout the reference gets from its ANTENNA table fixtures,
+    ``generate_data.py:920-928``).
+    """
+    rng = host_rng(key, salt=10)
+    n_core = max(2, int(core_fraction * n_stations))
+    n_rem = n_stations - n_core
+    core = rng.normal(scale=core_radius / 2.0, size=(n_core, 2))
+    r = np.exp(rng.uniform(np.log(core_radius), np.log(max_radius),
+                           size=n_rem))
+    th = rng.uniform(0.0, 2 * np.pi, size=n_rem)
+    rem = np.stack([r * np.cos(th), r * np.sin(th)], axis=-1)
+    enu2 = np.concatenate([core, rem], axis=0)
+    up = rng.normal(scale=5.0, size=(n_stations, 1))  # small height scatter
+    return jnp.asarray(np.concatenate([enu2, up], axis=-1), jnp.float32)
+
+
+def enu_to_equatorial(enu, lat: float = LOFAR_LAT):
+    """ENU -> equatorial (X toward meridian/equator, Y east, Z north pole)."""
+    e, n, u = enu[..., 0], enu[..., 1], enu[..., 2]
+    x = -jnp.sin(lat) * n + jnp.cos(lat) * u
+    y = e
+    z = jnp.cos(lat) * n + jnp.sin(lat) * u
+    return jnp.stack([x, y, z], axis=-1)
+
+
+def uvw_tracks(xyz_eq, times, ra0, dec0, lst0):
+    """Earth-rotation-synthesis station uvw: (T, N, 3) meters.
+
+    Standard synthesis relations for hour angle H = LST - ra0:
+      u =  sin(H) X + cos(H) Y
+      v = -sin(d) cos(H) X + sin(d) sin(H) Y + cos(d) Z
+      w =  cos(d) cos(H) X - cos(d) sin(H) Y + sin(d) Z
+    """
+    lst = lst0 + OMEGA_EARTH * times
+    H = lst - ra0
+    sh, ch = jnp.sin(H)[:, None], jnp.cos(H)[:, None]
+    sd, cd = jnp.sin(dec0), jnp.cos(dec0)
+    X, Y, Z = xyz_eq[None, :, 0], xyz_eq[None, :, 1], xyz_eq[None, :, 2]
+    u = sh * X + ch * Y
+    v = -sd * ch * X + sd * sh * Y + cd * Z
+    w = cd * ch * X - cd * sh * Y + sd * Z
+    return jnp.stack([u, v, w], axis=-1)
+
+
+def baseline_uvw(station_uvw, n_stations: int):
+    """(T, N, 3) station uvw -> (T, B, 3) baseline uvw, p < q row-major
+    (uvw_p - uvw_q, the convention of the reference's readuvw text files)."""
+    p, q = np.triu_indices(n_stations, 1)
+    return station_uvw[:, p, :] - station_uvw[:, q, :]
+
+
+def find_valid_target(key, low_el_deg: float = 3.0,
+                      strategy: int = 0):
+    """Draw (ra0, dec0, t0) with target elevation above ``low_el_deg``.
+
+    Reference: generate_data.py:50-105 (casacore measures loop).  Strategies:
+    0/2 uniform sky, 1 near a random A-team source.  t0 is seconds within a
+    sidereal day, doubling as the LST seed.  Host-side (numpy + rejection).
+    """
+    rng = host_rng(key, salt=11)
+    low_el = np.deg2rad(low_el_deg)
+    while True:
+        if strategy == 1:
+            i = rng.integers(len(ATEAM_DIRS))
+            dmax = np.deg2rad(0.5 + 30 * rng.random())
+            ra0 = float(ATEAM_DIRS[i, 0] + rng.random() * dmax)
+            dec0 = float(ATEAM_DIRS[i, 1] + rng.random() * dmax)
+        else:
+            ra0 = float(rng.random() * 2 * np.pi)
+            dec0 = float(rng.random() * np.pi / 2)
+        if dec0 > np.pi / 2:
+            continue
+        t0 = float(rng.random() * 24 * 3600.0)
+        lst0 = OMEGA_EARTH * t0 % (2 * np.pi)
+        _, el = coords.azel_from_radec(ra0, dec0, lst0, LOFAR_LAT)
+        if float(el) > low_el:
+            return ra0, dec0, t0
+
+
+def make_observation(key, n_stations: int = 14, n_freqs: int = 3,
+                     n_times: int = 20, t_int: float = 1.0,
+                     flow_mhz: float = None, fhigh_mhz: float = None,
+                     hba: bool = True, ra0: float = None, dec0: float = None,
+                     t0: float = None, layout_kwargs=None) -> Observation:
+    """Full synthetic observation (replaces makems + changefreq + FIELD patch).
+
+    Frequencies are drawn inside the LBA/HBA band exactly like the reference
+    (generate_data.py:993-1000): flow uniform in the lower half-band, fhigh in
+    the upper, Nf channels linspaced between.
+    """
+    rng = host_rng(key, salt=12)
+    if ra0 is None or dec0 is None:
+        drawn = find_valid_target(key)
+        ra0 = drawn[0] if ra0 is None else ra0
+        dec0 = drawn[1] if dec0 is None else dec0
+        t0 = drawn[2] if t0 is None else t0
+    elif t0 is None:
+        # pointing fixed by the caller: draw only the epoch (elevation is
+        # the caller's responsibility in this case)
+        t0 = float(rng.random() * 24 * 3600.0)
+    lo, hi = (HBA_LOW, HBA_HIGH) if hba else (LBA_LOW, LBA_HIGH)
+    if flow_mhz is None:
+        flow_mhz = lo + rng.random() * (hi - lo) / 2
+    if fhigh_mhz is None:
+        fhigh_mhz = lo + (hi - lo) / 2 + rng.random() * (hi - lo) / 2
+    freqs = jnp.asarray(np.linspace(flow_mhz, fhigh_mhz, n_freqs) * 1e6,
+                        jnp.float32)
+    enu = station_layout(key, n_stations, **(layout_kwargs or {}))
+    xyz = enu_to_equatorial(enu)
+    times = jnp.arange(n_times, dtype=jnp.float32) * t_int + 0.5 * t_int
+    lst0 = float(OMEGA_EARTH * t0 % (2 * np.pi))
+    st_uvw = uvw_tracks(xyz, times, ra0, dec0, lst0)
+    uvw = baseline_uvw(st_uvw, n_stations)
+    return Observation(uvw=uvw, freqs=freqs, ra0=float(ra0),
+                       dec0=float(dec0), lst0=lst0, times=times,
+                       n_stations=n_stations)

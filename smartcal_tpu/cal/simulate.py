@@ -1,0 +1,380 @@
+"""Synthetic sky models + systematic-error Jones solutions.
+
+In-framework replacement for the reference's file-based simulators:
+``calibration/simulate.py`` (simulate_models: sky0/sky/cluster/rho text files
++ ``.S.solutions``) and the sky/solution part of
+``calibration/generate_data.py:896-1237`` (simulate_data).  Instead of
+writing text files for external SAGECal binaries, everything is built as
+struct-of-arrays (cal/coherency.SkyArrays) consumed directly by the JAX
+prediction + solver path; cal/skyio can still round-trip the reference file
+formats at the data edge.
+
+All draws are host-side numpy from a seeded Generator — simulation setup is
+once-per-episode host work; the heavy math (prediction, solve, influence)
+stays on device.
+"""
+
+import math
+from typing import NamedTuple
+
+import numpy as np
+
+from smartcal_tpu.cal import observation as obs_mod
+from smartcal_tpu.cal.coherency import SkyArrays
+
+TWO_PI = 2.0 * math.pi
+
+
+def _rng_of(key, salt=0):
+    return obs_mod.host_rng(key, salt)
+
+
+def _powerlaw_flux(rng, n, a, b, alpha=-2.0):
+    """Fluxes with dN/dS ~ S^alpha in [a, b] (reference simulate.py:106-121)."""
+    nn = rng.random(n)
+    ap, bp = a ** (alpha + 1), b ** (alpha + 1)
+    return (ap + nn * (bp - ap)) ** (1.0 / (alpha + 1))
+
+
+class SkyDraw:
+    """Accumulator for struct-of-arrays sky construction."""
+
+    def __init__(self):
+        self.l, self.m, self.flux, self.sp = [], [], [], []
+        self.gauss, self.is_gauss, self.cluster = [], [], []
+
+    def add(self, l, m, flux, sp, cluster, gauss=None):
+        l, m, flux = map(np.atleast_1d, (l, m, flux))
+        n = l.shape[0]
+        sp = np.broadcast_to(np.atleast_1d(sp), (n,))
+        self.l.append(l)
+        self.m.append(m)
+        self.flux.append(flux)
+        self.sp.append(sp)
+        if gauss is None:
+            self.gauss.append(np.zeros((n, 3)))
+            self.is_gauss.append(np.zeros(n, bool))
+        else:
+            self.gauss.append(np.broadcast_to(gauss, (n, 3)))
+            self.is_gauss.append(np.ones(n, bool))
+        self.cluster.append(np.full(n, cluster, np.int32))
+
+    def build(self, n_clusters, f0):
+        l = np.concatenate(self.l)
+        m = np.concatenate(self.m)
+        n = np.sqrt(np.maximum(1.0 - l * l - m * m, 0.0)) - 1.0
+        flux = np.concatenate(self.flux)
+        sp = np.concatenate(self.sp)
+        fc = np.stack([np.log(np.maximum(flux, 1e-12)), sp,
+                       np.zeros_like(sp), np.zeros_like(sp)], axis=-1)
+        return SkyArrays(
+            lmn=np.stack([l, m, n], axis=-1), flux_coef=fc,
+            f0=np.full_like(flux, f0), gauss=np.concatenate(self.gauss),
+            is_gauss=np.concatenate(self.is_gauss),
+            cluster=np.concatenate(self.cluster), n_clusters=n_clusters)
+
+
+class CalibModels(NamedTuple):
+    """Output of :func:`simulate_models` (reference simulate.py return +
+    the files it wrote, as arrays).
+
+    sky_sim   : SkyArrays, K+1 clusters (K calibrated + weak background)
+    sky_cal   : SkyArrays, K clusters (outlier fluxes /100, as the
+                reference's calibration sky — beam-attenuation stand-in)
+    sky_table : (K, 5) float32 rows [cluster_id, l, m, sI, sP] (skylmn.txt)
+    rho       : (K,) spectral ADMM rho (analytic init, flux-proportional)
+    rho_spatial : (K,) spatial ADMM rho
+    lm_dirs   : (K, 2) cluster-center direction cosines (solution planes)
+    f0        : reference frequency (Hz)
+    """
+
+    sky_sim: SkyArrays
+    sky_cal: SkyArrays
+    sky_table: np.ndarray
+    rho: np.ndarray
+    rho_spatial: np.ndarray
+    lm_dirs: np.ndarray
+    f0: float
+
+
+def simulate_models(key, K=4, f0=150e6, Kc=80, M_weak=350, M_gauss=120,
+                    M2=40) -> CalibModels:
+    """Random calibration sky: Kc-source center cluster, K-1 compact outlier
+    clusters of M2 sources, M_weak point + M_gauss Gaussian background
+    sources.  Reference: calibration/simulate.py:61-379.
+    """
+    rng = _rng_of(key, salt=1)
+    sim, cal = SkyDraw(), SkyDraw()
+    table, lm_dirs = [], []
+
+    # center cluster (id 0 here; reference writes id 1)
+    lmin = 0.9
+    l = (rng.random(Kc) - 0.5) * lmin
+    m = (rng.random(Kc) - 0.5) * lmin
+    sI = ((rng.random(Kc) * 90) + 10) / 10
+    sI = sI / sI.min() * 0.03
+    sP = rng.standard_normal(Kc)
+    sim.add(l, m, sI, sP, 0)
+    cal.add(l, m, sI, sP, 0)
+    table.append([1, l.mean(), m.mean(), sI.mean(), sP.mean()])
+    lm_dirs.append([l.mean(), m.mean()])
+    rho = [sI.sum() * 100.0]
+
+    # outlier clusters (reference simulate.py:232-312): compact (1e-3 rad)
+    # M2-source clumps at bright off-center positions; calibration sky
+    # divides fluxes by 100 (beam attenuation stand-in)
+    lo = (rng.random(K - 1) - 0.5) * 0.7
+    mo = (rng.random(K - 1) - 0.5) * 0.7
+    sIo = ((rng.random(K - 1) * 900) + 100) / 10
+    sIo = sIo / sIo.min() * 250.0
+    sPo = rng.standard_normal(K - 1)
+    for cj in range(K - 1):
+        l2 = lo[cj] + (rng.random(M2) - 0.5) * 1e-3
+        m2 = mo[cj] + (rng.random(M2) - 0.5) * 1e-3
+        sI2 = rng.random(M2)
+        sI2 = sI2 / sI2.sum() * sIo[cj]
+        sim.add(l2, m2, sI2, sPo[cj], cj + 1)
+        cal.add(l2, m2, sI2 / 100.0, sPo[cj], cj + 1)
+        # NOTE reference quirk: skylmn.txt averages the *relative* offsets
+        # (simulate.py:289-296), placing outliers at ~(0,0); we store the
+        # true cluster center (the quantity the table is meant to carry).
+        table.append([cj + 2, lo[cj], mo[cj], (sI2 / 100).mean(), sPo[cj]])
+        lm_dirs.append([lo[cj], mo[cj]])
+        rho.append(sI2.sum() / 1000.0 * 100.0)
+
+    # weak background point sources, FOV ~16 deg (sim sky only, cluster K)
+    sII = _powerlaw_flux(rng, M_weak, 0.01, 0.5)
+    l0 = (rng.random(M_weak) - 0.5) * 15.5 * math.pi / 180
+    m0 = (rng.random(M_weak) - 0.5) * 15.5 * math.pi / 180
+    sim.add(l0, m0, sII, 0.0, K)
+
+    # extended (Gaussian) background sources
+    sI1 = _powerlaw_flux(rng, M_gauss, 0.01, 0.5)
+    l1 = (rng.random(M_gauss) - 0.5) * 15.5 * math.pi / 180
+    m1 = (rng.random(M_gauss) - 0.5) * 15.5 * math.pi / 180
+    for i in range(M_gauss):
+        g = np.asarray([(rng.random() - 0.5) * 0.5 * math.pi / 180,
+                        (rng.random() - 0.5) * 0.5 * math.pi / 180,
+                        (rng.random() - 0.5) * math.pi])
+        sim.add(l1[i], m1[i], sI1[i], 0.0, K, gauss=g)
+
+    return CalibModels(
+        sky_sim=sim.build(K + 1, f0), sky_cal=cal.build(K, f0),
+        sky_table=np.asarray(table, np.float32),
+        rho=np.asarray(rho, np.float32),
+        rho_spatial=np.full(K, 0.1, np.float32),
+        lm_dirs=np.asarray(lm_dirs, np.float32), f0=float(f0))
+
+
+# ---------------------------------------------------------------------------
+# Demixing sky (target field + A-team outliers)
+# ---------------------------------------------------------------------------
+
+class DemixModels(NamedTuple):
+    """Output of :func:`simulate_demixing_sky` — the array form of what the
+    reference assembles from base.sky/base.cluster + the random target field
+    (generate_data.py:1004-1140).  Cluster order: 0..K-2 = A-team outliers,
+    K-1 = target (matching the reference where target is the LAST direction
+    among the calibrated ones and weak sources live in an extra cluster).
+
+    separations/azimuth/elevation: per calibrated cluster (rad), the
+    casacore-measures metadata re-done in pure math (influence_tools.py:16-159)
+    fluxes: apparent flux sum per calibrated cluster
+    """
+
+    sky_sim: SkyArrays
+    sky_cal: SkyArrays
+    rho: np.ndarray
+    separations: np.ndarray
+    azimuth: np.ndarray
+    elevation: np.ndarray
+    fluxes: np.ndarray
+    lm_dirs: np.ndarray
+    f0: float
+
+
+def ateam_components(key, ra0, dec0, f0, n_comp=30):
+    """Synthetic A-team clusters: for each of the 5 sources, ``n_comp``
+    components scattered within ~0.3 deg of the true position, total flux at
+    the catalog scale.  Stand-in for the reference's checked-in
+    ``base.sky``/``base.cluster`` models (demixing/base.sky, 535 components)
+    — same role (bright off-axis interferers), independently generated."""
+    from smartcal_tpu.cal import coords
+
+    rng = _rng_of(key, salt=2)
+    comp = SkyDraw()
+    for i, (ra, dec) in enumerate(obs_mod.ATEAM_DIRS):
+        l, m, _ = coords.radectolm(ra, dec, ra0, dec0)
+        l, m = float(l), float(m)
+        dl = (rng.random(n_comp) - 0.5) * 0.01
+        dm = (rng.random(n_comp) - 0.5) * 0.01
+        w = rng.random(n_comp)
+        flux = w / w.sum() * obs_mod.ATEAM_FLUX[i]
+        sp = np.full(n_comp, -0.7) + 0.1 * rng.standard_normal(n_comp)
+        comp.add(l + dl, m + dm, flux, sp, i)
+    return comp
+
+
+def simulate_demixing_sky(key, ra0, dec0, t0, f0, K=6, Kc=40, M_weak=350,
+                          M_gauss=120, beam_atten=True) -> DemixModels:
+    """Target field + A-team sky for the demixing workloads.
+
+    Reference: generate_data.py:1004-1140 — Kc target sources (power-law
+    fluxes in [0.1, 200]), weak + Gaussian background in a 25.5-deg FOV,
+    A-team clusters prepended from base files.  ``beam_atten`` applies a
+    smooth elevation-dependent attenuation to the A-team apparent fluxes
+    (sim and cal skies alike, and the analytic rho) — the role of the
+    reference's ``-E 1`` beam during simulation; False uses catalog fluxes.
+    """
+    from smartcal_tpu.cal import coords
+
+    rng = _rng_of(key, salt=3)
+    n_ateam = K - 1
+    lst0 = obs_mod.OMEGA_EARTH * t0 % TWO_PI
+
+    # A-team outlier clusters 0..K-2
+    at = ateam_components(key, ra0, dec0, f0)
+    sim, cal = SkyDraw(), SkyDraw()
+    sep, azl, ell, fluxes, lm_dirs = [], [], [], [], []
+    atten = []
+    for i in range(n_ateam):
+        ra, dec = obs_mod.ATEAM_DIRS[i]
+        s = float(coords.angular_separation(ra0, dec0, ra, dec))
+        az, el = coords.azel_from_radec(ra, dec, lst0, obs_mod.LOFAR_LAT)
+        sep.append(s)
+        azl.append(float(az))
+        ell.append(float(el))
+        # elevation-driven apparent-flux attenuation (beam stand-in):
+        # sources below the horizon are strongly suppressed
+        if beam_atten:
+            a = 0.05 + 0.95 * max(0.0, math.sin(max(float(el), 0.0))) ** 2
+        else:
+            a = 1.0
+        atten.append(a)
+        l_i, m_i = at.l[i], at.m[i]
+        f_i = at.flux[i] * a
+        sim.add(l_i, m_i, f_i, at.sp[i], i)
+        cal.add(l_i, m_i, f_i, at.sp[i], i)
+        fluxes.append(float(np.sum(f_i)))
+        lm_dirs.append([float(np.mean(l_i)), float(np.mean(m_i))])
+
+    # target cluster K-1 at the phase center
+    l = (rng.random(Kc) - 0.5) * 0.2
+    m = (rng.random(Kc) - 0.5) * 0.2
+    sI = _powerlaw_flux(rng, Kc, 0.1, 200.0)
+    sP = rng.standard_normal(Kc)
+    sim.add(l, m, sI, sP, K - 1)
+    cal.add(l, m, sI, sP, K - 1)
+    az0, el0 = coords.azel_from_radec(ra0, dec0, lst0, obs_mod.LOFAR_LAT)
+    sep.append(0.0)
+    azl.append(float(az0))
+    ell.append(float(el0))
+    fluxes.append(float(sI.sum()))
+    lm_dirs.append([float(l.mean()), float(m.mean())])
+
+    # weak + Gaussian background (sim only, cluster K), 25.5-deg FOV
+    sII = _powerlaw_flux(rng, M_weak, 0.01, 0.5)
+    l0 = (rng.random(M_weak) - 0.5) * 25.5 * math.pi / 180
+    m0 = (rng.random(M_weak) - 0.5) * 25.5 * math.pi / 180
+    sim.add(l0, m0, sII, 0.0, K)
+    sI1 = _powerlaw_flux(rng, M_gauss, 0.01, 0.5)
+    l1 = (rng.random(M_gauss) - 0.5) * 25.5 * math.pi / 180
+    m1 = (rng.random(M_gauss) - 0.5) * 25.5 * math.pi / 180
+    for i in range(M_gauss):
+        g = np.asarray([(rng.random() - 0.5) * 0.5 * math.pi / 180,
+                        (rng.random() - 0.5) * 0.5 * math.pi / 180,
+                        (rng.random() - 0.5) * math.pi])
+        sim.add(l1[i], m1[i], sI1[i], 0.0, K, gauss=g)
+
+    # analytic rho: A-team at catalog scale x attenuation, target
+    # sum(sI)*10/Kc (generate_data.py:1077)
+    rho = np.asarray(
+        [obs_mod.ATEAM_FLUX[i] * atten[i] * 0.1 for i in range(n_ateam)]
+        + [sI.sum() * 10.0 / Kc], np.float32)
+
+    return DemixModels(
+        sky_sim=sim.build(K + 1, f0), sky_cal=cal.build(K, f0),
+        rho=rho, separations=np.asarray(sep, np.float32),
+        azimuth=np.asarray(azl, np.float32),
+        elevation=np.asarray(ell, np.float32),
+        fluxes=np.asarray(fluxes, np.float32),
+        lm_dirs=np.asarray(lm_dirs, np.float32), f0=float(f0))
+
+
+# ---------------------------------------------------------------------------
+# Systematic-error Jones solutions
+# ---------------------------------------------------------------------------
+
+def synth_solutions(key, K, n_stations, Ts, freqs, f0, amp=1.0,
+                    spatial_term=False, spalpha=0.95, lm_dirs=None):
+    """Synthetic per-direction systematic errors J: (Nf, Ts, K, 2N, 2, 2)
+    split-real float32.
+
+    Per direction: 8N base values (optionally the mix of a random part and
+    spatially smooth planes a0*l + a1*m + a2 over cluster centers), +1 on the
+    diagonal real parts, modulated by a random quadratic polynomial over
+    normalized frequency and a random cosine over time.
+    Reference: simulate.py:386-435 (amp=1, spatial planes),
+    generate_data.py:1154-1190 (amp=0.01, no spatial term).
+    """
+    rng = _rng_of(key, salt=4)
+    N8 = 8 * n_stations
+    freqs = np.asarray(freqs, np.float64)
+    ff = (freqs - f0) / f0                                  # (Nf,)
+    Nf = ff.shape[0]
+
+    if spatial_term:
+        a0, a1, a2 = rng.standard_normal((3, N8))
+        a0, a1, a2 = (v / np.linalg.norm(v) for v in (a0, a1, a2))
+        lm = np.asarray(lm_dirs)                            # (K, 2)
+        base = np.empty((K, N8))
+        for ck in range(K):
+            rp = rng.standard_normal(N8)
+            b = ((1 - spalpha) * rp / np.linalg.norm(rp)
+                 + spalpha * (a0 * lm[ck, 0] + a1 * lm[ck, 1] + a2))
+            base[ck] = b / np.linalg.norm(b)
+    else:
+        base = rng.standard_normal((K, N8)) * amp
+    base[:, 0::8] += 1.0
+    base[:, 6::8] += 1.0
+
+    # random quadratic frequency polynomial per (k, value)
+    beta = rng.standard_normal((K, N8, 3))
+    freqpol = (beta[..., 0:1] + beta[..., 1:2] * ff[None, None, :]
+               + beta[..., 2:3] * ff[None, None, :] ** 2)   # (K, N8, Nf)
+    gs = base[:, :, None] * freqpol
+
+    # random cosine time modulation per (k, value), shared across freq
+    tr = np.arange(Ts) / Ts
+    tb = rng.standard_normal((K, N8, 4))
+    tb = tb / np.linalg.norm(tb, axis=-1, keepdims=True)
+    timepol = (1.0 + tb[..., 0:1]
+               + tb[..., 1:2] * np.cos(tr[None, None, :] * tb[..., 2:3]
+                                       + tb[..., 3:4]))     # (K, N8, Ts)
+
+    full = gs[:, :, None, :] * timepol[..., None]           # (K, N8, Ts, Nf)
+    # 8 values per station: [J00re, J00im, J01re, J01im, J10re, J10im,
+    # J11re, J11im] -> (N, 2, 2, re/im)
+    full = full.reshape(K, n_stations, 2, 2, 2, Ts, Nf)
+    J = np.transpose(full, (6, 5, 0, 1, 2, 3, 4))           # (Nf,Ts,K,N,2,2,2)
+    J = J.reshape(Nf, Ts, K, 2 * n_stations, 2, 2)
+    return J.astype(np.float32)
+
+
+def identity_solutions(K, n_stations, Ts, Nf):
+    """J = I for every direction/station (the unperturbed-sky case)."""
+    J = np.zeros((Nf, Ts, K, 2 * n_stations, 2, 2), np.float32)
+    eye = np.eye(2, dtype=np.float32)
+    for p in range(n_stations):
+        J[:, :, :, 2 * p:2 * p + 2, :, 0] = eye
+    return J
+
+
+def add_noise(key, V, snr):
+    """AWGN scaled so ||noise|| = snr * ||signal|| (reference addnoise.py:7-17;
+    snr there is the noise-to-signal norm ratio).  V is split-real (..., 2)."""
+    rng = _rng_of(key, salt=5)
+    noise = rng.standard_normal(V.shape).astype(np.float32)
+    noise -= noise.mean()
+    scale = snr * np.linalg.norm(V) / max(np.linalg.norm(noise), 1e-30)
+    return V + noise * scale, float(scale)

@@ -11,6 +11,7 @@ and only ever run at the host data edge — device code consumes the arrays.
 
 import numpy as np
 
+from smartcal_tpu.cal import coords
 from smartcal_tpu.cal.coherency import SkyArrays
 
 
@@ -56,16 +57,12 @@ def build_sky_arrays(sky_path, cluster_path, ra0, dec0):
             cl_ids.append(cid)
             names.append(nm)
     info = np.stack(rows)                                  # (S, 18)
-    ra = (info[:, 0] + info[:, 1] / 60. + info[:, 2] / 3600.) \
-        * 360. / 24. * np.pi / 180.
-    dec = (info[:, 3] + info[:, 4] / 60. + info[:, 5] / 3600.) * np.pi / 180.
-
-    # direction cosines (vectorized radectolm)
-    dec0v = np.where((dec0 < 0.0) & (dec >= 0.0), dec0 + 2 * np.pi, dec0)
-    l = np.sin(ra - ra0) * np.cos(dec)
-    m = -(np.cos(ra - ra0) * np.cos(dec) * np.sin(dec0v)
-          - np.cos(dec0v) * np.sin(dec))
-    n = np.sqrt(np.maximum(1.0 - l * l - m * m, 0.0)) - 1.0
+    ra = coords.hms_to_rad(info[:, 0], info[:, 1], info[:, 2])
+    # dec stays a per-row loop: dms_to_rad's negative-zero sign logic is
+    # scalar-only
+    dec = np.asarray([coords.dms_to_rad(*row[3:6]) for row in info])
+    l, m, n = (np.asarray(v)
+               for v in coords.radectolm(ra, dec, ra0, dec0))
 
     flux_coef = np.stack([np.log(info[:, 6]), info[:, 10],
                           info[:, 11], info[:, 12]], axis=-1)

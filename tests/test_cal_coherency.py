@@ -107,9 +107,12 @@ class TestCoords:
         for rad in [0.3, 1.9, 5.0]:
             h, m, s = coords.rad_to_ra(rad)
             assert abs(coords.hms_to_rad(h, m, s) - rad) < 1e-9
-        for rad in [-0.5, 0.2, 1.2]:
+        # includes |dec| < 1 deg (sign carried by min/sec) and ~0 edge cases
+        for rad in [-0.5, 0.2, 1.2, -0.005, -0.0001, -1e-7]:
             d, m, s = coords.rad_to_dec(rad)
             assert abs(coords.dms_to_rad(d, m, s) - rad) < 1e-9
+        # negative-zero degree field from text parsing ('-00 12 34')
+        assert coords.dms_to_rad(-0.0, 12, 34) < 0
 
     def test_separation_zero_and_known(self):
         assert float(coords.angular_separation(1.0, 0.5, 1.0, 0.5)) < 1e-7

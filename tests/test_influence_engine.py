@@ -1,0 +1,120 @@
+"""Tests for the influence-map engine (cal/influence.py) against the
+reference's dense formulas (analysis_torch.py:141-156, analysis.py,
+influence_tools.py:219-372)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smartcal_tpu.cal import consensus, creal, influence
+
+
+def dense_hadd_reference(rho, alpha, freqs, f0, fidx, n_stations, n_poly,
+                         polytype):
+    """Straight transcription of the reference's dense Hadd build
+    (analysis_torch.py:141-156) using the dense (F, P) of consensus_poly."""
+    F, P = consensus.consensus_poly(n_poly, n_stations, freqs, f0, fidx,
+                                    polytype=polytype, rho=rho, alpha=alpha)
+    F, P = np.asarray(F, np.float64), np.asarray(P, np.float64)
+    FF = F.T @ F
+    n2 = 2 * n_stations
+    if alpha > 0.0:
+        PP = P.T @ P
+        H11 = 0.5 * rho * FF + 0.5 * alpha * rho * rho * PP
+        H12 = 0.5 * FF + 0.5 * alpha * rho * PP
+        H22 = -0.5 / rho * (np.eye(n2) - FF) + 0.5 * alpha * PP
+        Ht = H11 - H12 @ np.linalg.pinv(H22) @ H12
+        return np.kron(np.eye(2), Ht)
+    return 0.5 * rho * np.kron(
+        np.eye(2), FF @ (np.eye(n2) + np.linalg.pinv(np.eye(n2) - FF) @ FF))
+
+
+@pytest.mark.parametrize("alpha", [0.0, 0.3])
+@pytest.mark.parametrize("polytype", [0, 1])
+def test_hadd_scalar_matches_dense_reference(alpha, polytype):
+    n_stations, n_poly = 3, 2
+    freqs = np.linspace(110e6, 170e6, 8)
+    f0, fidx = 140e6, 3
+    rho = 7.5
+    h = np.asarray(influence.consensus_hadd_scalars(
+        [rho], [alpha], freqs, f0, fidx, n_poly=n_poly, polytype=polytype))
+    dense = dense_hadd_reference(rho, alpha, freqs, f0, fidx, n_stations,
+                                 n_poly, polytype)
+    np.testing.assert_allclose(h[0] * np.eye(4 * n_stations), dense,
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.fixture(scope="module")
+def chunk_problem():
+    rng = np.random.default_rng(3)
+    N, K, Ts, Td = 4, 2, 2, 3
+    B = N * (N - 1) // 2
+    T = Ts * Td
+    R = (rng.standard_normal((2 * B * T, 2))
+         + 1j * rng.standard_normal((2 * B * T, 2))).astype(np.complex64)
+    C = (rng.standard_normal((K, T * B, 4))
+         + 1j * rng.standard_normal((K, T * B, 4))).astype(np.complex64)
+    J = (rng.standard_normal((Ts, K, 2 * N, 2))
+         + 1j * rng.standard_normal((Ts, K, 2 * N, 2))).astype(np.complex64)
+    hadd = jnp.asarray([0.5, 1.0])
+    return N, K, Ts, Td, creal.split(R), creal.split(C), creal.split(J), hadd
+
+
+def test_influence_shapes_and_finiteness(chunk_problem):
+    N, K, Ts, Td, R, C, J, hadd = chunk_problem
+    B = N * (N - 1) // 2
+    res = influence.influence_visibilities(
+        jnp.asarray(R).reshape(-1, 2, 2), jnp.asarray(C), jnp.asarray(J),
+        hadd, N, Ts)
+    assert res.vis.shape == (Ts * Td * B, 4, 2)
+    assert res.llr.shape == (Ts, K)
+    assert np.all(np.isfinite(np.asarray(res.vis)))
+    # non-fullpol: XY/YX zeroed
+    assert np.all(np.asarray(res.vis[:, 1, :]) == 0)
+    assert np.all(np.asarray(res.vis[:, 2, :]) == 0)
+    # replicated over the Td slots within a chunk
+    v = np.asarray(res.vis).reshape(Ts, Td, B, 4, 2)
+    np.testing.assert_allclose(v[:, 0], v[:, 1])
+
+
+def test_perdir_sums_to_combined(chunk_problem):
+    """dR summed over directions == the combined engine, so the perdir
+    influence visibilities must sum to the all-directions ones."""
+    N, K, Ts, Td, R, C, J, hadd = chunk_problem
+    comb = influence.influence_visibilities(
+        jnp.asarray(R).reshape(-1, 2, 2), jnp.asarray(C), jnp.asarray(J),
+        hadd, N, Ts)
+    perdir = influence.influence_visibilities(
+        jnp.asarray(R).reshape(-1, 2, 2), jnp.asarray(C), jnp.asarray(J),
+        hadd, N, Ts, perdir=True)
+    assert perdir.vis.shape[0] == K
+    np.testing.assert_allclose(np.asarray(perdir.vis).sum(axis=0),
+                               np.asarray(comb.vis), rtol=1e-3, atol=1e-3)
+
+
+def test_perdir_summary(chunk_problem):
+    N, K, Ts, Td, R, C, J, hadd = chunk_problem
+    perdir = influence.influence_visibilities(
+        jnp.asarray(R).reshape(-1, 2, 2), jnp.asarray(C), jnp.asarray(J),
+        hadd, N, Ts, perdir=True)
+    summ = influence.perdir_summary(perdir.vis, perdir.llr, jnp.asarray(C),
+                                    jnp.asarray(J))
+    for f in summ:
+        assert f.shape == (K,)
+        assert np.all(np.isfinite(np.asarray(f)))
+    # norms match numpy directly
+    np.testing.assert_allclose(
+        np.asarray(summ.c_norm),
+        np.linalg.norm(np.asarray(C).reshape(K, -1), axis=1), rtol=1e-5)
+
+
+def test_influence_zero_residual_zero_coherency():
+    """With C = 0 the perturbation chain is all-zero -> zero influence."""
+    N, K, Ts, Td = 3, 1, 1, 2
+    B = N * (N - 1) // 2
+    T = Ts * Td
+    R = jnp.zeros((2 * B * T, 2, 2))
+    C = jnp.zeros((K, T * B, 4, 2))
+    J = jnp.zeros((Ts, K, 2 * N, 2, 2)).at[..., 0::2, 0, 0].set(1.0)
+    res = influence.influence_visibilities(R, C, J, jnp.ones((K,)), N, Ts)
+    np.testing.assert_allclose(np.asarray(res.vis), 0.0, atol=1e-6)
