@@ -177,8 +177,9 @@ class DemixModels(NamedTuple):
     K-1 = target (matching the reference where target is the LAST direction
     among the calibrated ones and weak sources live in an extra cluster).
 
-    separations/azimuth/elevation: per calibrated cluster (rad), the
-    casacore-measures metadata re-done in pure math (influence_tools.py:16-159)
+    separations/azimuth/elevation: per calibrated cluster in DEGREES (the
+    casacore-measures units the reference feeds its metadata/hints,
+    influence_tools.py:16-159), re-done in pure math
     fluxes: apparent flux sum per calibrated cluster
     """
 
@@ -241,9 +242,9 @@ def simulate_demixing_sky(key, ra0, dec0, t0, f0, K=6, Kc=40, M_weak=350,
         ra, dec = obs_mod.ATEAM_DIRS[i]
         s = float(coords.angular_separation(ra0, dec0, ra, dec))
         az, el = coords.azel_from_radec(ra, dec, lst0, obs_mod.LOFAR_LAT)
-        sep.append(s)
-        azl.append(float(az))
-        ell.append(float(el))
+        sep.append(math.degrees(s))
+        azl.append(math.degrees(float(az)))
+        ell.append(math.degrees(float(el)))
         # elevation-driven apparent-flux attenuation (beam stand-in):
         # sources below the horizon are strongly suppressed
         if beam_atten:
@@ -267,8 +268,8 @@ def simulate_demixing_sky(key, ra0, dec0, t0, f0, K=6, Kc=40, M_weak=350,
     cal.add(l, m, sI, sP, K - 1)
     az0, el0 = coords.azel_from_radec(ra0, dec0, lst0, obs_mod.LOFAR_LAT)
     sep.append(0.0)
-    azl.append(float(az0))
-    ell.append(float(el0))
+    azl.append(math.degrees(float(az0)))
+    ell.append(math.degrees(float(el0)))
     fluxes.append(float(sI.sum()))
     lm_dirs.append([float(l.mean()), float(m.mean())])
 

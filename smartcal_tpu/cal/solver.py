@@ -72,7 +72,9 @@ class SolveResult(NamedTuple):
     residual: jnp.ndarray   # (Nf, T, B, 2, 2, 2) V - sum_k Jp C Jq^H
     sigma_res: jnp.ndarray  # () std of residual (all subbands)
     sigma_data: jnp.ndarray # () std of data
-    final_cost: jnp.ndarray # (Nf, Ts) inner cost at the last ADMM iteration
+    final_cost: jnp.ndarray # (Nf, Ts) inner cost at the last ADMM
+                            # iteration, in DATA units (rescaled from the
+                            # internal normalization)
 
 
 def _blocks(J, n_stations):
@@ -121,11 +123,11 @@ def _cost_fn(x, V5, C5, prior, half_rho, cfg: SolverConfig):
     return chi2 + jnp.sum(half_rho * pr)
 
 
-@partial(jax.jit, static_argnames=("cfg", "axis_name"))
+@partial(jax.jit, static_argnames=("cfg", "axis_name", "n_chunks"))
 def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
                axis_name: Optional[str] = None,
                admm_iters: Optional[jnp.ndarray] = None,
-               freq_range=None) -> SolveResult:
+               freq_range=None, n_chunks: Optional[int] = None) -> SolveResult:
     """Consensus-ADMM calibration over (possibly sharded) frequency sub-bands.
 
     V     : (Nf, T, B, 2, 2, 2) observed visibilities (split-real 2x2)
@@ -144,9 +146,10 @@ def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
             ``axis_name`` + Bernstein polytype so every shard builds the
             same basis (see cal/consensus.poly_basis)
 
-    Solution intervals: Ts = T // tdelta chunks share one solution.  Here
-    Ts is derived from J0 when given, else a single interval (Ts=1);
-    pass V/C already chunked per interval for finer control.
+    n_chunks : number of solution intervals Ts (sagecal -t buckets); when
+            None, Ts is derived from J0 (or 1).  Pass n_chunks WITHOUT a J0
+            warm start to get per-interval solutions plus the chi2-only
+            init phase (a J0 warm start skips init_iters).
     """
     if axis_name is not None and cfg.polytype == 1 and freq_range is None:
         raise ValueError(
@@ -154,8 +157,25 @@ def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
             "freq_range=(fmin, fmax) — local shard min/max would build "
             "incompatible bases across shards")
     Nf, T, B = V.shape[0], V.shape[1], V.shape[2]
+
+    # Scale invariance: radio fluxes span ~0.01..1e4 Jy, so chi2 in raw
+    # units overflows float32 line-search arithmetic.  Normalize data and
+    # model by the data scale and rho by its square — the minimizer (J, Z)
+    # is unchanged, the arithmetic stays O(1).  Undone on the outputs below.
+    vmean = jnp.mean(V * V)
+    if axis_name is not None:
+        vmean = lax.pmean(vmean, axis_name)
+    data_scale = jnp.sqrt(vmean) + 1e-20
+    V = V / data_scale
+    C = C / data_scale
+    rho = jnp.asarray(rho) / (data_scale * data_scale)
     K, N = cfg.n_dirs, cfg.n_stations
-    Ts = 1 if J0 is None else J0.shape[1]
+    if n_chunks is not None:
+        Ts = n_chunks
+        if J0 is not None:
+            assert J0.shape[1] == Ts
+    else:
+        Ts = 1 if J0 is None else J0.shape[1]
     niter = cfg.admm_iters if admm_iters is None else admm_iters
 
     V6 = jax.vmap(lambda v: vis_to_chunks(v, Ts))(V)     # (Nf,Ts,td,B,...)
@@ -174,8 +194,12 @@ def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
     btb = bfull.T @ bfull
     if axis_name is not None:
         btb = lax.psum(btb, axis_name)
-    eps = 1e-6 * jnp.eye(cfg.n_poly)
-    Bi = jax.vmap(lambda r: jnp.linalg.pinv(r * btb + eps))(rho)  # (K,Ne,Ne)
+    # conditioning eps must scale with rho*btb: after the data-scale
+    # normalization rho can be tiny, and a fixed eps would bias Z to zero
+    tr = jnp.trace(btb) / cfg.n_poly
+    Bi = jax.vmap(
+        lambda r: jnp.linalg.pinv(
+            r * btb + (1e-6 * r * tr + 1e-30) * jnp.eye(cfg.n_poly)))(rho)
 
     half_rho = 0.5 * rho
 
@@ -234,10 +258,10 @@ def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
         r = jax.vmap(lambda j, v, c: v - predict_vis_sr(j, c, N))(Jf, Vf, Cf)
         return r.reshape(T, B, 2, 2, 2)
 
-    residual = jax.vmap(resid_f)(J, V6, C7)
+    residual = jax.vmap(resid_f)(J, V6, C7) * data_scale
 
     n_res = jnp.sum(residual * residual)
-    n_dat = jnp.sum(V * V)
+    n_dat = jnp.sum(V * V) * data_scale * data_scale
     count = jnp.asarray(residual.size, residual.dtype)
     if axis_name is not None:
         n_res = lax.psum(n_res, axis_name)
@@ -246,7 +270,8 @@ def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
     sigma_res = jnp.sqrt(n_res / count)
     sigma_data = jnp.sqrt(n_dat / count)
     return SolveResult(J=J, Z=Z, residual=residual, sigma_res=sigma_res,
-                       sigma_data=sigma_data, final_cost=cost)
+                       sigma_data=sigma_data,
+                       final_cost=cost * data_scale * data_scale)
 
 
 def simulate_vis_sr(J, C, n_stations, Ts):

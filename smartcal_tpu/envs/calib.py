@@ -1,0 +1,137 @@
+"""CalibEnv: RL environment for tuning per-direction ADMM regularization.
+
+Parity target: ``calibration/calibenv.py`` — action = 2M values in [-1, 1]
+(M spectral + M spatial rho), affine-mapped to [LOW, HIGH] with a -0.1
+penalty per out-of-range clip (:121-138); observation = {128x128 influence
+image x 1e-3, (M+1)x7 sky table x 1e-3} (:164-166); reward =
+sigma_data_img / sigma_res_img + 1e-4/(sigma_inf + EPS) + penalty (:170);
+reset draws K in [2, M] clusters and re-simulates (:177-204); hint = the
+analytic flux-proportional rho with spatial = 5% of spectral (:220-225).
+
+The external dosimul/docal/doinfluence shell pipeline is replaced by the
+in-framework backend (envs/radio.py); directions are padded to M so one
+compiled solver serves every K.
+"""
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from smartcal_tpu.envs import radio
+
+LOW, HIGH = 0.01, 1000.0        # calibenv.py:21-22
+INF_SCALE = 1e-3                # calibenv.py:25
+META_SCALE = 1e-3
+EPS = 0.01
+
+
+def _to_unit(rho):
+    """rho -> [-1, 1] action coordinates (calibenv.py:160-162)."""
+    return (rho - (HIGH + LOW) / 2) * (2 / (HIGH - LOW))
+
+
+class CalibEnv:
+    """Gym-style env (reset/step), dict observations {'img', 'sky'}."""
+
+    def __init__(self, M=5, provide_hint=False, backend: Optional[
+            radio.RadioBackend] = None, seed=0):
+        self.M = M
+        self.K = 0
+        self.provide_hint = provide_hint
+        self.hint = None
+        self.backend = backend or radio.RadioBackend()
+        self._key = jax.random.PRNGKey(seed)
+        self.rho_spectral = np.ones(M, np.float32)
+        self.rho_spatial = np.ones(M, np.float32)
+        self.ep = None
+        self.mdl = None
+        self.sky = None
+        self._sigma_data_img = 1.0
+
+    def _next_key(self):
+        self._key, k = jax.random.split(self._key)
+        return k
+
+    @property
+    def n_actions(self):
+        return 2 * self.M
+
+    def _run_calibration(self):
+        mask = np.zeros(self.M, np.float32)
+        mask[:self.K] = 1.0
+        rho = np.ones(self.M, np.float32)
+        rho[:self.K] = self.rho_spectral[:self.K]
+        res = self.backend.calibrate(self.ep, rho, mask=mask)
+        alpha = np.ones(self.M, np.float32) * 0.0
+        alpha[:self.K] = self.rho_spatial[:self.K]
+        img = self.backend.influence_image(self.ep, res, rho, alpha)
+        return res, np.asarray(img)
+
+    def _observation(self, img):
+        self.sky[:self.K, 5] = _to_unit(self.rho_spectral[:self.K])
+        self.sky[:self.K, 6] = _to_unit(self.rho_spatial[:self.K])
+        return {"img": img * INF_SCALE, "sky": self.sky * META_SCALE}
+
+    def step(self, action):
+        action = np.asarray(action, np.float32).squeeze()
+        assert action.shape == (2 * self.M,)
+        rho = action * (HIGH - LOW) / 2 + (HIGH + LOW) / 2
+        self.rho_spectral[:self.K] = rho[:self.K]
+        self.rho_spatial[:self.K] = rho[self.M:self.M + self.K]
+        penalty = 0.0
+        for arr in (self.rho_spectral, self.rho_spatial):
+            for ci in range(self.K):
+                if arr[ci] < LOW:
+                    arr[ci] = LOW
+                    penalty += -0.1
+                if arr[ci] > HIGH:
+                    arr[ci] = HIGH
+                    penalty += -0.1
+
+        res, img = self._run_calibration()
+        sigma1 = float(np.std(np.asarray(
+            self.backend.residual_image(self.ep, res))))
+        reward = (self._sigma_data_img / max(sigma1, 1e-12)
+                  + 1e-4 / (float(img.std()) + EPS) + penalty)
+        obs = self._observation(img)
+        done = False
+        info = {"sigma_res": float(res.sigma_res)}
+        if self.provide_hint:
+            return obs, reward, done, self.hint, info
+        return obs, reward, done, info
+
+    def reset(self):
+        key = self._next_key()
+        rng = radio.observation.host_rng(key, salt=21)
+        self.K = int(rng.integers(2, self.M + 1))
+        self.ep, self.mdl = self.backend.new_calib_episode(key, self.K,
+                                                           self.M)
+        self.rho_spectral = np.ones(self.M, np.float32)
+        self.rho_spatial = np.ones(self.M, np.float32)
+        self.rho_spectral[:self.K] = self.mdl.rho
+        self.rho_spatial[:self.K] = self.mdl.rho_spatial
+
+        # sky table (M+1, 7): K rows [id, l, m, sI, sP, ., .], final row
+        # [ra0, dec0, K, f_low_GHz, f_high_GHz] (calibenv.py:198-204)
+        freqs = np.asarray(self.ep.obs.freqs)
+        self.sky = np.zeros((self.M + 1, 7), np.float32)
+        self.sky[:self.K, :5] = self.mdl.sky_table
+        self.sky[-1, :5] = [self.ep.obs.ra0, self.ep.obs.dec0, self.K,
+                            freqs[0] / 1e9, freqs[-1] / 1e9]
+
+        res, img = self._run_calibration()
+        self._sigma_data_img = float(np.std(np.asarray(
+            self.backend.data_image(self.ep))))
+        if self.provide_hint:
+            self.hint = np.zeros(2 * self.M, np.float32)
+            self.hint[:self.K] = _to_unit(self.rho_spectral[:self.K])
+            self.hint[self.M:self.M + self.K] = _to_unit(
+                0.05 * self.rho_spectral[:self.K])
+        return self._observation(img)
+
+    def render(self, mode="human"):
+        print(self.rho_spectral, self.rho_spatial)
+
+    def close(self):
+        pass

@@ -1,0 +1,198 @@
+"""DemixingEnv: RL environment for selecting demixing directions.
+
+Parity target: ``demixing_rl/demixingenv.py`` — action = K values in
+[-1, 1]: K-1 direction-selection probabilities (select when the [0,1] map
+exceeds 0.5, :113-118) plus one max-ADMM-iterations value scaled to
+[5, 30] (:111); observation = {influence map (zeros unless
+``provide_influence``), metadata 3K+2 = separations/azimuth/elevation per
+direction (deg) + log(f_low_MHz) + N_stations, selected directions' sep
+zeroed} (:144-146, :197-203); reward = -AIC normalized by the empirical
+(-859)/3559 minus maxiter/100, relative to the single-direction baseline
+``reward0`` (:338-355); hint = exhaustive sweep over all 2^(K-1) subsets,
+AIC -> softmin(tau=100) -> expected selection vector (:301-336).
+
+The hint sweep — 32 sequential MPI calibrations in the reference — is one
+batched masked solve here (radio.RadioBackend.hint_sweep).
+"""
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from smartcal_tpu.envs import radio
+
+LOW, HIGH = 0.0, 1.0
+LOW_ITER, HIGH_ITER = 5, 30     # demixingenv.py:27-28
+INF_SCALE = 1e-3
+META_SCALE = 1e-3
+EPS = 0.01
+REWARD_MEAN, REWARD_STD = -859.0, 3559.0   # demixingenv.py:349-350
+
+
+def scalar_to_kvec(n, K=5):
+    """Integer -> K binary selection bits (demixingenv.py:297-303)."""
+    ll = [1 if digit == "1" else 0 for digit in bin(n)[2:]]
+    a = np.zeros(K)
+    a[len(a) - len(ll):] = ll
+    return a
+
+
+class DemixingEnv:
+    """Gym-style env, dict observations {'infmap', 'metadata'}."""
+
+    def __init__(self, K=6, provide_hint=False, provide_influence=False,
+                 backend: Optional[radio.RadioBackend] = None, seed=0,
+                 tau=100.0):
+        self.K = K
+        self.provide_hint = provide_hint
+        self.provide_influence = provide_influence
+        self.backend = backend or radio.RadioBackend(admm_iters=30)
+        self.tau = tau
+        self._key = jax.random.PRNGKey(seed)
+        self.ep = None
+        self.mdl = None
+        self.metadata = np.zeros(3 * K + 2, np.float32)
+        self.elevation = None
+        self.rho = np.ones(K, np.float32)
+        self.maxiter = 10
+        self.std_data = 1.0
+        self.std_residual = 1.0
+        self.reward0 = 0.0
+        self.hint = None
+        self.npix = self.backend.npix
+
+    def _next_key(self):
+        self._key, k = jax.random.split(self._key)
+        return k
+
+    @property
+    def n_actions(self):
+        return self.K
+
+    def _mask(self, clus_sel):
+        """(K,) mask: selected outliers + always the target (last)."""
+        m = np.zeros(self.K, np.float32)
+        m[clus_sel] = 1.0
+        m[self.K - 1] = 1.0
+        return m
+
+    def _calibrate(self, mask):
+        res = self.backend.calibrate(self.ep, self.rho, mask=mask,
+                                     admm_iters=self.maxiter)
+        return res
+
+    def _influence_map(self, res, mask):
+        if not self.provide_influence:
+            return np.zeros((self.npix, self.npix), np.float32)
+        alpha = np.zeros(self.K, np.float32)
+        img = self.backend.influence_image(self.ep, res, self.rho * mask
+                                           + (1 - mask), alpha)
+        return np.asarray(img)
+
+    def calculate_reward_(self, Kselected):
+        """-AIC, normalized; penalty grows with maxiter
+        (demixingenv.py:338-355)."""
+        data_var = self.std_data ** 2
+        noise_var = self.std_residual ** 2
+        N = self.backend.n_stations
+        reward = (-N * N * noise_var / (data_var + EPS)
+                  - Kselected * N)
+        reward = (reward - REWARD_MEAN) / REWARD_STD
+        return reward - self.maxiter / 100.0
+
+    def step(self, action):
+        action = np.asarray(action, np.float32).squeeze()
+        assert action.shape == (self.K,)
+        sel = action[:self.K - 1] * (HIGH - LOW) / 2 + (HIGH + LOW) / 2
+        self.maxiter = int(action[self.K - 1]
+                           * (HIGH_ITER - LOW_ITER) / 2
+                           + (HIGH_ITER + LOW_ITER) / 2)
+        clus_sel = np.where(sel > 0.5)[0].tolist()
+        mask = self._mask(clus_sel)
+        Kselected = int(mask.sum())
+
+        res = self._calibrate(mask)
+        self.std_residual = float(self.backend.noise_std(res.residual))
+        infdata = self._influence_map(res, mask)
+
+        md = self.metadata.copy()
+        md[np.where(mask > 0)[0]] = 0.0     # separations of calibrated dirs
+        obs = {"infmap": infdata * INF_SCALE, "metadata": md * META_SCALE}
+        reward = self.calculate_reward_(Kselected) - self.reward0
+        done = False
+        info = {"sigma_res": self.std_residual}
+        if self.provide_hint:
+            if self.hint is None:
+                self.hint = self.get_hint()
+            return obs, reward, done, self.hint, info
+        return obs, reward, done, info
+
+    def reset(self):
+        key = self._next_key()
+        self.ep, self.mdl = self.backend.new_demixing_episode(key, self.K)
+        self.elevation = self.mdl.elevation
+        self.rho = self.mdl.rho.astype(np.float32)
+        self.maxiter = 10
+        mask = self._mask([])               # target only
+        res = self._calibrate(mask)
+        self.std_data = float(self.backend.noise_std(self.ep.V))
+        self.std_residual = float(self.backend.noise_std(res.residual))
+        self.reward0 = self.calculate_reward_(1)
+
+        freqs = np.asarray(self.ep.obs.freqs)
+        md = np.zeros(3 * self.K + 2, np.float32)
+        md[:self.K] = self.mdl.separations
+        md[self.K:2 * self.K] = self.mdl.azimuth
+        md[2 * self.K:3 * self.K] = self.mdl.elevation
+        md[-2] = np.log(freqs[0] / 1e6)
+        md[-1] = self.backend.n_stations
+        self.metadata = md
+
+        infdata = self._influence_map(res, mask)
+        self.hint = None
+        return {"infmap": infdata * INF_SCALE,
+                "metadata": md * META_SCALE}
+
+    def get_hint(self):
+        """Exhaustive AIC sweep -> softmin expectation
+        (demixingenv.py:301-336), batched on device."""
+        n_cfg = 2 ** (self.K - 1)
+        masks, aic_fixed = [], {}
+        for idx in range(n_cfg):
+            bits = scalar_to_kvec(idx, self.K - 1)
+            chosen_el = self.elevation[:-1][bits > 0]
+            if np.any(chosen_el < 1.0):
+                aic_fixed[idx] = 1e5
+                masks.append(np.zeros(self.K))  # placeholder lane
+            else:
+                masks.append(self._mask(np.where(bits > 0)[0].tolist()))
+        sigma_res = np.asarray(self.backend.hint_sweep(
+            self.ep, self.rho, np.stack(masks), admm_iters=self.maxiter))
+
+        N = self.backend.n_stations
+        AIC = np.zeros(n_cfg)
+        for idx in range(n_cfg):
+            if idx in aic_fixed:
+                AIC[idx] = aic_fixed[idx]
+            else:
+                ksel = int(masks[idx].sum())
+                AIC[idx] = ((N * sigma_res[idx] / self.std_data) ** 2
+                            + ksel * N)
+        probs = np.exp(-AIC / self.tau)
+        probs /= probs.sum()
+        hint = np.zeros(self.K - 1)
+        for idx in range(n_cfg):
+            hint += probs[idx] * scalar_to_kvec(idx, self.K - 1)
+        hint = (hint - (HIGH + LOW) / 2) * (2 / (HIGH - LOW))
+        out = np.zeros(self.K, np.float32)
+        out[:self.K - 1] = hint
+        out[self.K - 1] = ((self.maxiter - (HIGH_ITER + LOW_ITER) / 2)
+                           * (2 / (HIGH_ITER - LOW_ITER)))
+        return out
+
+    def render(self, mode="human"):
+        print("maxiter", self.maxiter, "rho", self.rho)
+
+    def close(self):
+        pass

@@ -1,0 +1,7 @@
+"""Aux models: fuzzy controller, transformer classifier, regressors."""
+
+from .fuzzy import DemixController  # noqa: F401
+from .regressor import RegressorNet, TrainingBuffer  # noqa: F401
+from .transformer import TransformerEncoder, XYBuffer  # noqa: F401
+from .tsk import (TSKParams, load_tsk, save_tsk, train_tsk, tsk_forward,  # noqa: F401
+                  tsk_init)

@@ -1,0 +1,208 @@
+"""Trapezoidal Mamdani fuzzy controller for demixing direction priority.
+
+Parity target: ``demixing_fuzzy/demix_controller.py`` (scikit-fuzzy based).
+Seven antecedents (azimuth, azimuth_target, elevation, elevation_target,
+separation, log_intensity, intensity_ratio) each with low/medium/high
+trapezoids, one consequent (priority), and the reference's 13 hand-written
+rules (:196-222).  The RL action reparameterizes the trapezoid breakpoints
+via the chained update of ``update_set_`` (:95-112) with the exact inverse
+``update_action_`` (:114-125).
+
+TPU-first design: scikit-fuzzy builds rule objects and defuzzifies on a
+discretized universe per call, per direction, on host.  Here the whole
+Mamdani pipeline — trapezoid membership, min/max rule firing, clipped
+aggregation, centroid defuzzification — is closed-form jnp on a fixed
+101-point consequent grid, so evaluating all K-1 directions is one ``vmap``
+and can fuse into the env's jitted reward path.
+"""
+
+import json
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+VAR_ORDER = ("azimuth", "azimuth_target", "elevation", "elevation_target",
+             "separation", "log_intensity", "intensity_ratio")
+# action layout (update_limits, demix_controller.py:127-146)
+ACTION_ORDER = ("azimuth", "elevation", "separation", "log_intensity",
+                "intensity_ratio", "priority", "azimuth_target",
+                "elevation_target")
+N_ACTION = 32   # 8 sets x 4 action values (2 per low/medium pair boundary)
+
+
+def default_config() -> Dict:
+    """Reference default membership limits (demix_controller.py:19-95)."""
+    def trio(rng, low, med, high):
+        return {"range": list(rng), "low": list(low), "medium": list(med),
+                "high": list(high)}
+
+    inputs = {
+        "_azimuth": trio([-180, 180, 1], [-180, -180, -65, -55],
+                         [-65, -55, 55, 65], [55, 65, 180, 180]),
+        "_azimuth_target": trio([-180, 180, 1], [-180, -180, -65, -55],
+                                [-65, -55, 55, 65], [55, 65, 180, 180]),
+        "_elevation": trio([-90, 90, 1], [-90, -90, -5, 5],
+                           [-5, 5, 50, 60], [50, 60, 90, 90]),
+        "_elevation_target": trio([-90, 90, 1], [-90, -90, -5, 5],
+                                  [-5, 5, 50, 60], [50, 60, 90, 90]),
+        "_separation": trio([0, 180, 1], [0, 0, 10, 15],
+                            [10, 15, 45, 50], [45, 50, 180, 180]),
+        "_log_intensity": trio([0, 100, 1], [0, 0, 1.0, 2.0],
+                               [1.0, 2.0, 5.0, 10], [5.0, 10, 100, 100]),
+        "_intensity_ratio": trio([0, 100, 1], [0, 0, 0.5, 1.0],
+                                 [0.5, 1.0, 50, 55], [50, 55, 100, 100]),
+    }
+    outputs = {"_priority": trio([0, 100, 1], [0, 0, 40, 50],
+                                 [40, 50, 70, 75], [70, 75, 100, 100])}
+    return {"inputs": inputs, "outputs": outputs,
+            "_comment": "membership limits (auto-generated)"}
+
+
+def trapmf(x, abcd):
+    """Trapezoidal membership (skfuzzy.trapmf semantics): 0 outside [a, d],
+    1 inside [b, c], linear ramps; degenerate ramps (a==b / c==d) are
+    steps."""
+    a, b, c, d = abcd[..., 0], abcd[..., 1], abcd[..., 2], abcd[..., 3]
+    up = jnp.where(b > a, (x - a) / jnp.where(b > a, b - a, 1.0), 1.0)
+    down = jnp.where(d > c, (d - x) / jnp.where(d > c, d - c, 1.0), 1.0)
+    y = jnp.minimum(jnp.minimum(up, 1.0), jnp.minimum(down, 1.0))
+    y = jnp.where((x < a) | (x > d), 0.0, y)
+    return jnp.clip(y, 0.0, 1.0)
+
+
+def _membership_arrays(config):
+    """config -> {var: (3, 4) array rows [low, medium, high]} + priority."""
+    arrs = {}
+    for name in VAR_ORDER:
+        c = config["inputs"]["_" + name]
+        arrs[name] = np.asarray([c["low"], c["medium"], c["high"]],
+                                np.float32)
+    p = config["outputs"]["_priority"]
+    arrs["priority"] = np.asarray([p["low"], p["medium"], p["high"]],
+                                  np.float32)
+    return arrs
+
+
+@jax.jit
+def mamdani_priority(mf_stack, priority_mf, inputs):
+    """Crisp priority for one direction.
+
+    mf_stack: (7, 3, 4) trapezoids for the 7 antecedents (VAR_ORDER rows,
+    [low, medium, high] columns); priority_mf: (3, 4); inputs: (7,) crisp
+    values.  Rules are the reference's 13 (demix_controller.py:196-222);
+    AND=min, OR=max, implication=clip, aggregation=max, centroid defuzz on a
+    101-point universe.
+    """
+    mu = trapmf(inputs[:, None], mf_stack)           # (7, 3) memberships
+    az, azt, el, elt, sep, li, ir = (mu[i] for i in range(7))
+    LOW, MED, HIGH = 0, 1, 2
+
+    r = [
+        jnp.minimum(az[LOW], azt[LOW]),                              # 0 med
+        jnp.minimum(az[MED], azt[MED]),                              # 1 med
+        jnp.minimum(az[HIGH], azt[HIGH]),                            # 2 med
+        sep[LOW],                                                    # 3 high
+        el[LOW],                                                     # 4 low
+        jnp.min(jnp.stack([el[LOW], sep[HIGH], li[LOW], ir[LOW]])),  # 5 low
+        jnp.min(jnp.stack([el[MED], sep[MED], ir[HIGH]])),           # 6 med
+        jnp.min(jnp.stack([el[HIGH], sep[MED], ir[HIGH]])),          # 7 high
+        jnp.min(jnp.stack([el[HIGH], li[HIGH], ir[HIGH]])),          # 8 high
+        jnp.max(jnp.stack([el[MED], sep[MED], li[MED], ir[MED]])),   # 9 med
+        jnp.minimum(elt[LOW], el[HIGH]),                             # 10 high
+        jnp.minimum(elt[HIGH], el[LOW]),                             # 11 low
+        jnp.minimum(elt[MED], el[HIGH]),                             # 12 med
+    ]
+    fire_low = jnp.max(jnp.stack([r[4], r[5], r[11]]))
+    fire_med = jnp.max(jnp.stack([r[0], r[1], r[2], r[6], r[9], r[12]]))
+    fire_high = jnp.max(jnp.stack([r[3], r[7], r[8], r[10]]))
+
+    u = jnp.linspace(0.0, 100.0, 101)
+    agg = jnp.maximum(
+        jnp.maximum(jnp.minimum(fire_low, trapmf(u, priority_mf[0])),
+                    jnp.minimum(fire_med, trapmf(u, priority_mf[1]))),
+        jnp.minimum(fire_high, trapmf(u, priority_mf[2])))
+    total = jnp.sum(agg)
+    # skfuzzy raises on all-zero aggregate; the reference catches it and
+    # falls back to priority=50 (demix_controller.py:240-246)
+    return jnp.where(total > 1e-9, jnp.sum(agg * u) / (total + 1e-30), 50.0)
+
+
+class DemixController:
+    """Reference-API wrapper (update_limits / update_action / evaluate /
+    get_high_priority / print_config) over the jnp Mamdani core."""
+
+    def __init__(self, n_action=N_ACTION):
+        self.n_action = n_action
+        self.config = default_config()
+        assert n_action == N_ACTION
+
+    # -- action <-> membership maps (demix_controller.py:95-125) ------------
+
+    @staticmethod
+    def _update_set(fz, action):
+        hi = fz["range"][1]
+        fz["low"][2] = fz["low"][1] + action[0] * (hi - fz["low"][1])
+        fz["low"][3] = fz["low"][2] + action[1] * (hi - fz["low"][2])
+        fz["medium"][0] = fz["low"][2]
+        fz["medium"][1] = fz["low"][3]
+        fz["medium"][2] = fz["medium"][1] + action[2] * (hi - fz["medium"][1])
+        fz["medium"][3] = fz["medium"][2] + action[3] * (hi - fz["medium"][2])
+        fz["high"][0] = fz["medium"][2]
+        fz["high"][1] = fz["medium"][3]
+
+    @staticmethod
+    def _update_action(fz, action):
+        hi = fz["range"][1]
+        action[0] = (fz["low"][2] - fz["low"][1]) / (hi - fz["low"][1])
+        action[1] = (fz["low"][3] - fz["low"][2]) / (hi - fz["low"][2])
+        action[2] = ((fz["medium"][2] - fz["medium"][1])
+                     / (hi - fz["medium"][1]))
+        action[3] = ((fz["medium"][3] - fz["medium"][2])
+                     / (hi - fz["medium"][2]))
+
+    def update_limits(self, action):
+        action = np.asarray(action)
+        assert action.size == self.n_action
+        ins, outs = self.config["inputs"], self.config["outputs"]
+        for i, name in enumerate(ACTION_ORDER):
+            grp = outs if name == "priority" else ins
+            self._update_set(grp["_" + name], action[4 * i:4 * i + 4])
+
+    def update_action(self):
+        action = np.zeros(self.n_action)
+        ins, outs = self.config["inputs"], self.config["outputs"]
+        for i, name in enumerate(ACTION_ORDER):
+            grp = outs if name == "priority" else ins
+            self._update_action(grp["_" + name], action[4 * i:4 * i + 4])
+        return action
+
+    # -- evaluation ---------------------------------------------------------
+
+    def membership_stack(self):
+        arrs = _membership_arrays(self.config)
+        mf = jnp.asarray(np.stack([arrs[n] for n in VAR_ORDER]))
+        return mf, jnp.asarray(arrs["priority"])
+
+    def create_controller(self):
+        """No-op for API parity: the jnp core consumes the config directly
+        (the reference rebuilds a skfuzzy ControlSystem here)."""
+
+    def evaluate(self, azimuth, azimuth_target, elevation, elevation_target,
+                 separation, log_intensity, intensity_ratio):
+        mf, pmf = self.membership_stack()
+        x = jnp.asarray([azimuth, azimuth_target, elevation,
+                         elevation_target, separation, log_intensity,
+                         intensity_ratio], jnp.float32)
+        return float(mamdani_priority(mf, pmf, x))
+
+    def get_high_priority(self):
+        return self.config["outputs"]["_priority"]["high"][0]
+
+    def print_config(self, filename=None):
+        if filename:
+            with open(filename, "w+") as fh:
+                json.dump(self.config, fh)
+        else:
+            print(self.config)

@@ -1,0 +1,53 @@
+"""MLP regressor (MSP) + training buffer for metadata -> hint regression.
+
+Parity targets: ``demixing_rl/regressor_net.py:6-28`` (RegressorNet:
+M -> 32 -> 32 -> K-1 with tanh output) and
+``demixing_rl/training_buffer.py:5-51`` (TrainingBuffer).
+"""
+
+import pickle
+
+import numpy as np
+from flax import linen as nn
+
+
+class RegressorNet(nn.Module):
+    """3-layer MLP, tanh output in action space."""
+
+    n_outputs: int
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.tanh(nn.Dense(self.n_outputs)(x))
+
+
+class TrainingBuffer:
+    """Minimal (x, y) ring buffer with pickle persistence
+    (training_buffer.py:5-51)."""
+
+    def __init__(self, max_size, input_shape, output_shape):
+        self.mem_size = max_size
+        self.mem_cntr = 0
+        self.x = np.zeros((max_size, input_shape), np.float32)
+        self.y = np.zeros((max_size, output_shape), np.float32)
+
+    def store(self, x, y):
+        i = self.mem_cntr % self.mem_size
+        self.x[i] = x
+        self.y[i] = y
+        self.mem_cntr += 1
+
+    def filled(self):
+        n = min(self.mem_cntr, self.mem_size)
+        return self.x[:n], self.y[:n]
+
+    def save_checkpoint(self, path="databuffer.pkl"):
+        with open(path, "wb") as fh:
+            pickle.dump(self.__dict__, fh)
+
+    def load_checkpoint(self, path="databuffer.pkl"):
+        with open(path, "rb") as fh:
+            self.__dict__.update(pickle.load(fh))

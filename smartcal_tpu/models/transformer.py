@@ -1,0 +1,136 @@
+"""Transformer classifier for demixing-direction recommendation.
+
+Parity target: ``calibration/transformer_models.py:76-186`` — a 1-layer
+encoder whose multi-head attention has NO sequence axis: the input
+(batch, K*(Npix^2+8)) is projected to model_dim, reshaped into
+``num_heads = K`` head slots, and attention runs ACROSS THE HEADS (each
+head is one sky direction; attn_logits are (batch, heads, heads)).
+Output is a sigmoid over K-1 labels ("demix this direction?").
+
+Also the generic x/y ReplayBuffer of transformer_models.py:10-70 (host
+numpy with ``resize``).
+"""
+
+import pickle
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+class HeadAttention(nn.Module):
+    """The reference's seq-free MultiheadAttention
+    (transformer_models.py:85-119): qkv projection, heads as the attention
+    axis, output projection."""
+
+    embed_dim: int
+    num_heads: int
+
+    @nn.compact
+    def __call__(self, x, return_attention=False):
+        head_dim = self.embed_dim // self.num_heads
+        qkv = nn.Dense(3 * self.embed_dim,
+                       kernel_init=nn.initializers.xavier_uniform(),
+                       bias_init=nn.initializers.zeros)(x)
+        qkv = qkv.reshape(x.shape[0], self.num_heads, 3 * head_dim)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        logits = jnp.einsum("bhd,bgd->bhg", q, k) / jnp.sqrt(head_dim)
+        attn = nn.softmax(logits, axis=-1)
+        values = jnp.einsum("bhg,bgd->bhd", attn, v)
+        o = nn.Dense(self.embed_dim,
+                     kernel_init=nn.initializers.xavier_uniform(),
+                     bias_init=nn.initializers.zeros)(
+            values.reshape(x.shape[0], self.embed_dim))
+        if return_attention:
+            return o, attn
+        return o
+
+
+class EncoderBlock(nn.Module):
+    """Pre-norm-free residual block (transformer_models.py:121-151)."""
+
+    input_dim: int
+    num_heads: int
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        attn_out = HeadAttention(self.input_dim, self.num_heads)(x)
+        x = nn.LayerNorm()(x + nn.Dropout(self.dropout,
+                                          deterministic=not train)(attn_out))
+        h = nn.Dense(self.input_dim)(x)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        h = nn.relu(h)
+        h = nn.Dense(self.input_dim)(h)
+        x = nn.LayerNorm()(x + nn.Dropout(self.dropout,
+                                          deterministic=not train)(h))
+        return x
+
+
+class TransformerEncoder(nn.Module):
+    """transformer_models.py:153-186; sigmoid multi-label output."""
+
+    num_layers: int
+    input_dim: int
+    model_dim: int
+    num_classes: int
+    num_heads: int
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(self.model_dim)(x)
+        for _ in range(self.num_layers):
+            x = EncoderBlock(self.model_dim, self.num_heads,
+                             self.dropout)(x, train=train)
+        x = nn.Dense(self.model_dim)(x)
+        x = nn.LayerNorm()(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes)(x)
+        return nn.sigmoid(x)
+
+
+class XYBuffer:
+    """Generic (x, y) training buffer with grow-on-demand ``resize``
+    (transformer_models.py:10-70) and whole-object pickling."""
+
+    def __init__(self, max_size: int, x_shape: Tuple[int, ...],
+                 y_shape: Tuple[int, ...]):
+        self.mem_size = max_size
+        self.mem_cntr = 0
+        self.x = np.zeros((max_size,) + tuple(x_shape), np.float32)
+        self.y = np.zeros((max_size,) + tuple(y_shape), np.float32)
+
+    def store(self, x, y):
+        i = self.mem_cntr % self.mem_size
+        self.x[i] = x
+        self.y[i] = y
+        self.mem_cntr += 1
+
+    def sample(self, rng, batch_size):
+        hi = min(self.mem_cntr, self.mem_size)
+        idx = rng.choice(hi, min(batch_size, hi), replace=False)
+        return self.x[idx], self.y[idx]
+
+    def resize(self, new_size):
+        old_x, old_y, n = self.x, self.y, min(self.mem_cntr, self.mem_size)
+        self.x = np.zeros((new_size,) + old_x.shape[1:], np.float32)
+        self.y = np.zeros((new_size,) + old_y.shape[1:], np.float32)
+        self.x[:n] = old_x[:n]
+        self.y[:n] = old_y[:n]
+        self.mem_size = new_size
+        self.mem_cntr = n
+
+    def save(self, path):
+        with open(path, "wb") as fh:
+            pickle.dump({"x": self.x, "y": self.y,
+                         "mem_cntr": self.mem_cntr}, fh)
+
+    def load(self, path):
+        with open(path, "rb") as fh:
+            d = pickle.load(fh)
+        self.x, self.y, self.mem_cntr = d["x"], d["y"], d["mem_cntr"]
+        self.mem_size = self.x.shape[0]

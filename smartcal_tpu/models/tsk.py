@@ -1,0 +1,119 @@
+"""Takagi-Sugeno-Kang (TSK) order-1 fuzzy regressor in pure JAX.
+
+Parity target: the pytsk-based model of ``demixing_rl/train_tsk.py``:
+Gaussian-membership antecedents (``AntecedentGMF``), ``n_rule`` rules,
+order-1 consequents, tanh output head, plus the two custom regularizers —
+the inverse-center-distance loss (train_tsk.py:81-98, pushes rule centers
+apart) and the sigma-magnitude loss (:100-110).
+
+Model: for input x (M,), rule firing uses log-Gaussian memberships
+  z_r = sum_m -(x_m - c_{m,r})^2 / (2 sigma_{m,r}^2)
+  w = softmax(z)                         (normalized firing strengths)
+  y = tanh( sum_r w_r (A_r x + b_r) )    (order-1 consequents)
+"""
+
+import pickle
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class TSKParams(NamedTuple):
+    center: jnp.ndarray   # (M, R)
+    sigma: jnp.ndarray    # (M, R)
+    A: jnp.ndarray        # (R, M, out)
+    b: jnp.ndarray        # (R, out)
+
+
+def tsk_init(key, n_inputs, n_outputs, n_rule=3, x_sample=None):
+    """Init centers from data samples when given (pytsk uses k-means over
+    the training inputs; random data draws are the cheap equivalent)."""
+    kc, ka, kb = jax.random.split(key, 3)
+    if x_sample is not None and x_sample.shape[0] >= n_rule:
+        idx = jax.random.choice(kc, x_sample.shape[0], (n_rule,),
+                                replace=False)
+        center = jnp.asarray(x_sample)[idx].T            # (M, R)
+    else:
+        center = jax.random.normal(kc, (n_inputs, n_rule))
+    sigma = jnp.ones((n_inputs, n_rule))
+    A = 0.01 * jax.random.normal(ka, (n_rule, n_inputs, n_outputs))
+    b = 0.01 * jax.random.normal(kb, (n_rule, n_outputs))
+    return TSKParams(center=center, sigma=sigma, A=A, b=b)
+
+
+def tsk_forward(params: TSKParams, x):
+    """x (..., M) -> (..., out)."""
+    d = x[..., :, None] - params.center                  # (..., M, R)
+    z = -0.5 * jnp.sum((d / (params.sigma + 1e-8)) ** 2, axis=-2)
+    w = jax.nn.softmax(z, axis=-1)                       # (..., R)
+    rule_out = jnp.einsum("...m,rmo->...ro", x, params.A) + params.b
+    return jnp.tanh(jnp.einsum("...r,...ro->...o", w, rule_out))
+
+
+def center_difference_loss(params: TSKParams):
+    """Inverse pairwise center distance (train_tsk.py:81-98)."""
+    c = params.center                                    # (M, R)
+    M, R = c.shape
+    d2 = (c[:, :, None] - c[:, None, :]) ** 2            # (M, R, R)
+    iu = jnp.triu_indices(R, 1)
+    inv = jnp.sum(1.0 / (d2[:, iu[0], iu[1]] + 1e-5))
+    return inv / (M * R * (R - 1) / 2)
+
+
+def sigma_loss(params: TSKParams):
+    """Mean sigma^2 (train_tsk.py:100-110)."""
+    return jnp.mean(params.sigma ** 2)
+
+
+def tsk_loss(params: TSKParams, x, y, g1=1e-4, g2=1e-4):
+    """||y - f(x)||^2 / batch + g1*center_diff + g2*sigma
+    (train_tsk.py:136-147)."""
+    pred = tsk_forward(params, x)
+    mse = jnp.sum((pred - y) ** 2) / x.shape[0]
+    return mse + g1 * center_difference_loss(params) + g2 * sigma_loss(params)
+
+
+def train_tsk(key, x_train, y_train, n_rule=3, n_iter=2000, batch_size=256,
+              lr=1e-3, g1=1e-4, g2=1e-4, x_test=None, y_test=None,
+              log_every=0):
+    """Adam training loop (train_tsk.py:112-158), jit-scanned on device."""
+    x_train = jnp.asarray(x_train, jnp.float32)
+    y_train = jnp.asarray(y_train, jnp.float32)
+    kp, kloop = jax.random.split(key)
+    params = tsk_init(kp, x_train.shape[1], y_train.shape[1], n_rule,
+                      x_sample=x_train)
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+    bs = min(batch_size, x_train.shape[0])
+
+    @jax.jit
+    def step(carry, k):
+        params, opt_state = carry
+        idx = jax.random.choice(k, x_train.shape[0], (bs,), replace=False)
+        loss, grads = jax.value_and_grad(tsk_loss)(params, x_train[idx],
+                                                   y_train[idx], g1, g2)
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    keys = jax.random.split(kloop, n_iter)
+    (params, _), losses = jax.lax.scan(step, (params, opt_state), keys)
+    out = {"params": params, "losses": np.asarray(losses)}
+    if x_test is not None:
+        pred = tsk_forward(params, jnp.asarray(x_test))
+        out["test_mse"] = float(jnp.mean(jnp.sum(
+            (pred - jnp.asarray(y_test)) ** 2, axis=-1)))
+    return out
+
+
+def save_tsk(params: TSKParams, path="tsk.model.pkl"):
+    with open(path, "wb") as fh:
+        pickle.dump(jax.device_get(params), fh)
+
+
+def load_tsk(path="tsk.model.pkl") -> TSKParams:
+    with open(path, "rb") as fh:
+        return TSKParams(*pickle.load(fh))

@@ -20,7 +20,9 @@ import optax
 from jax import lax
 
 from . import replay as rp
-from .networks import MLPCritic, MLPDeterministicActor
+from .networks import (MLPCritic, MLPDeterministicActor,
+                       SplitImageMetaCritic,
+                       SplitImageMetaDeterministicActor)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,6 +38,8 @@ class DDPGConfig:
     ou_sigma: float = 0.15
     ou_theta: float = 0.2
     ou_dt: float = 1e-2
+    img_shape: Optional[Tuple[int, int]] = None   # see sac.SACConfig
+    use_image: bool = True
 
 
 class OUState(NamedTuple):
@@ -65,6 +69,12 @@ class DDPGState(NamedTuple):
 
 
 def _nets(cfg: DDPGConfig):
+    if cfg.img_shape is not None:
+        return (SplitImageMetaDeterministicActor(
+                    img_shape=cfg.img_shape, n_actions=cfg.n_actions,
+                    use_image=cfg.use_image),
+                SplitImageMetaCritic(img_shape=cfg.img_shape,
+                                     use_image=cfg.use_image))
     return MLPDeterministicActor(cfg.n_actions), MLPCritic()
 
 

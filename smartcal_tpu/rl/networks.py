@@ -195,6 +195,78 @@ class ImageMetaCritic(nn.Module):
         return _dense(1, final=True)(x)
 
 
+class SplitObs(nn.Module):
+    """Carve a FLAT observation vector into (img, meta) for the image+meta
+    towers.  The dict observations of the radio envs ({'img'/'infmap',
+    'sky'/'metadata'}) are flattened at the env-agent boundary
+    (``flatten_obs``) so the replay buffer and every agent keep a single
+    flat obs array; the network re-splits here."""
+
+    img_shape: Tuple[int, int]
+
+    def split(self, obs):
+        h, w = self.img_shape
+        img = obs[..., :h * w].reshape(*obs.shape[:-1], h, w)
+        meta = obs[..., h * w:]
+        return img, meta
+
+
+class SplitImageMetaActor(SplitObs):
+    """ImageMetaActor over a flat obs (Gaussian policy head for SAC)."""
+
+    img_shape: Tuple[int, int] = (128, 128)
+    n_actions: int = 1
+    use_image: bool = True
+
+    @nn.compact
+    def __call__(self, obs):
+        img, meta = self.split(obs)
+        return ImageMetaActor(self.n_actions, use_image=self.use_image)(
+            img, meta)
+
+
+class SplitImageMetaDeterministicActor(SplitObs):
+    """Deterministic tanh variant for TD3/DDPG (reference calib_td3.py)."""
+
+    img_shape: Tuple[int, int] = (128, 128)
+    n_actions: int = 1
+    use_image: bool = True
+
+    @nn.compact
+    def __call__(self, obs):
+        img, meta = self.split(obs)
+        mu, _ = ImageMetaActor(self.n_actions, use_image=self.use_image)(
+            img, meta)
+        return jnp.tanh(mu)
+
+
+class SplitImageMetaCritic(SplitObs):
+    """ImageMetaCritic over a flat obs."""
+
+    img_shape: Tuple[int, int] = (128, 128)
+    use_image: bool = True
+
+    @nn.compact
+    def __call__(self, obs, action):
+        img, meta = self.split(obs)
+        return ImageMetaCritic(use_image=self.use_image)(img, meta, action)
+
+
+def flatten_obs(obs_dict, img_key=None, meta_key=None):
+    """Dict observation -> flat vector [img.ravel(), meta.ravel()].
+
+    Works for both radio envs: CalibEnv {'img', 'sky'} and DemixingEnv
+    {'infmap', 'metadata'}."""
+    import numpy as np
+
+    if img_key is None:
+        img_key = "img" if "img" in obs_dict else "infmap"
+    if meta_key is None:
+        meta_key = "sky" if "sky" in obs_dict else "metadata"
+    return np.concatenate([np.asarray(obs_dict[img_key]).ravel(),
+                           np.asarray(obs_dict[meta_key]).ravel()])
+
+
 def gaussian_sample(mu, logsigma, key):
     """Tanh-squashed reparameterised sample + log-prob.
 

@@ -34,7 +34,8 @@ import optax
 from jax import lax
 
 from . import replay as rp
-from .networks import MLPActor, MLPCritic, gaussian_sample
+from .networks import (MLPActor, MLPCritic, SplitImageMetaActor,
+                       SplitImageMetaCritic, gaussian_sample)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,6 +58,11 @@ class SACConfig:
     alpha_lr: float = 1e-4
     prioritized: bool = False
     error_clip: float = 100.0     # PER absolute_error_upper (enet_sac.py:212)
+    # dict-obs (radio) variants: when img_shape is set, obs_dim must equal
+    # H*W + meta_dim and the CNN+metadata towers are used (calib_sac.py,
+    # demix_sac.py); use_image=False drops the CNN branch (demixing_fuzzy)
+    img_shape: Optional[Tuple[int, int]] = None
+    use_image: bool = True
 
 
 class SACState(NamedTuple):
@@ -74,6 +80,12 @@ class SACState(NamedTuple):
 
 
 def _nets(cfg: SACConfig):
+    if cfg.img_shape is not None:
+        return (SplitImageMetaActor(img_shape=cfg.img_shape,
+                                    n_actions=cfg.n_actions,
+                                    use_image=cfg.use_image),
+                SplitImageMetaCritic(img_shape=cfg.img_shape,
+                                     use_image=cfg.use_image))
     return MLPActor(cfg.n_actions), MLPCritic()
 
 

@@ -37,7 +37,9 @@ import optax
 from jax import lax
 
 from . import replay as rp
-from .networks import MLPCritic, MLPDeterministicActor
+from .networks import (MLPCritic, MLPDeterministicActor,
+                       SplitImageMetaCritic,
+                       SplitImageMetaDeterministicActor)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,6 +62,8 @@ class TD3Config:
     corr_min: float = 0.5         # enet_td3.py:143
     prioritized: bool = False
     error_clip: float = 100.0
+    img_shape: Optional[Tuple[int, int]] = None   # see sac.SACConfig
+    use_image: bool = True
 
 
 class TD3State(NamedTuple):
@@ -77,6 +81,12 @@ class TD3State(NamedTuple):
 
 
 def _nets(cfg: TD3Config):
+    if cfg.img_shape is not None:
+        return (SplitImageMetaDeterministicActor(
+                    img_shape=cfg.img_shape, n_actions=cfg.n_actions,
+                    use_image=cfg.use_image),
+                SplitImageMetaCritic(img_shape=cfg.img_shape,
+                                     use_image=cfg.use_image))
     return MLPDeterministicActor(cfg.n_actions), MLPCritic()
 
 

@@ -1,0 +1,93 @@
+"""Calibration (ADMM-rho tuning) SAC training driver.
+
+Mirrors ``calibration/main_sac.py``: M=10 max directions, 2M actions,
+episodes of up to 4 steps, rewards > 1 scaled by 10, per-episode model
+checkpointing, score moving average.  The env runs hermetically on the
+in-framework backend (envs/radio.py) instead of shelling to
+dosimul/docal/doinfluence.
+
+Usage:
+    python -m smartcal_tpu.train.calib_sac --episodes 50 --seed 0
+        [--use_hint] [--stations 14] [--small]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+
+import numpy as np
+
+from ..envs import CalibEnv
+from ..envs.radio import RadioBackend
+from ..rl import sac
+from ..rl.networks import flatten_obs
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--episodes", type=int, default=50)
+    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--M", type=int, default=10)
+    p.add_argument("--use_hint", action="store_true")
+    p.add_argument("--stations", type=int, default=14)
+    p.add_argument("--npix", type=int, default=128)
+    p.add_argument("--small", action="store_true",
+                   help="tiny shapes for smoke runs")
+    p.add_argument("--load", action="store_true")
+    p.add_argument("--prefix", type=str, default="calib_sac")
+    args = p.parse_args(argv)
+
+    if args.small:
+        backend = RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                               admm_iters=2, lbfgs_iters=3, init_iters=5,
+                               npix=32)
+    else:
+        backend = RadioBackend(n_stations=args.stations, npix=args.npix)
+    env = CalibEnv(M=args.M, provide_hint=args.use_hint, backend=backend,
+                   seed=args.seed)
+    npix = backend.npix
+    obs_dim = npix * npix + (args.M + 1) * 7
+    agent_cfg = sac.SACConfig(
+        obs_dim=obs_dim, n_actions=2 * args.M, gamma=0.99, tau=0.005,
+        batch_size=32, mem_size=10000, lr_a=1e-3, lr_c=1e-3,
+        reward_scale=args.M, alpha=0.03, hint_threshold=0.01, admm_rho=1.0,
+        use_hint=args.use_hint, hint_distance="kld",
+        img_shape=(npix, npix))
+    agent = sac.SACAgent(agent_cfg, seed=args.seed, name_prefix=args.prefix)
+    if args.load:
+        agent.load_models()
+
+    scores = []
+    for i in range(args.episodes):
+        obs = env.reset()
+        flat = flatten_obs(obs)
+        score, loop, done = 0.0, 0, False
+        while not done and loop < args.steps:
+            action = np.asarray(agent.choose_action(flat)).squeeze()
+            out = env.step(action)
+            if args.use_hint:
+                obs2, reward, done, hint, info = out
+            else:
+                obs2, reward, done, info = out
+                hint = np.zeros(2 * args.M, np.float32)
+            flat2 = flatten_obs(obs2)
+            # rewards > 1 scaled by 10 (main_sac.py:24,49)
+            scaled = reward * 10 if reward > 1 else reward
+            agent.store_transition(flat, action, scaled, flat2, done, hint)
+            agent.learn()
+            score += reward
+            flat = flat2
+            loop += 1
+        scores.append(score / max(loop, 1))
+        print(f"episode {i} score {scores[-1]:.2f} "
+              f"average score {np.mean(scores[-100:]):.2f}")
+        agent.save_models()
+        with open(f"{args.prefix}_scores.pkl", "wb") as fh:
+            pickle.dump(scores, fh)
+    return scores
+
+
+if __name__ == "__main__":
+    main()

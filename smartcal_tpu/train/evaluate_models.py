@@ -1,0 +1,93 @@
+"""Lockstep agent comparison on the demixing env.
+
+Parity target: ``demixing_rl/evaluate_models.py:32-86`` — three SAC agents
+(trained without hint, trained with hint, untrained) step the SAME env
+episodes; per episode the best-reward action of each is reported, plus the
+reward of the exhaustive-AIC hint itself.
+
+Usage:
+    python -m smartcal_tpu.train.evaluate_models --games 10
+        [--nohint PREFIX] [--withhint PREFIX] [--small]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from ..envs import DemixingEnv
+from ..envs.radio import RadioBackend
+from ..rl import sac
+from ..rl.networks import flatten_obs
+
+
+def evaluate(env: DemixingEnv, agents: dict, n_steps: int, n_games: int,
+             quiet=False):
+    """Returns {name: [best reward per episode]} plus 'hint' rewards."""
+    results = {name: [] for name in agents}
+    results["hint"] = []
+    for cn in range(n_games):
+        obs0 = env.reset()
+        flats = {name: flatten_obs(obs0) for name in agents}
+        best = {name: -np.inf for name in agents}
+        hint = None
+        for ci in range(n_steps):
+            for name, agent in agents.items():
+                action = np.asarray(
+                    agent.choose_action(flats[name])).squeeze()
+                out = env.step(action)
+                obs_, reward, done, hint, info = out
+                flats[name] = flatten_obs(obs_)
+                best[name] = max(best[name], reward)
+                if not quiet:
+                    print(f"Iter {cn}:{ci} {name} reward {reward:.3f}")
+        for name in agents:
+            results[name].append(best[name])
+        _, reward_hint, *_ = env.step(hint)
+        results["hint"].append(reward_hint)
+        if not quiet:
+            print(f"Episode {cn}: rewards "
+                  + " ".join(f"{n}={results[n][-1]:.3f}" for n in results))
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--games", type=int, default=10)
+    p.add_argument("--K", type=int, default=6)
+    p.add_argument("--nohint", type=str, default="")
+    p.add_argument("--withhint", type=str, default="")
+    p.add_argument("--small", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.small:
+        backend = RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                               admm_iters=30, lbfgs_iters=3, init_iters=5,
+                               npix=32)
+    else:
+        backend = RadioBackend(admm_iters=30)
+    env = DemixingEnv(K=args.K, provide_hint=True, backend=backend)
+    npix = backend.npix
+    obs_dim = npix * npix + 3 * args.K + 2
+
+    def make_agent(prefix, use_hint):
+        cfg = sac.SACConfig(obs_dim=obs_dim, n_actions=args.K,
+                            batch_size=256, mem_size=4096, alpha=0.03,
+                            use_hint=use_hint, img_shape=(npix, npix))
+        a = sac.SACAgent(cfg, name_prefix=prefix)
+        if prefix:
+            a.load_models()
+        return a
+
+    agents = {"nohint": make_agent(args.nohint, False),
+              "withhint": make_agent(args.withhint, True),
+              "untrained": make_agent("", False)}
+    results = evaluate(env, agents, n_steps=args.K, n_games=args.games)
+    for name, vals in results.items():
+        print(f"{name}: mean best reward {np.mean(vals):.4f}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,241 @@
+"""Supervised pipelines: data generation + training for the aux models.
+
+Parity targets:
+  * ``demixing_rl/makedata.py`` — (metadata, exhaustive-AIC hint) pairs
+    into a TrainingBuffer (:27-37);
+  * ``demixing_rl/train_regressor.py`` — Adam MLP regression with a
+    train/test split and ||.||^2 loss (:36-84);
+  * ``demixing_rl/train_tsk.py`` — TSK fuzzy regressor on the same buffer;
+  * ``calibration/generate_data.py:519-615`` (generate_training_data) —
+    per-direction features (normalized influence image + 8 scalars) and
+    binary demix labels for the transformer classifier;
+  * ``demixing/train_model.py`` — BCE transformer training;
+  * ``demixing_rl/evaluate_tsk_msp.py`` — MLP vs TSK vs hint reward
+    comparison on live env episodes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from smartcal_tpu.cal import influence as influence_mod
+from smartcal_tpu.cal import imager, solver
+from smartcal_tpu.envs.demixing import DemixingEnv
+from smartcal_tpu.envs.radio import RadioBackend
+from smartcal_tpu.models.regressor import RegressorNet, TrainingBuffer
+from smartcal_tpu.models.transformer import TransformerEncoder, XYBuffer
+from smartcal_tpu.models.tsk import train_tsk
+
+META_SCALE = 1e-3
+
+
+def make_hint_dataset(n_iter=40, K=6, backend: Optional[RadioBackend] = None,
+                      seed=0, buffer_path=None, n_samples=3000):
+    """(metadata, hint[:-1]) pairs from env resets (makedata.py:27-37)."""
+    env = DemixingEnv(K=K, provide_hint=True, provide_influence=False,
+                      backend=backend, seed=seed)
+    M = 3 * K + 2
+    buf = TrainingBuffer(n_samples, M, K - 1)
+    for ci in range(n_iter):
+        obs = env.reset()
+        hint = env.get_hint()
+        buf.store(obs["metadata"], hint[:-1])
+        if buffer_path:
+            buf.save_checkpoint(buffer_path)
+    return buf
+
+
+def train_regressor(buf: TrainingBuffer, n_iter=1000, batch_size=32,
+                    lr=1e-3, test_frac=0.2, seed=0, hidden=32):
+    """Adam MLP training (train_regressor.py:36-84).  Returns
+    (params, history dict)."""
+    x, y = buf.filled()
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(x.shape[0])
+    n_test = max(1, int(test_frac * x.shape[0]))
+    test_idx, train_idx = idx[:n_test], idx[n_test:]
+    x_train = jnp.asarray(x[train_idx])
+    y_train = jnp.asarray(y[train_idx])
+    x_test = jnp.asarray(x[test_idx])
+    y_test = jnp.asarray(y[test_idx])
+
+    net = RegressorNet(n_outputs=y.shape[1], hidden=hidden)
+    params = net.init(jax.random.PRNGKey(seed), x_train[:1])["params"]
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+    bs = min(batch_size, x_train.shape[0])
+
+    @jax.jit
+    def step(carry, k):
+        params, opt_state = carry
+        i = jax.random.choice(k, x_train.shape[0], (bs,), replace=False)
+
+        def loss_fn(p):
+            pred = net.apply({"params": p}, x_train[i])
+            return jnp.sum((pred - y_train[i]) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return (optax.apply_updates(params, updates), opt_state), loss
+
+    keys = jax.random.split(jax.random.PRNGKey(seed + 1), n_iter)
+    (params, _), losses = jax.lax.scan(step, (params, opt_state), keys)
+    test_mse = float(jnp.mean(jnp.sum(
+        (net.apply({"params": params}, x_test) - y_test) ** 2, axis=-1)))
+    return params, {"losses": np.asarray(losses), "test_mse": test_mse,
+                    "net": net}
+
+
+def train_tsk_on_buffer(buf: TrainingBuffer, seed=0, **kw):
+    """TSK regressor on the same hint buffer (train_tsk.py)."""
+    x, y = buf.filled()
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(x.shape[0])
+    n_test = max(1, int(0.2 * x.shape[0]))
+    return train_tsk(jax.random.PRNGKey(seed), x[idx[n_test:]],
+                     y[idx[n_test:]], x_test=x[idx[:n_test]],
+                     y_test=y[idx[:n_test]], **kw)
+
+
+# ---------------------------------------------------------------------------
+# Transformer classifier data + training
+# ---------------------------------------------------------------------------
+
+def generate_training_data(key, backend: RadioBackend, K=6,
+                           flux_floor=1.0, el_floor=3.0):
+    """One (x, y) sample for the demix transformer.
+
+    x: K blocks of [normalized per-direction influence image (npix^2),
+    separation, azimuth, elevation, log||J||, log||C||, log|Inf|, LLR,
+    log(f_0)] (generate_data.py:586-615).  y: K-1 binary labels.
+
+    Labels: the reference images each cluster with the beam and thresholds
+    masked pixel sums (generate_data.py:535-580); here apparent fluxes are
+    known exactly from the simulation, so y = apparent flux above
+    ``flux_floor`` and elevation above ``el_floor`` — same decision, no
+    imaging round-trip.
+    """
+    ep, mdl = backend.new_demixing_episode(key, K)
+    res = backend.calibrate(ep, mdl.rho, mask=np.ones(K, np.float32))
+
+    freqs = np.asarray(ep.obs.freqs)
+    hadd = influence_mod.consensus_hadd_scalars(
+        mdl.rho, np.full(K, 0.001, np.float32), freqs, ep.f0, 0,
+        n_poly=backend.n_poly, polytype=backend.polytype)
+    Rk = solver.residual_to_kernel(res.residual[0])
+    inf = influence_mod.influence_visibilities(
+        Rk, ep.Ccal[0], res.J[0], hadd, backend.n_stations,
+        backend.n_chunks, perdir=True)
+    summary = influence_mod.perdir_summary(inf.vis, inf.llr, ep.Ccal[0],
+                                           res.J[0])
+
+    uvw = jnp.asarray(np.asarray(ep.obs.uvw).reshape(-1, 3))
+    cell = imager.default_cell(ep.obs.uvw, float(freqs[0]))
+    npix = backend.npix
+    nout = npix * npix + 8
+    x = np.zeros(K * nout, np.float32)
+    for ck in range(K):
+        ivis = influence_mod.stokes_i_influence(inf.vis[ck])
+        img = np.asarray(imager.dirty_image_sr(uvw, ivis, float(freqs[0]),
+                                               cell, npix=npix))
+        flat = img.reshape(-1, order="F")
+        flat = flat / max(np.linalg.norm(flat), 1e-12)
+        o = ck * nout
+        x[o:o + npix * npix] = flat
+        x[o + npix * npix + 0] = mdl.separations[ck]
+        x[o + npix * npix + 1] = mdl.azimuth[ck]
+        x[o + npix * npix + 2] = mdl.elevation[ck]
+        x[o + npix * npix + 3] = np.log(max(float(summary.j_norm[ck]), 1e-12))
+        x[o + npix * npix + 4] = np.log(max(float(summary.c_norm[ck]), 1e-12))
+        x[o + npix * npix + 5] = np.log(max(float(summary.inf_mean[ck]),
+                                            1e-12))
+        x[o + npix * npix + 6] = float(summary.llr_mean[ck])
+        x[o + npix * npix + 7] = np.log(freqs[0])
+
+    y = ((mdl.fluxes[:-1] > flux_floor)
+         & (mdl.elevation[:-1] >= el_floor)).astype(np.float32)
+    return x, y
+
+
+def make_transformer_dataset(n_iter=30, K=6,
+                             backend: Optional[RadioBackend] = None,
+                             seed=0, buffer_path=None):
+    """demixing/simulate_data.py: n_iter samples into an XYBuffer."""
+    backend = backend or RadioBackend()
+    npix = backend.npix
+    buf = XYBuffer(max(n_iter, 8), (K * (npix * npix + 8),), (K - 1,))
+    key = jax.random.PRNGKey(seed)
+    for ci in range(n_iter):
+        key, k = jax.random.split(key)
+        x, y = generate_training_data(k, backend, K=K)
+        buf.store(x, y)
+        if buffer_path:
+            buf.save(buffer_path)
+    return buf
+
+
+def train_transformer(buf: XYBuffer, K=6, model_dim=66, epochs=2000,
+                      batch_size=8, lr=1e-3, dropout=0.6, seed=0):
+    """BCE training of the K-head classifier (demixing/train_model.py:26-57;
+    Nmodel=66, dropout 0.6, heads=K)."""
+    n = min(buf.mem_cntr, buf.mem_size)
+    x = jnp.asarray(buf.x[:n])
+    y = jnp.asarray(buf.y[:n])
+    model = TransformerEncoder(num_layers=1, input_dim=x.shape[1],
+                               model_dim=model_dim * K, num_classes=K - 1,
+                               num_heads=K, dropout=dropout)
+    k0, kd = jax.random.split(jax.random.PRNGKey(seed))
+    params = model.init({"params": k0, "dropout": kd}, x[:1],
+                        train=True)["params"]
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+    bs = min(batch_size, n)
+
+    @jax.jit
+    def step(carry, k):
+        params, opt_state = carry
+        ki, kd = jax.random.split(k)
+        i = jax.random.choice(ki, n, (bs,), replace=False)
+
+        def loss_fn(p):
+            pred = model.apply({"params": p}, x[i], train=True,
+                               rngs={"dropout": kd})
+            pred = jnp.clip(pred, 1e-6, 1 - 1e-6)
+            return -jnp.mean(y[i] * jnp.log(pred)
+                             + (1 - y[i]) * jnp.log(1 - pred))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return (optax.apply_updates(params, updates), opt_state), loss
+
+    keys = jax.random.split(jax.random.PRNGKey(seed + 1), epochs)
+    (params, _), losses = jax.lax.scan(step, (params, opt_state), keys)
+    return params, {"losses": np.asarray(losses), "model": model}
+
+
+def evaluate_tsk_msp(buf: TrainingBuffer, mlp_params, mlp_net, tsk_params,
+                     env: DemixingEnv, episodes=3):
+    """MLP vs TSK vs data-driven hint rewards over live episodes
+    (evaluate_tsk_msp.py:62-89).  Returns dict of per-episode rewards."""
+    from smartcal_tpu.models.tsk import tsk_forward
+
+    out = {"mlp": [], "tsk": [], "hint": []}
+    for _ in range(episodes):
+        obs = env.reset()
+        md = jnp.asarray(obs["metadata"])[None]
+        hint = env.get_hint()
+        iter_act = hint[-1]
+        for name, sel in (
+                ("mlp", np.asarray(mlp_net.apply({"params": mlp_params},
+                                                 md))[0]),
+                ("tsk", np.asarray(tsk_forward(tsk_params, md))[0]),
+                ("hint", hint[:-1])):
+            action = np.concatenate([sel, [iter_act]]).astype(np.float32)
+            _, reward, _, _ = env.step(action)[:4]
+            out[name].append(float(reward))
+    return out

@@ -1,0 +1,126 @@
+"""Tests for the aux models (transformer, regressor, TSK) and the
+supervised pipelines (reference demixing_rl/makedata.py,
+train_regressor.py, train_tsk.py, demixing/train_model.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smartcal_tpu.models.regressor import RegressorNet, TrainingBuffer
+from smartcal_tpu.models.transformer import TransformerEncoder, XYBuffer
+from smartcal_tpu.models.tsk import (center_difference_loss, sigma_loss,
+                                     train_tsk, tsk_forward, tsk_init)
+
+
+class TestTransformer:
+    def test_forward_shapes_and_range(self):
+        K = 4
+        model = TransformerEncoder(num_layers=1, input_dim=40,
+                                   model_dim=8 * K, num_classes=K - 1,
+                                   num_heads=K)
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (5, 40)).astype(np.float32))
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        y = model.apply({"params": params}, x)
+        assert y.shape == (5, K - 1)
+        assert np.all(np.asarray(y) >= 0) and np.all(np.asarray(y) <= 1)
+
+    def test_learns_trivial_rule(self):
+        """BCE training must fit y = 1[x_0 > 0] on toy data."""
+        from smartcal_tpu.train.supervised import train_transformer
+        rng = np.random.default_rng(1)
+        K = 3
+        buf = XYBuffer(64, (30,), (K - 1,))
+        for _ in range(64):
+            x = rng.standard_normal(30).astype(np.float32)
+            y = np.asarray([x[0] > 0, x[1] > 0], np.float32)
+            buf.store(x, y)
+        params, info = train_transformer(buf, K=K, model_dim=8, epochs=400,
+                                         batch_size=16, dropout=0.0)
+        model = info["model"]
+        pred = np.asarray(model.apply({"params": params},
+                                      jnp.asarray(buf.x)))
+        acc = np.mean((pred > 0.5) == (buf.y > 0.5))
+        assert acc > 0.8
+
+    def test_xybuffer_resize(self):
+        buf = XYBuffer(4, (3,), (2,))
+        for i in range(3):
+            buf.store(np.full(3, i), np.full(2, i))
+        buf.resize(8)
+        assert buf.mem_size == 8
+        np.testing.assert_array_equal(buf.x[2], np.full(3, 2))
+
+
+class TestRegressor:
+    def test_training_reduces_test_mse(self):
+        from smartcal_tpu.train.supervised import train_regressor
+        rng = np.random.default_rng(2)
+        buf = TrainingBuffer(128, 6, 2)
+        W = rng.standard_normal((6, 2)) * 0.3
+        for _ in range(128):
+            x = rng.standard_normal(6).astype(np.float32)
+            buf.store(x, np.tanh(x @ W))
+        params, hist = train_regressor(buf, n_iter=500, batch_size=32)
+        assert hist["test_mse"] < 0.1
+        assert hist["losses"][-1] < hist["losses"][0]
+
+    def test_buffer_roundtrip(self, tmp_path):
+        buf = TrainingBuffer(8, 3, 1)
+        buf.store([1, 2, 3], [4])
+        p = str(tmp_path / "buf.pkl")
+        buf.save_checkpoint(p)
+        buf2 = TrainingBuffer(8, 3, 1)
+        buf2.load_checkpoint(p)
+        np.testing.assert_array_equal(buf2.x[0], [1, 2, 3])
+        assert buf2.mem_cntr == 1
+
+
+class TestTSK:
+    def test_forward_shape_and_range(self):
+        params = tsk_init(jax.random.PRNGKey(0), 5, 2, n_rule=3)
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (7, 5)).astype(np.float32))
+        y = tsk_forward(params, x)
+        assert y.shape == (7, 2)
+        assert np.all(np.abs(np.asarray(y)) <= 1.0)
+
+    def test_regularizers_positive(self):
+        params = tsk_init(jax.random.PRNGKey(1), 4, 1, n_rule=3)
+        assert float(center_difference_loss(params)) > 0
+        assert float(sigma_loss(params)) == pytest.approx(1.0)
+
+    def test_training_fits_linear_map(self):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((200, 4)).astype(np.float32)
+        W = rng.standard_normal((4, 2)) * 0.4
+        y = np.tanh(x @ W).astype(np.float32)
+        out = train_tsk(jax.random.PRNGKey(0), x[:160], y[:160], n_rule=3,
+                        n_iter=800, batch_size=64, x_test=x[160:],
+                        y_test=y[160:])
+        assert out["test_mse"] < 0.2
+
+
+def test_make_hint_dataset_smoke():
+    from smartcal_tpu.envs.radio import RadioBackend
+    from smartcal_tpu.train.supervised import make_hint_dataset
+    be = RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                      admm_iters=30, lbfgs_iters=3, init_iters=5, npix=32)
+    buf = make_hint_dataset(n_iter=2, K=3, backend=be, seed=1)
+    x, y = buf.filled()
+    assert x.shape == (2, 11)
+    assert y.shape == (2, 2)
+    assert np.all(np.isfinite(x)) and np.all(np.isfinite(y))
+
+
+def test_generate_training_data_smoke():
+    from smartcal_tpu.envs.radio import RadioBackend
+    from smartcal_tpu.train.supervised import generate_training_data
+    be = RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                      admm_iters=2, lbfgs_iters=3, init_iters=5, npix=16)
+    x, y = generate_training_data(jax.random.PRNGKey(5), be, K=3)
+    assert x.shape == (3 * (16 * 16 + 8),)
+    assert y.shape == (2,)
+    assert set(np.unique(y)).issubset({0.0, 1.0})
+    assert np.all(np.isfinite(x))

@@ -1,0 +1,132 @@
+"""Tests for the fuzzy controller (models/fuzzy.py) and fuzzy demixing env
+against the reference (demixing_fuzzy/demix_controller.py, demixingenv.py)."""
+
+import numpy as np
+import pytest
+
+from smartcal_tpu.models.fuzzy import (N_ACTION, DemixController,
+                                       default_config, trapmf)
+
+
+class TestTrapmf:
+    def test_shape_points(self):
+        import jax.numpy as jnp
+        abcd = jnp.asarray([0.0, 10.0, 20.0, 40.0])
+        assert float(trapmf(jnp.asarray(-1.0), abcd)) == 0.0
+        assert float(trapmf(jnp.asarray(5.0), abcd)) == pytest.approx(0.5)
+        assert float(trapmf(jnp.asarray(15.0), abcd)) == 1.0
+        assert float(trapmf(jnp.asarray(30.0), abcd)) == pytest.approx(0.5)
+        assert float(trapmf(jnp.asarray(41.0), abcd)) == 0.0
+
+    def test_degenerate_edges(self):
+        import jax.numpy as jnp
+        # a == b (step up), as in the 'low' sets
+        abcd = jnp.asarray([-90.0, -90.0, -5.0, 5.0])
+        assert float(trapmf(jnp.asarray(-90.0), abcd)) == 1.0
+        assert float(trapmf(jnp.asarray(0.0), abcd)) == pytest.approx(0.5)
+
+
+class TestControllerActionMaps:
+    def test_update_roundtrip(self):
+        """update_limits then update_action must return the same action
+        (the reference documents update_action_ as the exact inverse)."""
+        ctrl = DemixController()
+        rng = np.random.default_rng(0)
+        action = rng.uniform(0.05, 0.6, N_ACTION)
+        ctrl.update_limits(action)
+        back = ctrl.update_action()
+        np.testing.assert_allclose(back, action, rtol=1e-10)
+
+    def test_default_action_roundtrip(self):
+        ctrl = DemixController()
+        a0 = ctrl.update_action()
+        ctrl2 = DemixController()
+        ctrl2.update_limits(a0)
+        for grp in ("inputs", "outputs"):
+            for k, v in ctrl2.config[grp].items():
+                if k.startswith("_comment"):
+                    continue
+                ref = default_config()[grp][k]
+                for term in ("low", "medium", "high"):
+                    np.testing.assert_allclose(v[term], ref[term], atol=1e-9)
+
+    def test_chained_breakpoints_monotone(self):
+        ctrl = DemixController()
+        ctrl.update_limits(np.full(N_ACTION, 0.3))
+        for name, var in ctrl.config["inputs"].items():
+            lo, me, hi = var["low"], var["medium"], var["high"]
+            assert lo[1] <= lo[2] <= lo[3]
+            assert me[0] == lo[2] and me[1] == lo[3]
+            assert me[1] <= me[2] <= me[3]
+            assert hi[0] == me[2] and hi[1] == me[3]
+
+
+class TestPriority:
+    def test_bright_close_high_elevation_scores_high(self):
+        ctrl = DemixController()
+        # close separation, high elevation, bright source
+        p_good = ctrl.evaluate(azimuth=0.0, azimuth_target=0.0,
+                               elevation=70.0, elevation_target=70.0,
+                               separation=5.0, log_intensity=8.0,
+                               intensity_ratio=60.0)
+        # below horizon, far, weak
+        p_bad = ctrl.evaluate(azimuth=120.0, azimuth_target=-100.0,
+                              elevation=-30.0, elevation_target=70.0,
+                              separation=120.0, log_intensity=0.5,
+                              intensity_ratio=0.1)
+        assert p_good > p_bad
+        assert p_good >= 50.0
+        assert p_bad <= 45.0
+
+    def test_priority_in_range(self):
+        ctrl = DemixController()
+        rng = np.random.default_rng(1)
+        for _ in range(10):
+            p = ctrl.evaluate(
+                azimuth=float(rng.uniform(-180, 180)),
+                azimuth_target=float(rng.uniform(-180, 180)),
+                elevation=float(rng.uniform(-90, 90)),
+                elevation_target=float(rng.uniform(-90, 90)),
+                separation=float(rng.uniform(0, 180)),
+                log_intensity=float(rng.uniform(0, 10)),
+                intensity_ratio=float(rng.uniform(0, 100)))
+            assert 0.0 <= p <= 100.0
+
+
+class TestFuzzyEnv:
+    @pytest.fixture(scope="class")
+    def env(self):
+        from smartcal_tpu.envs import FuzzyDemixingEnv
+        from smartcal_tpu.envs.radio import RadioBackend
+        be = RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                          admm_iters=15, lbfgs_iters=3, init_iters=5,
+                          npix=32)
+        env = FuzzyDemixingEnv(K=3, provide_hint=True,
+                               provide_influence=False, backend=be, seed=11)
+        obs = env.reset()
+        return env, obs
+
+    def test_reset(self, env):
+        e, obs = env
+        assert obs["metadata"].shape == (5 * e.K + 2,)
+        md = obs["metadata"] / 1e-3
+        # selection flags: only target at reset
+        flags = md[4 * e.K:5 * e.K]
+        np.testing.assert_array_equal(flags, [0, 0, 1])
+        assert e.hint is not None and e.hint.shape == (e.n_actions,)
+
+    def test_hint_is_default_config(self, env):
+        e, _ = env
+        a01 = e.hint * 0.5 + 0.5
+        base = DemixController().update_action()
+        np.testing.assert_allclose(a01[:24], base[:24], atol=1e-6)
+        np.testing.assert_allclose(a01[-8:], base[-8:], atol=1e-6)
+
+    def test_step_with_hint_action(self, env):
+        e, _ = env
+        obs, r, done, hint, info = e.step(e.hint)
+        assert np.isfinite(r)
+        assert obs["metadata"].shape == (5 * e.K + 2,)
+        assert len(info["priority"]) == e.K - 1
+        # maxiter fixed at 15 in the fuzzy variant
+        assert e.maxiter == 15

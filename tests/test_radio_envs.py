@@ -1,0 +1,154 @@
+"""Tests for the radio RL environments (CalibEnv, DemixingEnv) against the
+reference contracts (calibration/calibenv.py, demixing_rl/demixingenv.py).
+Hermetic: runs on the CPU test backend with tiny shapes."""
+
+import numpy as np
+import pytest
+
+from smartcal_tpu.envs import CalibEnv, DemixingEnv
+from smartcal_tpu.envs.demixing import scalar_to_kvec
+from smartcal_tpu.envs.radio import RadioBackend
+
+
+def tiny_backend(**kw):
+    args = dict(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                admm_iters=2, lbfgs_iters=3, init_iters=5, npix=32)
+    args.update(kw)
+    return RadioBackend(**args)
+
+
+@pytest.fixture(scope="module")
+def calib_env():
+    env = CalibEnv(M=3, provide_hint=True, backend=tiny_backend(), seed=3)
+    obs = env.reset()
+    return env, obs
+
+
+class TestCalibEnv:
+    def test_reset_observation(self, calib_env):
+        env, obs = calib_env
+        assert obs["img"].shape == (32, 32)
+        assert obs["sky"].shape == (env.M + 1, 7)
+        assert np.all(np.isfinite(obs["img"]))
+        # final sky row carries (ra0, dec0, K, f_low, f_high) * META_SCALE
+        last = obs["sky"][-1] / 1e-3
+        assert last[2] == env.K
+        assert 2 <= env.K <= env.M
+
+    def test_hint_is_analytic_rho(self, calib_env):
+        env, obs = calib_env
+        assert env.hint is not None
+        assert env.hint.shape == (2 * env.M,)
+        # spatial hint = 5% of spectral, mapped affinely: undo the map
+        from smartcal_tpu.envs.calib import HIGH, LOW
+        spec = env.hint[:env.K] * (HIGH - LOW) / 2 + (HIGH + LOW) / 2
+        spat = (env.hint[env.M:env.M + env.K] * (HIGH - LOW) / 2
+                + (HIGH + LOW) / 2)
+        np.testing.assert_allclose(spat, 0.05 * spec, rtol=1e-5, atol=1e-3)
+
+    def test_step_reward_and_penalty(self, calib_env):
+        env, _ = calib_env
+        a = np.zeros(2 * env.M, np.float32)        # mid-range rho
+        obs, r, done, hint, info = env.step(a)
+        assert np.isfinite(r)
+        assert not done
+        # action at -1 maps rho to LOW boundary: no clip -> no penalty;
+        # the clip penalty only triggers below LOW, which the affine map
+        # cannot reach, so penalty stays 0 (parity with calibenv.py:126-138)
+        obs2, r2, *_ = env.step(-np.ones(2 * env.M, np.float32))
+        assert np.isfinite(r2)
+
+    def test_rho_update_reflected_in_sky_cols(self, calib_env):
+        env, _ = calib_env
+        a = np.full(2 * env.M, 0.5, np.float32)
+        obs, *_ = env.step(a)
+        sky = obs["sky"] / 1e-3
+        np.testing.assert_allclose(sky[:env.K, 5], 0.5, atol=1e-5)
+        np.testing.assert_allclose(sky[:env.K, 6], 0.5, atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def demix_env():
+    env = DemixingEnv(K=3, provide_hint=False, provide_influence=True,
+                      backend=tiny_backend(admm_iters=30), seed=5)
+    obs = env.reset()
+    return env, obs
+
+
+class TestDemixingEnv:
+    def test_reset_observation(self, demix_env):
+        env, obs = demix_env
+        assert obs["infmap"].shape == (32, 32)
+        assert obs["metadata"].shape == (3 * env.K + 2,)
+        md = obs["metadata"] / 1e-3
+        assert md[-1] == env.backend.n_stations
+        # target separation (last of the K) is zero
+        assert md[env.K - 1] == 0.0
+        assert np.isfinite(env.reward0)
+
+    def test_step_selection_and_metadata_zeroing(self, demix_env):
+        env, _ = demix_env
+        a = np.zeros(env.K, np.float32)
+        a[0] = 0.9           # select outlier 0
+        a[-1] = -1.0         # maxiter -> LOW_ITER
+        obs, r, done, info = env.step(a)
+        assert env.maxiter == 5
+        assert np.isfinite(r)
+        md = obs["metadata"] / 1e-3
+        assert md[0] == 0.0                       # selected -> zeroed
+        assert md[env.K - 1] == 0.0               # target always zeroed
+
+    def test_more_directions_lower_residual(self, demix_env):
+        env, _ = demix_env
+        none_sel = np.zeros(env.K, np.float32)
+        none_sel[:-1] = -1.0
+        _, _, _, _ = env.step(none_sel)
+        sigma_none = env.std_residual
+        all_sel = np.zeros(env.K, np.float32)
+        all_sel[:-1] = 1.0
+        _, _, _, _ = env.step(all_sel)
+        sigma_all = env.std_residual
+        assert sigma_all < sigma_none
+
+    def test_maxiter_penalty_in_reward(self, demix_env):
+        env, _ = demix_env
+        base = env.calculate_reward_(1)
+        env.maxiter = 30
+        high_iter = env.calculate_reward_(1)
+        env.maxiter = 5
+        low_iter = env.calculate_reward_(1)
+        assert low_iter > high_iter
+        assert np.isclose(high_iter - low_iter, -25 / 100.0)
+
+
+def test_scalar_to_kvec_parity():
+    # demixingenv.py:297-303
+    np.testing.assert_array_equal(scalar_to_kvec(0, 5), np.zeros(5))
+    np.testing.assert_array_equal(scalar_to_kvec(1, 5), [0, 0, 0, 0, 1])
+    np.testing.assert_array_equal(scalar_to_kvec(5, 5), [0, 0, 1, 0, 1])
+    np.testing.assert_array_equal(scalar_to_kvec(31, 5), np.ones(5))
+
+
+def test_demix_hint_sweep():
+    env = DemixingEnv(K=3, provide_hint=True, provide_influence=False,
+                      backend=tiny_backend(admm_iters=30), seed=7)
+    env.reset()
+    hint = env.get_hint()
+    assert hint.shape == (3,)
+    assert np.all(np.isfinite(hint))
+    # selection components live in [-1, 1]; maxiter component encodes 10
+    assert np.all(hint[:-1] >= -1.0) and np.all(hint[:-1] <= 1.0)
+    expected_iter = (10 - (30 + 5) / 2) * (2 / (30 - 5))
+    assert np.isclose(hint[-1], expected_iter)
+
+
+def test_demix_hint_respects_low_elevation():
+    env = DemixingEnv(K=3, provide_hint=True, provide_influence=False,
+                      backend=tiny_backend(admm_iters=30), seed=7)
+    env.reset()
+    # force an outlier below the elevation floor: its configs get AIC=1e5,
+    # so the hint probability of selecting it collapses
+    env.elevation = env.elevation.copy()
+    env.elevation[0] = 0.5
+    hint = env.get_hint()
+    assert hint[0] < -0.45     # ~never selected -> close to -1
