@@ -1,0 +1,186 @@
+"""Distributed prioritized-experience-replay learner/actor training.
+
+Parity target: ``elasticnet/distributed_per_sac.py`` (and the demixing
+variant ``demixing_rl/distributed_per_sac.py``): a rank-0 Learner owns the
+SAC agent + PER buffer; per episode it fires ``rpc_async`` rollouts on N
+remote Actors; each Actor pulls a CPU copy of the actor weights (:84-90,
+:123-128), runs ``epochs x steps`` env steps into a small local buffer
+(:130-141), and ``rpc_sync`` uploads the whole buffer; the Learner ingests
+transition by transition under a ``threading.Lock``, calling ``learn()``
+per transition (:44-57).
+
+TPU-native re-expression: the RPC fan-out becomes one SPMD program over the
+mesh's ``dp`` axis —
+
+* actor envs are sharded over ``dp``; the "weight pull" is parameter
+  replication (zero copies, the broadcast IS the sharding);
+* the rollout is a ``lax.scan`` over epochs x steps, vmapped over the
+  actor axis — every actor uses the episode-frozen actor params exactly
+  like the reference's stale CPU snapshot;
+* the "buffer upload" is the resharding of the transition batch from
+  dp-sharded to replicated (an all-gather over ICI inserted by XLA);
+* ingestion + learning runs replicated (identical on every device — the
+  lock disappears because the learner is deterministic SPMD, not a
+  thread).  ``learn_per_transition=True`` reproduces the reference's
+  learn-per-ingested-transition cadence; ``False`` does one batched learn
+  per actor-buffer (faster, recommended at scale).
+
+The same program runs multi-host under ``jax.distributed`` — ``dp`` spans
+all hosts' devices and the transition all-gather rides ICI/DCN, replacing
+TensorPipe/Gloo.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..envs import enet
+from ..rl import replay as rp
+from ..rl import sac
+
+
+class DistPERState(NamedTuple):
+    agent: sac.SACState
+    buf: rp.ReplayState
+    episode: jnp.ndarray    # () int32
+
+
+def make_distributed_per_sac(env_cfg: enet.EnetConfig,
+                             agent_cfg: sac.SACConfig, mesh: Mesh,
+                             n_actors: int, rollout_epochs: int = 10,
+                             rollout_steps: int = 10,
+                             use_hint: bool = False,
+                             learn_per_transition: bool = False):
+    """Build (init_fn, run_episode_fn) bound to ``mesh``.
+
+    One ``run_episode`` = the reference Learner's ``run_episodes`` body
+    (:60-74): all actors roll out with frozen weights, the learner ingests
+    everything and trains.  ``agent_cfg.prioritized`` should be True for
+    parity (distributed PER).
+    """
+    if n_actors % mesh.shape["dp"] != 0:
+        raise ValueError(f"n_actors={n_actors} not divisible by dp axis "
+                         f"{mesh.shape['dp']}")
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P("dp"))
+    n_trans = rollout_epochs * rollout_steps
+
+    def init_fn(key) -> DistPERState:
+        k_agent, _ = jax.random.split(key)
+        agent = sac.sac_init(k_agent, agent_cfg)
+        buf = rp.replay_init(
+            agent_cfg.mem_size,
+            rp.transition_spec(env_cfg.obs_dim, agent_cfg.n_actions))
+        st = DistPERState(agent=agent, buf=buf,
+                          episode=jnp.asarray(0, jnp.int32))
+        return jax.device_put(st, _shardings(st))
+
+    def _shardings(st: DistPERState):
+        return DistPERState(
+            agent=jax.tree_util.tree_map(lambda _: repl, st.agent),
+            buf=jax.tree_util.tree_map(lambda _: repl, st.buf),
+            episode=repl)
+
+    def _actor_rollout(agent_state, key):
+        """One actor: epochs x steps transitions with frozen params
+        (reference Actor.run_observations, :123-146)."""
+
+        def epoch_body(carry, k_epoch):
+            k_reset, k_noise, k_scan = jax.random.split(k_epoch, 3)
+            env_state, obs = enet.reset(env_cfg, k_reset)
+            env_state = enet.draw_noise(env_cfg, env_state, k_noise)
+            hint = (enet.get_hint(env_cfg, env_state) if use_hint
+                    else jnp.zeros((agent_cfg.n_actions,), jnp.float32))
+
+            def step_body(scarry, inp):
+                k, first = inp
+                env_state, obs = scarry
+                k_act, k_env = jax.random.split(k)
+                a = sac.choose_action(agent_cfg, agent_state, obs[None],
+                                      k_act)[0]
+                env_state, obs2, r, done = enet.step(env_cfg, env_state, a,
+                                                     k_env, keepnoise=first)
+                tr = {"state": obs, "action": a, "reward": r,
+                      "new_state": obs2, "done": done, "hint": hint}
+                return (env_state, obs2), tr
+
+            keys = jax.random.split(k_scan, rollout_steps)
+            first = jnp.arange(rollout_steps) == 0
+            _, trs = jax.lax.scan(step_body, (env_state, obs), (keys, first))
+            return carry, trs
+
+        _, trs = jax.lax.scan(epoch_body, 0,
+                              jax.random.split(key, rollout_epochs))
+        # (epochs, steps, ...) -> (epochs*steps, ...)
+        return jax.tree_util.tree_map(
+            lambda x: x.reshape((n_trans,) + x.shape[2:]), trs)
+
+    def run_episode(st: DistPERState, key):
+        k_roll, k_learn = jax.random.split(key)
+        actor_keys = jax.random.split(k_roll, n_actors)
+        # actors sharded over dp; params frozen for the whole episode
+        trs = jax.vmap(lambda k: _actor_rollout(st.agent, k))(actor_keys)
+        # flatten actor axis -> the learner's ingestion stream (XLA
+        # all-gathers here because the learner state is replicated)
+        flat = jax.tree_util.tree_map(
+            lambda x: x.reshape((n_actors * n_trans,) + x.shape[2:]), trs)
+
+        if learn_per_transition:
+            def ingest(carry, inp):
+                agent, buf = carry
+                tr, k = inp
+                buf = rp.replay_add(buf, tr)
+                agent, buf, m = sac.learn(agent_cfg, agent, buf, k)
+                return (agent, buf), m["critic_loss"]
+
+            keys = jax.random.split(k_learn, n_actors * n_trans)
+            (agent, buf), losses = jax.lax.scan(ingest, (st.agent, st.buf),
+                                                (flat, keys))
+            metrics = {"critic_loss": losses[-1]}
+        else:
+            buf = rp.replay_add_batch(st.buf, flat)
+            agent, buf, metrics = sac.learn(agent_cfg, st.agent, buf,
+                                            k_learn)
+        metrics["mean_reward"] = jnp.mean(flat["reward"])
+        return DistPERState(agent=agent, buf=buf, episode=st.episode + 1), \
+            metrics
+
+    dummy = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    sh = _shardings(dummy)
+    run_episode_jit = jax.jit(run_episode, in_shardings=(sh, repl),
+                              out_shardings=(sh, repl))
+    return init_fn, run_episode_jit
+
+
+def train_distributed(seed=0, episodes=100, n_actors=None, mesh=None,
+                      env_kwargs=None, agent_kwargs=None, use_hint=False,
+                      learn_per_transition=False, quiet=False):
+    """Host driver mirroring ``run_process`` + ``Learner.run_episodes``
+    (distributed_per_sac.py:60-82, :154-174)."""
+    from . import make_mesh
+
+    mesh = mesh or make_mesh()
+    n_actors = n_actors or mesh.shape["dp"]
+    env_cfg = enet.EnetConfig(**(env_kwargs or {}))
+    agent_kwargs = dict(agent_kwargs or {})
+    agent_kwargs.setdefault("prioritized", True)
+    agent_cfg = sac.SACConfig(obs_dim=env_cfg.obs_dim, n_actions=2,
+                              use_hint=use_hint, **agent_kwargs)
+    init_fn, run_episode = make_distributed_per_sac(
+        env_cfg, agent_cfg, mesh, n_actors, use_hint=use_hint,
+        learn_per_transition=learn_per_transition)
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    st = init_fn(k0)
+    scores = []
+    for ep in range(episodes):
+        key, k = jax.random.split(key)
+        st, metrics = run_episode(st, k)
+        scores.append(float(metrics["mean_reward"]))
+        if not quiet:
+            print(f"episode {ep} mean reward {scores[-1]:.4f}")
+    return st, scores

@@ -1,0 +1,103 @@
+"""Mesh-sharded calibration: frequency-parallel ADMM + chunk-parallel
+influence.
+
+The reference distributes calibration across frequency sub-bands with MPI
+ranks inside ``sagecal-mpi`` (consensus ADMM, ``calibration/docal.sh:12``)
+and parallelizes influence over calibration time-chunks with
+multiprocessing pools (``analysis_torch.py:160-170``).  Here both become
+``shard_map`` programs:
+
+* ``solve_admm_sharded`` — the frequency axis of (V, C, freqs) is sharded
+  over the mesh axis ``fp``; cal/solver.solve_admm's Z consensus update
+  psums over ``fp`` (the MPI allreduce as an ICI collective).
+* ``influence_sharded`` — the calibration-interval axis is sharded over
+  ``sp``; chunks are embarrassingly parallel (the pool had no
+  communication either), so the only collective is the output gather.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..cal import influence as influence_mod
+from ..cal import solver
+
+
+def solve_admm_sharded(mesh: Mesh, V, C, freqs, f0, rho,
+                       cfg: solver.SolverConfig, axis: str = "fp",
+                       n_chunks: Optional[int] = None,
+                       admm_iters=None, freq_range=None):
+    """Consensus-ADMM solve with the frequency axis sharded over ``axis``.
+
+    V (Nf, T, B, 2, 2, 2), C (Nf, K, T*B, 4, 2), freqs (Nf,) are global;
+    Nf must divide by the axis size.  Returns a SolveResult with J /
+    residual / final_cost frequency-sharded and Z / sigmas replicated —
+    bitwise the same math as the single-device solve (the psum IS the
+    global sum).
+    """
+    nfp = mesh.shape[axis]
+    if V.shape[0] % nfp != 0:
+        raise ValueError(f"Nf={V.shape[0]} not divisible by {axis}={nfp}")
+    if cfg.polytype == 1 and freq_range is None:
+        import numpy as np
+        fr = np.asarray(freqs)
+        freq_range = (float(fr.min()), float(fr.max()))
+
+    fn = partial(solver.solve_admm, cfg=cfg, axis_name=axis,
+                 n_chunks=n_chunks, admm_iters=admm_iters,
+                 freq_range=freq_range)
+    out_specs = solver.SolveResult(
+        J=P(axis), Z=P(), residual=P(axis), sigma_res=P(),
+        sigma_data=P(), final_cost=P(axis))
+    sharded = shard_map(
+        lambda v, c, f, r: fn(v, c, f, f0, r),
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P()),
+        out_specs=out_specs,
+        check_rep=False)
+    return sharded(V, C, jnp.asarray(freqs), jnp.asarray(rho))
+
+
+def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
+                      n_chunks: int, axis: str = "sp", fullpol=False,
+                      perdir=False):
+    """Influence visibilities with the calibration-interval (chunk) axis
+    sharded over ``axis`` (the reference's process pool as a mesh axis).
+
+    Same signature/semantics as cal/influence.influence_visibilities;
+    ``n_chunks`` must divide by the axis size.
+    """
+    nsp = mesh.shape[axis]
+    if n_chunks % nsp != 0:
+        raise ValueError(f"n_chunks={n_chunks} not divisible by "
+                         f"{axis}={nsp}")
+    B = n_stations * (n_stations - 1) // 2
+    T = C.shape[1] // B
+    Td = T // n_chunks
+    K = C.shape[0]
+    local_chunks = n_chunks // nsp
+
+    # pre-chunk so the shard axis is leading
+    R4 = R.reshape(n_chunks, 2 * B * Td, 2, 2)
+    C4 = jnp.moveaxis(C.reshape(K, n_chunks, B * Td, 4, 2), 1, 0)
+
+    def local(r4, c4, j):
+        r = r4.reshape(local_chunks * 2 * B * Td, 2, 2)
+        c = jnp.moveaxis(c4, 0, 1).reshape(K, local_chunks * B * Td, 4, 2)
+        return influence_mod.influence_visibilities(
+            r, c, j, hadd, n_stations, local_chunks, fullpol=fullpol,
+            perdir=perdir)
+
+    out_specs = influence_mod.InfluenceResult(
+        vis=P(None, axis) if perdir else P(axis), llr=P(axis))
+    sharded = shard_map(local, mesh=mesh,
+                       in_specs=(P(axis), P(axis), P(axis)),
+                       out_specs=out_specs, check_rep=False)
+    res = sharded(R4, C4, J)
+    # local results concatenate along the chunk-major sample axis, which is
+    # exactly the global time-major order
+    return res
