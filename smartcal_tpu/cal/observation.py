@@ -181,10 +181,31 @@ def make_observation(key, n_stations: int = 14, n_freqs: int = 3,
     """
     rng = host_rng(key, salt=12)
     if ra0 is None or dec0 is None:
+        # find_valid_target validates a full (ra, dec, t) triple; any caller
+        # substitution (one coordinate, or t0) voids that guarantee, so
+        # re-establish the above-horizon property for the FINAL combination
         drawn = find_valid_target(key)
+        caller_fixed = ra0 is not None or dec0 is not None or t0 is not None
         ra0 = drawn[0] if ra0 is None else ra0
         dec0 = drawn[1] if dec0 is None else dec0
         t0 = drawn[2] if t0 is None else t0
+        if caller_fixed:
+            low_el = np.deg2rad(3.0)
+            el_max = np.pi / 2 - abs(LOFAR_LAT - dec0)
+            if el_max <= low_el:
+                raise ValueError(
+                    f"dec0={dec0:.4f} rad never rises above 3 deg at the "
+                    "LOFAR latitude; supply both ra0 and dec0 (or neither)")
+            for _ in range(1000):
+                lst0 = OMEGA_EARTH * t0 % (2 * np.pi)
+                _, el = coords.azel_from_radec(ra0, dec0, lst0, LOFAR_LAT)
+                if float(el) > low_el:
+                    break
+                t0 = float(rng.random() * 24 * 3600.0)
+            else:
+                raise ValueError(
+                    "could not find an epoch with the target above the "
+                    f"horizon for ra0={ra0:.4f} dec0={dec0:.4f}")
     elif t0 is None:
         # pointing fixed by the caller: draw only the epoch (elevation is
         # the caller's responsibility in this case)

@@ -158,27 +158,27 @@ class DemixingEnv:
         """Exhaustive AIC sweep -> softmin expectation
         (demixingenv.py:301-336), batched on device."""
         n_cfg = 2 ** (self.K - 1)
-        masks, aic_fixed = [], {}
+        masks, valid_idx = {}, []
+        AIC = np.full(n_cfg, 1e5)   # low-elevation configs keep the fixed AIC
         for idx in range(n_cfg):
             bits = scalar_to_kvec(idx, self.K - 1)
             chosen_el = self.elevation[:-1][bits > 0]
-            if np.any(chosen_el < 1.0):
-                aic_fixed[idx] = 1e5
-                masks.append(np.zeros(self.K))  # placeholder lane
-            else:
-                masks.append(self._mask(np.where(bits > 0)[0].tolist()))
+            if not np.any(chosen_el < 1.0):
+                masks[idx] = self._mask(np.where(bits > 0)[0].tolist())
+                valid_idx.append(idx)
+        # only valid configurations enter the batched sweep — excluded ones
+        # would burn a full solver lane each just to have their AIC
+        # overwritten (the reference skips the sagecal call the same way,
+        # demixingenv.py:311-315)
         sigma_res = np.asarray(self.backend.hint_sweep(
-            self.ep, self.rho, np.stack(masks), admm_iters=self.maxiter))
+            self.ep, self.rho, np.stack([masks[i] for i in valid_idx]),
+            admm_iters=self.maxiter))
 
         N = self.backend.n_stations
-        AIC = np.zeros(n_cfg)
-        for idx in range(n_cfg):
-            if idx in aic_fixed:
-                AIC[idx] = aic_fixed[idx]
-            else:
-                ksel = int(masks[idx].sum())
-                AIC[idx] = ((N * sigma_res[idx] / self.std_data) ** 2
-                            + ksel * N)
+        for lane, idx in enumerate(valid_idx):
+            ksel = int(masks[idx].sum())
+            AIC[idx] = ((N * sigma_res[lane] / self.std_data) ** 2
+                        + ksel * N)
         probs = np.exp(-AIC / self.tau)
         probs /= probs.sum()
         hint = np.zeros(self.K - 1)

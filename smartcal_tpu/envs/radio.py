@@ -48,11 +48,17 @@ class RadioBackend:
     def __init__(self, n_stations=14, n_freqs=3, n_times=20, tdelta=10,
                  n_poly=2, admm_iters=10, lbfgs_iters=8, init_iters=30,
                  polytype=0, npix=128):
+        if n_times <= 0 or n_times % tdelta != 0:
+            raise ValueError(
+                f"n_times={n_times} must be a positive multiple of "
+                f"tdelta={tdelta}: every solution interval needs the same "
+                "number of slots (vis_to_chunks/coherency_to_chunks reshape "
+                "by Ts)")
         self.n_stations = n_stations
         self.n_freqs = n_freqs
         self.n_times = n_times
         self.tdelta = tdelta
-        self.n_chunks = max(1, n_times // tdelta)
+        self.n_chunks = n_times // tdelta
         self.n_poly = n_poly
         self.admm_iters = admm_iters
         self.lbfgs_iters = lbfgs_iters
@@ -154,10 +160,16 @@ class RadioBackend:
         """Batched masked calibrations (the exhaustive AIC hint): the
         2^(K-1) configurations run as vmapped batches of ``batch`` masks
         (lax.map over batches bounds memory) instead of the reference's 32
-        sequential MPI launches.  Returns sigma_res per mask."""
+        sequential MPI launches.
+
+        Returns the STOKES-I residual statistic per mask — the same
+        get_noise_-style quantity (demixingenv.py:233-252,322) the env
+        reward and std_data use, so the hint's AIC residual term is on the
+        same scale as the reward the agent is trained on (a full-pol RMS
+        here would rescale it against the ksel*N complexity penalty)."""
         def one(mask):
             res = self.calibrate(ep, rho, mask=mask, admm_iters=admm_iters)
-            return res.sigma_res
+            return self.noise_std(res.residual)
 
         masks = jnp.asarray(masks, jnp.float32)
         n = masks.shape[0]

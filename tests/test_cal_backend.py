@@ -261,3 +261,23 @@ def test_multifreq_image_average():
     img = imager.multifreq_image_sr(obs.uvw, V, obs.freqs, cell, npix=32)
     assert img.shape == (32, 32)
     assert np.all(np.isfinite(np.asarray(img)))
+
+
+def test_make_observation_mixed_pointing_above_horizon():
+    """Supplying only one of ra0/dec0 must still yield an above-horizon
+    target (ADVICE r1: the drawn coordinate's elevation guarantee does not
+    transfer to the mixed combination)."""
+    from smartcal_tpu.cal import coords
+    from smartcal_tpu.cal.observation import LOFAR_LAT
+
+    for seed in range(6):
+        key = jax.random.PRNGKey(seed)
+        obs = observation.make_observation(key, n_stations=6, n_freqs=1,
+                                           n_times=2, ra0=1.0)
+        _, el = coords.azel_from_radec(obs.ra0, obs.dec0, obs.lst0,
+                                       LOFAR_LAT)
+        assert float(el) > np.deg2rad(3.0)
+    # a declination that never rises at LOFAR latitude is rejected
+    with pytest.raises(ValueError, match="never rises"):
+        observation.make_observation(jax.random.PRNGKey(0), n_stations=6,
+                                     n_freqs=1, n_times=2, dec0=-1.2)

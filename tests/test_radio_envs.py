@@ -152,3 +152,28 @@ def test_demix_hint_respects_low_elevation():
     env.elevation[0] = 0.5
     hint = env.get_hint()
     assert hint[0] < -0.45     # ~never selected -> close to -1
+
+
+def test_backend_rejects_ragged_tdelta():
+    # n_times not a multiple of tdelta would silently change the solution
+    # interval length (ADVICE r1): must fail loudly at construction
+    with pytest.raises(ValueError, match="multiple of tdelta"):
+        RadioBackend(n_stations=6, n_times=25, tdelta=10)
+
+
+def test_hint_sweep_uses_stokes_i_statistic():
+    """The sweep statistic must be the same Stokes-I noise_std the reward
+    uses (reference get_noise_, demixingenv.py:233-252,322) — a full-pol
+    RMS would rescale AIC's residual term vs the ksel*N penalty."""
+    import jax
+
+    backend = tiny_backend(admm_iters=2)
+    env = DemixingEnv(K=3, backend=backend, seed=5)
+    env.reset()
+    mask = np.ones(3, np.float32)
+    swept = np.asarray(backend.hint_sweep(
+        env.ep, env.rho, mask[None, :], admm_iters=env.maxiter))[0]
+    res = backend.calibrate(env.ep, env.rho, mask=mask,
+                            admm_iters=env.maxiter)
+    direct = float(backend.noise_std(res.residual))
+    np.testing.assert_allclose(swept, direct, rtol=1e-4)

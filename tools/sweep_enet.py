@@ -1,0 +1,144 @@
+"""Multi-seed hint/no-hint elasticnet SAC sweep (learning-curve evidence).
+
+Reproduces the reference's reward-curve experiment (``elasticnet/do.sh:1-6``:
+10 seeds x {hint, no-hint}) on the in-framework TPU driver and records the
+artifacts BASELINE.md metric #3 (reward parity) is judged on:
+
+* ``results/enet_sweep/scores.jsonl`` — one line per episode per run:
+  {"mode", "seed", "episode", "score"}
+* ``results/enet_sweep/summary.json`` — final 100-episode averages per run
+* ``results/enet_sweep/learning_curves.png`` — mean +/- std moving average,
+  hint vs no-hint (the repo's counterpart of figures/comparison.png)
+
+The jitted episode function is built ONCE per mode and reused across seeds
+(seeds only change PRNG keys and init, not the jaxpr), so the sweep pays two
+compiles total instead of 2 x n_seeds.
+
+Usage: python tools/sweep_enet.py [--seeds 10] [--episodes 1000] [--steps 5]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from smartcal_tpu.envs import enet
+from smartcal_tpu.rl import replay as rp
+from smartcal_tpu.rl import sac
+from smartcal_tpu.train.enet_sac import make_episode_fn
+
+
+def run_one(episode_fn, env_cfg, agent_cfg, seed, episodes, log):
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    agent_state = sac.sac_init(k0, agent_cfg)
+    buf = rp.replay_init(agent_cfg.mem_size,
+                         rp.transition_spec(env_cfg.obs_dim, 2))
+    scores = []
+    for i in range(episodes):
+        key, k = jax.random.split(key)
+        agent_state, buf, score = episode_fn(agent_state, buf, k)
+        scores.append(float(score))
+        log(i, scores[-1])
+    return scores
+
+
+def moving_avg(xs, w=100):
+    out = []
+    for i in range(len(xs)):
+        lo = max(0, i - w + 1)
+        out.append(sum(xs[lo:i + 1]) / (i + 1 - lo))
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seeds", default=10, type=int)
+    p.add_argument("--episodes", default=1000, type=int)
+    p.add_argument("--steps", default=5, type=int)
+    p.add_argument("--outdir", default="results/enet_sweep")
+    args = p.parse_args()
+
+    os.makedirs(args.outdir, exist_ok=True)
+    jsonl_path = os.path.join(args.outdir, "scores.jsonl")
+    env_cfg = enet.EnetConfig(M=20, N=20)
+    summary = []
+    t_start = time.time()
+
+    with open(jsonl_path, "w") as jf:
+        for use_hint in (False, True):
+            mode = "hint" if use_hint else "nohint"
+            agent_cfg = sac.SACConfig(
+                obs_dim=env_cfg.obs_dim, n_actions=2, gamma=0.99, tau=0.005,
+                batch_size=64, mem_size=1024, lr_a=1e-3, lr_c=1e-3,
+                reward_scale=20.0, alpha=0.03, use_hint=use_hint)
+            episode_fn = make_episode_fn(env_cfg, agent_cfg, args.steps,
+                                         use_hint)
+            for seed in range(args.seeds):
+                t0 = time.time()
+
+                def log(i, s, mode=mode, seed=seed):
+                    jf.write(json.dumps({"mode": mode, "seed": seed,
+                                         "episode": i, "score": round(s, 4)})
+                             + "\n")
+                    if i % 200 == 0:
+                        jf.flush()
+                        print(f"[{time.time() - t_start:7.0f}s] {mode} "
+                              f"seed {seed} episode {i} score {s:.2f}",
+                              flush=True)
+
+                scores = run_one(episode_fn, env_cfg, agent_cfg, seed,
+                                 args.episodes, log)
+                final = sum(scores[-100:]) / len(scores[-100:])
+                summary.append({"mode": mode, "seed": seed,
+                                "final_avg_100": round(final, 3),
+                                "first_avg_100": round(
+                                    sum(scores[:100]) / min(100, len(scores)),
+                                    3),
+                                "wall_s": round(time.time() - t0, 1)})
+                print(f"DONE {mode} seed {seed}: final_avg {final:.2f} "
+                      f"({summary[-1]['wall_s']}s)", flush=True)
+
+    with open(os.path.join(args.outdir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+
+    # plot mean +/- std of the moving average over seeds, hint vs no-hint
+    import numpy as np
+    runs = {"hint": [], "nohint": []}
+    with open(jsonl_path) as f:
+        per_run = {}
+        for line in f:
+            r = json.loads(line)
+            per_run.setdefault((r["mode"], r["seed"]), []).append(r["score"])
+    for (mode, _), sc in sorted(per_run.items()):
+        runs[mode].append(moving_avg(sc))
+
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for mode, color in (("nohint", "tab:blue"), ("hint", "tab:orange")):
+        arr = np.asarray(runs[mode])
+        if arr.size == 0:
+            continue
+        mu, sd = arr.mean(axis=0), arr.std(axis=0)
+        x = np.arange(arr.shape[1])
+        ax.plot(x, mu, color=color, label=f"{mode} (n={arr.shape[0]})")
+        ax.fill_between(x, mu - sd, mu + sd, color=color, alpha=0.2)
+    ax.set_xlabel("episode")
+    ax.set_ylabel("score (100-episode moving average)")
+    ax.set_title("Elastic-net SAC on TPU: hint vs no-hint "
+                 f"({args.seeds} seeds)")
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(os.path.join(args.outdir, "learning_curves.png"), dpi=120)
+    print("sweep complete:", json.dumps(summary[-1]))
+
+
+if __name__ == "__main__":
+    main()
