@@ -1,17 +1,27 @@
 """Headline benchmark: elasticnet SAC env-steps/sec on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
-Workload = the reference `elasticnet/main_sac.py` configuration (N=M=20,
-batch 64, mem 1024, 5 steps/episode): every env step runs the full inner
-L-BFGS elastic-net solve + influence eigen-state, and every loop iteration
-also runs the SAC learn step.  Here the whole loop is one jitted lax.scan
-per episode on the TPU.
+Primary workload = the reference `elasticnet/main_sac.py` configuration
+(N=M=20, batch 64, mem 1024, 5 steps/episode): every env step runs the full
+inner L-BFGS elastic-net solve + influence eigen-state, and every loop
+iteration also runs the SAC learn step.  Here the whole loop is one jitted
+lax.scan per episode on the TPU.
 
 Baseline = the reference implementation itself (torch, this host's CPU —
 upstream publishes no numbers; see BASELINE.md), measured by
 tools/measure_reference.py with the identical protocol: warm-up until the
 replay buffer reaches batch_size, then time N timed steps.
+
+``extra`` carries BASELINE.md metric #2 — calibration-episode wall-clock at
+the REFERENCE scale (N=62 stations, B=1891 baselines, Nf=8 sub-bands,
+Tdelta=10, K=6 directions, 128x128 influence map; BASELINE.md workload
+table): one episode = simulate + consensus-ADMM calibrate + influence map,
+the dosimul.sh / docal.sh / doinfluence.sh triple of calibenv.py.  The
+reference's own number does not exist (sagecal-mpi + GPUs are not
+measurable here), so the entry reports absolute wall-clock, steady-state
+(post-compile), with the compile time alongside.  Set BENCH_SKIP_CALIB=1 to
+emit only the primary metric.
 """
 
 import json
@@ -19,6 +29,7 @@ import os
 import time
 
 import jax
+import numpy as np
 
 from smartcal_tpu.envs import enet
 from smartcal_tpu.rl import replay as rp
@@ -28,6 +39,40 @@ from smartcal_tpu.train.enet_sac import make_episode_fn
 STEPS_PER_EPISODE = 5
 TIMED_EPISODES = 20  # 100 timed env steps, same as the reference measurement
 FALLBACK_BASELINE = 4.16  # tools/reference_baseline.json, torch CPU
+
+
+def bench_calib_episode():
+    """Calibration episode wall-clock at LOFAR scale (N=62, B=1891, Nf=8)."""
+    from smartcal_tpu.envs.radio import RadioBackend
+
+    backend = RadioBackend(n_stations=62, n_freqs=8, n_times=20, tdelta=10,
+                           admm_iters=10, lbfgs_iters=8, init_iters=30,
+                           npix=128)
+    key = jax.random.PRNGKey(7)
+
+    def episode(k):
+        ep, mdl = backend.new_demixing_episode(k, K=6)
+        res = backend.calibrate(ep, mdl.rho, mask=np.ones(6, np.float32))
+        img = backend.influence_image(ep, res, mdl.rho,
+                                      np.zeros(6, np.float32))
+        return jax.block_until_ready(img), float(res.sigma_res)
+
+    t0 = time.time()
+    k1, k2 = jax.random.split(key)
+    episode(k1)                       # compile + run
+    t_first = time.time() - t0
+    t0 = time.time()
+    img, sigma = episode(k2)          # steady state (cached executables)
+    t_steady = time.time() - t0
+    assert np.all(np.isfinite(np.asarray(img)))
+    return {
+        "metric": "calib_episode_wall_clock",
+        "value": round(t_steady, 2),
+        "unit": "s/episode",
+        "vs_baseline": None,
+        "scale": "N=62 B=1891 Nf=8 Tdelta=10 K=6 npix=128",
+        "first_episode_incl_compile_s": round(t_first, 2),
+    }
 
 
 def main():
@@ -68,12 +113,20 @@ def main():
         with open(baseline_path) as f:
             baseline = json.load(f)["value"]
 
-    print(json.dumps({
+    out = {
         "metric": "enet_sac_env_steps_per_sec",
         "value": round(value, 2),
         "unit": "env-steps/sec/chip",
         "vs_baseline": round(value / baseline, 2),
-    }))
+    }
+    if not os.environ.get("BENCH_SKIP_CALIB"):
+        # never let the optional extra discard the measured primary metric
+        try:
+            out["extra"] = [bench_calib_episode()]
+        except Exception as e:  # noqa: BLE001 — report, don't drop the line
+            out["extra"] = [{"metric": "calib_episode_wall_clock",
+                             "error": f"{type(e).__name__}: {e}"}]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -23,10 +23,14 @@ with shared-memory tensors (analysis_torch.py:160-170); here chunks are a
 SCALAR per direction (the reference's dense F and P'P are both multiples of
 I_2N — see consensus_hadd_scalars), so no 4N x 4N dense prior is built.
 
-Memory note: dR is (8, 4B, B) per chunk — at LOFAR scale (N=62, B=1891)
-that is ~1 GB in float pairs, same as the reference's GPU tensor; for large
-N use ``r_chunk=1`` (the reference's ``loop_in_r``) once needed.  The
-in-framework envs run at N<=30 where the full-r batch is the fast path.
+Memory note: the engine consumes only the COLUMN MEANS of dR, so the
+(8, 4B, B) tensor — ~1 GB per chunk at LOFAR scale (N=62, B=1891), the
+reason the reference needs its ``loop_in_r`` r-chunking — is never
+materialized here: kernels.dresiduals_colmeans_sr reduces the row axis
+analytically (segment-sum onto stations + one einsum against dJ), leaving
+the (8, K, 4N, B) dJ tensor as the largest buffer (~180 MB at N=62).  The
+dense dresiduals_all_sr kernels remain as the golden-test oracles and the
+API for consumers that need the full derivative tensor.
 """
 
 from functools import partial
@@ -91,20 +95,13 @@ def _chunk_influence(R, C, J, hadd, n_stations, fullpol, perdir):
     N4 = H.shape[1]
     H = H.at[:, jnp.arange(N4), jnp.arange(N4), 0].add(hadd[:, None])
     dJ = kernels.dsolutions_all_sr(C, J, n_stations, H)
-    if perdir:
-        dR = kernels.dresiduals_all_perdir_sr(C, J, n_stations, dJ,
-                                              addself=False)
-        # (8, K, 4B, B, 2): mean over rows j of the pol-extracted blocks
-        d4 = dR.reshape(dR.shape[0], dR.shape[1], -1, 4, dR.shape[3], 2)
-        pol_means = jnp.mean(d4, axis=2)          # (8, K, 4, B, 2)
-        vis = jnp.sum(pol_means, axis=0)          # (K, 4, B, 2)
-        vis = jnp.swapaxes(vis, -3, -2)           # (K, B, 4, 2)
-    else:
-        dR = kernels.dresiduals_all_sr(C, J, n_stations, dJ, addself=False)
-        d4 = dR.reshape(dR.shape[0], -1, 4, dR.shape[2], 2)  # (8,B,4,B,2)
-        pol_means = jnp.mean(d4, axis=1)          # (8, 4, B, 2)
-        vis = jnp.sum(pol_means, axis=0)          # (4, B, 2)
-        vis = jnp.swapaxes(vis, -3, -2)           # (B, 4, 2)
+    # fused column means: never materializes the (8, [K,] 4B, B) dR tensor
+    # (kernels.dresiduals_colmeans_sr) — the memory move that makes the
+    # LOFAR-scale regime (N=62, B=1891) fit in HBM without r-chunking
+    pol_means = kernels.dresiduals_colmeans_sr(C, J, n_stations, dJ,
+                                               addself=False, perdir=perdir)
+    vis = jnp.sum(pol_means, axis=0)          # (K, 4, B, 2) or (4, B, 2)
+    vis = jnp.swapaxes(vis, -3, -2)           # (K, B, 4, 2) or (B, 4, 2)
     if not fullpol:
         vis = vis.at[..., 1, :].set(0.0).at[..., 2, :].set(0.0)
     llr = kernels.log_likelihood_ratio_sr(R, C, J, n_stations)

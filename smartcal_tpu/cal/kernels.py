@@ -206,8 +206,8 @@ def dsolutions(C, J, n_stations, Dgrad, r):
 # Residual derivatives dR/dx
 # ---------------------------------------------------------------------------
 
-def _dresiduals_blocks_sr(Cs, Js, n_stations, dJs):
-    """Common core: per-direction fillvex blocks (8, K, B, 2, 2, B, 2)."""
+def _dresiduals_lhs_sr(Cs, Js, n_stations):
+    """Shared lhs blocks -(C_sum Jq^H)^T per (k, b): (K, B, 2, 2, 2)."""
     B = n_stations * (n_stations - 1) // 2
     K = Cs.shape[0]
     C5 = jnp.swapaxes(Cs.reshape(K, -1, B, 2, 2, 2), -3, -2)
@@ -216,7 +216,14 @@ def _dresiduals_blocks_sr(Cs, Js, n_stations, dJs):
     p_idx, q_idx = baseline_indices(n_stations)
     Jq = J4[:, q_idx]
     inner = creal.einsum("kbuv,kbwv->kbuw", Csum, creal.conj(Jq))
-    lhs = -jnp.swapaxes(inner, -3, -2)                  # -(C Jq^H)^T
+    return -jnp.swapaxes(inner, -3, -2), p_idx
+
+
+def _dresiduals_blocks_sr(Cs, Js, n_stations, dJs):
+    """Common core: per-direction fillvex blocks (8, K, B, 2, 2, B, 2)."""
+    B = dJs.shape[3]
+    K = Cs.shape[0]
+    lhs, p_idx = _dresiduals_lhs_sr(Cs, Js, n_stations)
 
     # dJ rows {2p, 2p+1} and {2N+2p, 2N+2p+1}: view as (8, K, 2, N, 2, B, 2)
     dJ6 = dJs.reshape(8, K, 2, n_stations, 2, B, 2)
@@ -256,6 +263,56 @@ def dresiduals_all(C, J, n_stations, dJ, addself=True):
     out = dresiduals_all_sr(creal.split(C), creal.split(J), n_stations,
                             creal.split(dJ), addself=addself)
     return creal.fuse(np.asarray(out))
+
+
+@partial(jax.jit, static_argnames=("n_stations", "addself", "perdir"))
+def dresiduals_colmeans_sr(Cs, Js, n_stations, dJs, addself=True,
+                           perdir=False):
+    """Column means over the row-baseline axis of dR, WITHOUT materializing
+    the (8, 4B, B) residual-derivative tensor.
+
+    Returns (8, 4, B, 2) — or (8, K, 4, B, 2) when ``perdir`` — equal to
+    ``mean_b dresiduals_all_sr(...)[:, 4b+pol, :, :]`` (resp. the perdir
+    variant): exactly the quantity the influence engine consumes
+    (analysis_torch.py:56-76 takes column means of dR and never uses dR
+    itself again).
+
+    Key structural fact: dR's dependence on its ROW baseline b enters only
+    through the station p(b) (the fillvex blocks gather dJ rows at p_idx,
+    see _dresiduals_blocks_sr), so the mean over rows collapses to a
+    segment-sum of the lhs blocks onto stations followed by one small
+    einsum against dJ.  Memory drops from O(B^2) (the reference needs
+    ``loop_in_r`` / r-chunking at LOFAR scale, Dresiduals_r
+    calibration_tools.py:1028-1126: ~1 GB per chunk at N=62, B=1891) to
+    O(N*B) — the dJ tensor itself is the largest operand.  This is the
+    reference-scale (N=62) influence path.
+    """
+    B = n_stations * (n_stations - 1) // 2
+    K = Cs.shape[0]
+    T = Cs.shape[1] // B
+    lhs, p_idx = _dresiduals_lhs_sr(Cs, Js, n_stations)  # (K, B, i, j, 2)
+
+    # G[k, n, i, j] = sum over baselines b with p(b) = n of lhs[k, b, i, j]
+    G = jax.ops.segment_sum(jnp.swapaxes(lhs, 0, 1), p_idx,
+                            num_segments=n_stations)    # (N, K, i, j, 2)
+    G = jnp.swapaxes(G, 0, 1)                           # (K, N, i, j, 2)
+
+    dJ6 = dJs.reshape(8, K, 2, n_stations, 2, B, 2)     # (r,k,j,n,u,c,2)
+    if perdir:
+        out = creal.einsum("knij,rkjnuc->rkiuc", G, dJ6)
+        out = out.reshape(8, K, 4, B, 2) / (B * B * T)
+        if addself:
+            # dense path: dR[r, k, 4b + r//2, b, r%2] += T (then /(B*T));
+            # each column has exactly one contributing row -> mean adds 1/B^2
+            sel = _selfterm() / (B * B)                 # (8, 4, 2)
+            out = out + sel[:, None, :, None, :]
+    else:
+        out = creal.einsum("knij,rkjnuc->riuc", G, dJ6)
+        out = out.reshape(8, 4, B, 2) / (B * B * T)
+        if addself:
+            sel = _selfterm() * K / (B * B)
+            out = out + sel[:, :, None, :]
+    return out
 
 
 @partial(jax.jit, static_argnames=("n_stations", "addself"))

@@ -32,3 +32,19 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Skip ``slow``-marked tests by default, but still run them when the
+    user gives a marker expression (-m slow) or names one explicitly by
+    node id — an addopts marker filter would silently deselect even an
+    exact node-id selection."""
+    if config.option.markexpr:
+        return
+    explicit = {str(a).split("::")[-1].split("[")[0]
+                for a in config.invocation_params.args if "::" in str(a)}
+    skip_slow = pytest.mark.skip(reason="slow test: run with -m slow")
+    for item in items:
+        if "slow" in item.keywords and \
+                item.name.split("[")[0] not in explicit:
+            item.add_marker(skip_slow)

@@ -196,6 +196,31 @@ class TestDresiduals:
         np.testing.assert_allclose(perdir.sum(axis=1), total,
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.parametrize("addself", [False, True])
+    @pytest.mark.parametrize("perdir", [False, True])
+    def test_colmeans_match_dense(self, rng, addself, perdir):
+        """The fused column-means path (the N=62 memory move) must equal
+        the per-pol row means of the dense dR oracle."""
+        from smartcal_tpu.cal import creal
+
+        N = 5
+        R, C, J, B, T, K = _mk_problem(rng, N=N, T=2, K=3)
+        Dgrad = golden_hessian(R, C, J, N) \
+            + 0.5 * np.eye(4 * N, dtype=np.complex64)[None]
+        Cs, Js = creal.split(C), creal.split(J)
+        dJs = kernels.dsolutions_all_sr(Cs, Js, N, creal.split(Dgrad))
+        got = np.asarray(kernels.dresiduals_colmeans_sr(
+            Cs, Js, N, dJs, addself=addself, perdir=perdir))
+        if perdir:
+            dR = np.asarray(kernels.dresiduals_all_perdir_sr(
+                Cs, Js, N, dJs, addself=addself))
+            want = dR.reshape(8, K, B, 4, B, 2).mean(axis=2)
+        else:
+            dR = np.asarray(kernels.dresiduals_all_sr(
+                Cs, Js, N, dJs, addself=addself))
+            want = dR.reshape(8, B, 4, B, 2).mean(axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
 
 class TestLLR:
     def test_matches_loop_oracle(self, rng):
