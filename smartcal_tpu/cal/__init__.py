@@ -7,4 +7,5 @@ This is the TPU-native re-expression of the reference's
 einsum/segment-sum kernels that XLA can tile onto the MXU.
 """
 
-from smartcal_tpu.cal import coords, consensus, coherency, kernels, skyio  # noqa: F401
+from smartcal_tpu.cal import (coords, consensus, coherency, dataset,  # noqa: F401
+                              kernels, ms_io, skyio)

@@ -1,0 +1,181 @@
+"""Shapelet (Gauss-Hermite) diffuse-sky models.
+
+Reference: the diffuse-sky option of the simulator writes random shapelet
+mode files for SAGECal to predict (``calibration/simulate.py:360-383``,
+``calibration_tools.py:1254-1295`` generate_random_shapelet_model,
+``correct_shapelet_modes.py`` factorial rescale).  The prediction itself
+happens inside SAGECal there; in-framework it is done analytically here —
+the shapelet basis is (up to i^n) its own Fourier transform, so the uv-plane
+coherency of a diffuse component is a closed-form sum that lands on the MXU
+as one (modes x samples) matmul, no gridding needed.
+
+Conventions (matching cal/coherency's e^{+i phase} prediction):
+  image basis   phi_n(x; b) = H_n(x/b) exp(-x^2/(2 b^2))
+                              / sqrt(2^n n! sqrt(pi) b)
+  visibility    V(u, v) = 2 pi sum_{n1, n2} a_{n1 n2} i^{n1+n2}
+                          phi_{n1}(2 pi u_l; 1/b) phi_{n2}(2 pi v_l; 1/b)
+with u_l, v_l in wavelengths; this is the exact continuous FT of
+I(l, m) = sum a phi phi under V = int I e^{+2 pi i (u l + v m)} dl dm
+(golden-tested against a direct numpy grid integration).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def basis_1d(n_max: int, x, beta):
+    """phi_0..phi_{n_max-1} at ``x``: (n_max, ...) orthonormal basis.
+
+    Evaluated via the recurrence on the NORMALIZED Hermite functions
+    psi_{n+1} = t sqrt(2/(n+1)) psi_n - sqrt(n/(n+1)) psi_{n-1} with the
+    Gaussian envelope folded in from the start — the raw H_n(t) recurrence
+    overflows float32 at the large uv arguments of resolved-out baselines
+    (H_19(1e4) = inf, then inf * exp(-t^2/2) = nan), while psi_n stays
+    bounded and underflows cleanly to 0 there.
+    """
+    t = jnp.asarray(x) / beta
+    env = jnp.exp(-0.5 * t * t)
+    psi = [env * (math.pi ** -0.25)]
+    if n_max > 1:
+        psi.append(t * math.sqrt(2.0) * psi[0])
+    for n in range(1, n_max - 1):
+        psi.append(t * math.sqrt(2.0 / (n + 1)) * psi[n]
+                   - math.sqrt(n / (n + 1.0)) * psi[n - 1])
+    return jnp.stack(psi[:n_max]) / jnp.sqrt(beta)
+
+
+def shapelet_image(coeff, l, m, beta, l0=0.0, m0=0.0):
+    """I(l, m) = sum a_{n1 n2} phi_{n1}(l - l0) phi_{n2}(m - m0)."""
+    coeff = jnp.asarray(coeff)
+    n0 = coeff.shape[0]
+    bl = basis_1d(n0, jnp.asarray(l) - l0, beta)          # (n0, ...)
+    bm = basis_1d(n0, jnp.asarray(m) - m0, beta)
+    return jnp.einsum("ab,a...,b...->...", coeff, bl, bm)
+
+
+def shapelet_uv_sr(coeff, u_l, v_l, beta, l0=0.0, m0=0.0):
+    """Split-real visibilities (..., 2) of the shapelet at baseline
+    coordinates ``u_l, v_l`` (wavelengths).
+
+    The i^{n1+n2} factor routes each mode into one of (+Re, +Im, -Re, -Im);
+    an off-center component picks up the usual e^{+2 pi i (u l0 + v m0)}
+    phase ramp.
+    """
+    coeff = jnp.asarray(coeff, jnp.float32)
+    n0 = coeff.shape[0]
+    ku = 2.0 * jnp.pi * jnp.asarray(u_l)
+    kv = 2.0 * jnp.pi * jnp.asarray(v_l)
+    bu = basis_1d(n0, ku, 1.0 / beta)                     # (n0, R)
+    bv = basis_1d(n0, kv, 1.0 / beta)
+    prod = jnp.einsum("ab,a...,b...->ab...", coeff, bu, bv)
+    n_sum = np.add.outer(np.arange(n0), np.arange(n0)) % 4
+    # i^n: n=0 -> +Re, 1 -> +Im, 2 -> -Re, 3 -> -Im
+    re_w = jnp.asarray(np.where(n_sum == 0, 1.0, 0.0)
+                       - np.where(n_sum == 2, 1.0, 0.0), jnp.float32)
+    im_w = jnp.asarray(np.where(n_sum == 1, 1.0, 0.0)
+                       - np.where(n_sum == 3, 1.0, 0.0), jnp.float32)
+    sp = (2.0 * jnp.pi)
+    re = sp * jnp.einsum("ab,ab...->...", re_w, prod)
+    im = sp * jnp.einsum("ab,ab...->...", im_w, prod)
+    phase = 2.0 * jnp.pi * (jnp.asarray(u_l) * l0 + jnp.asarray(v_l) * m0)
+    c, s = jnp.cos(phase), jnp.sin(phase)
+    return jnp.stack([re * c - im * s, re * s + im * c], axis=-1)
+
+
+def shapelet_coherency_sr(coeff, uu, vv, freq, beta, flux=1.0,
+                          l0=0.0, m0=0.0):
+    """(R, 4, 2) coherency contribution of a Stokes-I shapelet component:
+    V in XX and YY (the cluster convention of cal/coherency._predict),
+    scaled by the sky-table flux.  ``uu, vv`` in meters."""
+    C_LIGHT = 299792458.0
+    scale = freq / C_LIGHT
+    vis = flux * shapelet_uv_sr(coeff, jnp.asarray(uu) * scale,
+                                jnp.asarray(vv) * scale, beta,
+                                l0=l0, m0=m0)
+    R = vis.shape[0]
+    C = jnp.zeros((R, 4, 2), jnp.float32)
+    C = C.at[:, 0, :].set(vis)
+    C = C.at[:, 3, :].set(vis)
+    return C
+
+
+class ShapeletModel(NamedTuple):
+    """A random diffuse component + its perturbed calibration twin
+    (simulate.py:365-377 writes exact modes for simulation and a perturbed
+    file for the calibration model)."""
+
+    coeff: np.ndarray         # (n0, n0)
+    beta: float
+    coeff_cal: np.ndarray
+    beta_cal: float
+    l0: float = 0.0
+    m0: float = 0.0
+    flux: float = 250.0       # sky-table Stokes I (simulate.py:366)
+
+
+def random_shapelet(rng, perturb: bool = True) -> ShapeletModel:
+    """Random modes with the reference's statistics
+    (calibration_tools.py:1256-1271): n0 in [10, 20), beta = U + 0.1
+    capped so n0*beta ~ 2, N(0,1) coefficients attenuated by
+    (outer(1..n0, 1..n0))^1.2; the perturbed twin adds 10% beta noise and
+    10%-norm coefficient noise (:1281-1294)."""
+    n0 = int(rng.integers(10, 20))
+    beta = float(rng.random() + 0.1)
+    if beta * n0 > 2:
+        beta = float((2 + rng.random() * 0.001) / n0)
+    x = np.arange(1, n0 + 1)
+    coeff = rng.standard_normal((n0, n0)) / np.outer(x, x) ** 1.2
+    if perturb:
+        beta_cal = beta + 0.1 * beta * rng.random()
+        noise = rng.standard_normal((n0, n0))
+        noise = noise / np.linalg.norm(noise) * 0.1 * np.linalg.norm(coeff)
+        coeff_cal = coeff + noise
+    else:
+        beta_cal, coeff_cal = beta, coeff.copy()
+    return ShapeletModel(coeff=coeff.astype(np.float32), beta=beta,
+                         coeff_cal=coeff_cal.astype(np.float32),
+                         beta_cal=float(beta_cal))
+
+
+def write_modes(path, coeff, beta, radec=(0, 0, 0.0, 0, 0, 0.0)):
+    """SAGECal ``.modes`` text writer (generate_random_shapelet_model
+    format: sexagesimal position line, 'n0 beta', n0^2 'idx value' lines,
+    linear-transform line)."""
+    coeff = np.asarray(coeff)
+    n0 = coeff.shape[0]
+    flat = coeff.reshape(-1)
+    with open(path, "w") as fh:
+        fh.write(" ".join(str(v) for v in radec) + "\n")
+        fh.write(f"{n0} {beta}\n")
+        for ci in range(n0 * n0):
+            fh.write(f"{ci} {flat[ci]}\n")
+        fh.write(f"L 1.0 1.0 {math.pi / 2}\n")
+        fh.write("#model created by smartcal_tpu\n")
+
+
+def read_modes(path):
+    """Inverse of :func:`write_modes` -> (coeff (n0, n0), beta)."""
+    with open(path) as fh:
+        lines = [ln.strip() for ln in fh if ln.strip()
+                 and not ln.startswith("#")]
+    n0, beta = lines[1].split()
+    n0, beta = int(n0), float(beta)
+    vals = np.zeros(n0 * n0, np.float32)
+    for ln in lines[2:2 + n0 * n0]:
+        idx, v = ln.split()
+        vals[int(idx)] = float(v)
+    return vals.reshape(n0, n0), beta
+
+
+def rescale_modes(coeff):
+    """Old->new SAGECal mode convention (correct_shapelet_modes.py:6-30):
+    value * ci!/(ci+1)! * cj!/(cj+1)! = value / ((ci+1)(cj+1))."""
+    coeff = np.asarray(coeff)
+    n0 = coeff.shape[0]
+    i = np.arange(n0) + 1.0
+    return coeff / np.outer(i, i)

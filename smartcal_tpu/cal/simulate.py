@@ -95,13 +95,21 @@ class CalibModels(NamedTuple):
     rho_spatial: np.ndarray
     lm_dirs: np.ndarray
     f0: float
+    # optional diffuse shapelet component in the center cluster
+    # (simulate.py:360-383 random diffuse sky); None unless diffuse=True
+    shapelet: object = None
 
 
 def simulate_models(key, K=4, f0=150e6, Kc=80, M_weak=350, M_gauss=120,
-                    M2=40) -> CalibModels:
+                    M2=40, diffuse=False) -> CalibModels:
     """Random calibration sky: Kc-source center cluster, K-1 compact outlier
     clusters of M2 sources, M_weak point + M_gauss Gaussian background
     sources.  Reference: calibration/simulate.py:61-379.
+
+    ``diffuse=True`` adds a random shapelet component at the phase center
+    (the reference's random diffuse-sky option, simulate.py:360-383): the
+    exact modes enter the simulated data, the perturbed twin the
+    calibration model (cal/shapelets.py).
     """
     rng = _rng_of(key, salt=1)
     sim, cal = SkyDraw(), SkyDraw()
@@ -158,12 +166,19 @@ def simulate_models(key, K=4, f0=150e6, Kc=80, M_weak=350, M_gauss=120,
                         (rng.random() - 0.5) * math.pi])
         sim.add(l1[i], m1[i], sI1[i], 0.0, K, gauss=g)
 
+    shp = None
+    if diffuse:
+        from smartcal_tpu.cal.shapelets import random_shapelet
+
+        shp = random_shapelet(rng)
+
     return CalibModels(
         sky_sim=sim.build(K + 1, f0), sky_cal=cal.build(K, f0),
         sky_table=np.asarray(table, np.float32),
         rho=np.asarray(rho, np.float32),
         rho_spatial=np.full(K, 0.1, np.float32),
-        lm_dirs=np.asarray(lm_dirs, np.float32), f0=float(f0))
+        lm_dirs=np.asarray(lm_dirs, np.float32), f0=float(f0),
+        shapelet=shp)
 
 
 # ---------------------------------------------------------------------------

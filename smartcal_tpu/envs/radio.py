@@ -95,19 +95,39 @@ class RadioBackend:
         Vn, _ = simulate.add_noise(key, np.asarray(V), snr=snr)
         return jnp.asarray(Vn)
 
-    def new_calib_episode(self, key, K, M):
+    def _add_shapelet(self, obs, C, coeff, beta, flux):
+        """Add a diffuse shapelet component to cluster 0 of a coherency
+        tensor (cal/shapelets.py; the role of SAGECal's in-solver shapelet
+        prediction for the reference's random diffuse sky)."""
+        from smartcal_tpu.cal import shapelets
+
+        uvw = np.asarray(obs.uvw).reshape(-1, 3)
+        add = jnp.stack([
+            shapelets.shapelet_coherency_sr(coeff, uvw[:, 0], uvw[:, 1],
+                                            float(f), beta, flux=flux)
+            for f in np.asarray(obs.freqs)])
+        return C.at[:, 0].add(add)
+
+    def new_calib_episode(self, key, K, M, diffuse=False):
         """CalibEnv episode: K drawn clusters padded to M directions.
         Returns (episode, models) with Ccal zero-padded to M directions."""
         obs = observation.make_observation(
             key, n_stations=self.n_stations, n_freqs=self.n_freqs,
             n_times=self.n_times)
         mdl = simulate.simulate_models(key, K=K, f0=float(
-            np.asarray(obs.freqs).mean()))
+            np.asarray(obs.freqs).mean()), diffuse=diffuse)
         Csim = self._coherencies(obs, mdl.sky_sim)
+        if mdl.shapelet is not None:
+            Csim = self._add_shapelet(obs, Csim, mdl.shapelet.coeff,
+                                      mdl.shapelet.beta, mdl.shapelet.flux)
         V = self._corrupt_and_noise(key, obs, Csim, J_extra_dirs=1, snr=0.05,
                                     amp=1.0, spatial_term=True,
                                     lm_dirs=mdl.lm_dirs)
         Ck = self._coherencies(obs, mdl.sky_cal)
+        if mdl.shapelet is not None:
+            Ck = self._add_shapelet(obs, Ck, mdl.shapelet.coeff_cal,
+                                    mdl.shapelet.beta_cal,
+                                    mdl.shapelet.flux)
         pad = M - K
         Ccal = jnp.pad(Ck, ((0, 0), (0, pad), (0, 0), (0, 0), (0, 0)))
         ep = Episode(obs=obs, V=V, Ccal=Ccal, f0=mdl.f0, n_dirs=M, snr=0.05)

@@ -252,6 +252,47 @@ class SplitImageMetaCritic(SplitObs):
         return ImageMetaCritic(use_image=self.use_image)(img, meta, action)
 
 
+class SplitImageMetaCategoricalActor(SplitObs):
+    """image+meta towers -> one dense vector over a DISCRETE action set.
+
+    As the actor this is the categorical policy (logits) of the distributed
+    demixing learner, whose action space is the 2^(K-1) direction subsets
+    (``demixing_rl/distributed_per_sac.py:34,180-184``: the reference
+    treats the actor output as a probability vector over the subset index
+    and samples it with ``np.random.choice``)."""
+
+    img_shape: Tuple[int, int] = (128, 128)
+    n_actions: int = 32
+    use_image: bool = True
+    meta_hidden: Sequence[int] = (128, 16)
+    head_hidden: Sequence[int] = (256, 128)
+
+    @nn.compact
+    def __call__(self, obs) -> jnp.ndarray:
+        img, meta = self.split(obs)
+        feats = []
+        if self.use_image:
+            feats.append(InfluenceCNN()(img))
+        m = meta
+        for h in self.meta_hidden:
+            m = _dense(h)(m)
+            m = nn.LayerNorm()(m)
+            m = nn.elu(m)
+        feats.append(m)
+        x = jnp.concatenate(feats, axis=-1)
+        for h in self.head_hidden:
+            x = _dense(h)(x)
+            x = nn.LayerNorm()(x)
+            x = nn.elu(x)
+        return _dense(self.n_actions, final=True)(x)      # (..., n_actions)
+
+
+class SplitImageMetaQVector(SplitImageMetaCategoricalActor):
+    """Same towers/head, read as a state-only critic: Q(s, .) per discrete
+    action — one forward gives every action's value, so the discrete-SAC
+    soft value is an exact expectation (no action tower needed)."""
+
+
 def flatten_obs(obs_dict, img_key=None, meta_key=None):
     """Dict observation -> flat vector [img.ravel(), meta.ravel()].
 

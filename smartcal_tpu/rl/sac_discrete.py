@@ -1,0 +1,207 @@
+"""Discrete-action Soft Actor-Critic (categorical policy).
+
+Parity target: the distributed demixing learner's agent
+(``demixing_rl/distributed_per_sac.py:34,144,180-184``): actions are the
+``2^(K-1)`` direction subsets, the actor emits a probability vector over
+the subset index, actors sample it (``np.random.choice(p=probs)``) and
+evaluation takes the argmax.  The reference reuses its continuous
+``DemixingAgent`` under the hood; here the discrete case gets the standard
+discrete-SAC form (the clean re-expression of the same intent):
+
+* actor: categorical logits pi(a|s) (softmax);
+* critics: Q(s, .) vectors over all actions (one forward gives every
+  action's value, so the soft value is an exact expectation — no
+  reparameterised sampling needed);
+* targets: V(s') = sum_a pi(a|s') [min_i Q_i(s', a) - alpha log pi(a|s')];
+* actor loss: E_s sum_a pi(a|s) [alpha log pi(a|s) - min_i Q_i(s, a)];
+* PER priorities from |TD error| as in the continuous agent.
+
+Everything is a pure jitted function over a :class:`DSACState` pytree,
+matching the structure of :mod:`smartcal_tpu.rl.sac` so the distributed
+runtime can swap agents freely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from . import replay as rp
+from .networks import SplitImageMetaCategoricalActor, SplitImageMetaQVector
+
+
+@dataclasses.dataclass(frozen=True)
+class DSACConfig:
+    obs_dim: int
+    n_actions: int                 # 2^(K-1) subset configurations
+    gamma: float = 0.99
+    tau: float = 0.005
+    lr_a: float = 1e-3
+    lr_c: float = 1e-3
+    alpha: float = 0.03
+    reward_scale: float = 1.0
+    batch_size: int = 64
+    mem_size: int = 1024
+    prioritized: bool = True       # the reference variant is distributed PER
+    error_clip: float = 1.0        # demix_sac.py:160
+    img_shape: Optional[Tuple[int, int]] = None
+    use_image: bool = True
+
+
+class DSACState(NamedTuple):
+    actor_params: Any
+    c1_params: Any
+    c2_params: Any
+    t1_params: Any
+    t2_params: Any
+    actor_opt: Any
+    c1_opt: Any
+    c2_opt: Any
+    alpha: jnp.ndarray
+    learn_counter: jnp.ndarray
+
+
+def _nets(cfg: DSACConfig):
+    if cfg.img_shape is None:
+        raise ValueError("discrete SAC serves the radio dict-obs envs; "
+                         "set img_shape (use_image=False drops the CNN)")
+    actor = SplitImageMetaCategoricalActor(
+        img_shape=cfg.img_shape, n_actions=cfg.n_actions,
+        use_image=cfg.use_image)
+    critic = SplitImageMetaQVector(
+        img_shape=cfg.img_shape, n_actions=cfg.n_actions,
+        use_image=cfg.use_image)
+    return actor, critic
+
+
+def transition_spec(obs_dim: int):
+    """Replay layout: discrete action stored as a single int32 index."""
+    return {
+        "state": ((obs_dim,), jnp.float32),
+        "action": ((), jnp.int32),
+        "reward": ((), jnp.float32),
+        "new_state": ((obs_dim,), jnp.float32),
+        "done": ((), jnp.bool_),
+    }
+
+
+def dsac_init(key, cfg: DSACConfig) -> DSACState:
+    actor, critic = _nets(cfg)
+    ka, k1, k2 = jax.random.split(key, 3)
+    obs = jnp.zeros((1, cfg.obs_dim))
+    actor_params = actor.init(ka, obs)["params"]
+    c1_params = critic.init(k1, obs)["params"]
+    c2_params = critic.init(k2, obs)["params"]
+    return DSACState(
+        actor_params=actor_params, c1_params=c1_params, c2_params=c2_params,
+        t1_params=jax.tree_util.tree_map(jnp.copy, c1_params),
+        t2_params=jax.tree_util.tree_map(jnp.copy, c2_params),
+        actor_opt=optax.adam(cfg.lr_a).init(actor_params),
+        c1_opt=optax.adam(cfg.lr_c).init(c1_params),
+        c2_opt=optax.adam(cfg.lr_c).init(c2_params),
+        alpha=jnp.asarray(cfg.alpha, jnp.float32),
+        learn_counter=jnp.asarray(0, jnp.int32))
+
+
+def choose_action(cfg: DSACConfig, st: DSACState, obs, key,
+                  deterministic: bool = False):
+    """Sample the categorical policy (Actor.choose_action,
+    distributed_per_sac.py:155-176; argmax when evaluating)."""
+    actor, _ = _nets(cfg)
+    logits = actor.apply({"params": st.actor_params}, obs)
+    if deterministic:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def learn(cfg: DSACConfig, st: DSACState, buf: rp.ReplayState,
+          key) -> Tuple[DSACState, rp.ReplayState, dict]:
+    """One discrete-SAC learn step (no-op below batch_size, scannable)."""
+    actor, critic = _nets(cfg)
+    opt_a, opt_c = optax.adam(cfg.lr_a), optax.adam(cfg.lr_c)
+
+    def do_learn(args):
+        st, buf, key = args
+        k_samp, _ = jax.random.split(key)
+        if cfg.prioritized:
+            batch, idx, is_w, buf2 = rp.replay_sample_per(
+                buf, k_samp, cfg.batch_size)
+        else:
+            batch, idx = rp.replay_sample_uniform(buf, k_samp,
+                                                  cfg.batch_size)
+            is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
+
+        s, a = batch["state"], batch["action"]
+        r = cfg.reward_scale * batch["reward"]
+        s2, done = batch["new_state"], batch["done"]
+
+        # soft target value: exact expectation over the action set
+        logits2 = actor.apply({"params": st.actor_params}, s2)
+        pi2 = jax.nn.softmax(logits2, axis=-1)
+        logpi2 = jax.nn.log_softmax(logits2, axis=-1)
+        q1t = critic.apply({"params": st.t1_params}, s2)
+        q2t = critic.apply({"params": st.t2_params}, s2)
+        v2 = jnp.sum(pi2 * (jnp.minimum(q1t, q2t) - st.alpha * logpi2),
+                     axis=-1)
+        y = lax.stop_gradient(r + cfg.gamma * jnp.where(done, 0.0, v2))
+
+        def critic_loss(c1p, c2p):
+            q1 = jnp.take_along_axis(
+                critic.apply({"params": c1p}, s), a[:, None], -1)[:, 0]
+            q2 = jnp.take_along_axis(
+                critic.apply({"params": c2p}, s), a[:, None], -1)[:, 0]
+            if cfg.prioritized:
+                l = (rp.per_mse(q1[:, None], y[:, None], is_w)
+                     + rp.per_mse(q2[:, None], y[:, None], is_w))
+            else:
+                l = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+            return l, q1
+
+        (closs, q1v), (g1, g2) = jax.value_and_grad(
+            critic_loss, argnums=(0, 1), has_aux=True)(st.c1_params,
+                                                       st.c2_params)
+        u1, c1_opt = opt_c.update(g1, st.c1_opt, st.c1_params)
+        c1_params = optax.apply_updates(st.c1_params, u1)
+        u2, c2_opt = opt_c.update(g2, st.c2_opt, st.c2_params)
+        c2_params = optax.apply_updates(st.c2_params, u2)
+
+        def actor_loss(ap):
+            logits = actor.apply({"params": ap}, s)
+            pi = jax.nn.softmax(logits, axis=-1)
+            logpi = jax.nn.log_softmax(logits, axis=-1)
+            qmin = jnp.minimum(critic.apply({"params": c1_params}, s),
+                               critic.apply({"params": c2_params}, s))
+            return jnp.mean(jnp.sum(
+                pi * (st.alpha * logpi - lax.stop_gradient(qmin)), axis=-1))
+
+        aloss, ga = jax.value_and_grad(actor_loss)(st.actor_params)
+        ua, actor_opt = opt_a.update(ga, st.actor_opt, st.actor_params)
+        actor_params = optax.apply_updates(st.actor_params, ua)
+
+        if cfg.prioritized:
+            td = jnp.abs(q1v - y)
+            buf2 = rp.replay_update_priorities(buf2, idx, td, cfg.error_clip)
+
+        lerp = lambda t, o: jax.tree_util.tree_map(
+            lambda a_, b_: cfg.tau * a_ + (1.0 - cfg.tau) * b_, o, t)
+        st_new = DSACState(
+            actor_params=actor_params, c1_params=c1_params,
+            c2_params=c2_params,
+            t1_params=lerp(st.t1_params, c1_params),
+            t2_params=lerp(st.t2_params, c2_params),
+            actor_opt=actor_opt, c1_opt=c1_opt, c2_opt=c2_opt,
+            alpha=st.alpha, learn_counter=st.learn_counter + 1)
+        return st_new, buf2, {"critic_loss": closs, "actor_loss": aloss}
+
+    def no_learn(args):
+        st, buf, _ = args
+        return st, buf, {"critic_loss": jnp.asarray(0.0),
+                         "actor_loss": jnp.asarray(0.0)}
+
+    return lax.cond(buf.cntr >= cfg.batch_size, do_learn, no_learn,
+                    (st, buf, key))

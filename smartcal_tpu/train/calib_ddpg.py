@@ -1,0 +1,42 @@
+"""Calibration (ADMM-rho tuning) DDPG training driver.
+
+Mirrors ``calibration/main_ddpg.py``: CNN+metadata DDPG agent
+(Ornstein-Uhlenbeck exploration noise, single critic, target actor+critic)
+on CalibEnv episodes; per-episode checkpointing.
+
+Usage:
+    python -m smartcal_tpu.train.calib_ddpg --episodes 30 [--small]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ..envs import CalibEnv
+from ..rl import ddpg
+from .calib_td3 import add_common_args, build_backend, run
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    add_common_args(p)
+    p.add_argument("--prefix", type=str, default="calib_ddpg")
+    args = p.parse_args(argv)
+
+    backend = build_backend(args)
+    env = CalibEnv(M=args.M, provide_hint=args.use_hint, backend=backend,
+                   seed=args.seed)
+    npix = backend.npix
+    cfg = ddpg.DDPGConfig(
+        obs_dim=npix * npix + (args.M + 1) * 7, n_actions=2 * args.M,
+        gamma=0.99, tau=0.005, batch_size=32, mem_size=1000, lr_a=1e-3,
+        lr_c=1e-3, img_shape=(npix, npix))
+    agent = ddpg.DDPGAgent(cfg, seed=args.seed, name_prefix=args.prefix)
+    if args.load:
+        agent.load_models()
+    return run(env, agent, args.episodes, args.steps, args.use_hint,
+               args.prefix)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Calibration (ADMM-rho tuning) TD3 training driver.
+
+Mirrors ``calibration/main_td3.py``: CNN+metadata TD3 agent (warmup random
+phase, delayed actor updates every 2 learn calls, exploration noise 0.1)
+stepping CalibEnv episodes of up to 10 steps, per-episode checkpointing.
+The hint path uses TD3's adaptive-rho ADMM inner loop (enet_td3.py:310-361)
+rather than SAC's penalty form.
+
+Usage:
+    python -m smartcal_tpu.train.calib_td3 --episodes 30 [--use_hint]
+        [--small]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+
+import numpy as np
+
+from ..envs import CalibEnv
+from ..envs.radio import RadioBackend
+from ..rl import td3
+from ..rl.networks import flatten_obs
+
+
+def run(env, agent, episodes, steps, use_hint, prefix):
+    """Shared episode loop of the radio TD3/DDPG drivers
+    (main_td3.py:23-48 / main_ddpg.py)."""
+    scores = []
+    for i in range(episodes):
+        obs = env.reset()
+        flat = flatten_obs(obs)
+        score, loop, done = 0.0, 0, False
+        while not done and loop < steps:
+            action = np.asarray(agent.choose_action(flat)).squeeze()
+            out = env.step(action)
+            if use_hint:
+                obs2, reward, done, hint, info = out
+            else:
+                obs2, reward, done, info = out
+                hint = np.zeros_like(action)
+            flat2 = flatten_obs(obs2)
+            agent.store_transition(flat, action, reward, flat2, done, hint)
+            agent.learn()
+            score += reward
+            flat = flat2
+            loop += 1
+        scores.append(score / max(loop, 1))
+        print(f"episode {i} score {scores[-1]:.2f} "
+              f"average score {np.mean(scores[-100:]):.2f}")
+        agent.save_models()
+        with open(f"{prefix}_scores.pkl", "wb") as fh:
+            pickle.dump(scores, fh)
+    return scores
+
+
+def build_backend(args):
+    if args.small:
+        return RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                            admm_iters=2, lbfgs_iters=3, init_iters=5,
+                            npix=32)
+    return RadioBackend(n_stations=args.stations, npix=args.npix)
+
+
+def add_common_args(p):
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--episodes", type=int, default=30)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--M", type=int, default=10)
+    p.add_argument("--use_hint", action="store_true")
+    p.add_argument("--stations", type=int, default=14)
+    p.add_argument("--npix", type=int, default=128)
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--load", action="store_true")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    add_common_args(p)
+    p.add_argument("--prefix", type=str, default="calib_td3")
+    args = p.parse_args(argv)
+
+    backend = build_backend(args)
+    env = CalibEnv(M=args.M, provide_hint=args.use_hint, backend=backend,
+                   seed=args.seed)
+    npix = backend.npix
+    cfg = td3.TD3Config(
+        obs_dim=npix * npix + (args.M + 1) * 7, n_actions=2 * args.M,
+        gamma=0.99, tau=0.005, batch_size=32, mem_size=1000, lr_a=1e-3,
+        lr_c=1e-3, warmup=100, noise=0.1, update_actor_interval=2,
+        use_hint=args.use_hint, img_shape=(npix, npix))
+    agent = td3.TD3Agent(cfg, seed=args.seed, name_prefix=args.prefix)
+    if args.load:
+        agent.load_models()
+    return run(env, agent, args.episodes, args.steps, args.use_hint,
+               args.prefix)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Fuzzy-controller demixing SAC training driver.
+
+Mirrors ``demixing_fuzzy/main_sac.py``: the action is the 24(K-1)+8
+membership-trapezoid parameter vector of the Mamdani controller; the env
+(FuzzyDemixingEnv) updates the controller, evaluates per-direction
+priority vs cutoff to select directions, and calibrates.  Metadata is
+5K+2 (adds log-fluxes + selected flags); influence maps are optional
+(``--use_influence``; without it the CNN branch is dropped,
+demixing_fuzzy/demix_sac.py:96-135) — the reward-shaping scale (x10 on
+rewards above 0.01) and warmup-random phase follow the reference
+(main_sac.py:70-99).
+
+Usage:
+    python -m smartcal_tpu.train.demix_fuzzy_sac --iteration 1000
+        [--use_hint] [--use_influence] [--small]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+
+import numpy as np
+
+from ..envs.demixing_fuzzy import FuzzyDemixingEnv
+from ..envs.radio import RadioBackend
+from ..rl import sac
+from ..rl.networks import flatten_obs
+
+MIN_POSITIVE_REWARD = 0.01      # reference main_sac.py:70
+REWARD_SCALE_POS = 10.0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--iteration", type=int, default=1000)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=30)
+    p.add_argument("--K", type=int, default=6)
+    p.add_argument("--memory", type=int, default=30000)
+    p.add_argument("--batch_size", type=int, default=256)
+    p.add_argument("--use_hint", action="store_true")
+    p.add_argument("--use_influence", action="store_true")
+    p.add_argument("--stations", type=int, default=14)
+    p.add_argument("--npix", type=int, default=128)
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--load", action="store_true")
+    p.add_argument("--prefix", type=str, default="demix_fuzzy_sac")
+    args = p.parse_args(argv)
+
+    rng = np.random.default_rng(args.seed)
+    if args.small:
+        backend = RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                               admm_iters=2, lbfgs_iters=3, init_iters=5,
+                               npix=32)
+    else:
+        backend = RadioBackend(n_stations=args.stations, npix=args.npix)
+    env = FuzzyDemixingEnv(K=args.K, provide_hint=args.use_hint,
+                           provide_influence=args.use_influence,
+                           backend=backend, seed=args.seed)
+    npix = backend.npix
+    n_meta = env.n_metadata
+    n_actions = env.n_actions
+    if args.use_influence:
+        obs_dim, img_shape = npix * npix + n_meta, (npix, npix)
+    else:
+        obs_dim, img_shape = n_meta, None
+    agent_cfg = sac.SACConfig(
+        obs_dim=obs_dim, n_actions=n_actions, gamma=0.99, tau=0.005,
+        batch_size=args.batch_size, mem_size=args.memory, lr_a=3e-4,
+        lr_c=3e-4, alpha=0.03, hint_threshold=0.01, admm_rho=1.0,
+        use_hint=args.use_hint, hint_distance="kld", img_shape=img_shape,
+        use_image=args.use_influence)
+    agent = sac.SACAgent(agent_cfg, seed=args.seed, name_prefix=args.prefix)
+    scores = []
+    if args.load:
+        agent.load_models()
+        with open(f"{args.prefix}_scores.pkl", "rb") as fh:
+            scores = pickle.load(fh)
+
+    def to_flat(o):
+        return (flatten_obs(o) if args.use_influence
+                else np.asarray(o["metadata"], np.float32))
+
+    total_steps = 0
+    warmup_steps = args.warmup * args.steps
+    for i in range(args.iteration):
+        obs = env.reset()
+        flat = to_flat(obs)
+        score, loop, done = 0.0, 0, False
+        while not done and loop < args.steps:
+            if total_steps < warmup_steps:
+                action = rng.uniform(-1, 1, n_actions).astype(np.float32)
+            else:
+                action = np.asarray(agent.choose_action(flat)).squeeze()
+            out = env.step(action)
+            if args.use_hint:
+                obs2, reward, done, hint, info = out
+            else:
+                obs2, reward, done, info = out
+                hint = np.zeros(n_actions, np.float32)
+            flat2 = to_flat(obs2)
+            scaled = (reward * REWARD_SCALE_POS
+                      if reward > MIN_POSITIVE_REWARD else reward)
+            agent.store_transition(flat, action, scaled, flat2, done, hint)
+            agent.learn()
+            score += reward
+            flat = flat2
+            loop += 1
+            total_steps += 1
+        scores.append(score / max(loop, 1))
+        print(f"episode {i} score {scores[-1]:.2f} "
+              f"average score {np.mean(scores[-100:]):.2f}")
+        agent.save_models()
+        with open(f"{args.prefix}_scores.pkl", "wb") as fh:
+            pickle.dump(scores, fh)
+    return scores
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""Influence functions OF the trained aux models.
+
+The reference's flagship "influence" story ends with two pipelines that
+apply the influence machinery to the *trained recommender models*
+themselves (how sensitive is the model's output to each input coordinate,
+through the trained weights):
+
+* ``demixing/eval_model.py:51-118`` — transformer: run a few epochs of
+  batch-mode L-BFGS on the trained net (only to accumulate curvature pairs
+  approximating the loss Hessian), reload the trained weights, then
+  ``influence_matrix`` of one sample; reshape each output class's row into
+  per-direction (Ninf^2 + 8) blocks and save influence MAPS per
+  (class, direction).
+* ``demixing_rl/influence_tsk.py:64-72`` — TSK fuzzy regressor: average
+  ``influence_matrix`` (Taylor inverse-HVP, no optimizer history) over 100
+  inputs.
+
+Both sit on :func:`smartcal_tpu.ops.autodiff.influence_matrix`; the M x N
+python loop of the reference is already a jacfwd/vmap there.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from smartcal_tpu.models.transformer import TransformerEncoder, XYBuffer
+from smartcal_tpu.models.tsk import TSKParams, tsk_forward
+from smartcal_tpu.ops.autodiff import influence_matrix
+from smartcal_tpu.ops.lbfgs import lbfgs_init, lbfgs_step
+
+
+def _bce(pred, y):
+    pred = jnp.clip(pred, 1e-6, 1 - 1e-6)
+    return -jnp.mean(y * jnp.log(pred) + (1 - y) * jnp.log(1 - pred))
+
+
+def transformer_influence(params, model: TransformerEncoder, buf: XYBuffer,
+                          K: int, npix: int, warmup_epochs: int = 30,
+                          batch_size: int = 4, seed: int = 0,
+                          outdir: Optional[str] = None):
+    """Per-(class, direction) influence maps of a trained transformer.
+
+    Reference ``demixing/eval_model.py:52-118``: L-BFGS warmup in batch
+    mode over the training buffer builds the curvature history whose
+    two-loop recursion is the inverse-Hessian applied inside
+    ``influence_matrix``; the TRAINED weights (not the warmup iterate) are
+    what the influence is evaluated at.
+
+    Returns ``(If, maps)``: If (K-1, K*(npix^2+8)); maps a dict
+    ``(class ci, direction ck) -> (npix, npix) array`` plus
+    ``('meta', ci, ck) -> (8,)`` metadata-influence vectors.
+    """
+    n = min(buf.mem_cntr, buf.mem_size)
+    x_all = jnp.asarray(buf.x[:n])
+    y_all = jnp.asarray(buf.y[:n])
+
+    flat, unravel = ravel_pytree(params)
+
+    # --- L-BFGS warmup: batch-mode steps on the BCE loss, collecting
+    # curvature pairs (eval_model.py:52-70; LBFGSNew(history_size=7,
+    # max_iter=4, batch_mode=True), 30 epochs x batch 4)
+    rng = np.random.default_rng(seed)
+    st = lbfgs_init(flat, history_size=7)
+    for _ in range(warmup_epochs):
+        idx = jnp.asarray(rng.integers(0, n, size=min(batch_size, n)))
+
+        def loss_fn(p_flat):
+            pred = model.apply({"params": unravel(p_flat)}, x_all[idx],
+                               train=False)
+            return _bce(pred, y_all[idx])
+
+        st, _ = lbfgs_step(loss_fn, st, max_iter=4)
+
+    # --- influence of ONE sample at the trained weights (:76-96)
+    x0, y0 = x_all[0], y_all[0]
+
+    def model_fn(p, xx):
+        return model.apply({"params": p}, xx[None], train=False)[0]
+
+    If = influence_matrix(model_fn, params, x0, y0, hist=st.hist)
+    If = np.asarray(If)
+
+    nout = npix * npix + 8
+    maps = {}
+    for ci in range(If.shape[0]):                     # output classes (K-1)
+        Z = If[ci].reshape(K, nout)                   # per direction blocks
+        for ck in range(K):
+            maps[(ci, ck)] = Z[ck, :npix * npix].reshape(npix, npix)
+            maps[("meta", ci, ck)] = Z[ck, npix * npix:]
+    if outdir is not None:
+        import os
+
+        os.makedirs(outdir, exist_ok=True)
+        np.savez(os.path.join(outdir, "transformer_influence.npz"),
+                 If=If, **{f"map_{ci}_{ck}": maps[(ci, ck)]
+                           for ci in range(If.shape[0]) for ck in range(K)})
+        try:                                          # PNG maps, like the
+            import matplotlib                         # reference If_*.png
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+
+            for (key, m) in maps.items():
+                if key[0] == "meta":
+                    continue
+                ci, ck = key
+                plt.imsave(os.path.join(outdir, f"If_{ci}_{ck}.png"), m)
+        except Exception:
+            pass
+    return If, maps
+
+
+def tsk_influence(params: TSKParams, X, y, n_avg: int = 100,
+                  taylor_iters: int = 10):
+    """Mean influence matrix of the trained TSK regressor over ``n_avg``
+    inputs (influence_tsk.py:64-72; Taylor inverse-HVP, opt=None)."""
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y, np.float32)
+    n_avg = min(n_avg, X.shape[0])
+
+    def model_fn(p, xx):
+        return tsk_forward(p, xx[None])[0]
+
+    If = None
+    for ci in range(n_avg):
+        one = influence_matrix(model_fn, params, jnp.asarray(X[ci]),
+                               jnp.asarray(y[ci]), hist=None,
+                               taylor_iters=taylor_iters)
+        If = one if If is None else If + one
+    return np.asarray(If) / n_avg

@@ -24,7 +24,7 @@ import numpy as np
 import optax
 
 from smartcal_tpu.cal import influence as influence_mod
-from smartcal_tpu.cal import imager, solver
+from smartcal_tpu.cal import solver
 from smartcal_tpu.envs.demixing import DemixingEnv
 from smartcal_tpu.envs.radio import RadioBackend
 from smartcal_tpu.models.regressor import RegressorNet, TrainingBuffer
@@ -120,6 +120,8 @@ def generate_training_data(key, backend: RadioBackend, K=6,
     ``flux_floor`` and elevation above ``el_floor`` — same decision, no
     imaging round-trip.
     """
+    from smartcal_tpu.cal.dataset import assemble_features
+
     ep, mdl = backend.new_demixing_episode(key, K)
     res = backend.calibrate(ep, mdl.rho, mask=np.ones(K, np.float32))
 
@@ -133,29 +135,9 @@ def generate_training_data(key, backend: RadioBackend, K=6,
         backend.n_chunks, perdir=True)
     summary = influence_mod.perdir_summary(inf.vis, inf.llr, ep.Ccal[0],
                                            res.J[0])
-
-    uvw = jnp.asarray(np.asarray(ep.obs.uvw).reshape(-1, 3))
-    cell = imager.default_cell(ep.obs.uvw, float(freqs[0]))
-    npix = backend.npix
-    nout = npix * npix + 8
-    x = np.zeros(K * nout, np.float32)
-    for ck in range(K):
-        ivis = influence_mod.stokes_i_influence(inf.vis[ck])
-        img = np.asarray(imager.dirty_image_sr(uvw, ivis, float(freqs[0]),
-                                               cell, npix=npix))
-        flat = img.reshape(-1, order="F")
-        flat = flat / max(np.linalg.norm(flat), 1e-12)
-        o = ck * nout
-        x[o:o + npix * npix] = flat
-        x[o + npix * npix + 0] = mdl.separations[ck]
-        x[o + npix * npix + 1] = mdl.azimuth[ck]
-        x[o + npix * npix + 2] = mdl.elevation[ck]
-        x[o + npix * npix + 3] = np.log(max(float(summary.j_norm[ck]), 1e-12))
-        x[o + npix * npix + 4] = np.log(max(float(summary.c_norm[ck]), 1e-12))
-        x[o + npix * npix + 5] = np.log(max(float(summary.inf_mean[ck]),
-                                            1e-12))
-        x[o + npix * npix + 6] = float(summary.llr_mean[ck])
-        x[o + npix * npix + 7] = np.log(freqs[0])
+    x = assemble_features(inf.vis, summary, ep.obs.uvw, freqs,
+                          mdl.separations, mdl.azimuth, mdl.elevation,
+                          npix=backend.npix)
 
     y = ((mdl.fluxes[:-1] > flux_floor)
          & (mdl.elevation[:-1] >= el_floor)).astype(np.float32)
@@ -216,6 +198,86 @@ def train_transformer(buf: XYBuffer, K=6, model_dim=66, epochs=2000,
     keys = jax.random.split(jax.random.PRNGKey(seed + 1), epochs)
     (params, _), losses = jax.lax.scan(step, (params, opt_state), keys)
     return params, {"losses": np.asarray(losses), "model": model}
+
+
+# ---------------------------------------------------------------------------
+# Transformer dataset maintenance: merge + class balancing
+# ---------------------------------------------------------------------------
+
+def merge_xy_buffers(*bufs: XYBuffer) -> XYBuffer:
+    """Concatenate the filled parts of several datasets into one
+    (demixing/mergebuffers.py:25-35)."""
+    xs, ys = [], []
+    for b in bufs:
+        n = min(b.mem_cntr, b.mem_size)
+        xs.append(b.x[:n])
+        ys.append(b.y[:n])
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    out = XYBuffer(x.shape[0], x.shape[1:], y.shape[1:])
+    for xi, yi in zip(x, y):
+        out.store(xi, yi)
+    return out
+
+
+def label_combination_counts(buf: XYBuffer):
+    """Bit-encode each multi-label row into a class integer and count
+    occurrences (populatebuffer.py:31-42's imbalance inspection).
+    Returns (codes (n,), {code: count})."""
+    n = min(buf.mem_cntr, buf.mem_size)
+    codes = np.zeros(n, dtype=int)
+    for ci in range(n):
+        for bit in buf.y[ci]:
+            codes[ci] = (codes[ci] << 1) | int(bit > 0.5)
+    uniq, cnt = np.unique(codes, return_counts=True)
+    return codes, dict(zip(uniq.tolist(), cnt.tolist()))
+
+
+def balance_xy_buffer(buf: XYBuffer, seed: int = 0,
+                      jitter: float = 1e-3) -> XYBuffer:
+    """SMOTE-style oversampling of minority label combinations.
+
+    The reference balances the transformer dataset with imblearn's
+    SMOTETomek (populatebuffer.py:45-50); the essential mechanism —
+    synthesize minority-class samples by convex interpolation between
+    same-class neighbours — is ~20 lines of numpy, done here directly
+    (no imblearn in the image).  Singleton combinations get jittered
+    copies (no partner to interpolate with); the Tomek-link cleaning
+    step is omitted (it removes boundary pairs, immaterial for the BCE
+    training path).  Every combination is raised to the majority count.
+    """
+    rng = np.random.default_rng(seed)
+    n = min(buf.mem_cntr, buf.mem_size)
+    codes, counts = label_combination_counts(buf)
+    target = max(counts.values())
+    xs = [buf.x[:n]]
+    ys = [buf.y[:n]]
+    for code, cnt in counts.items():
+        need = target - cnt
+        if need <= 0:
+            continue
+        idx = np.where(codes == code)[0]
+        i = rng.choice(idx, size=need)
+        if len(idx) > 1:
+            j = rng.choice(idx, size=need)
+            resample = (j == i)
+            j[resample] = idx[(np.searchsorted(idx, j[resample]) + 1)
+                              % len(idx)]
+            u = rng.random((need, 1)).astype(buf.x.dtype)
+            x_new = buf.x[i] + u * (buf.x[j] - buf.x[i])
+        else:
+            scale = jitter * max(float(np.abs(buf.x[idx]).max()), 1.0)
+            x_new = buf.x[i] + scale * rng.standard_normal(
+                (need,) + buf.x.shape[1:]).astype(buf.x.dtype)
+        xs.append(x_new)
+        ys.append(buf.y[i])
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    perm = rng.permutation(x.shape[0])
+    out = XYBuffer(x.shape[0], x.shape[1:], y.shape[1:])
+    for k in perm:
+        out.store(x[k], y[k])
+    return out
 
 
 def evaluate_tsk_msp(buf: TrainingBuffer, mlp_params, mlp_net, tsk_params,

@@ -1,0 +1,59 @@
+"""Model-influence pipelines (VERDICT r1 item 5): influence OF the trained
+aux models, eval_model.py / influence_tsk.py parity."""
+
+import os
+
+import jax
+import numpy as np
+
+from smartcal_tpu.models.transformer import TransformerEncoder, XYBuffer
+from smartcal_tpu.models.tsk import train_tsk
+from smartcal_tpu.train import supervised
+from smartcal_tpu.train.model_influence import (transformer_influence,
+                                                tsk_influence)
+
+K = 3
+NPIX = 4
+NOUT = NPIX * NPIX + 8
+
+
+def _buffer(rng, n=12):
+    buf = XYBuffer(n, (K * NOUT,), (K - 1,))
+    for _ in range(n):
+        buf.store(rng.standard_normal(K * NOUT).astype(np.float32),
+                  (rng.random(K - 1) > 0.5).astype(np.float32))
+    return buf
+
+
+def test_transformer_influence(tmp_path):
+    rng = np.random.default_rng(0)
+    buf = _buffer(rng)
+    params, hist = supervised.train_transformer(buf, K=K, model_dim=6,
+                                                epochs=30, batch_size=4)
+    model = hist["model"]
+    If, maps = transformer_influence(params, model, buf, K=K, npix=NPIX,
+                                     warmup_epochs=5,
+                                     outdir=str(tmp_path))
+    assert If.shape == (K - 1, K * NOUT)
+    assert np.all(np.isfinite(If))
+    assert not np.allclose(If, 0.0)
+    # per-(class, direction) maps unpack the row blocks exactly
+    assert maps[(0, 0)].shape == (NPIX, NPIX)
+    np.testing.assert_array_equal(maps[(0, 1)].ravel(),
+                                  If[0, NOUT:NOUT + NPIX * NPIX])
+    np.testing.assert_array_equal(maps[("meta", 0, 0)],
+                                  If[0, NPIX * NPIX:NOUT])
+    assert os.path.exists(tmp_path / "transformer_influence.npz")
+
+
+def test_tsk_influence():
+    rng = np.random.default_rng(1)
+    M = 3 * K + 2
+    X = rng.standard_normal((30, M)).astype(np.float32)
+    y = np.tanh(X[:, :K - 1] + 0.1 * rng.standard_normal((30, K - 1))
+                ).astype(np.float32)
+    params = train_tsk(jax.random.PRNGKey(0), X, y, n_iter=50)["params"]
+    If = tsk_influence(params, X, y, n_avg=5, taylor_iters=5)
+    assert If.shape == (K - 1, M)
+    assert np.all(np.isfinite(If))
+    assert not np.allclose(If, 0.0)

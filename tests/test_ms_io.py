@@ -1,0 +1,154 @@
+"""MS data edge: npz store roundtrips, extract_dataset, featurization, CLI.
+
+Covers VERDICT r1 item 2 (the real-data edge): the synthetic stand-in MS is
+written through the same writer a real observation would use, and the
+feature/evaluate path consumes it through cal.ms_io exactly as it would a
+casacore MS.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from smartcal_tpu.cal import creal, ms_io
+from smartcal_tpu.envs.radio import RadioBackend
+
+
+K = 4
+STATIONS = 6
+TIMES = 8
+TDELTA = 4
+NPIX = 8
+
+
+@pytest.fixture(scope="module")
+def backend():
+    return RadioBackend(n_stations=STATIONS, n_times=TIMES, tdelta=TDELTA,
+                        npix=NPIX, admm_iters=2, lbfgs_iters=3,
+                        init_iters=4)
+
+
+@pytest.fixture(scope="module")
+def episode(backend):
+    return backend.new_demixing_episode(jax.random.PRNGKey(7), K)[0]
+
+
+@pytest.fixture()
+def ms_set(tmp_path, episode):
+    return ms_io.observation_to_ms_set(str(tmp_path), episode.obs,
+                                       np.asarray(episode.V))
+
+
+def test_write_read_roundtrip(ms_set, episode):
+    """read_corr returns exactly the visibilities the simulator wrote,
+    autocorrelations excluded, rows time-major baseline-minor."""
+    uu, vv, ww, xx, xy, yx, yy = ms_io.read_corr(ms_set[0], "DATA")
+    B = episode.obs.n_baselines
+    assert uu.shape == (TIMES * B,)
+    V = creal.fuse(np.asarray(episode.V[0])).reshape(TIMES * B, 4)
+    np.testing.assert_allclose(xx, V[:, 0], rtol=1e-6)
+    np.testing.assert_allclose(yy, V[:, 3], rtol=1e-6)
+    uvw = np.asarray(episode.obs.uvw).reshape(-1, 3)
+    np.testing.assert_allclose(uu, uvw[:, 0], rtol=1e-5)
+
+
+def test_ms_info(ms_set, episode):
+    info = ms_io.ms_info(ms_set[0])
+    assert info.n_stations == STATIONS
+    assert info.n_baselines == episode.obs.n_baselines
+    assert info.n_times == TIMES
+    assert info.ra0 == pytest.approx(episode.obs.ra0)
+    assert info.freqs[0] == pytest.approx(
+        float(np.asarray(episode.obs.freqs)[0]))
+
+
+def test_write_corr_and_add_column(ms_set):
+    uu, vv, ww, xx, xy, yx, yy = ms_io.read_corr(ms_set[0], "DATA")
+    ms_io.write_corr(ms_set[0], 2 * xx, 2 * xy, 2 * yx, 2 * yy,
+                     colname="CORRECTED_DATA")
+    _, _, _, cxx, _, _, cyy = ms_io.read_corr(ms_set[0], "CORRECTED_DATA")
+    np.testing.assert_allclose(cxx, 2 * xx, rtol=1e-6)
+    np.testing.assert_allclose(cyy, 2 * yy, rtol=1e-6)
+
+
+def test_change_freq_and_add_noise(ms_set):
+    ms_io.change_freq(ms_set[1], 123.0e6)
+    assert ms_io.ms_info(ms_set[1]).freqs[0] == pytest.approx(123.0e6)
+    _, _, _, xx0, *_ = ms_io.read_corr(ms_set[1], "DATA")
+    ms_io.add_noise(ms_set[1], snr=1.0, rng=np.random.default_rng(1))
+    _, _, _, xx1, *_ = ms_io.read_corr(ms_set[1], "DATA")
+    assert not np.allclose(xx0, xx1)
+    # SNR definition: noise magnitude comparable to the data magnitude
+    snr = np.linalg.norm(xx0) / np.linalg.norm(xx1 - xx0)
+    assert 0.2 < snr < 5.0
+
+
+def test_extract_dataset(tmp_path, episode):
+    """Channel averaging + time-window cut (DP3-replacement semantics)."""
+    mslist = ms_io.observation_to_ms_set(str(tmp_path), episode.obs,
+                                         np.asarray(episode.V))
+    # give the middle MS two identical channels to verify averaging
+    main, meta = ms_io._load(mslist[1])
+    main["DATA"] = np.concatenate([main["DATA"], main["DATA"]], axis=1)
+    meta["CHAN_FREQ"] = np.asarray([100e6, 110e6])
+    ms_io._store(mslist[1], main, meta)
+
+    out = ms_io.extract_dataset(mslist, timesec=4.0, Nf=3,
+                                rng=np.random.default_rng(0),
+                                outdir=str(tmp_path))
+    assert len(out) == 3
+    # the hand-edited 100/110 MHz MS is a frequency ENDPOINT of the set
+    # (obs freqs are either all-LBA ~40-70 MHz or all-HBA ~110-180 MHz),
+    # so it must appear channel-averaged to 105 MHz at out[0] or out[-1]
+    out_infos = [ms_io.ms_info(m) for m in out]
+    assert all(i.n_chan == 1 for i in out_infos)
+    edited = [i for i in out_infos
+              if i.freqs[0] == pytest.approx(105e6)]
+    assert len(edited) == 1
+    assert all(4 <= i.n_times <= TIMES for i in out_infos)
+    # endpoint sub-bands are always the lowest/highest FREQUENCY MS
+    src_freqs = sorted(float(np.mean(ms_io.ms_info(m).freqs))
+                       for m in mslist)
+    out_freqs = [i.freqs[0] for i in out_infos]
+    assert out_freqs[0] == pytest.approx(src_freqs[0])
+    assert out_freqs[-1] == pytest.approx(src_freqs[-1])
+
+
+def test_get_info_from_dataset(tmp_path, episode):
+    """End-to-end real-data featurization on the MS-shaped stand-in:
+    x has the reference layout K x (Ninf^2 + 8) (generate_data.py:835-858)
+    with finite values and unit-normalized image blocks."""
+    from smartcal_tpu.cal import dataset
+
+    mslist = ms_io.observation_to_ms_set(str(tmp_path), episode.obs,
+                                         np.asarray(episode.V))
+    x = dataset.get_info_from_dataset(
+        mslist, timesec=float(TIMES), Ninf=NPIX, K=K, tdelta=TDELTA,
+        admm_iters=2, lbfgs_iters=3, init_iters=4,
+        workdir=str(tmp_path))
+    nout = NPIX * NPIX + 8
+    assert x.shape == (K * nout,)
+    assert np.all(np.isfinite(x))
+    for ck in range(K):
+        img = x[ck * nout:ck * nout + NPIX * NPIX]
+        assert np.linalg.norm(img) == pytest.approx(1.0, abs=1e-4)
+        sep, az, el = x[ck * nout + NPIX * NPIX:ck * nout + NPIX * NPIX + 3]
+        assert -360 <= az <= 360 and -90 <= el <= 90 and sep >= 0
+
+
+def test_evaluate_cli_selftest(tmp_path, monkeypatch):
+    """The evaluate CLI end-to-end: simulate -> MS -> train tiny model ->
+    recommend (demixing/evaluate.py:51-61 parity)."""
+    monkeypatch.chdir(tmp_path)
+    from smartcal_tpu.train import evaluate
+
+    probs = evaluate._selftest(_args())
+    assert probs.shape == (_args().K - 1,)
+    assert np.all((probs >= 0) & (probs <= 1))
+
+
+def _args():
+    import argparse
+
+    return argparse.Namespace(stations=STATIONS, times=TIMES,
+                              tdelta=TDELTA, npix=NPIX, K=K)

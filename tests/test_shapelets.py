@@ -1,0 +1,123 @@
+"""Shapelet diffuse-sky models (VERDICT r1 item 8): uv-plane prediction
+golden-tested against a direct numpy image-grid Fourier oracle."""
+
+import math
+
+import numpy as np
+import pytest
+
+from smartcal_tpu.cal import shapelets
+
+
+def test_basis_orthonormal():
+    """The 1D basis is orthonormal: integral phi_a phi_b = delta_ab."""
+    x = np.linspace(-12, 12, 6001)
+    dx = x[1] - x[0]
+    B = np.asarray(shapelets.basis_1d(5, x, beta=0.7))
+    G = B @ B.T * dx
+    np.testing.assert_allclose(G, np.eye(5), atol=2e-5)
+
+
+def test_uv_matches_numpy_dft_oracle():
+    """V(u, v) from the analytic FT == direct grid integration of the
+    image-domain shapelet (validates normalization, i^n routing, and the
+    e^{+i} sign convention of cal/coherency)."""
+    rng = np.random.default_rng(3)
+    n0 = 4
+    beta = 0.05
+    coeff = rng.standard_normal((n0, n0)).astype(np.float32)
+    # image grid wide enough to capture the envelope (n0 * beta ~ 0.2 rad)
+    npix = 801
+    half = 12 * beta
+    grid = np.linspace(-half, half, npix)
+    dl = grid[1] - grid[0]
+    L, M = np.meshgrid(grid, grid, indexing="ij")
+    img = np.asarray(shapelets.shapelet_image(coeff, L, M, beta))
+
+    u = np.asarray([0.0, 1.3, -2.0, 4.0, 0.5]) / beta / (2 * np.pi)
+    v = np.asarray([0.0, -0.7, 1.1, 0.2, -3.0]) / beta / (2 * np.pi)
+    vis = np.asarray(shapelets.shapelet_uv_sr(coeff, u, v, beta))
+    for i in range(u.size):
+        kernel = np.exp(2j * np.pi * (u[i] * L + v[i] * M))
+        oracle = np.sum(img * kernel) * dl * dl
+        np.testing.assert_allclose(vis[i, 0], oracle.real, rtol=2e-3,
+                                   atol=2e-3 * np.abs(oracle).max())
+        np.testing.assert_allclose(vis[i, 1], oracle.imag, rtol=2e-3,
+                                   atol=2e-3 * np.abs(oracle).max())
+
+
+def test_offset_phase_ramp():
+    """An off-center shapelet is the centered one times e^{2 pi i (u l0 +
+    v m0)}."""
+    rng = np.random.default_rng(4)
+    coeff = rng.standard_normal((3, 3)).astype(np.float32)
+    u = np.asarray([1.0, 2.0])
+    v = np.asarray([0.5, -1.0])
+    l0, m0 = 0.01, -0.02
+    v_cen = np.asarray(shapelets.shapelet_uv_sr(coeff, u, v, 0.1))
+    v_off = np.asarray(shapelets.shapelet_uv_sr(coeff, u, v, 0.1,
+                                                l0=l0, m0=m0))
+    ph = 2 * np.pi * (u * l0 + v * m0)
+    expect_re = v_cen[:, 0] * np.cos(ph) - v_cen[:, 1] * np.sin(ph)
+    expect_im = v_cen[:, 0] * np.sin(ph) + v_cen[:, 1] * np.cos(ph)
+    np.testing.assert_allclose(v_off[:, 0], expect_re, rtol=1e-5)
+    np.testing.assert_allclose(v_off[:, 1], expect_im, rtol=1e-5)
+
+
+def test_modes_file_roundtrip(tmp_path):
+    rng = np.random.default_rng(5)
+    m = shapelets.random_shapelet(rng)
+    assert 10 <= m.coeff.shape[0] < 20
+    assert m.beta * m.coeff.shape[0] <= 2.001
+    assert not np.allclose(m.coeff, m.coeff_cal)     # perturbed twin
+    p = tmp_path / "test.modes"
+    shapelets.write_modes(str(p), m.coeff, m.beta)
+    coeff2, beta2 = shapelets.read_modes(str(p))
+    np.testing.assert_allclose(coeff2, m.coeff, rtol=1e-5)
+    assert beta2 == pytest.approx(m.beta)
+
+
+def test_rescale_modes():
+    c = np.ones((3, 3))
+    out = shapelets.rescale_modes(c)
+    # value / ((ci+1)(cj+1)), the correct_shapelet_modes factorial ratio
+    assert out[0, 0] == pytest.approx(1.0 / (1 * 1))
+    assert out[2, 1] == pytest.approx(1.0 / (3 * 2))
+
+
+def test_diffuse_episode():
+    """simulate_models(diffuse=True) + backend integration.
+
+    At LOFAR baseline lengths a ~0.1-rad shapelet is essentially resolved
+    out (its uv support is ~1/(2 pi beta) wavelengths), so the visible-
+    contribution check uses a meters-scale compact layout; the standard-
+    scale episode checks the full path stays finite and solvable."""
+    import jax
+
+    from smartcal_tpu.cal import observation, simulate
+    from smartcal_tpu.envs.radio import RadioBackend
+
+    backend = RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                           admm_iters=2, lbfgs_iters=3, init_iters=4,
+                           npix=16)
+    key = jax.random.PRNGKey(0)
+    mdl = simulate.simulate_models(key, K=3, diffuse=True)
+    assert mdl.shapelet is not None
+    assert simulate.simulate_models(key, K=3).shapelet is None
+
+    # compact array (meter baselines): the diffuse component contributes
+    obs = observation.make_observation(
+        key, n_stations=6, n_freqs=2, n_times=4, hba=False,
+        layout_kwargs=dict(core_radius=2.0, max_radius=20.0))
+    C = backend._coherencies(obs, mdl.sky_cal)
+    C2 = backend._add_shapelet(obs, C, mdl.shapelet.coeff_cal,
+                               mdl.shapelet.beta_cal, mdl.shapelet.flux)
+    assert not np.allclose(np.asarray(C[:, 0]), np.asarray(C2[:, 0]))
+    np.testing.assert_allclose(np.asarray(C[:, 1]), np.asarray(C2[:, 1]),
+                               rtol=1e-6)
+
+    # full episode at standard scale solves and stays finite
+    ep1, mdl1 = backend.new_calib_episode(key, K=3, M=3, diffuse=True)
+    assert mdl1.shapelet is not None
+    res = backend.calibrate(ep1, mdl1.rho)
+    assert np.all(np.isfinite(np.asarray(res.residual)))
