@@ -96,11 +96,17 @@ def main():
         agent_state, buf, score = episode_fn(agent_state, buf, k)
     jax.block_until_ready(score)
 
+    # BENCH_TRACE_DIR=<dir> captures a jax.profiler trace of the timed
+    # section (answers "where does the step spend its time"; view with
+    # tensorboard --logdir <dir>)
+    from smartcal_tpu.utils import profiler_trace
+
     t0 = time.time()
-    for _ in range(TIMED_EPISODES):
-        key, k = jax.random.split(key)
-        agent_state, buf, score = episode_fn(agent_state, buf, k)
-    jax.block_until_ready(score)
+    with profiler_trace(os.environ.get("BENCH_TRACE_DIR")):
+        for _ in range(TIMED_EPISODES):
+            key, k = jax.random.split(key)
+            agent_state, buf, score = episode_fn(agent_state, buf, k)
+        jax.block_until_ready(score)
     wall = time.time() - t0
 
     steps = TIMED_EPISODES * STEPS_PER_EPISODE

@@ -101,6 +101,11 @@ def calibration_sky(ra0, dec0, t0, f0, K=6, sky_path=None,
     normalized to unit scale first, so flux 1.0 is the right magnitude).
     """
     lst0 = obs_mod.OMEGA_EARTH * t0 % (2 * math.pi)
+    if (sky_path is None) != (cluster_path is None):
+        raise ValueError(
+            "sky_path and cluster_path must be given together — with only "
+            "one, the synthetic stand-in sky would silently replace the "
+            "user's model")
     if sky_path is not None and cluster_path is not None:
         sky = skyio.build_sky_arrays(sky_path, cluster_path, ra0, dec0)
         Kf = sky.n_clusters

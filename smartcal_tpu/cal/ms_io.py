@@ -330,6 +330,11 @@ def extract_dataset(mslist: List[str], timesec: float, Nf: int = 3,
                 " use a different outdir/basename")
         main, meta = _load_any(src)
         sel = (main["TIME"] >= t_lo) & (main["TIME"] <= t_hi)
+        if not np.any(sel):
+            raise ValueError(
+                f"extract_dataset: the {timesec}s window [{t_lo:.1f}, "
+                f"{t_hi:.1f}] selects no rows of {src} (integration "
+                "interval longer than the window?) — increase timesec")
         new_main = {}
         for k, v in main.items():
             v = v[sel]
@@ -357,6 +362,10 @@ def _casa_ms_info(path) -> MSInfo:  # pragma: no cover - needs casacore
     t0 = float(tt[0]["TIME"])
     interval = float(tt[0]["INTERVAL"])
     nrows = tt.nrows()
+    # count autocorrelation rows rather than guessing from divisibility
+    # (T*(N-1) divisible by N+1 happens for real shapes, e.g. N=15, T=16)
+    n_auto = int(np.count_nonzero(a1 == a2))
+    rows_per_time = b + n_st if n_auto else b
     tt.close()
     tf = _ctab.table(os.path.join(path, "SPECTRAL_WINDOW"), readonly=True)
     freqs = np.asarray(tf.getcol("CHAN_FREQ")[0], np.float64)
@@ -365,8 +374,7 @@ def _casa_ms_info(path) -> MSInfo:  # pragma: no cover - needs casacore
     fld = _ctab.table(os.path.join(path, "FIELD"), readonly=True)
     ra0, dec0 = (float(x) for x in fld.getcol("PHASE_DIR")[0][0])
     fld.close()
-    names_per_time = b + n_st if nrows % (b + n_st) == 0 else b
-    return MSInfo(n_st, b, nrows // names_per_time, freqs.size, freqs, ref,
+    return MSInfo(n_st, b, nrows // rows_per_time, freqs.size, freqs, ref,
                   ra0, dec0, t0, interval)
 
 

@@ -451,7 +451,12 @@ def lbfgs_step(fun: Callable, state: LBFGSState, max_iter: int = 4,
     which caps the backtracking search; within the batch, pairs are stored
     with the trust-region modification ``y <- y + lm0 * s``.
 
-    Returns ``(state, loss)``.
+    Returns ``(state, loss)`` where ``loss`` is the PRE-STEP objective at
+    the incoming iterate — the first closure evaluation, exactly what the
+    reference ``optimizer.step(closure)`` returns (lbfgsnew.py:509-513).
+    Callers logging convergence should evaluate ``fun(state.x)`` after the
+    step (or log the next call's return) rather than treat this as the
+    post-step loss.
     """
     value_and_grad = jax.value_and_grad(fun)
 

@@ -35,7 +35,7 @@ def main(argv=None):
     if args.load:
         agent.load_models()
     return run(env, agent, args.episodes, args.steps, args.use_hint,
-               args.prefix)
+               args.prefix, metrics_path=args.metrics)
 
 
 if __name__ == "__main__":

@@ -22,6 +22,7 @@ from ..envs import CalibEnv
 from ..envs.radio import RadioBackend
 from ..rl import sac
 from ..rl.networks import flatten_obs
+from ..utils import JsonlLogger
 
 
 def main(argv=None):
@@ -37,6 +38,8 @@ def main(argv=None):
                    help="tiny shapes for smoke runs")
     p.add_argument("--load", action="store_true")
     p.add_argument("--prefix", type=str, default="calib_sac")
+    p.add_argument("--metrics", type=str, default=None,
+                   help="JSONL metrics stream path")
     args = p.parse_args(argv)
 
     if args.small:
@@ -60,6 +63,7 @@ def main(argv=None):
         agent.load_models()
 
     scores = []
+    mlog = JsonlLogger(args.metrics)
     for i in range(args.episodes):
         obs = env.reset()
         flat = flatten_obs(obs)
@@ -81,11 +85,14 @@ def main(argv=None):
             flat = flat2
             loop += 1
         scores.append(score / max(loop, 1))
+        mlog.log("episode", episode=i, score=scores[-1], seed=args.seed,
+                 use_hint=args.use_hint)
         print(f"episode {i} score {scores[-1]:.2f} "
               f"average score {np.mean(scores[-100:]):.2f}")
         agent.save_models()
         with open(f"{args.prefix}_scores.pkl", "wb") as fh:
             pickle.dump(scores, fh)
+    mlog.close()
     return scores
 
 

@@ -24,10 +24,13 @@ from ..rl import td3
 from ..rl.networks import flatten_obs
 
 
-def run(env, agent, episodes, steps, use_hint, prefix):
+def run(env, agent, episodes, steps, use_hint, prefix, metrics_path=None):
     """Shared episode loop of the radio TD3/DDPG drivers
     (main_td3.py:23-48 / main_ddpg.py)."""
+    from ..utils import JsonlLogger
+
     scores = []
+    mlog = JsonlLogger(metrics_path)
     for i in range(episodes):
         obs = env.reset()
         flat = flatten_obs(obs)
@@ -47,11 +50,13 @@ def run(env, agent, episodes, steps, use_hint, prefix):
             flat = flat2
             loop += 1
         scores.append(score / max(loop, 1))
+        mlog.log("episode", episode=i, score=scores[-1], use_hint=use_hint)
         print(f"episode {i} score {scores[-1]:.2f} "
               f"average score {np.mean(scores[-100:]):.2f}")
         agent.save_models()
         with open(f"{prefix}_scores.pkl", "wb") as fh:
             pickle.dump(scores, fh)
+    mlog.close()
     return scores
 
 
@@ -73,6 +78,8 @@ def add_common_args(p):
     p.add_argument("--npix", type=int, default=128)
     p.add_argument("--small", action="store_true")
     p.add_argument("--load", action="store_true")
+    p.add_argument("--metrics", type=str, default=None,
+                   help="JSONL metrics stream path")
 
 
 def main(argv=None):
@@ -94,7 +101,7 @@ def main(argv=None):
     if args.load:
         agent.load_models()
     return run(env, agent, args.episodes, args.steps, args.use_hint,
-               args.prefix)
+               args.prefix, metrics_path=args.metrics)
 
 
 if __name__ == "__main__":

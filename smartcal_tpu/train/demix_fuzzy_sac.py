@@ -23,9 +23,10 @@ import pickle
 import numpy as np
 
 from ..envs.demixing_fuzzy import FuzzyDemixingEnv
-from ..envs.radio import RadioBackend
 from ..rl import sac
 from ..rl.networks import flatten_obs
+from .calib_td3 import build_backend
+from .demix_sac import run_warmup_loop
 
 MIN_POSITIVE_REWARD = 0.01      # reference main_sac.py:70
 REWARD_SCALE_POS = 10.0
@@ -47,15 +48,12 @@ def main(argv=None):
     p.add_argument("--small", action="store_true")
     p.add_argument("--load", action="store_true")
     p.add_argument("--prefix", type=str, default="demix_fuzzy_sac")
+    p.add_argument("--metrics", type=str, default=None,
+                   help="JSONL metrics stream path")
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
-    if args.small:
-        backend = RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
-                               admm_iters=2, lbfgs_iters=3, init_iters=5,
-                               npix=32)
-    else:
-        backend = RadioBackend(n_stations=args.stations, npix=args.npix)
+    backend = build_backend(args)
     env = FuzzyDemixingEnv(K=args.K, provide_hint=args.use_hint,
                            provide_influence=args.use_influence,
                            backend=backend, seed=args.seed)
@@ -83,39 +81,11 @@ def main(argv=None):
         return (flatten_obs(o) if args.use_influence
                 else np.asarray(o["metadata"], np.float32))
 
-    total_steps = 0
-    warmup_steps = args.warmup * args.steps
-    for i in range(args.iteration):
-        obs = env.reset()
-        flat = to_flat(obs)
-        score, loop, done = 0.0, 0, False
-        while not done and loop < args.steps:
-            if total_steps < warmup_steps:
-                action = rng.uniform(-1, 1, n_actions).astype(np.float32)
-            else:
-                action = np.asarray(agent.choose_action(flat)).squeeze()
-            out = env.step(action)
-            if args.use_hint:
-                obs2, reward, done, hint, info = out
-            else:
-                obs2, reward, done, info = out
-                hint = np.zeros(n_actions, np.float32)
-            flat2 = to_flat(obs2)
-            scaled = (reward * REWARD_SCALE_POS
-                      if reward > MIN_POSITIVE_REWARD else reward)
-            agent.store_transition(flat, action, scaled, flat2, done, hint)
-            agent.learn()
-            score += reward
-            flat = flat2
-            loop += 1
-            total_steps += 1
-        scores.append(score / max(loop, 1))
-        print(f"episode {i} score {scores[-1]:.2f} "
-              f"average score {np.mean(scores[-100:]):.2f}")
-        agent.save_models()
-        with open(f"{args.prefix}_scores.pkl", "wb") as fh:
-            pickle.dump(scores, fh)
-    return scores
+    return run_warmup_loop(
+        env, agent, args, scores, to_flat, n_actions=n_actions,
+        scale_reward=lambda r: (r * REWARD_SCALE_POS
+                                if r > MIN_POSITIVE_REWARD else r),
+        rng=rng)
 
 
 if __name__ == "__main__":

@@ -21,6 +21,7 @@ from ..envs import DemixingEnv
 from ..envs.radio import RadioBackend
 from ..rl import sac
 from ..rl.networks import flatten_obs
+from ..utils import JsonlLogger
 
 
 def main(argv=None):
@@ -39,6 +40,8 @@ def main(argv=None):
     p.add_argument("--small", action="store_true")
     p.add_argument("--load", action="store_true")
     p.add_argument("--prefix", type=str, default="demix_sac")
+    p.add_argument("--metrics", type=str, default=None,
+                   help="JSONL metrics stream path")
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
@@ -77,6 +80,19 @@ def main(argv=None):
         return (flatten_obs(o) if args.provide_influence
                 else np.asarray(o["metadata"], np.float32))
 
+    # rewards > 0 scaled by 10 (demixing_rl/main_sac.py reward shaping)
+    return run_warmup_loop(
+        env, agent, args, scores, to_flat, n_actions=args.K,
+        scale_reward=lambda r: r * 10 if r > 0 else r, rng=rng)
+
+
+def run_warmup_loop(env, agent, args, scores, to_flat, n_actions,
+                    scale_reward, rng):
+    """Shared warmup/step/store/learn episode loop of the demixing-family
+    drivers (demixing_rl/main_sac.py:54-98, demixing_fuzzy/main_sac.py:
+    70-99 — identical control flow, differing only in the reward-shaping
+    rule and the observation flattening)."""
+    mlog = JsonlLogger(args.metrics)
     total_steps = 0
     warmup_steps = args.warmup * args.steps
     for i in range(args.iteration):
@@ -85,7 +101,7 @@ def main(argv=None):
         score, loop, done = 0.0, 0, False
         while not done and loop < args.steps:
             if total_steps < warmup_steps:
-                action = rng.uniform(-1, 1, args.K).astype(np.float32)
+                action = rng.uniform(-1, 1, n_actions).astype(np.float32)
             else:
                 action = np.asarray(agent.choose_action(flat)).squeeze()
             out = env.step(action)
@@ -93,21 +109,24 @@ def main(argv=None):
                 obs2, reward, done, hint, info = out
             else:
                 obs2, reward, done, info = out
-                hint = np.zeros(args.K, np.float32)
+                hint = np.zeros(n_actions, np.float32)
             flat2 = to_flat(obs2)
-            scaled = reward * 10 if reward > 0 else reward
-            agent.store_transition(flat, action, scaled, flat2, done, hint)
+            agent.store_transition(flat, action, scale_reward(reward),
+                                   flat2, done, hint)
             agent.learn()
             score += reward
             flat = flat2
             loop += 1
             total_steps += 1
         scores.append(score / max(loop, 1))
+        mlog.log("episode", episode=i, score=scores[-1], seed=args.seed,
+                 use_hint=args.use_hint)
         print(f"episode {i} score {scores[-1]:.2f} "
               f"average score {np.mean(scores[-100:]):.2f}")
         agent.save_models()
         with open(f"{args.prefix}_scores.pkl", "wb") as fh:
             pickle.dump(scores, fh)
+    mlog.close()
     return scores
 
 

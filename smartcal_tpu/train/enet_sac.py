@@ -74,7 +74,9 @@ def make_episode_fn(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
 
 def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,
                 M=20, N=20, log_every=1, save_every=500, prefix="",
-                quiet=False):
+                quiet=False, metrics_path=None):
+    from ..utils import JsonlLogger
+
     env_cfg = enet.EnetConfig(M=M, N=N)
     agent_cfg = sac.SACConfig(
         obs_dim=env_cfg.obs_dim, n_actions=2, gamma=0.99, tau=0.005,
@@ -90,16 +92,20 @@ def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,
 
     scores = []
     t0 = time.time()
+    mlog = JsonlLogger(metrics_path)
     for i in range(episodes):
         key, k = jax.random.split(key)
         agent_state, buf, score = episode_fn(agent_state, buf, k)
         scores.append(float(score))
+        mlog.log("episode", episode=i, score=scores[-1], seed=seed,
+                 use_hint=use_hint)
         if not quiet and i % log_every == 0:
             avg = sum(scores[-100:]) / len(scores[-100:])
             print(f"episode {i} score {scores[-1]:.2f} average score {avg:.2f}")
         if save_every and i and i % save_every == 0:
             _save(agent_state, buf, scores, prefix)
     wall = time.time() - t0
+    mlog.close()
     _save(agent_state, buf, scores, prefix)
     return scores, wall, agent_state, buf
 
@@ -152,12 +158,14 @@ def main():
     p.add_argument("--steps", default=5, type=int)
     p.add_argument("--use_hint", action="store_true", default=False)
     p.add_argument("--mode", default="fused", choices=["fused", "loop"])
+    p.add_argument("--metrics", default=None,
+                   help="JSONL metrics stream path (one line per episode)")
     args = p.parse_args()
 
     if args.mode == "fused":
         scores, wall, _, _ = train_fused(
             seed=args.seed, episodes=args.episodes, steps=args.steps,
-            use_hint=args.use_hint)
+            use_hint=args.use_hint, metrics_path=args.metrics)
         print(json.dumps({"episodes": args.episodes,
                           "steps_per_episode": args.steps,
                           "wall_s": round(wall, 2),

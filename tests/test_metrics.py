@@ -1,0 +1,56 @@
+"""JSONL metrics stream + profiler hook (VERDICT r1 item 9
+observability)."""
+
+import json
+
+import numpy as np
+
+from smartcal_tpu.utils import JsonlLogger, profiler_trace
+
+
+def test_jsonl_logger(tmp_path):
+    path = tmp_path / "m.jsonl"
+    with JsonlLogger(str(path)) as log:
+        log.log("episode", episode=0, score=np.float32(1.5))
+        log.log("episode", episode=1, score=2.0, use_hint=True)
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["event"] == "episode"
+    assert lines[0]["score"] == 1.5          # numpy scalar -> plain float
+    assert lines[1]["use_hint"] is True
+    assert all("t" in ln for ln in lines)
+
+
+def test_jsonl_logger_disabled():
+    log = JsonlLogger(None)
+    log.log("episode", score=1.0)            # no-op, no error
+    log.close()
+
+
+def test_jsonl_logger_appends(tmp_path):
+    path = tmp_path / "m.jsonl"
+    for i in range(2):
+        with JsonlLogger(str(path)) as log:
+            log.log("run", i=i)
+    assert len(path.read_text().splitlines()) == 2
+
+
+def test_profiler_trace_noop():
+    with profiler_trace(None):
+        pass
+    with profiler_trace(""):
+        pass
+
+
+def test_driver_metrics_stream(tmp_path, monkeypatch):
+    """The enet driver emits one JSONL line per episode."""
+    monkeypatch.chdir(tmp_path)
+    from smartcal_tpu.train.enet_sac import train_fused
+
+    train_fused(episodes=3, steps=2, M=6, N=6, quiet=True, save_every=0,
+                metrics_path=str(tmp_path / "enet.jsonl"))
+    lines = [json.loads(ln)
+             for ln in (tmp_path / "enet.jsonl").read_text().splitlines()]
+    assert len(lines) == 3
+    assert [ln["episode"] for ln in lines] == [0, 1, 2]
+    assert all(np.isfinite(ln["score"]) for ln in lines)

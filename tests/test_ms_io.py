@@ -136,6 +136,7 @@ def test_get_info_from_dataset(tmp_path, episode):
         assert -360 <= az <= 360 and -90 <= el <= 90 and sep >= 0
 
 
+@pytest.mark.slow
 def test_evaluate_cli_selftest(tmp_path, monkeypatch):
     """The evaluate CLI end-to-end: simulate -> MS -> train tiny model ->
     recommend (demixing/evaluate.py:51-61 parity)."""

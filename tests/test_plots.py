@@ -1,0 +1,73 @@
+"""Plot/inspect utilities (SURVEY §2.6 row 47: plot_databuffer,
+inspect_replaybuffer, plot_tsk parity)."""
+
+import os
+
+import jax
+import numpy as np
+
+from smartcal_tpu.envs.demixing import META_SCALE, REWARD_MEAN, REWARD_STD
+from smartcal_tpu.models.regressor import TrainingBuffer
+from smartcal_tpu.models.tsk import tsk_init
+from smartcal_tpu.rl import replay as rp
+from smartcal_tpu.train import plots
+
+K = 4
+
+
+def test_plot_databuffer(tmp_path):
+    buf = TrainingBuffer(8, 3 * K + 2, K - 1)
+    rng = np.random.default_rng(0)
+    md = rng.uniform(0, 90, size=(5, 3 * K + 2)).astype(np.float32)
+    for row in md:
+        buf.store(row * META_SCALE, np.zeros(K - 1, np.float32))
+    out = tmp_path / "foo.png"
+    cols = plots.plot_databuffer(buf, K, field="azimuth",
+                                 out_png=str(out))
+    assert out.exists() and out.stat().st_size > 0
+    # un-scaled azimuth block returned
+    np.testing.assert_allclose(cols, md[:, K:2 * K], rtol=1e-5)
+
+
+def test_plot_rewards_rescale(tmp_path):
+    out = tmp_path / "bar.png"
+    normed = np.asarray([0.0, 1.0, -1.0])
+    raw = plots.plot_rewards(normed, out_png=str(out))
+    assert out.exists()
+    # inverse of (r - mean)/std with mean = -859: r*3559 - 859
+    np.testing.assert_allclose(raw[0],
+                               normed * REWARD_STD + REWARD_MEAN)
+    assert raw[0][0] == REWARD_MEAN
+
+
+def test_inspect_replaybuffer(tmp_path):
+    h = w = 6
+    obs_dim = h * w + 5
+    buf = rp.replay_init(16, {
+        "state": ((obs_dim,), np.float32),
+        "action": ((2,), np.float32),
+        "reward": ((), np.float32),
+        "new_state": ((obs_dim,), np.float32),
+        "done": ((), np.bool_)})
+    rng = np.random.default_rng(1)
+    for i in range(9):
+        buf = rp.replay_add(buf, {
+            "state": rng.standard_normal(obs_dim).astype(np.float32),
+            "action": np.zeros(2, np.float32), "reward": np.float32(0),
+            "new_state": np.zeros(obs_dim, np.float32), "done": False},
+            priority=1.0)
+    out = tmp_path / "grid.png"
+    tiles = plots.inspect_replaybuffer(buf, (h, w), out_png=str(out),
+                                       stride=2)
+    assert out.exists() and out.stat().st_size > 0
+    assert tiles.shape == (5, h, w)                  # 9 states, stride 2
+    assert np.all(np.isfinite(tiles))
+
+
+def test_plot_tsk(tmp_path):
+    params = tsk_init(jax.random.PRNGKey(0), n_inputs=5, n_outputs=3,
+                      n_rule=3)
+    out = tmp_path / "tsk.png"
+    dumped = plots.plot_tsk(params, out_png=str(out))
+    assert out.exists() and out.stat().st_size > 0
+    assert dumped["center"].shape == (5, 3)

@@ -136,6 +136,34 @@ def test_sac_hint_dual_update():
     assert float(st2.rho) > 0.0
 
 
+def test_sac_learned_alpha():
+    """learn_alpha=True: alpha follows the reference's clamped gradient-
+    sign update alpha <- max(0, alpha + lr * mean(target_entropy + logpi))
+    every 10 learn calls (enet_sac.py:608-613) and never goes negative."""
+    cfg = sac.SACConfig(obs_dim=6, n_actions=2, batch_size=4, mem_size=16,
+                        learn_alpha=True, alpha=0.03, alpha_lr=0.1)
+    st = sac.sac_init(jax.random.PRNGKey(0), cfg)
+    buf = rp.replay_init(cfg.mem_size, _spec())
+    rng = np.random.default_rng(2)
+    for i in range(8):
+        tr = _tr(i)
+        tr["state"] = rng.normal(size=6).astype(np.float32)
+        buf = rp.replay_add(buf, tr, priority=jnp.asarray(1.0))
+    # counter 0 -> temperature update fires on the first learn call
+    st2, buf, m = sac.learn(cfg, st, buf, jax.random.PRNGKey(3))
+    assert float(st2.alpha) != float(st.alpha)
+    assert float(st2.alpha) >= 0.0
+    # counters 1..9 -> alpha frozen between the every-10 updates
+    st3, buf, _ = sac.learn(cfg, st2, buf, jax.random.PRNGKey(4))
+    assert float(st3.alpha) == float(st2.alpha)
+    # ten learn calls later the update fires again; alpha stays clamped
+    for k in range(8):
+        st3, buf, _ = sac.learn(cfg, st3, buf, jax.random.PRNGKey(5 + k))
+    st4, buf, _ = sac.learn(cfg, st3, buf, jax.random.PRNGKey(20))
+    assert float(st4.alpha) >= 0.0
+    assert int(st4.learn_counter) == 11
+
+
 def test_sac_prioritized_path():
     cfg = sac.SACConfig(obs_dim=6, n_actions=2, batch_size=4, mem_size=16,
                         prioritized=True)

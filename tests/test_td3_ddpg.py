@@ -1,6 +1,7 @@
 """Tests for TD3 (warmup, delayed actor, hint-ADMM, PER) and DDPG (OU noise)."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -61,6 +62,7 @@ def test_td3_learn_and_delayed_actor():
     assert float(jnp.linalg.norm(flat(st2.actor_params) - a0)) > 0
 
 
+@pytest.mark.slow
 def test_td3_hint_admm_pulls_towards_hint():
     """With a strong hint constraint the ADMM inner loop should move the
     actor towards the hint more than the unconstrained update does."""

@@ -62,7 +62,13 @@ def main():
     p.add_argument("--episodes", default=1000, type=int)
     p.add_argument("--steps", default=5, type=int)
     p.add_argument("--outdir", default="results/enet_sweep")
+    p.add_argument("--platform", default=None, choices=["cpu", "axon"],
+                   help="force a JAX platform (the axon TPU plugin is "
+                   "registered at interpreter start, so JAX_PLATFORMS=cpu "
+                   "alone cannot select CPU)")
     args = p.parse_args()
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
 
     os.makedirs(args.outdir, exist_ok=True)
     jsonl_path = os.path.join(args.outdir, "scores.jsonl")
