@@ -66,12 +66,17 @@ def main():
                    help="force a JAX platform (the axon TPU plugin is "
                    "registered at interpreter start, so JAX_PLATFORMS=cpu "
                    "alone cannot select CPU)")
+    p.add_argument("--plot-only", action="store_true",
+                   help="regenerate plots/summary from existing scores.jsonl")
     args = p.parse_args()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
     os.makedirs(args.outdir, exist_ok=True)
     jsonl_path = os.path.join(args.outdir, "scores.jsonl")
+    if args.plot_only:
+        make_plots(jsonl_path, args)
+        return
     env_cfg = enet.EnetConfig(M=20, N=20)
     summary = []
     t_start = time.time()
@@ -113,37 +118,59 @@ def main():
     with open(os.path.join(args.outdir, "summary.json"), "w") as f:
         json.dump(summary, f, indent=1)
 
-    # plot mean +/- std of the moving average over seeds, hint vs no-hint
+    make_plots(jsonl_path, args)
+    print("sweep complete:", json.dumps(summary[-1]))
+
+
+def make_plots(jsonl_path, args):
+    """Two-panel learning curves: mean +/- std of the per-seed moving
+    average, AND the cross-seed MEDIAN curve.
+
+    The median panel matters: the reward's eig-ratio term min(E)/max(E)
+    (enetenv.py:149) occasionally explodes to ~-1e3 when max(E) ~ 0, and
+    a single such episode drags a 100-episode mean by -10 — the mean
+    curve is spike-dominated while the policy itself keeps producing
+    normal scores (the spikes recover within a few episodes).
+    """
     import numpy as np
-    runs = {"hint": [], "nohint": []}
+    raw = {"hint": [], "nohint": []}
     with open(jsonl_path) as f:
         per_run = {}
         for line in f:
             r = json.loads(line)
             per_run.setdefault((r["mode"], r["seed"]), []).append(r["score"])
     for (mode, _), sc in sorted(per_run.items()):
-        runs[mode].append(moving_avg(sc))
+        raw[mode].append(sc)
 
     import matplotlib
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
-    fig, ax = plt.subplots(figsize=(8, 5))
+    fig, (ax, ax2) = plt.subplots(1, 2, figsize=(13, 5))
+    robust = {}
     for mode, color in (("nohint", "tab:blue"), ("hint", "tab:orange")):
-        arr = np.asarray(runs[mode])
-        if arr.size == 0:
+        if not raw[mode]:
             continue
+        arr = np.asarray([moving_avg(sc) for sc in raw[mode]])
         mu, sd = arr.mean(axis=0), arr.std(axis=0)
         x = np.arange(arr.shape[1])
         ax.plot(x, mu, color=color, label=f"{mode} (n={arr.shape[0]})")
         ax.fill_between(x, mu - sd, mu + sd, color=color, alpha=0.2)
-    ax.set_xlabel("episode")
-    ax.set_ylabel("score (100-episode moving average)")
-    ax.set_title("Elastic-net SAC on TPU: hint vs no-hint "
-                 f"({args.seeds} seeds)")
-    ax.legend()
+        med = np.median(np.asarray(raw[mode]), axis=0)
+        med_ma = moving_avg(list(med))
+        ax2.plot(x, med_ma, color=color, label=f"{mode} median")
+        robust[mode] = round(float(np.mean(med_ma[-100:])), 3)
+    for a, title in ((ax, "mean +/- std of per-seed moving averages"),
+                     (ax2, "cross-seed median (spike-robust)")):
+        a.set_xlabel("episode")
+        a.set_ylabel("score (100-episode moving average)")
+        a.set_title(title)
+        a.legend()
+    fig.suptitle(f"Elastic-net SAC: hint vs no-hint ({args.seeds} seeds)")
     fig.tight_layout()
     fig.savefig(os.path.join(args.outdir, "learning_curves.png"), dpi=120)
-    print("sweep complete:", json.dumps(summary[-1]))
+    with open(os.path.join(args.outdir, "robust_final.json"), "w") as f:
+        json.dump(robust, f)
+    print("robust final (median-curve tail):", json.dumps(robust))
 
 
 if __name__ == "__main__":
