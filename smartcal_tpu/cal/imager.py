@@ -42,13 +42,31 @@ def default_cell(uvw, freq, oversample=3.0):
     return 1.0 / (oversample * 2.0 * max(umax, 1.0))
 
 
-@partial(jax.jit, static_argnames=("npix",))
 def dirty_image_sr(uvw, vis, freq, cell, npix=128):
     """Dirty image (npix, npix) from split-real Stokes visibilities.
 
     uvw : (R, 3) meters;  vis : (R, 2) split-real complex samples
     I(l, m) = mean_r Re( V_r exp(i phase) ),  phase = scale (u l + v m)
+
+    Dispatches to the fused Pallas kernel on TPU for aligned image sizes
+    (ops/pallas_imager.py: the (P, R) phase/trig intermediates never
+    leave VMEM), the XLA formulation otherwise.  Callers inside a
+    GSPMD-sharded program must use :func:`dirty_image_sr_xla` directly —
+    pallas_call has no partitioning rule.
     """
+    from smartcal_tpu.ops import pallas_imager  # lazy: ops is above cal
+
+    if ((npix * npix) % pallas_imager.TILE_P == 0
+            and pallas_imager.pallas_available()):
+        return pallas_imager.dirty_image_pallas(uvw, vis, freq, cell,
+                                                npix=npix)
+    return dirty_image_sr_xla(uvw, vis, freq, cell, npix=npix)
+
+
+@partial(jax.jit, static_argnames=("npix",))
+def dirty_image_sr_xla(uvw, vis, freq, cell, npix=128):
+    """Plain XLA formulation (materializes the (P, R) phase matrix); the
+    safe path inside sharded jits and the golden oracle for the kernel."""
     scale = 2.0 * jnp.pi * freq / C_LIGHT
     uv = uvw[:, :2] * scale                                # (R, 2)
     lm = pixel_grid(npix, cell)                            # (P, 2)

@@ -166,9 +166,12 @@ def make_distributed_demix_sac(backend: radio.RadioBackend, K: int,
             inf = influence_mod.influence_visibilities(
                 Rk, wl_ep.Ccal[fi], res.J[fi], hadd, N, backend.n_chunks)
             ivis = influence_mod.stokes_i_influence(inf.vis)
-            imgs.append(imager.dirty_image_sr(uvw_flat, ivis,
-                                              wl_ep.freqs[fi], wl_ep.cell,
-                                              npix=npix))
+            # explicitly the XLA formulation: this runs inside the
+            # dp-sharded jitted rollout and pallas_call has no GSPMD
+            # partitioning rule (imager.dirty_image_sr's pallas dispatch
+            # would fail to shard or replicate the kernel per chip)
+            imgs.append(imager.dirty_image_sr_xla(
+                uvw_flat, ivis, wl_ep.freqs[fi], wl_ep.cell, npix=npix))
         return jnp.mean(jnp.stack(imgs), axis=0)
 
     def _aic_reward(std_res, std_data, ksel):
