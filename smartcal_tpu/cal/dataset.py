@@ -124,7 +124,7 @@ def calibration_sky(ra0, dec0, t0, f0, K=6, sky_path=None,
             flux.append(float(np.sum(np.exp(
                 np.asarray(sky.flux_coef)[sel, 0]))))
         if rho_path is not None:
-            rho = skyio.read_rho(rho_path, Kf)[:, 0]
+            rho = skyio.read_rho(rho_path, Kf)[0]    # spectral column
         else:
             rho = 0.1 * np.asarray(flux, np.float32)
         return CalSky(sky, np.asarray(sep, np.float32),

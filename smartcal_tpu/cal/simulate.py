@@ -317,6 +317,43 @@ def simulate_demixing_sky(key, ra0, dec0, t0, f0, K=6, Kc=40, M_weak=350,
         lm_dirs=np.asarray(lm_dirs, np.float32), f0=float(f0))
 
 
+def write_dp3_parsets(outdir, sourcedb="sky_bbs.txt", tdelta=10):
+    """Emit DP3 parsets for external cross-checks of the same data
+    (reference simulate.py:142-188: demix / ddecal / predict-subtract
+    steps, L-BFGS solver settings matching the in-framework solver's
+    robust-L-BFGS configuration).  Pure text emission — DP3 itself is an
+    external tool; nothing in-framework consumes these."""
+    import os
+
+    def w(name, step, opts):
+        with open(os.path.join(outdir, name), "w") as fh:
+            fh.write(f"steps=[{step}]\n")
+            for k, v in opts.items():
+                fh.write(f"{step}.{k}={v}\n")
+
+    w("test_demix.parset", "demix", {
+        "type": "demixer", "blrange": "[60,100000]",
+        "demixtimestep": tdelta, "demixfreqstep": 16, "ntimechunk": 4,
+        "uselbfgssolver": "true", "lbfgs.historysize": 10, "maxiter": 30,
+        "lbfgs.robustdof": 200})
+    w("test_ddecal.parset", "ddecal", {
+        "type": "ddecal", "h5parm": "./solutions.h5",
+        "sourcedb": sourcedb, "mode": "fulljones", "uvlambdamin": 30,
+        "usebeammodel": "true", "beamproximitylimit": 0.1,
+        "solveralgorithm": "lbfgs", "solverlbfgs.dof": 200.0,
+        "solverlbfgs.iter": 4, "solverlbfgs.minibatches": 3,
+        "solverlbfgs.history": 10, "maxiter": 50,
+        "smoothnessconstraint": 1e6, "nchan": 16, "stepsize": 1e-3,
+        "solint": tdelta})
+    w("test_predict.parset", "predict", {
+        "type": "h5parmpredict", "sourcedb": sourcedb,
+        "usebeammodel": "true", "applycal.correction": "fulljones",
+        "applycal.parmdb": "./solutions.h5", "operation": "subtract"})
+    return [os.path.join(outdir, n) for n in
+            ("test_demix.parset", "test_ddecal.parset",
+             "test_predict.parset")]
+
+
 # ---------------------------------------------------------------------------
 # Systematic-error Jones solutions
 # ---------------------------------------------------------------------------

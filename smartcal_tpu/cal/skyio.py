@@ -74,6 +74,218 @@ def build_sky_arrays(sky_path, cluster_path, ra0, dec0):
         cluster=np.asarray(cl_ids), n_clusters=len(clusters))
 
 
+def write_sky_model(path, rows):
+    """SAGECal LSM writer: ``rows`` of (name, ra_rad, dec_rad, sI, sp1,
+    eX, eY, eP, f0) -> the 18-column text format parse_sky_model reads.
+    Gaussian sources are any with nonzero extent (name should lead 'G')."""
+    with open(path, "w") as fh:
+        fh.write("## LSM file\n")
+        fh.write("### Name | RA (h m s) | DEC (d m s) | I Q U V | SI0 SI1 "
+                 "SI2 | RM | eX eY eP | f0\n")
+        for (name, ra, dec, sI, sp1, eX, eY, eP, f0) in rows:
+            hh, mm, ss = coords.rad_to_ra(ra)
+            dd, dm, ds = coords.rad_to_dec(dec)
+            fh.write(f"{name} {hh} {mm} {ss:.6f} {dd} {dm} {ds:.6f} "
+                     f"{sI} 0 0 0 {sp1} 0 0 0 {eX} {eY} {eP} {f0}\n")
+
+
+def write_cluster_file(path, clusters, hybrid=1):
+    """Cluster-file writer: ``clusters`` = [(cluster_id, [names])]."""
+    with open(path, "w") as fh:
+        fh.write("### Cluster file\n")
+        for cid, names in clusters:
+            fh.write(f"{cid} {hybrid} " + " ".join(names) + "\n")
+
+
+def _sex_to_rad(txt, is_ra):
+    """DP3 position field -> radians.
+
+    Accepts Ra 'hh:mm:ss.s', Dec '+dd.mm.ss.s' (dot-separated sexagesimal
+    needs >= 2 dots), colon-separated dec, and plain decimal degrees
+    ('52.3444' — one dot — is degrees, NOT 52 deg 3444 min)."""
+    t = txt.strip().replace("+", "")
+    neg = t.startswith("-")
+    body = t.lstrip("-")
+    if ":" in body:
+        parts = body.split(":")
+    elif body.count(".") >= 2:             # dd.mm.ss[.frac] sexagesimal
+        p = body.split(".")
+        parts = [p[0], p[1], ".".join(p[2:]) if len(p) > 2 else "0"]
+    else:                                   # decimal degrees
+        val = np.deg2rad(float(body))
+        if is_ra:
+            val = val * 1.0                # Ra in degrees is legal too
+        return -val if neg else val
+    a, b, c = (float(x) for x in (parts + ["0", "0"])[:3])
+    if is_ra:
+        val = float(coords.hms_to_rad(a, b, c))
+        return -val if neg else val
+    val = np.deg2rad(a + b / 60.0 + c / 3600.0)
+    return -val if neg else val
+
+
+def _split_csv_brackets(ln):
+    """Split a makesourcedb row on commas OUTSIDE [...] brackets (a
+    multi-term SpectralIndex like '[-0.7, 0.02]' is one field)."""
+    out, depth, cur = [], 0, []
+    for ch in ln:
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth = max(0, depth - 1)
+        if ch == "," and depth == 0:
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur).strip())
+    return out
+
+
+def parse_makesourcedb(path):
+    """DP3 makesourcedb sky model -> (sources, patches).
+
+    The format the LINC target download produces (and lsmtool consumes in
+    the reference's ``convertmodel.py``): a ``format = Name, Type, Patch,
+    Ra, Dec, I, ...`` header, patch-definition rows with empty Name/Type,
+    and per-source rows.  Returns sources as dicts with keys name/type/
+    patch/ra/dec/I/spectral_index/major/minor/orientation/ref_freq and
+    the ordered patch-name list.
+    """
+    def _fields_from(spec):
+        """Field names + their header defaults (e.g.
+        ReferenceFrequency='134e6' declares the value used when a row
+        leaves that column empty)."""
+        names, defaults = [], {}
+        for f in _split_csv_brackets(spec.strip(" ()")):
+            if "=" in f:
+                nm, dv = f.split("=", 1)
+                nm = nm.strip().strip("()")
+                defaults[nm] = dv.strip().strip("'\"")
+            else:
+                nm = f.strip().strip("()")
+            names.append(nm)
+        return names, defaults
+
+    fields, defaults = None, {}
+    sources, patches = [], []
+    with open(path) as fh:
+        for ln in fh:
+            ln = ln.strip()
+            if not ln or ln.startswith("#"):
+                # two header styles exist: '# (<fields>) = format' (the
+                # trailing marker; fields may themselves contain '=', e.g.
+                # ReferenceFrequency='134e6') and 'format = <fields>'
+                body = ln.lstrip("# ").rstrip()
+                if body.lower().endswith("= format"):
+                    fields, defaults = _fields_from(
+                        body[:body.lower().rfind("= format")])
+                continue
+            if fields is None and ln.lower().startswith("format"):
+                fields, defaults = _fields_from(ln.split("=", 1)[1])
+                continue
+            vals = _split_csv_brackets(ln)
+            row = dict(zip(fields or [], vals))
+            name = row.get("Name", "")
+            if not name:                       # patch definition row
+                if row.get("Patch"):
+                    patches.append(row["Patch"])
+                continue
+            si_txt = row.get("SpectralIndex", "").strip("[] ")
+            # multi-term indices split on ',' or ';'; first term used
+            si = (float(si_txt.replace(";", ",").split(",")[0])
+                  if si_txt else 0.0)
+            f0 = float(row.get("ReferenceFrequency")
+                       or defaults.get("ReferenceFrequency") or 0.0) \
+                or 100e6
+            asec = np.pi / (180.0 * 3600.0)
+            sources.append({
+                "name": name,
+                "type": row.get("Type", "POINT").upper(),
+                "patch": row.get("Patch", ""),
+                "ra": _sex_to_rad(row["Ra"], True),
+                "dec": _sex_to_rad(row["Dec"], False),
+                "I": float(row.get("I", 0.0) or 0.0),
+                "spectral_index": si,
+                "major": float(row.get("MajorAxis") or 0.0) * asec,
+                "minor": float(row.get("MinorAxis") or 0.0) * asec,
+                "orientation": np.pi / 2 - (np.pi - np.deg2rad(
+                    float(row.get("Orientation") or 0.0))),
+                "ref_freq": f0,
+            })
+            if sources[-1]["patch"] and sources[-1]["patch"] not in patches:
+                patches.append(sources[-1]["patch"])
+    return sources, patches
+
+
+def convert_dp3_skymodel(skymodel, out_sky, out_cluster, out_rho,
+                         start_cluster=1, num_patches=0):
+    """DP3 makesourcedb model -> SAGECal sky/cluster/rho text files.
+
+    Reference: ``calibration/convertmodel.py:16-76`` (lsmtool-based) —
+    one cluster per patch, Gaussian sources renamed 'G<patch><i>' and
+    points 'P<patch><i>', rho 1.0 per cluster, patch order preserved.
+    Returns the number of clusters written.
+    """
+    sources, patches = parse_makesourcedb(skymodel)
+    if num_patches > 0:
+        patches = patches[:num_patches]
+    rows, clusters, rhos = [], [], []
+    cid = start_cluster
+    for patch in patches:
+        names = []
+        for ci, s in enumerate(p for p in sources if p["patch"] == patch):
+            prefix = "G" if s["type"] == "GAUSSIAN" else "P"
+            name = f"{prefix}{patch}{ci}"
+            names.append(name)
+            rows.append((name, s["ra"], s["dec"], s["I"],
+                         s["spectral_index"], s["major"], s["minor"],
+                         s["orientation"], s["ref_freq"]))
+        if names:
+            clusters.append((cid, names))
+            rhos.append(cid)
+            cid += 1
+    write_sky_model(out_sky, rows)
+    write_cluster_file(out_cluster, clusters)
+    # rho 1.0 per cluster like the reference (:49), ids matching the
+    # cluster file (write_rho would renumber from 1, breaking the
+    # start_cluster interchange contract)
+    with open(out_rho, "w") as fh:
+        fh.write("# cluster_id hybrid spectral_admm_rho spatial_admm_rho\n")
+        for c in rhos:
+            fh.write(f"{c} 1 1.0 0.0\n")
+    return len(clusters)
+
+
+def write_bbs_skymodel(path, rows, f0):
+    """Inverse direction: SAGECal-style rows -> a DP3 makesourcedb file
+    (the ``sky_bbs.txt`` the simulator emits for external DP3 runs,
+    simulate.py:139-141).  ``rows`` as for :func:`write_sky_model`."""
+    with open(path, "w") as fh:
+        fh.write("# (Name, Type, Patch, Ra, Dec, I, Q, U, V, "
+                 f"ReferenceFrequency='{f0}', SpectralIndex='[]', "
+                 "MajorAxis, MinorAxis, Orientation) = format\n")
+        fh.write(", , center, 00:00:00.0, +00.00.00.0\n")
+        for (name, ra, dec, sI, sp1, eX, eY, eP, rf0) in rows:
+            hh, mm, ss = coords.rad_to_ra(ra)
+            # sign handled here: rad_to_dec carries it on the first
+            # NONZERO field, which would print '+00.-30.00' for
+            # declinations in (-1, 0) deg
+            sgn = "-" if dec < 0 else "+"
+            dd, dm, ds = coords.rad_to_dec(abs(float(dec)))
+            stype = "GAUSSIAN" if (eX or eY) else "POINT"
+            # inverse of the parse-side convention
+            # (orientation = deg2rad(o) - pi/2), so write/parse round-trip
+            ori_deg = np.rad2deg(eP + np.pi / 2)
+            fh.write(f"{name}, {stype}, center, "
+                     f"{int(hh):02d}:{int(mm):02d}:{ss:06.3f}, "
+                     f"{sgn}{int(dd):02d}.{int(dm):02d}.{ds:06.3f}, "
+                     f"{sI}, 0, 0, 0, {rf0}, [{sp1}], "
+                     f"{eX * 180 * 3600 / np.pi}, "
+                     f"{eY * 180 * 3600 / np.pi}, "
+                     f"{ori_deg}\n")
+
+
 def read_rho(path, n_clusters):
     """admm rho file: 'id hybrid rho_spectral rho_spatial' per cluster.
     Returns (rho_spectral, rho_spatial), each (K,) float32.

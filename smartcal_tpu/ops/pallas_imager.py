@@ -116,8 +116,14 @@ def dirty_image_pallas(uvw, vis, freq, cell, npix=128, interpret=False):
 
 
 def pallas_available() -> bool:
-    """True when the default backend is a TPU and pallas imported."""
-    if pltpu is None:
+    """True when the default backend is a TPU and pallas imported.
+
+    ``SMARTCAL_DISABLE_PALLAS=1`` is the operational escape hatch: it
+    forces the XLA path everywhere (e.g. if a new jaxlib's Mosaic
+    lowering rejects the kernel) without touching call sites."""
+    import os
+
+    if pltpu is None or os.environ.get("SMARTCAL_DISABLE_PALLAS"):
         return False
     try:
         return jax.devices()[0].platform == "tpu"

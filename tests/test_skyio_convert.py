@@ -1,0 +1,116 @@
+"""DP3 skymodel conversion + parset emission (SURVEY §2.5 convertmodel /
+simulate.py parset roles)."""
+
+import numpy as np
+import pytest
+
+from smartcal_tpu.cal import coords, simulate, skyio
+
+MAKESOURCEDB = """\
+format = Name, Type, Patch, Ra, Dec, I, Q, U, V, ReferenceFrequency='134e6', SpectralIndex='[]', MajorAxis, MinorAxis, Orientation
+ , , CasA, 23:23:24.0, +58.48.54.0
+casa_1, POINT, CasA, 23:23:24.0, +58.48.54.0, 8000.0, 0, 0, 0, 134e6, [-0.7, 0.02], , ,
+casa_2, GAUSSIAN, CasA, 23:23:27.1, +58.49.00.0, 2000.0, 0, 0, 0, 134e6, [-0.6], 120.0, 60.0, 30.0
+ , , Target, 12:00:00.0, +45.00.00.0
+t_1, POINT, Target, 12:00:00.0, +45.00.00.0, 2.5, 0, 0, 0, , [], , ,
+t_2, POINT, Target, 12:00:10.0, -0.5123, 1.0, 0, 0, 0, , [], , ,
+"""
+
+
+def test_parse_makesourcedb(tmp_path):
+    p = tmp_path / "model.txt"
+    p.write_text(MAKESOURCEDB)
+    sources, patches = skyio.parse_makesourcedb(str(p))
+    assert patches == ["CasA", "Target"]
+    assert len(sources) == 4
+    s = sources[0]
+    assert s["type"] == "POINT" and s["patch"] == "CasA"
+    assert s["ra"] == pytest.approx(float(coords.hms_to_rad(23, 23, 24.0)),
+                                    rel=1e-9)
+    assert s["dec"] == pytest.approx(np.deg2rad(58 + 48 / 60 + 54 / 3600),
+                                     rel=1e-9)
+    # multi-term spectral index: brackets protect the comma; first term
+    assert s["I"] == 8000.0 and s["spectral_index"] == -0.7
+    # empty ReferenceFrequency uses the HEADER default, not 100 MHz
+    assert sources[2]["ref_freq"] == pytest.approx(134e6)
+    # decimal-degree dec is degrees, not dd.mm sexagesimal
+    assert sources[3]["dec"] == pytest.approx(np.deg2rad(-0.5123),
+                                              rel=1e-9)
+    # Gaussian extents arrive in radians
+    assert sources[1]["major"] == pytest.approx(
+        120.0 * np.pi / (180 * 3600))
+
+
+def test_convert_dp3_skymodel_roundtrip(tmp_path):
+    model = tmp_path / "model.txt"
+    model.write_text(MAKESOURCEDB)
+    n = skyio.convert_dp3_skymodel(
+        str(model), str(tmp_path / "sky.txt"),
+        str(tmp_path / "cluster.txt"), str(tmp_path / "rho.txt"),
+        start_cluster=1)
+    assert n == 2
+    # the emitted files parse with the standard readers
+    ra0 = float(coords.hms_to_rad(12, 0, 0.0))
+    dec0 = np.deg2rad(45.0)
+    sky = skyio.build_sky_arrays(str(tmp_path / "sky.txt"),
+                                 str(tmp_path / "cluster.txt"), ra0, dec0)
+    assert sky.n_clusters == 2
+    # gaussian naming: converted GAUSSIAN source leads with 'G'
+    S = skyio.parse_sky_model(str(tmp_path / "sky.txt"))
+    assert any(nm.startswith("GCasA") for nm in S)
+    assert any(nm.startswith("PTarget") for nm in S)
+    # the phase-center source (first of the Target patch) has l, m ~ 0
+    tgt = np.asarray(sky.lmn)[np.asarray(sky.cluster) == 1]
+    np.testing.assert_allclose(tgt[0, :2], 0.0, atol=1e-6)
+    rho_spec, rho_spat = skyio.read_rho(str(tmp_path / "rho.txt"), 2)
+    np.testing.assert_allclose(rho_spec, 1.0)
+    np.testing.assert_allclose(rho_spat, 0.0)
+
+
+def test_write_bbs_skymodel_roundtrip(tmp_path):
+    rows = [("P0", 1.0, 0.5, 2.5, -0.7, 0.0, 0.0, 0.0, 150e6),
+            ("G1", 1.01, 0.49, 1.5, -0.5, 1e-4, 5e-5, 0.3, 150e6),
+            ("P2", 2.0, np.deg2rad(-0.5), 1.0, 0.0, 0.0, 0.0, 0.0, 150e6)]
+    p = tmp_path / "bbs.txt"
+    skyio.write_bbs_skymodel(str(p), rows, f0=150e6)
+    sources, patches = skyio.parse_makesourcedb(str(p))
+    assert len(sources) == 3
+    assert sources[0]["type"] == "POINT"
+    assert sources[1]["type"] == "GAUSSIAN"
+    assert sources[0]["ra"] == pytest.approx(1.0, abs=1e-6)
+    assert sources[0]["dec"] == pytest.approx(0.5, abs=1e-6)
+    assert sources[1]["I"] == 1.5
+    # orientation convention round-trips through write + parse
+    assert sources[1]["orientation"] == pytest.approx(0.3, abs=1e-6)
+    # declination in (-1, 0) deg keeps its sign and magnitude
+    assert sources[2]["dec"] == pytest.approx(np.deg2rad(-0.5), abs=1e-9)
+
+
+def test_convert_start_cluster_rho_ids(tmp_path):
+    model = tmp_path / "model.txt"
+    model.write_text(MAKESOURCEDB)
+    skyio.convert_dp3_skymodel(
+        str(model), str(tmp_path / "s.txt"), str(tmp_path / "c.txt"),
+        str(tmp_path / "r.txt"), start_cluster=5)
+    # rho ids match the cluster file's (the interchange contract)
+    rho_ids = [ln.split()[0] for ln in
+               (tmp_path / "r.txt").read_text().splitlines()
+               if not ln.startswith("#")]
+    clu_ids = [ln.split()[0] for ln in
+               (tmp_path / "c.txt").read_text().splitlines()
+               if not ln.startswith("#")]
+    assert rho_ids == clu_ids == ["5", "6"]
+
+
+def test_write_dp3_parsets(tmp_path):
+    paths = simulate.write_dp3_parsets(str(tmp_path), sourcedb="sky.txt",
+                                       tdelta=10)
+    assert len(paths) == 3
+    demix = (tmp_path / "test_demix.parset").read_text()
+    assert "steps=[demix]" in demix
+    assert "demix.demixtimestep=10" in demix
+    dde = (tmp_path / "test_ddecal.parset").read_text()
+    assert "ddecal.sourcedb=sky.txt" in dde
+    assert "ddecal.solveralgorithm=lbfgs" in dde
+    pred = (tmp_path / "test_predict.parset").read_text()
+    assert "predict.operation=subtract" in pred
