@@ -50,19 +50,32 @@ def bench_calib_episode():
                            npix=128)
     key = jax.random.PRNGKey(7)
 
-    def episode(k):
+    def episode(k, stages=None):
+        t = time.time()
         ep, mdl = backend.new_demixing_episode(k, K=6)
+        jax.block_until_ready(ep.V)
+        if stages is not None:
+            stages["simulate_s"] = round(time.time() - t, 2)
+        t = time.time()
         res = backend.calibrate(ep, mdl.rho, mask=np.ones(6, np.float32))
+        jax.block_until_ready(res.residual)
+        if stages is not None:
+            stages["calibrate_s"] = round(time.time() - t, 2)
+        t = time.time()
         img = backend.influence_image(ep, res, mdl.rho,
                                       np.zeros(6, np.float32))
-        return jax.block_until_ready(img), float(res.sigma_res)
+        jax.block_until_ready(img)
+        if stages is not None:
+            stages["influence_image_s"] = round(time.time() - t, 2)
+        return img, float(res.sigma_res)
 
     t0 = time.time()
     k1, k2 = jax.random.split(key)
     episode(k1)                       # compile + run
     t_first = time.time() - t0
+    stages = {}                       # per-stage steady-state breakdown
     t0 = time.time()
-    img, sigma = episode(k2)          # steady state (cached executables)
+    img, sigma = episode(k2, stages)  # steady state (cached executables)
     t_steady = time.time() - t0
     assert np.all(np.isfinite(np.asarray(img)))
     return {
@@ -72,6 +85,7 @@ def bench_calib_episode():
         "vs_baseline": None,
         "scale": "N=62 B=1891 Nf=8 Tdelta=10 K=6 npix=128",
         "first_episode_incl_compile_s": round(t_first, 2),
+        "stage_breakdown": stages,
     }
 
 

@@ -111,10 +111,8 @@ def _sex_to_rad(txt, is_ra):
     elif body.count(".") >= 2:             # dd.mm.ss[.frac] sexagesimal
         p = body.split(".")
         parts = [p[0], p[1], ".".join(p[2:]) if len(p) > 2 else "0"]
-    else:                                   # decimal degrees
+    else:      # plain decimal degrees (legal for both Ra and Dec)
         val = np.deg2rad(float(body))
-        if is_ra:
-            val = val * 1.0                # Ra in degrees is legal too
         return -val if neg else val
     a, b, c = (float(x) for x in (parts + ["0", "0"])[:3])
     if is_ra:
@@ -184,8 +182,12 @@ def parse_makesourcedb(path):
             if fields is None and ln.lower().startswith("format"):
                 fields, defaults = _fields_from(ln.split("=", 1)[1])
                 continue
+            if fields is None:
+                raise ValueError(
+                    f"{path}: data row before any recognized 'format' "
+                    "header — cannot assign columns")
             vals = _split_csv_brackets(ln)
-            row = dict(zip(fields or [], vals))
+            row = dict(zip(fields, vals))
             name = row.get("Name", "")
             if not name:                       # patch definition row
                 if row.get("Patch"):
@@ -236,7 +238,9 @@ def convert_dp3_skymodel(skymodel, out_sky, out_cluster, out_rho,
         names = []
         for ci, s in enumerate(p for p in sources if p["patch"] == patch):
             prefix = "G" if s["type"] == "GAUSSIAN" else "P"
-            name = f"{prefix}{patch}{ci}"
+            # separator prevents cross-patch collisions
+            # ('X' idx 11 vs 'X1' idx 1 both -> 'PX11')
+            name = f"{prefix}{patch}.{ci}"
             names.append(name)
             rows.append((name, s["ra"], s["dec"], s["I"],
                          s["spectral_index"], s["major"], s["minor"],
@@ -248,12 +252,9 @@ def convert_dp3_skymodel(skymodel, out_sky, out_cluster, out_rho,
     write_sky_model(out_sky, rows)
     write_cluster_file(out_cluster, clusters)
     # rho 1.0 per cluster like the reference (:49), ids matching the
-    # cluster file (write_rho would renumber from 1, breaking the
-    # start_cluster interchange contract)
-    with open(out_rho, "w") as fh:
-        fh.write("# cluster_id hybrid spectral_admm_rho spatial_admm_rho\n")
-        for c in rhos:
-            fh.write(f"{c} 1 1.0 0.0\n")
+    # cluster file (the start_cluster interchange contract)
+    write_rho(out_rho, np.ones(len(rhos), np.float32),
+              np.zeros(len(rhos), np.float32), ids=rhos)
     return len(clusters)
 
 
@@ -296,12 +297,15 @@ def read_rho(path, n_clusters):
     return vals[:, 2].copy(), vals[:, 3].copy()
 
 
-def write_rho(path, rho_spectral, rho_spatial, hybrid=1):
-    """Inverse of read_rho, format per reference calibenv.py:105-114."""
+def write_rho(path, rho_spectral, rho_spatial, hybrid=1, ids=None):
+    """Inverse of read_rho, format per reference calibenv.py:105-114.
+    ``ids`` overrides the default 1..K numbering (files are matched by id
+    externally, e.g. after convert_dp3_skymodel's start_cluster)."""
     with open(path, "w") as fh:
         fh.write("# id hybrid rho_spectral rho_spatial\n")
         for i, (rs, rp) in enumerate(zip(rho_spectral, rho_spatial)):
-            fh.write(f"{i + 1} {hybrid} {float(rs)} {float(rp)}\n")
+            cid = ids[i] if ids is not None else i + 1
+            fh.write(f"{cid} {hybrid} {float(rs)} {float(rp)}\n")
 
 
 def read_skycluster(path, n_rows):

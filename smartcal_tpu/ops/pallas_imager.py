@@ -123,7 +123,8 @@ def pallas_available() -> bool:
     lowering rejects the kernel) without touching call sites."""
     import os
 
-    if pltpu is None or os.environ.get("SMARTCAL_DISABLE_PALLAS"):
+    flag = os.environ.get("SMARTCAL_DISABLE_PALLAS", "").strip().lower()
+    if pltpu is None or flag in ("1", "true", "yes", "on"):
         return False
     try:
         return jax.devices()[0].platform == "tpu"

@@ -277,7 +277,8 @@ def make_distributed_demix_sac(backend: radio.RadioBackend, K: int,
 
 def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
                             K=4, backend=None, provide_influence=False,
-                            agent_kwargs=None, quiet=False):
+                            agent_kwargs=None, quiet=False,
+                            rollout_epochs=2, rollout_steps=5):
     """Host driver (run_process + Learner.run_episodes parity,
     distributed_per_sac.py:193-229)."""
     from . import make_mesh
@@ -292,6 +293,7 @@ def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
         use_image=provide_influence, **(agent_kwargs or {}))
     init_fn, make_wl, run_episode = make_distributed_demix_sac(
         backend, K, agent_cfg, mesh, n_actors,
+        rollout_epochs=rollout_epochs, rollout_steps=rollout_steps,
         provide_influence=provide_influence)
     key = jax.random.PRNGKey(seed)
     key, k0 = jax.random.split(key)
@@ -305,3 +307,45 @@ def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
         if not quiet:
             print(f"episode {ep} mean reward {scores[-1]:.4f}")
     return st, scores
+
+
+def main(argv=None):
+    """CLI (the run_process entry of distributed_per_sac.py:193-229 —
+    no MASTER_ADDR/rank plumbing: the mesh IS the world).
+
+    Usage: python -m smartcal_tpu.parallel.demix_learner --episodes 10
+        [--actors 8] [--K 4] [--small] [--provide_influence]
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--episodes", type=int, default=10)
+    p.add_argument("--actors", type=int, default=None)
+    p.add_argument("--K", type=int, default=6)
+    p.add_argument("--stations", type=int, default=14)
+    p.add_argument("--npix", type=int, default=128)
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--provide_influence", action="store_true")
+    p.add_argument("--rollout_epochs", type=int, default=2,
+                   help="episodes per actor per learner episode")
+    p.add_argument("--rollout_steps", type=int, default=5)
+    args = p.parse_args(argv)
+    if args.small:
+        backend = radio.RadioBackend(n_stations=6, n_times=4, tdelta=2,
+                                     npix=16, admm_iters=2, lbfgs_iters=3,
+                                     init_iters=4)
+    else:
+        backend = radio.RadioBackend(n_stations=args.stations,
+                                     npix=args.npix)
+    _, scores = train_distributed_demix(
+        seed=args.seed, episodes=args.episodes, n_actors=args.actors,
+        K=args.K, backend=backend,
+        provide_influence=args.provide_influence,
+        rollout_epochs=args.rollout_epochs,
+        rollout_steps=args.rollout_steps)
+    return scores
+
+
+if __name__ == "__main__":
+    main()

@@ -184,3 +184,30 @@ def train_distributed(seed=0, episodes=100, n_actors=None, mesh=None,
         if not quiet:
             print(f"episode {ep} mean reward {scores[-1]:.4f}")
     return st, scores
+
+
+def main(argv=None):
+    """CLI (run_process of elasticnet/distributed_per_sac.py:154-194 —
+    no MASTER_ADDR/rank plumbing: the mesh IS the world).
+
+    Usage: python -m smartcal_tpu.parallel.learner --episodes 100
+        [--actors 8] [--use_hint] [--learn_per_transition]
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--episodes", type=int, default=100)
+    p.add_argument("--actors", type=int, default=None)
+    p.add_argument("--use_hint", action="store_true")
+    p.add_argument("--learn_per_transition", action="store_true")
+    args = p.parse_args(argv)
+    _, scores = train_distributed(
+        seed=args.seed, episodes=args.episodes, n_actors=args.actors,
+        use_hint=args.use_hint,
+        learn_per_transition=args.learn_per_transition)
+    return scores
+
+
+if __name__ == "__main__":
+    main()
