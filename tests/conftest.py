@@ -34,6 +34,20 @@ def rng():
     return np.random.default_rng(0)
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Bound the number of live compiled executables.
+
+    With ~180 tests compiling fresh jaxprs on the CPU client, the full
+    suite deterministically segfaults near the end (observed in
+    tests/test_td3_ddpg.py::test_td3_per_priority_refresh, which passes
+    in isolation and in any sub-group).  Clearing jit caches at module
+    teardown keeps the executable count bounded; cross-module cache
+    reuse was minimal anyway (modules use distinct shapes)."""
+    yield
+    jax.clear_caches()
+
+
 def pytest_collection_modifyitems(config, items):
     """Skip ``slow``-marked tests by default, but still run them when the
     user gives a marker expression (-m slow) or names one explicitly by

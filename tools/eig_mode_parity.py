@@ -34,23 +34,29 @@ from smartcal_tpu.rl import sac
 from smartcal_tpu.train.enet_sac import make_episode_fn
 
 
-def run(mode, seed, episodes, steps):
+def make_runner(mode, steps):
+    """Compile once per mode; seeds only change keys/init (the same
+    compile-once-per-mode pattern as tools/sweep_enet.py)."""
     env_cfg = enet.EnetConfig(M=20, N=20, eig_mode=mode)
     agent_cfg = sac.SACConfig(obs_dim=env_cfg.obs_dim, n_actions=2,
                               batch_size=64, mem_size=1024,
                               reward_scale=20.0, alpha=0.03)
     episode_fn = make_episode_fn(env_cfg, agent_cfg, steps, use_hint=False)
-    key = jax.random.PRNGKey(seed)
-    key, k0 = jax.random.split(key)
-    st = sac.sac_init(k0, agent_cfg)
-    buf = rp.replay_init(agent_cfg.mem_size,
-                         rp.transition_spec(env_cfg.obs_dim, 2))
-    scores = []
-    for _ in range(episodes):
-        key, k = jax.random.split(key)
-        st, buf, score = episode_fn(st, buf, k)
-        scores.append(float(score))
-    return np.asarray(scores)
+
+    def run(seed, episodes):
+        key = jax.random.PRNGKey(seed)
+        key, k0 = jax.random.split(key)
+        st = sac.sac_init(k0, agent_cfg)
+        buf = rp.replay_init(agent_cfg.mem_size,
+                             rp.transition_spec(env_cfg.obs_dim, 2))
+        scores = []
+        for _ in range(episodes):
+            key, k = jax.random.split(key)
+            st, buf, score = episode_fn(st, buf, k)
+            scores.append(float(score))
+        return np.asarray(scores)
+
+    return run
 
 
 def main():
@@ -64,9 +70,11 @@ def main():
 
     out = {"per_seed": []}
     t0 = time.time()
+    run_sym = make_runner("symmetric", args.steps)
+    run_ext = make_runner("exact", args.steps)
     for seed in range(args.seeds):
-        sym = run("symmetric", seed, args.episodes, args.steps)
-        ext = run("exact", seed, args.episodes, args.steps)
+        sym = run_sym(seed, args.episodes)
+        ext = run_ext(seed, args.episodes)
         w = min(100, len(sym))
         rec = {
             "seed": seed,
