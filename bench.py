@@ -26,6 +26,8 @@ emit only the primary metric.
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import jax
@@ -39,6 +41,37 @@ from smartcal_tpu.train.enet_sac import make_episode_fn
 STEPS_PER_EPISODE = 5
 TIMED_EPISODES = 20  # 100 timed env steps, same as the reference measurement
 FALLBACK_BASELINE = 4.16  # tools/reference_baseline.json, torch CPU
+
+
+def probe_backend():
+    """(platform, note): 'tpu' if the backend initializes within a
+    bounded time, else 'cpu' with a note explaining why.
+
+    ``BENCH_PLATFORM=cpu|tpu`` skips the probe entirely — use it for
+    deliberate CPU runs, for hosts without the TPU plugin, and whenever
+    another TPU process is already running (ONE client at a time: a
+    concurrent probe can itself wedge the axon tunnel, see
+    .claude/skills/verify/SKILL.md).  Without the override, the probe
+    runs in a SUBPROCESS with a timeout because a wedged tunnel hangs
+    backend init indefinitely (observed 2026-07-29/30) and bench.py must
+    always print its one JSON line.  The fallback CPU measurement stays
+    comparable: the recorded baseline is the torch reference on this
+    same host CPU.
+    """
+    forced = os.environ.get("BENCH_PLATFORM", "").strip().lower()
+    if forced in ("cpu", "tpu"):
+        return forced, f"forced via BENCH_PLATFORM={forced}"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=150)
+        if r.returncode == 0 and r.stdout.strip() in ("axon", "tpu"):
+            return "tpu", ""
+        return "cpu", ("no TPU platform available "
+                       f"(probe saw {r.stdout.strip() or r.returncode})")
+    except subprocess.TimeoutExpired:
+        return "cpu", "TPU backend init timed out (tunnel wedged?)"
 
 
 def bench_calib_episode():
@@ -90,6 +123,10 @@ def bench_calib_episode():
 
 
 def main():
+    platform, note = probe_backend()
+    if platform != "tpu":
+        # wedge-proof: measure on CPU rather than hang on a dead tunnel
+        jax.config.update("jax_platforms", "cpu")
     env_cfg = enet.EnetConfig(M=20, N=20)
     agent_cfg = sac.SACConfig(
         obs_dim=env_cfg.obs_dim, n_actions=2, gamma=0.99, tau=0.005,
@@ -139,6 +176,8 @@ def main():
         "unit": "env-steps/sec/chip",
         "vs_baseline": round(value / baseline, 2),
     }
+    if platform != "tpu":
+        out["platform"] = f"cpu ({note})"
     if not os.environ.get("BENCH_SKIP_CALIB"):
         # never let the optional extra discard the measured primary metric
         try:
