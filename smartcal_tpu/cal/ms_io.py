@@ -11,13 +11,19 @@ numpy module with two storage backends:
 * **casacore**, used when python-casacore is importable and the path is a
   real MS (``table.dat`` present).  Import is gated: nothing in the package
   requires casacore to exist.
-* **npz**, an MS-shaped directory (``MAIN.npz`` + ``META.npz``) written by
-  :func:`write_observation_ms` from the in-framework simulator.  Same row
-  semantics as a real MS: one row per (time, antenna pair) INCLUDING
-  autocorrelations, sorted by TIME,ANTENNA1,ANTENNA2, DATA of shape
-  (nrows, nchan, 4).  This is the synthetic stand-in the rest of the
-  pipeline (featurization, evaluate CLI) exercises in tests, through the
-  very same code path a real MS would take.
+* **sct**, the framework's own native columnar store (``TABLE.sct``, one
+  binary file written/read by the first-party C++ library in
+  :mod:`smartcal_tpu.native` — the in-build counterpart of the casacore
+  table system).  Default write format when the native library is
+  available; ``SMARTCAL_MS_FORMAT=npz`` forces the pure-python backend.
+* **npz**, an MS-shaped directory (``MAIN.npz`` + ``META.npz``), the
+  no-toolchain fallback with identical semantics.
+
+Both synthetic backends share the real-MS row semantics: one row per
+(time, antenna pair) INCLUDING autocorrelations, sorted by
+TIME,ANTENNA1,ANTENNA2, DATA of shape (nrows, nchan, 4).  They are the
+synthetic stand-in the rest of the pipeline (featurization, evaluate CLI)
+exercises in tests, through the very same code path a real MS would take.
 
 Everything here is host-side numpy; device work happens downstream on the
 split-real arrays these functions return.
@@ -37,23 +43,46 @@ except Exception:  # pragma: no cover - exercised implicitly everywhere
 
 MAIN = "MAIN.npz"
 META = "META.npz"
+SCT = "TABLE.sct"
 
 # Columns every store carries; extra data columns (MODEL_DATA, ...) are
 # created on demand by add_column.
 _BASE_COLS = ("TIME", "ANTENNA1", "ANTENNA2", "UVW", "INTERVAL", "DATA")
 
 
+def is_sct_ms(path) -> bool:
+    return os.path.isfile(os.path.join(path, SCT))
+
+
 def is_npz_ms(path) -> bool:
-    return os.path.isfile(os.path.join(path, MAIN))
+    """True for any synthetic (non-casacore) store, either backend."""
+    return (os.path.isfile(os.path.join(path, MAIN)) or is_sct_ms(path))
 
 
 def _is_casa_ms(path) -> bool:
     return os.path.isfile(os.path.join(path, "table.dat"))
 
 
+def _write_format() -> str:
+    fmt = os.environ.get("SMARTCAL_MS_FORMAT", "").strip().lower()
+    if fmt in ("sct", "npz"):
+        return fmt
+    if fmt:
+        raise ValueError(
+            f"SMARTCAL_MS_FORMAT={fmt!r}: expected 'sct' or 'npz'")
+    from smartcal_tpu import native
+    return "sct" if native.available() else "npz"
+
+
 def _load(path):
-    if not is_npz_ms(path):
-        raise FileNotFoundError(f"not an npz MS: {path}")
+    if is_sct_ms(path):
+        from smartcal_tpu import native
+        cols = native.sct_read(os.path.join(path, SCT))
+        main = {k[5:]: v for k, v in cols.items() if k.startswith("MAIN/")}
+        meta = {k[5:]: v for k, v in cols.items() if k.startswith("META/")}
+        return main, meta
+    if not os.path.isfile(os.path.join(path, MAIN)):
+        raise FileNotFoundError(f"not a synthetic MS (sct or npz): {path}")
     with np.load(os.path.join(path, MAIN)) as z:
         main = dict(z)
     with np.load(os.path.join(path, META)) as z:
@@ -63,8 +92,21 @@ def _load(path):
 
 def _store(path, main, meta):
     os.makedirs(path, exist_ok=True)
-    np.savez(os.path.join(path, MAIN), **main)
-    np.savez(os.path.join(path, META), **meta)
+    fmt = _write_format()
+    if fmt == "sct":
+        from smartcal_tpu import native
+        cols = {"MAIN/" + k: v for k, v in main.items()}
+        cols.update({"META/" + k: v for k, v in meta.items()})
+        native.sct_write(os.path.join(path, SCT), cols)
+        stale = (MAIN, META)                  # don't leave a two-format store
+    else:
+        np.savez(os.path.join(path, MAIN), **main)
+        np.savez(os.path.join(path, META), **meta)
+        stale = (SCT,)
+    for name in stale:
+        f = os.path.join(path, name)
+        if os.path.isfile(f):
+            os.remove(f)
 
 
 class MSInfo(NamedTuple):
@@ -252,10 +294,11 @@ def observation_to_ms_set(outdir, obs, V_all_sr, basename="L_SB"):
 # ---------------------------------------------------------------------------
 
 def _load_any(path):
-    """(main, meta) column dicts from either backend — npz directly, or a
+    """(main, meta) column dicts from any backend — sct/npz directly, or a
     casacore MS read column-by-column into the same layout (so the
     averaging/extraction logic below is backend-agnostic; extracted work
-    files are always written as npz, leaving real MSs untouched)."""
+    files are always written as synthetic stores, leaving real MSs
+    untouched)."""
     if is_npz_ms(path):
         return _load(path)
     if _ctab is None or not _is_casa_ms(path):  # pragma: no cover
@@ -276,7 +319,12 @@ def _load_any(path):
 
 
 def _peek_freq(path) -> float:
-    """First channel frequency without loading the data columns."""
+    """First channel frequency without loading the main data columns."""
+    if is_sct_ms(path):
+        from smartcal_tpu import native
+        freq = native.sct_read_one(os.path.join(path, SCT),
+                                   "META/CHAN_FREQ")
+        return float(np.asarray(freq).ravel()[0])
     if is_npz_ms(path):
         with np.load(os.path.join(path, META)) as z:
             return float(np.asarray(z["CHAN_FREQ"]).ravel()[0])

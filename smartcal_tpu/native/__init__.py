@@ -1,0 +1,361 @@
+"""First-party native (C++) runtime components, bound via ctypes.
+
+The reference's native layer is external C++ reached through bindings —
+casacore tables for MS I/O (reference calibration/casa_io.py:1), plus
+CUDA/MPI binaries for compute.  The compute path here is JAX/XLA/Pallas;
+this package holds the framework's own native *runtime* pieces:
+
+* ``sct.cc``  — single-file binary columnar table store (the casacore-table
+  role for synthetic/work MS data; used by :mod:`smartcal_tpu.cal.ms_io`).
+* ``sumtree.cc`` — host-side O(log n) sum tree for prioritized replay
+  (the reference SumTree, enet_sac.py:82-200), the counterpart the
+  HBM prefix-sum PER in :mod:`smartcal_tpu.rl.replay` is measured against
+  (SURVEY.md §7 "PER on TPU ... measure both").
+
+The shared library is compiled on demand with g++ (no pybind11 in this
+image; plain C ABI + ctypes).  Everything degrades gracefully: if no
+compiler is available, ``lib()`` returns None and callers fall back to
+their pure-python/numpy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_src")
+_SOURCES = ("sct.cc", "sumtree.cc")
+_LIB_BASENAME = "libsmartcal_native.so"
+
+_lock = threading.Lock()
+_lib: Optional[ct.CDLL] = None
+_lib_tried = False
+
+# numpy dtype <-> SCT dtype code (sct.cc header)
+DTYPE_CODES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+    np.dtype(np.complex64): 4,
+    np.dtype(np.complex128): 5,
+    np.dtype(np.uint8): 6,
+}
+CODE_DTYPES = {v: k for k, v in DTYPE_CODES.items()}
+
+
+def _build_dir() -> str:
+    d = os.environ.get("SMARTCAL_NATIVE_BUILD_DIR")
+    if not d:
+        d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _newest_source_mtime() -> float:
+    return max(os.path.getmtime(os.path.join(_SRC_DIR, s)) for s in _SOURCES)
+
+
+def build(force: bool = False) -> Optional[str]:
+    """Compile the shared library if needed; returns its path or None.
+
+    The build is a single g++ invocation writing to a temp file then
+    atomically renamed, so concurrent importers race benignly.
+    """
+    out = os.path.join(_build_dir(), _LIB_BASENAME)
+    if (not force and os.path.exists(out)
+            and os.path.getmtime(out) >= _newest_source_mtime()):
+        return out
+    srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_build_dir())
+    os.close(fd)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp] + srcs
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            os.unlink(tmp)
+            return None
+        os.replace(tmp, out)
+        return out
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _bind(path: str) -> ct.CDLL:
+    lib = ct.CDLL(path)
+    c_i64 = ct.c_int64
+    lib.sct_write.restype = ct.c_int
+    lib.sct_write.argtypes = [
+        ct.c_char_p, ct.c_int, ct.POINTER(ct.c_char_p),
+        ct.POINTER(ct.c_int), ct.POINTER(ct.c_int), ct.POINTER(c_i64),
+        ct.POINTER(ct.c_void_p)]
+    lib.sct_open.restype = ct.c_void_p
+    lib.sct_open.argtypes = [ct.c_char_p]
+    lib.sct_close.restype = None
+    lib.sct_close.argtypes = [ct.c_void_p]
+    lib.sct_h_ncols.restype = ct.c_int
+    lib.sct_h_ncols.argtypes = [ct.c_void_p]
+    lib.sct_h_find.restype = ct.c_int
+    lib.sct_h_find.argtypes = [ct.c_void_p, ct.c_char_p]
+    lib.sct_h_col_meta.restype = ct.c_int
+    lib.sct_h_col_meta.argtypes = [
+        ct.c_void_p, ct.c_int, ct.c_char_p, ct.c_int,
+        ct.POINTER(ct.c_int), ct.POINTER(c_i64)]
+    lib.sct_h_read_col.restype = c_i64
+    lib.sct_h_read_col.argtypes = [ct.c_void_p, ct.c_int, ct.c_void_p,
+                                   c_i64]
+    lib.st_create.restype = ct.c_void_p
+    lib.st_create.argtypes = [c_i64]
+    lib.st_free.argtypes = [ct.c_void_p]
+    for name in ("st_capacity", "st_filled", "st_cursor"):
+        fn = getattr(lib, name)
+        fn.restype = c_i64
+        fn.argtypes = [ct.c_void_p]
+    for name in ("st_total", "st_max_priority", "st_min_priority"):
+        fn = getattr(lib, name)
+        fn.restype = ct.c_double
+        fn.argtypes = [ct.c_void_p]
+    lib.st_add.restype = c_i64
+    lib.st_add.argtypes = [ct.c_void_p, ct.c_double]
+    lib.st_update.restype = None
+    lib.st_update.argtypes = [ct.c_void_p, c_i64, ct.c_double]
+    lib.st_update_batch.restype = None
+    lib.st_update_batch.argtypes = [ct.c_void_p, c_i64,
+                                    ct.POINTER(c_i64), ct.POINTER(ct.c_double)]
+    lib.st_get_leaf.restype = c_i64
+    lib.st_get_leaf.argtypes = [ct.c_void_p, ct.c_double,
+                                ct.POINTER(ct.c_double)]
+    lib.st_sample_stratified.restype = None
+    lib.st_sample_stratified.argtypes = [
+        ct.c_void_p, c_i64, ct.POINTER(ct.c_double), ct.POINTER(c_i64),
+        ct.POINTER(ct.c_double)]
+    lib.st_get_leaves.restype = None
+    lib.st_get_leaves.argtypes = [ct.c_void_p, ct.POINTER(ct.c_double)]
+    lib.st_set_state.restype = None
+    lib.st_set_state.argtypes = [ct.c_void_p, ct.POINTER(ct.c_double),
+                                 c_i64, c_i64]
+    return lib
+
+
+def lib() -> Optional[ct.CDLL]:
+    """The loaded native library, building it on first use; None if the
+    toolchain is unavailable (callers must fall back)."""
+    global _lib, _lib_tried
+    with _lock:
+        if _lib is None and not _lib_tried:
+            _lib_tried = True
+            if os.environ.get("SMARTCAL_DISABLE_NATIVE"):
+                return None
+            path = build()
+            if path is not None:
+                try:
+                    _lib = _bind(path)
+                except OSError:
+                    _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# SCT store: numpy dict <-> single binary file
+# ---------------------------------------------------------------------------
+
+def sct_write(path: str, columns: dict) -> None:
+    """Write ``{name: ndarray}`` as one SCT file (atomic replace)."""
+    L = lib()
+    if L is None:
+        raise RuntimeError("native library unavailable")
+    names, codes, ndims, dims, ptrs, keep = [], [], [], [], [], []
+    for name, arr in columns.items():
+        # NOT ascontiguousarray: it promotes 0-d scalars to shape (1,)
+        a = np.asarray(arr)
+        if not a.flags["C_CONTIGUOUS"]:
+            a = np.ascontiguousarray(a)
+        if a.dtype == np.bool_:
+            a = a.astype(np.uint8)
+        if a.dtype not in DTYPE_CODES:
+            raise TypeError(f"unsupported dtype {a.dtype} for column {name}")
+        keep.append(a)                       # hold buffers until the call
+        names.append(name.encode())
+        codes.append(DTYPE_CODES[a.dtype])
+        ndims.append(a.ndim)
+        dims.extend(int(d) for d in a.shape)
+        ptrs.append(a.ctypes.data_as(ct.c_void_p))
+    n = len(names)
+    rc = L.sct_write(
+        path.encode(), n,
+        (ct.c_char_p * n)(*names),
+        (ct.c_int * n)(*codes),
+        (ct.c_int * n)(*ndims),
+        (ct.c_int64 * max(1, len(dims)))(*(dims or [0])),
+        (ct.c_void_p * n)(*[ct.cast(p, ct.c_void_p) for p in ptrs]))
+    if rc != 0:
+        raise IOError(f"sct_write({path}) failed: rc={rc}")
+
+
+class _SctReader:
+    """RAII handle over one open SCT file; the header parses once."""
+
+    def __init__(self, path: str):
+        L = lib()
+        if L is None:
+            raise RuntimeError("native library unavailable")
+        self._L = L
+        self.path = path
+        self._h = L.sct_open(path.encode())
+        if not self._h:
+            raise IOError(f"sct_open({path}): cannot open / bad header")
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._L.sct_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @property
+    def ncols(self) -> int:
+        return self._L.sct_h_ncols(self._h)
+
+    def col(self, index: int) -> np.ndarray:
+        """(name, array) of column `index`."""
+        name_buf = ct.create_string_buffer(4097)
+        dims_buf = (ct.c_int64 * 16)()
+        dtype_out = ct.c_int(0)
+        ndim = self._L.sct_h_col_meta(self._h, index, name_buf, 4097,
+                                      ct.byref(dtype_out), dims_buf)
+        if ndim < 0:
+            raise IOError(f"sct_h_col_meta({self.path}, {index}) rc={ndim}")
+        shape = tuple(int(dims_buf[d]) for d in range(ndim))
+        arr = np.empty(shape, CODE_DTYPES[int(dtype_out.value)])
+        got = self._L.sct_h_read_col(self._h, index,
+                                     arr.ctypes.data_as(ct.c_void_p),
+                                     ct.c_int64(arr.nbytes))
+        if got != arr.nbytes:
+            raise IOError(f"sct_h_read_col({self.path}, {index}) rc={got}")
+        return name_buf.value.decode(), arr
+
+    def read_one(self, name: str) -> np.ndarray:
+        """One named column's payload — nothing else is read."""
+        idx = self._L.sct_h_find(self._h, name.encode())
+        if idx < 0:
+            raise KeyError(f"column {name} not in {self.path}")
+        return self.col(idx)[1]
+
+
+def sct_read(path: str) -> dict:
+    """Read an SCT file back into ``{name: ndarray}``."""
+    with _SctReader(path) as r:
+        return dict(r.col(i) for i in range(r.ncols))
+
+
+def sct_read_one(path: str, name: str) -> np.ndarray:
+    """Read a single named column without touching the other payloads."""
+    with _SctReader(path) as r:
+        return r.read_one(name)
+
+
+# ---------------------------------------------------------------------------
+# Native sum tree handle (thin RAII wrapper; PER logic lives in
+# smartcal_tpu.rl.replay_native)
+# ---------------------------------------------------------------------------
+
+class SumTree:
+    """ctypes handle to the C++ sum tree; capacity rounds up to 2^k."""
+
+    def __init__(self, capacity: int):
+        L = lib()
+        if L is None:
+            raise RuntimeError("native library unavailable")
+        self._L = L
+        self._h = L.st_create(int(capacity))
+        if not self._h:
+            raise MemoryError("st_create failed")
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._L.st_free(h)
+            self._h = None
+
+    @property
+    def capacity(self) -> int:
+        return int(self._L.st_capacity(self._h))
+
+    @property
+    def filled(self) -> int:
+        return int(self._L.st_filled(self._h))
+
+    @property
+    def cursor(self) -> int:
+        return int(self._L.st_cursor(self._h))
+
+    def total(self) -> float:
+        return float(self._L.st_total(self._h))
+
+    def max_priority(self) -> float:
+        return float(self._L.st_max_priority(self._h))
+
+    def add(self, priority: float) -> int:
+        return int(self._L.st_add(self._h, float(priority)))
+
+    def update(self, leaf: int, priority: float) -> None:
+        self._L.st_update(self._h, int(leaf), float(priority))
+
+    def update_batch(self, leaves, priorities) -> None:
+        leaves = np.ascontiguousarray(leaves, np.int64)
+        priorities = np.ascontiguousarray(priorities, np.float64)
+        self._L.st_update_batch(
+            self._h, leaves.size,
+            leaves.ctypes.data_as(ct.POINTER(ct.c_int64)),
+            priorities.ctypes.data_as(ct.POINTER(ct.c_double)))
+
+    def get_leaf(self, v: float):
+        p = ct.c_double(0.0)
+        leaf = int(self._L.st_get_leaf(self._h, float(v), ct.byref(p)))
+        return leaf, float(p.value)
+
+    def sample_stratified(self, batch: int, uniforms):
+        uniforms = np.ascontiguousarray(uniforms, np.float64)
+        assert uniforms.size == batch
+        idx = np.empty(batch, np.int64)
+        pri = np.empty(batch, np.float64)
+        self._L.st_sample_stratified(
+            self._h, batch,
+            uniforms.ctypes.data_as(ct.POINTER(ct.c_double)),
+            idx.ctypes.data_as(ct.POINTER(ct.c_int64)),
+            pri.ctypes.data_as(ct.POINTER(ct.c_double)))
+        return idx, pri
+
+    def leaves(self) -> np.ndarray:
+        out = np.empty(self.capacity, np.float64)
+        self._L.st_get_leaves(self._h,
+                              out.ctypes.data_as(ct.POINTER(ct.c_double)))
+        return out
+
+    def set_state(self, leaves, cursor: int, filled: int) -> None:
+        leaves = np.ascontiguousarray(leaves, np.float64)
+        assert leaves.size == self.capacity
+        self._L.st_set_state(
+            self._h, leaves.ctypes.data_as(ct.POINTER(ct.c_double)),
+            int(cursor), int(filled))

@@ -1,0 +1,143 @@
+"""Host-side prioritized replay on the native C++ sum tree.
+
+The framework's default PER lives in HBM and samples with a vectorised
+prefix-sum search (:mod:`smartcal_tpu.rl.replay`).  SURVEY.md §7 ("PER on
+TPU") names the alternative design — a host-side tree with device-side
+storage — and asks that both be measured.  This module is that
+alternative: transitions stay in host numpy ring arrays, priorities in the
+O(log n) C++ sum tree of :mod:`smartcal_tpu.native` (the reference's
+SumTree, elasticnet/enet_sac.py:82-200, minus the python interpreter), and
+only the sampled minibatch crosses to the device each learn step.
+
+Semantics mirror ``rl.replay`` exactly (same constants, same priority
+rules, same stratified segments + IS weights + beta annealing), so the two
+backends are drop-in comparable — ``tools/bench_per.py`` does the measuring.
+
+Trade-off, measured and documented in tools/bench_per.py: the HBM variant
+fuses store+sample into the jitted train step (no host<->device hop, wins
+whenever the rest of the step is device-resident); the host tree wins when
+the replay payload is too large for HBM or the loop is host-driven anyway
+(the distributed learner ingesting actor buffers).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from smartcal_tpu import native
+from smartcal_tpu.rl.replay import (PER_ALPHA, PER_BETA0, PER_BETA_INCREMENT,
+                                    PER_EPSILON)
+
+
+class NativePER:
+    """Prioritized replay: numpy ring storage + native sum-tree priorities.
+
+    ``spec`` is the same ``{field: (shape, dtype)}`` layout
+    :func:`smartcal_tpu.rl.replay.transition_spec` produces.
+    """
+
+    def __init__(self, size: int, spec: dict, error_clip: float = 100.0):
+        if native.lib() is None:
+            raise RuntimeError(
+                "native library unavailable (no g++?); use rl.replay")
+        self.size = int(size)
+        self.error_clip = float(error_clip)
+        self.spec = dict(spec)
+        self.data = {k: np.zeros((self.size,) + tuple(shape),
+                                 np.dtype(dtype))
+                     for k, (shape, dtype) in spec.items()}
+        self.tree = native.SumTree(self.size)
+        if self.tree.capacity != self.size:
+            raise ValueError(
+                f"size must be a power of two (got {size}); the tree "
+                f"rounds to {self.tree.capacity}")
+        self.cntr = 0
+        self.beta = PER_BETA0
+
+    # -- storing ----------------------------------------------------------
+    def _priority_from_error(self, error) -> float:
+        # replay.replay_add: min((|e|+eps)^alpha, clip)
+        return float(min((abs(float(error)) + PER_EPSILON) ** PER_ALPHA,
+                         self.error_clip))
+
+    def store(self, transition: dict, error=None) -> int:
+        """Store one transition; returns its slot.  Priority defaults to the
+        current max (or clip when empty) like ``PER.store_transition``."""
+        if error is None:
+            pmax = self.tree.max_priority()
+            p = self.error_clip if pmax == 0.0 else pmax
+        else:
+            p = self._priority_from_error(error)
+        idx = self.cntr % self.size
+        for k, v in self.data.items():
+            v[idx] = np.asarray(transition[k], v.dtype)
+        leaf = self.tree.add(p)
+        assert leaf == idx
+        self.cntr += 1
+        return idx
+
+    def store_batch(self, transitions: dict, errors=None) -> None:
+        """Bulk ingestion (the learner's ``store_transition_from_buffer``
+        role) — transitions enter one by one, preserving priority-init
+        semantics."""
+        n = len(next(iter(transitions.values())))
+        for i in range(n):
+            t = {k: v[i] for k, v in transitions.items()}
+            e = None if errors is None else errors[i]
+            self.store(t, e)
+
+    @property
+    def filled(self) -> int:
+        return min(self.cntr, self.size)
+
+    def ready(self, batch_size: int) -> bool:
+        return self.filled >= batch_size
+
+    # -- sampling ---------------------------------------------------------
+    def sample(self, batch_size: int, rng: np.random.Generator,
+               uniforms=None):
+        """(batch, idx, is_weights) with the same stratified scheme and
+        beta annealing as ``replay.replay_sample_per``.  ``uniforms``
+        overrides the per-segment draws (testing/replay determinism)."""
+        self.beta = min(1.0, self.beta + PER_BETA_INCREMENT)
+        u = rng.random(batch_size) if uniforms is None else \
+            np.asarray(uniforms, np.float64)
+        idx, pri = self.tree.sample_stratified(batch_size, u)
+        total = self.tree.total()
+        probs = pri / total
+        is_w = (batch_size * probs) ** (-self.beta)
+        is_w = is_w / np.max(is_w)
+        batch = {k: v[idx] for k, v in self.data.items()}
+        return batch, idx, is_w.astype(np.float32)
+
+    def update_priorities(self, idx, errors) -> None:
+        """``batch_update``: p = min(|e|+eps, clip)^alpha."""
+        clipped = np.minimum(np.abs(np.asarray(errors, np.float64))
+                             + PER_EPSILON, self.error_clip)
+        self.tree.update_batch(np.asarray(idx, np.int64),
+                               clipped ** PER_ALPHA)
+
+    # -- checkpoint -------------------------------------------------------
+    def save(self, path: str) -> None:
+        state = {
+            "data": self.data, "cntr": self.cntr, "beta": self.beta,
+            "leaves": self.tree.leaves(), "cursor": self.tree.cursor,
+            "filled": self.tree.filled, "size": self.size,
+            "error_clip": self.error_clip, "spec": self.spec,
+        }
+        with open(path, "wb") as f:
+            pickle.dump(state, f)
+
+    @classmethod
+    def load(cls, path: str) -> "NativePER":
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        buf = cls(state["size"], state["spec"],
+                  error_clip=state["error_clip"])
+        buf.data = state["data"]
+        buf.cntr = state["cntr"]
+        buf.beta = state["beta"]
+        buf.tree.set_state(state["leaves"], state["cursor"], state["filled"])
+        return buf

@@ -1,0 +1,127 @@
+"""Measure both PER designs (SURVEY.md §7 "PER on TPU").
+
+Design A — HBM prefix-sum PER (rl.replay): priorities live on device, the
+sum-tree walk is replaced by searchsorted(cumsum(p), v); store/sample fuse
+into the jitted train step.
+
+Design B — host-side native sum tree (rl.replay_native + native/sumtree.cc):
+the reference's O(log n) pointer-chase in C++, storage in host numpy,
+minibatch crosses to the device per learn step.
+
+Run:  PYTHONPATH=/root/repo:$PYTHONPATH python tools/bench_per.py
+      [--size 16384] [--batch 256] [--iters 200] [--cpu]
+
+Prints one JSON line per measurement plus a summary, and overwrites
+results/per_bench.json (in-repo, cwd-independent) with the latest run.
+"""
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+def bench_device(size, batch, iters, obs_dim=128, n_actions=4):
+    import jax
+    import jax.numpy as jnp
+
+    from smartcal_tpu.rl import replay as rp
+
+    spec = rp.transition_spec(obs_dim, n_actions)
+    buf = rp.replay_init(size, spec)
+    tr = {k: jnp.zeros(shape, dtype) for k, (shape, dtype) in spec.items()}
+
+    @jax.jit
+    def fill(buf, key):
+        e = jax.random.uniform(key, ())
+        return rp.replay_add(buf, tr, error=e)
+
+    key = jax.random.PRNGKey(0)
+    for i in range(size):
+        key, k = jax.random.split(key)
+        buf = fill(buf, k)
+    jax.block_until_ready(buf.priority)
+
+    @jax.jit
+    def cycle(buf, key):
+        """sample -> (pretend TD errors) -> priority update, one fused step."""
+        k1, k2 = jax.random.split(key)
+        batch_data, idx, is_w, buf = rp.replay_sample_per(buf, k1, batch)
+        errors = jax.random.uniform(k2, (batch,))
+        buf = rp.replay_update_priorities(buf, idx, errors)
+        return buf, batch_data["state"].sum()
+
+    key = jax.random.PRNGKey(1)
+    buf, s = cycle(buf, key)   # compile
+    jax.block_until_ready(s)
+    t0 = time.time()
+    for _ in range(iters):
+        key, k = jax.random.split(key)
+        buf, s = cycle(buf, k)
+    jax.block_until_ready(s)
+    dt = time.time() - t0
+    return {"design": "device_prefix_sum", "size": size, "batch": batch,
+            "iters": iters, "sample_update_us": round(dt / iters * 1e6, 1),
+            "platform": jax.devices()[0].platform}
+
+
+def bench_native(size, batch, iters, obs_dim=128, n_actions=4):
+    from smartcal_tpu.rl import replay as rp
+    from smartcal_tpu.rl.replay_native import NativePER
+
+    spec = rp.transition_spec(obs_dim, n_actions)
+    buf = NativePER(size, spec)
+    rng = np.random.default_rng(0)
+    tr = {k: np.zeros(shape, np.dtype(dtype))
+          for k, (shape, dtype) in spec.items()}
+    for _ in range(size):
+        buf.store(tr, error=rng.random())
+
+    t0 = time.time()
+    for _ in range(iters):
+        batch_data, idx, _ = buf.sample(batch, rng)
+        buf.update_priorities(idx, rng.random(batch))
+    dt = time.time() - t0
+    return {"design": "native_sumtree", "size": size, "batch": batch,
+            "iters": iters, "sample_update_us": round(dt / iters * 1e6, 1),
+            "platform": "host"}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=16384)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the device design onto CPU")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    rows = [bench_native(args.size, args.batch, args.iters),
+            bench_device(args.size, args.batch, args.iters)]
+    for r in rows:
+        print(json.dumps(r))
+    ratio = rows[0]["sample_update_us"] / max(rows[1]["sample_update_us"],
+                                              1e-9)
+    summary = {"native_over_device_time_ratio": round(ratio, 3),
+               "note": "ratio < 1 means the host tree is faster "
+                       "(standalone sample+update; the device design "
+                       "additionally fuses into the jitted train step)"}
+    print(json.dumps(summary))
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "results", "per_bench.json")
+    try:
+        with open(out, "w") as f:
+            json.dump({"rows": rows, "summary": summary}, f, indent=1)
+    except OSError:
+        pass
+
+
+if __name__ == "__main__":
+    main()
