@@ -1,4 +1,5 @@
 from .mesh import make_mesh, replicated, sharded_batch  # noqa: F401
+from . import multihost  # noqa: F401
 from .trainer import (  # noqa: F401
     ParallelTrainState,
     episode_scores,

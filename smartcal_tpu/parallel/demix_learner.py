@@ -311,12 +311,17 @@ def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
 
 def main(argv=None):
     """CLI (the run_process entry of distributed_per_sac.py:193-229 —
-    no MASTER_ADDR/rank plumbing: the mesh IS the world).
+    the mesh IS the world; multi-host runs pass --coordinator/
+    --num_processes/--process_id on every host, the jax.distributed
+    replacement for the reference's MASTER_ADDR/world_size/rank plumbing).
 
     Usage: python -m smartcal_tpu.parallel.demix_learner --episodes 10
         [--actors 8] [--K 4] [--small] [--provide_influence]
+        [--coordinator host:port --num_processes N --process_id i]
     """
     import argparse
+
+    from . import multihost
 
     p = argparse.ArgumentParser(description=main.__doc__)
     p.add_argument("--seed", type=int, default=0)
@@ -330,7 +335,10 @@ def main(argv=None):
     p.add_argument("--rollout_epochs", type=int, default=2,
                    help="episodes per actor per learner episode")
     p.add_argument("--rollout_steps", type=int, default=5)
+    multihost.add_cli_args(p)
     args = p.parse_args(argv)
+    if multihost.initialize_from_args(args):
+        print("multihost:", multihost.runtime_summary())
     if args.small:
         backend = radio.RadioBackend(n_stations=6, n_times=4, tdelta=2,
                                      npix=16, admm_iters=2, lbfgs_iters=3,

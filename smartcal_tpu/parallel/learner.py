@@ -188,12 +188,17 @@ def train_distributed(seed=0, episodes=100, n_actors=None, mesh=None,
 
 def main(argv=None):
     """CLI (run_process of elasticnet/distributed_per_sac.py:154-194 —
-    no MASTER_ADDR/rank plumbing: the mesh IS the world).
+    the mesh IS the world; multi-host runs pass --coordinator/--num_processes
+    /--process_id on every host, the jax.distributed replacement for the
+    reference's MASTER_ADDR/world_size/rank plumbing).
 
     Usage: python -m smartcal_tpu.parallel.learner --episodes 100
         [--actors 8] [--use_hint] [--learn_per_transition]
+        [--coordinator host:port --num_processes N --process_id i]
     """
     import argparse
+
+    from . import multihost
 
     p = argparse.ArgumentParser(description=main.__doc__)
     p.add_argument("--seed", type=int, default=0)
@@ -201,7 +206,10 @@ def main(argv=None):
     p.add_argument("--actors", type=int, default=None)
     p.add_argument("--use_hint", action="store_true")
     p.add_argument("--learn_per_transition", action="store_true")
+    multihost.add_cli_args(p)
     args = p.parse_args(argv)
+    if multihost.initialize_from_args(args):
+        print("multihost:", multihost.runtime_summary())
     _, scores = train_distributed(
         seed=args.seed, episodes=args.episodes, n_actors=args.actors,
         use_hint=args.use_hint,

@@ -1,0 +1,87 @@
+"""Multi-host runtime initialisation (the MASTER_ADDR / world-size edge).
+
+The reference brings its distributed runtime up through torch RPC env
+conventions — ``MASTER_ADDR``/``MASTER_PORT``, ``GLOO_SOCKET_IFNAME``/
+``TP_SOCKET_IFNAME``, explicit ``world_size``/``rank`` CLI args
+(``elasticnet/distributed_per_sac.py:154-190``, ``elasticnet/README.md:
+6-18``).  The TPU-native equivalent is single-controller-per-host JAX:
+every host runs the same program, ``jax.distributed.initialize`` wires the
+hosts together, and from then on all communication is XLA collectives —
+psum/all_gather riding ICI inside a slice and DCN across slices.  No RPC,
+no weight shipping, no locks: the mesh IS the communication backend.
+
+``initialize()`` below is the one call a driver needs before touching
+``jax.devices()``.  It is a no-op for single-host runs, so every CLI can
+call it unconditionally (the ``--coordinator`` flag mirrors the
+reference's ``--master_addr``/``--master_port`` pair).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_initialized = False
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Bring up multi-host JAX if configured; returns True when distributed.
+
+    Sources, in order: explicit args, then the standard env vars
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``,
+    or the cloud-TPU auto-detection built into jax.distributed).  With no
+    configuration at all this is a no-op single-host run.
+
+    Call BEFORE the first ``jax.devices()``/jit of the process.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator is None and num_processes is None:
+        return False                       # single-host: nothing to do
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    return True
+
+
+def add_cli_args(parser) -> None:
+    """Attach the multi-host flags every parallel CLI shares
+    (the reference's --master_addr/--master_port/--world_size/--rank,
+    distributed_per_sac.py:176-190)."""
+    parser.add_argument("--coordinator", default=None,
+                        help="coordinator host:port (all hosts pass the "
+                             "same value; host 0 must be reachable there)")
+    parser.add_argument("--num_processes", type=int, default=None,
+                        help="total participating hosts")
+    parser.add_argument("--process_id", type=int, default=None,
+                        help="this host's rank in [0, num_processes)")
+
+
+def initialize_from_args(args) -> bool:
+    return initialize(coordinator=getattr(args, "coordinator", None),
+                      num_processes=getattr(args, "num_processes", None),
+                      process_id=getattr(args, "process_id", None))
+
+
+def runtime_summary() -> dict:
+    """One-line visibility into the process's place in the job."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": jax.device_count(),
+        "platform": jax.devices()[0].platform,
+    }
