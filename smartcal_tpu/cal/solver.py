@@ -123,6 +123,80 @@ def _cost_fn(x, V5, C5, prior, half_rho, cfg: SolverConfig):
     return chi2 + jnp.sum(half_rho * pr)
 
 
+# ---- pieces shared by the fused (solve_admm) and host-segmented
+# (solve_admm_host) drivers: ONE copy of the numerically sensitive
+# formulas — normalization, consensus conditioning, dual update, sigmas —
+# parameterized by axis_name (None when the frequency axis is local).
+
+def _prep(V, C, freqs, f0, rho, cfg, Ts, freq_range, axis_name):
+    """Scale normalization + chunking + consensus operators.
+
+    Scale invariance: radio fluxes span ~0.01..1e4 Jy, so chi2 in raw
+    units overflows float32 line-search arithmetic.  Normalize data and
+    model by the data scale and rho by its square — the minimizer (J, Z)
+    is unchanged, the arithmetic stays O(1).  Undone on the outputs by
+    _finalize."""
+    B = V.shape[2]
+    vmean = jnp.mean(V * V)
+    if axis_name is not None:
+        vmean = lax.pmean(vmean, axis_name)
+    data_scale = jnp.sqrt(vmean) + 1e-20
+    V = V / data_scale
+    C = C / data_scale
+    rho = jnp.asarray(rho) / (data_scale * data_scale)
+    V6 = jax.vmap(lambda v: vis_to_chunks(v, Ts))(V)     # (Nf,Ts,td,B,...)
+    C7 = jax.vmap(lambda c: coherency_to_chunks(c, B, Ts))(C)
+    # frequency basis, shared across directions; per-frequency row b_f
+    bfull = consensus.poly_basis(freqs, f0, cfg.n_poly, cfg.polytype,
+                                 frange=freq_range)      # (Nf, Ne)
+    # Bi_k = pinv(rho_k sum_f b_f b_f^T): needs the GLOBAL sum over freq
+    btb = bfull.T @ bfull
+    if axis_name is not None:
+        btb = lax.psum(btb, axis_name)
+    # conditioning eps must scale with rho*btb: after the data-scale
+    # normalization rho can be tiny, and a fixed eps would bias Z to zero
+    tr = jnp.trace(btb) / cfg.n_poly
+    Bi = jax.vmap(
+        lambda r: jnp.linalg.pinv(
+            r * btb + (1e-6 * r * tr + 1e-30) * jnp.eye(cfg.n_poly)))(rho)
+    return V6, C7, rho, data_scale, bfull, Bi
+
+
+def _bz(bfull, Z):
+    """B_f Z: (Nf, Ts, K, 2N, 2, 2) from Z (Ts, K, Ne, 2N, 2, 2)."""
+    return jnp.einsum("fe,tkenij->ftknij", bfull, Z)
+
+
+def _z_update(bfull, Bi, rho, J, Y, axis_name=None):
+    # S_k = sum_f b_f (rho_k J_fk + Y_fk)  -> (Ts, K, Ne, 2N, 2, 2)
+    w = rho[None, None, :, None, None, None] * J + Y
+    S = jnp.einsum("fe,ftknij->tkenij", bfull, w)
+    if axis_name is not None:
+        S = lax.psum(S, axis_name)
+    return jnp.einsum("kem,tkmnij->tkenij", Bi, S)
+
+
+def _finalize(J, V6, C7, data_scale, cost, cfg, T, axis_name=None):
+    """Residual over the full data + noise statistics, in DATA units."""
+    B = V6.shape[3]
+    N = cfg.n_stations
+
+    def resid_f(Jf, Vf, Cf):
+        r = jax.vmap(lambda j, v, c: v - predict_vis_sr(j, c, N))(Jf, Vf, Cf)
+        return r.reshape(T, B, 2, 2, 2)
+
+    residual = jax.vmap(resid_f)(J, V6, C7) * data_scale
+    n_res = jnp.sum(residual * residual)
+    n_dat = jnp.sum(V6 * V6) * data_scale * data_scale
+    count = jnp.asarray(residual.size, residual.dtype)
+    if axis_name is not None:
+        n_res = lax.psum(n_res, axis_name)
+        n_dat = lax.psum(n_dat, axis_name)
+        count = lax.psum(count, axis_name)
+    return (residual, jnp.sqrt(n_res / count), jnp.sqrt(n_dat / count),
+            cost * data_scale * data_scale)
+
+
 @partial(jax.jit, static_argnames=("cfg", "axis_name", "n_chunks"))
 def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
                axis_name: Optional[str] = None,
@@ -157,18 +231,6 @@ def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
             "freq_range=(fmin, fmax) — local shard min/max would build "
             "incompatible bases across shards")
     Nf, T, B = V.shape[0], V.shape[1], V.shape[2]
-
-    # Scale invariance: radio fluxes span ~0.01..1e4 Jy, so chi2 in raw
-    # units overflows float32 line-search arithmetic.  Normalize data and
-    # model by the data scale and rho by its square — the minimizer (J, Z)
-    # is unchanged, the arithmetic stays O(1).  Undone on the outputs below.
-    vmean = jnp.mean(V * V)
-    if axis_name is not None:
-        vmean = lax.pmean(vmean, axis_name)
-    data_scale = jnp.sqrt(vmean) + 1e-20
-    V = V / data_scale
-    C = C / data_scale
-    rho = jnp.asarray(rho) / (data_scale * data_scale)
     K, N = cfg.n_dirs, cfg.n_stations
     if n_chunks is not None:
         Ts = n_chunks
@@ -178,28 +240,14 @@ def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
         Ts = 1 if J0 is None else J0.shape[1]
     niter = cfg.admm_iters if admm_iters is None else admm_iters
 
-    V6 = jax.vmap(lambda v: vis_to_chunks(v, Ts))(V)     # (Nf,Ts,td,B,...)
-    C7 = jax.vmap(lambda c: coherency_to_chunks(c, B, Ts))(C)
+    V6, C7, rho, data_scale, bfull, Bi = _prep(
+        V, C, freqs, f0, rho, cfg, Ts, freq_range, axis_name)
 
     warm = J0 is not None
     if not warm:
         eye = jnp.zeros((2, 2, 2)).at[:, :, 0].set(jnp.eye(2))
         J0 = jnp.broadcast_to(eye, (Nf, Ts, K, N, 2, 2, 2)).reshape(
             Nf, Ts, K, 2 * N, 2, 2)
-
-    # frequency basis, shared across directions; per-frequency row b_f
-    bfull = consensus.poly_basis(freqs, f0, cfg.n_poly, cfg.polytype,
-                                 frange=freq_range)      # (Nf, Ne)
-    # Bi_k = pinv(rho_k sum_f b_f b_f^T): needs the GLOBAL sum over freq
-    btb = bfull.T @ bfull
-    if axis_name is not None:
-        btb = lax.psum(btb, axis_name)
-    # conditioning eps must scale with rho*btb: after the data-scale
-    # normalization rho can be tiny, and a fixed eps would bias Z to zero
-    tr = jnp.trace(btb) / cfg.n_poly
-    Bi = jax.vmap(
-        lambda r: jnp.linalg.pinv(
-            r * btb + (1e-6 * r * tr + 1e-30) * jnp.eye(cfg.n_poly)))(rho)
 
     half_rho = 0.5 * rho
 
@@ -225,53 +273,149 @@ def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
             J0.reshape(x_shape), V6, C7, pr0)
         J0 = x_init.reshape(J0.shape)
 
-    def bz(Z):
-        """B_f Z: (Nf, Ts, K, 2N, 2, 2) from Z (Ts, K, Ne, 2N, 2, 2)."""
-        return jnp.einsum("fe,tkenij->ftknij", bfull, Z)
-
-    def z_update(J, Y):
-        # S_k = sum_f b_f (rho_k J_fk + Y_fk)  -> (Ts, K, Ne, 2N, 2, 2)
-        w = rho[None, None, :, None, None, None] * J + Y
-        S = jnp.einsum("fe,ftknij->tkenij", bfull, w)
-        if axis_name is not None:
-            S = lax.psum(S, axis_name)
-        return jnp.einsum("kem,tkmnij->tkenij", Bi, S)
-
     def body(i, state):
         J, Y, Z, cost = state
-        prior = bz(Z) - Y / rho[None, None, :, None, None, None]
+        prior = _bz(bfull, Z) - Y / rho[None, None, :, None, None, None]
         x0 = J.reshape(x_shape)
         pr = prior.reshape((Nf, Ts, K, 2 * N, 2, 2))
         x, cost = batch_solve(x0, V6, C7, pr)
         J = x.reshape(J.shape)
-        Z = z_update(J, Y)
-        Y = Y + rho[None, None, :, None, None, None] * (J - bz(Z))
+        Z = _z_update(bfull, Bi, rho, J, Y, axis_name)
+        Y = Y + rho[None, None, :, None, None, None] * (J - _bz(bfull, Z))
         return J, Y, Z, cost
 
     Y0 = jnp.zeros_like(J0)
-    Z0 = z_update(J0, Y0)
+    Z0 = _z_update(bfull, Bi, rho, J0, Y0, axis_name)
     cost0 = jnp.zeros((Nf, Ts), J0.dtype)
     J, Y, Z, cost = lax.fori_loop(0, niter, body, (J0, Y0, Z0, cost0))
 
-    # residual over the full data
-    def resid_f(Jf, Vf, Cf):
-        r = jax.vmap(lambda j, v, c: v - predict_vis_sr(j, c, N))(Jf, Vf, Cf)
-        return r.reshape(T, B, 2, 2, 2)
-
-    residual = jax.vmap(resid_f)(J, V6, C7) * data_scale
-
-    n_res = jnp.sum(residual * residual)
-    n_dat = jnp.sum(V * V) * data_scale * data_scale
-    count = jnp.asarray(residual.size, residual.dtype)
-    if axis_name is not None:
-        n_res = lax.psum(n_res, axis_name)
-        n_dat = lax.psum(n_dat, axis_name)
-        count = lax.psum(count, axis_name)
-    sigma_res = jnp.sqrt(n_res / count)
-    sigma_data = jnp.sqrt(n_dat / count)
+    residual, sigma_res, sigma_data, fcost = _finalize(
+        J, V6, C7, data_scale, cost, cfg, T, axis_name)
     return SolveResult(J=J, Z=Z, residual=residual, sigma_res=sigma_res,
-                       sigma_data=sigma_data,
-                       final_cost=cost * data_scale * data_scale)
+                       sigma_data=sigma_data, final_cost=fcost)
+
+
+# ---------------------------------------------------------------------------
+# Host-segmented solve: identical math, bounded device dispatches
+# ---------------------------------------------------------------------------
+#
+# solve_admm fuses init + the whole ADMM loop into ONE XLA program.  At
+# LOFAR scale (N=62, Nf=8, init 30 + 10x8 L-BFGS iterations) that program
+# runs for minutes on one chip — long enough to trip device/RPC-tunnel
+# watchdogs (observed on the axon TPU tunnel as "UNAVAILABLE: TPU device
+# error ... kernel fault"; N=62 with few iterations runs fine, N=40 with
+# the full count faults).  The host-segmented driver below runs the SAME
+# math as a sequence of bounded jitted calls: L-BFGS init in exact-resume
+# segments (ops/lbfgs.lbfgs_resume) and one dispatch per ADMM outer
+# iteration.  Numerics match solve_admm to float tolerance (identical op
+# sequence; only XLA fusion boundaries differ) — tests/test_cal_backend.py
+# asserts it.
+
+@partial(jax.jit, static_argnames=("cfg", "iters", "init_phase"))
+def _seg_start(x0, V6, C7, prior, rho, cfg, iters, init_phase):
+    """Open a vmapped (Nf, Ts) L-BFGS solve for ``iters`` iterations;
+    init_phase drops the consensus prior term (chi2-only)."""
+    half_rho = jnp.zeros_like(rho) if init_phase else 0.5 * rho
+
+    def one(x, v5, c5, pr):
+        fun = lambda xx: _cost_fn(xx, v5, c5, pr, half_rho, cfg)
+        return lbfgs.lbfgs_solve(fun, x, max_iters=iters,
+                                 use_line_search=True)
+
+    return jax.vmap(jax.vmap(one))(x0, V6, C7, prior)
+
+
+@partial(jax.jit, static_argnames=("cfg", "iters", "init_phase"))
+def _seg_resume(res, V6, C7, prior, rho, cfg, iters, init_phase):
+    half_rho = jnp.zeros_like(rho) if init_phase else 0.5 * rho
+
+    def one(r, v5, c5, pr):
+        fun = lambda xx: _cost_fn(xx, v5, c5, pr, half_rho, cfg)
+        return lbfgs.lbfgs_resume(fun, r, iters)
+
+    return jax.vmap(jax.vmap(one))(res, V6, C7, prior)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _host_consensus(J, Y, bfull, Bi, rho, cfg):
+    """Z and dual updates after an outer iteration's inner solves (the
+    shared _z_update/_bz formulas, one bounded dispatch)."""
+    Z = _z_update(bfull, Bi, rho, J, Y)
+    Y = Y + rho[None, None, :, None, None, None] * (J - _bz(bfull, Z))
+    return Z, Y, _bz(bfull, Z) - Y / rho[None, None, :, None, None, None]
+
+
+_host_finalize = partial(jax.jit, static_argnames=("cfg", "T"))(_finalize)
+
+
+def solve_admm_host(V, C, freqs, f0, rho, cfg: SolverConfig,
+                    n_chunks: int = 1, admm_iters: Optional[int] = None,
+                    freq_range=None, seg_iters: int = 8) -> SolveResult:
+    """``solve_admm`` as bounded host-driven dispatches (single host/device;
+    for the sharded multi-device path use parallel.sharded_cal, whose
+    shard_map programs keep per-dispatch work 1/n-th the size anyway).
+
+    seg_iters : max L-BFGS iterations per device dispatch.  The inner
+        ADMM solves (cfg.lbfgs_iters each) are also segmented when
+        cfg.lbfgs_iters > seg_iters.  Cold start only (J0 warm start is a
+        solve_admm feature the radio envs don't use with host
+        segmentation).
+    """
+    Nf = V.shape[0]
+    T = V.shape[1]
+    K, N = cfg.n_dirs, cfg.n_stations
+    Ts = n_chunks
+    niter = cfg.admm_iters if admm_iters is None else int(admm_iters)
+    if cfg.polytype == 1 and freq_range is None:
+        fr = np.asarray(freqs)
+        freq_range = (float(fr.min()), float(fr.max()))
+
+    V6, C7, rho_n, data_scale, bfull, Bi = _prep(
+        jnp.asarray(V), jnp.asarray(C), jnp.asarray(freqs), f0, rho, cfg,
+        Ts, freq_range, axis_name=None)
+
+    eye = jnp.zeros((2, 2, 2)).at[:, :, 0].set(jnp.eye(2))
+    J0 = jnp.broadcast_to(eye, (Nf, Ts, K, N, 2, 2, 2)).reshape(
+        Nf, Ts, K, 2 * N, 2, 2)
+    x_shape = (Nf, Ts, K * 2 * N * 2 * 2)
+
+    def segmented_solve(x0, prior, total, init_phase):
+        """total L-BFGS iterations as ceil(total/seg_iters) dispatches."""
+        first = min(seg_iters, total)
+        res = _seg_start(x0, V6, C7, prior, rho_n, cfg, first, init_phase)
+        jax.block_until_ready(res.x)
+        done = first
+        while done < total:
+            step = min(seg_iters, total - done)
+            res = _seg_resume(res, V6, C7, prior, rho_n, cfg, step,
+                              init_phase)
+            jax.block_until_ready(res.x)
+            done += step
+        return res
+
+    # chi2-only init phase (solve_admm's init_iters)
+    if cfg.init_iters > 0:
+        pr0 = J0.reshape((Nf, Ts, K, 2 * N, 2, 2))
+        res = segmented_solve(J0.reshape(x_shape), pr0, cfg.init_iters,
+                              init_phase=True)
+        J0 = res.x.reshape(J0.shape)
+
+    Y = jnp.zeros_like(J0)
+    Z = _z_update(bfull, Bi, rho_n, J0, Y)
+    J = J0
+    prior = _bz(bfull, Z) - Y / rho_n[None, None, :, None, None, None]
+    cost = jnp.zeros((Nf, Ts), J0.dtype)
+    for _ in range(niter):
+        res = segmented_solve(J.reshape(x_shape),
+                              prior.reshape((Nf, Ts, K, 2 * N, 2, 2)),
+                              cfg.lbfgs_iters, init_phase=False)
+        J, cost = res.x.reshape(J.shape), res.loss
+        Z, Y, prior = _host_consensus(J, Y, bfull, Bi, rho_n, cfg)
+
+    residual, sigma_res, sigma_data, fcost = _host_finalize(
+        J, V6, C7, data_scale, cost, cfg, T)
+    return SolveResult(J=J, Z=Z, residual=residual, sigma_res=sigma_res,
+                       sigma_data=sigma_data, final_cost=fcost)
 
 
 def simulate_vis_sr(J, C, n_stations, Ts):

@@ -166,14 +166,48 @@ class RadioBackend:
         """Solve with per-direction rho; ``mask`` (K,) in {0,1} excludes
         directions by zeroing their model (static shapes, no recompile).
         Cold start: n_chunks (not J0) sets the solution intervals, so the
-        solver's chi2-only init phase runs."""
+        solver's chi2-only init phase runs.
+
+        Large problems route to the host-segmented driver automatically
+        (bounded device dispatches; a single fused XLA program running for
+        minutes trips device/tunnel watchdogs — solver.solve_admm_host).
+        Under a jax trace (the vmapped hint sweep) the fused path is the
+        only legal one and is kept.
+        """
         C = ep.Ccal
         if mask is not None:
             C = C * jnp.asarray(mask)[None, :, None, None, None]
+        traced = any(isinstance(x, jax.core.Tracer)
+                     for x in (C, ep.V, rho, admm_iters))
+        if not traced and self._use_host_solver(admm_iters):
+            return solver.solve_admm_host(
+                ep.V, C, ep.obs.freqs, ep.f0, jnp.asarray(rho),
+                self._solver_cfg(ep.n_dirs), n_chunks=self.n_chunks,
+                admm_iters=None if admm_iters is None else int(admm_iters))
         return solver.solve_admm(
             ep.V, C, ep.obs.freqs, ep.f0, jnp.asarray(rho),
             self._solver_cfg(ep.n_dirs), n_chunks=self.n_chunks,
             admm_iters=None if admm_iters is None else jnp.asarray(admm_iters))
+
+    def _use_host_solver(self, admm_iters=None) -> bool:
+        """Proxy for 'one fused solve would run too long on a chip': total
+        L-BFGS iterations x per-iteration work, with the per-call ADMM
+        iteration override (the demixing action's maxiter) counted, not the
+        constructor default.  N=14/Nf=3 training configs stay fused (they
+        live inside vmapped sweeps and finish in seconds); LOFAR-scale
+        N=62/Nf=8 segments.  SMARTCAL_HOST_SOLVER=0/1 overrides."""
+        import os
+
+        override = os.environ.get("SMARTCAL_HOST_SOLVER", "").strip()
+        if override in ("0", "1"):
+            return override == "1"
+        admm = self.admm_iters if admm_iters is None else int(admm_iters)
+        total_iters = self.init_iters + admm * self.lbfgs_iters
+        work = (self.n_stations ** 2) * self.n_freqs * self.n_times
+        # calibration units: N=62/Nf=8 at few iterations (3.7e6) measured
+        # ~10s steady on one v5e chip and runs fine; the watchdog bites
+        # near ~60-90s (2-3e7).  1e7 =~ 35s leaves margin both ways.
+        return total_iters * work > 1e7
 
     def hint_sweep(self, ep: Episode, rho, masks, admm_iters=None,
                    batch=8):

@@ -169,6 +169,30 @@ class TestSolver:
                / np.linalg.norm(np.asarray(V)))
         assert rel < 0.12
 
+    def test_host_segmented_matches_fused(self, problem):
+        """solve_admm_host (bounded dispatches, lbfgs_resume segments) walks
+        the same trajectory as the fused solve_admm: same J/Z/residual to
+        float tolerance, with seg_iters forcing several resume segments in
+        both the init phase and the inner ADMM solves."""
+        obs, mdl, C, Jtrue, V, Vn = problem
+        cfg = solver.SolverConfig(n_stations=6, n_dirs=2, n_poly=2,
+                                  admm_iters=3, lbfgs_iters=5,
+                                  init_iters=11)
+        fused = solver.solve_admm(Vn, C, obs.freqs, float(obs.freqs[1]),
+                                  jnp.asarray(mdl.rho), cfg, n_chunks=2)
+        host = solver.solve_admm_host(Vn, C, obs.freqs, float(obs.freqs[1]),
+                                      jnp.asarray(mdl.rho), cfg, n_chunks=2,
+                                      seg_iters=4)
+        np.testing.assert_allclose(np.asarray(host.J), np.asarray(fused.J),
+                                   rtol=2e-3, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(host.residual),
+                                   np.asarray(fused.residual),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(float(host.sigma_res),
+                                   float(fused.sigma_res), rtol=1e-3)
+        np.testing.assert_allclose(float(host.sigma_data),
+                                   float(fused.sigma_data), rtol=1e-5)
+
     def test_dynamic_admm_iters(self, problem):
         obs, mdl, C, Jtrue, V, Vn = problem
         cfg = solver.SolverConfig(n_stations=6, n_dirs=2, n_poly=2,

@@ -167,3 +167,30 @@ def test_solve_reports_convergence_on_trivial_problem():
                       max_iters=100)
     assert bool(res.converged)
     assert int(res.n_iters) < 100
+
+
+def test_resume_walks_identical_trajectory():
+    """solve(N) == solve(k) + resume chain (exact segmented dispatch parity
+    — what solve_admm_host relies on), including the stop flag short-circuit."""
+    from smartcal_tpu.ops.lbfgs import lbfgs_resume
+
+    rng = np.random.default_rng(5)
+    A = jnp.asarray(rng.standard_normal((40, 12)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal(40), jnp.float32)
+
+    def fun(x):
+        return jnp.mean((A @ x - y) ** 2) + 0.05 * jnp.sum(x * x)
+
+    full = lbfgs_solve(fun, jnp.zeros(12), max_iters=21)
+    seg = lbfgs_solve(fun, jnp.zeros(12), max_iters=8)
+    seg = lbfgs_resume(fun, seg, 8)
+    seg = lbfgs_resume(fun, seg, 5)
+    np.testing.assert_array_equal(np.asarray(seg.x), np.asarray(full.x))
+    assert int(seg.n_iters) == int(full.n_iters)
+    assert bool(seg.converged) == bool(full.converged)
+
+    # resume past convergence is a no-op
+    conv = lbfgs_solve(fun, jnp.zeros(12), max_iters=200)
+    again = lbfgs_resume(fun, conv, 10)
+    assert int(again.n_iters) == int(conv.n_iters)
+    np.testing.assert_array_equal(np.asarray(again.x), np.asarray(conv.x))
