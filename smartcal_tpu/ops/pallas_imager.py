@@ -43,8 +43,16 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-TILE_P = 256     # pixels per tile (multiple of 8 sublanes x 128 lanes)
-TILE_R = 512     # uv samples per tile; phase tile = 256x512x4B = 512 KB
+# Mosaic requires the last two block dims be (divisible by 8, divisible by
+# 128) or equal to the full array dims; the output tile is (TILE_P//128,
+# 128), so TILE_P must be a multiple of 8*128 = 1024.  (A 256-pixel tile
+# lowered fine in interpreter mode but was REJECTED by the real TPU
+# lowering with block shape (2, 128) — caught on hardware.)
+TILE_P = 1024    # pixels per tile -> (8, 128) output block
+# phase tile + its cos/sin temporaries + double-buffered input blocks must
+# fit the 16 MB scoped-vmem budget: 1024x512 tiles OOMed at 19.6 MB on a
+# v5e (caught on hardware), 1024x256 leaves headroom
+TILE_R = 256     # uv samples per tile; phase tile = 1024x256x4B = 1 MB
 
 
 def _imager_kernel(lm_ref, uvt_ref, vre_ref, vim_ref, out_ref):
@@ -52,6 +60,12 @@ def _imager_kernel(lm_ref, uvt_ref, vre_ref, vim_ref, out_ref):
     # (TILE_P, 2) @ (2, TILE_R) -> phase tile, never leaves VMEM
     phase = jnp.dot(lm_ref[:], uvt_ref[:],
                     preferred_element_type=jnp.float32)
+    # explicit range reduction: |phase| reaches ~1e3 rad at LOFAR uv
+    # scales, where raw f32 trig approximations diverge visibly between
+    # implementations (0.3% pallas-vs-XLA observed on a v5e); one mod-2pi
+    # keeps the trig argument small at the cost of two VPU ops
+    two_pi = jnp.float32(2.0 * jnp.pi)
+    phase = phase - two_pi * jnp.round(phase / two_pi)
     acc = (jnp.dot(jnp.cos(phase), vre_ref[:],
                    preferred_element_type=jnp.float32)
            + jnp.dot(jnp.sin(phase), vim_ref[:],
@@ -72,7 +86,7 @@ def dirty_image_pallas(uvw, vis, freq, cell, npix=128, interpret=False):
     """Drop-in Pallas version of :func:`cal.imager.dirty_image_sr`.
 
     uvw : (R, 3) meters; vis : (R, 2) split-real samples.  Requires
-    npix^2 % TILE_P == 0 (npix >= 16 and a multiple of 16); R is
+    npix^2 % TILE_P == 0 (npix a multiple of 32); R is
     zero-padded to TILE_R internally (padded vis rows are 0, so any
     phase value contributes nothing).
     """

@@ -19,7 +19,7 @@ def _case(rng, R, freq=150e6):
 
 def test_matches_xla_oracle():
     rng = np.random.default_rng(0)
-    npix = 16                                  # P=256 = one TILE_P
+    npix = 32                                  # P=1024 = one TILE_P
     uvw, vis, freq, cell = _case(rng, R=700)   # forces R padding (2 tiles)
     ref = np.asarray(imager.dirty_image_sr(uvw, vis, freq, cell,
                                            npix=npix))
@@ -31,7 +31,7 @@ def test_matches_xla_oracle():
 
 def test_multi_pixel_tiles():
     rng = np.random.default_rng(1)
-    npix = 32                                  # P=1024 = 4 pixel tiles
+    npix = 64                                  # P=4096 = 4 pixel tiles
     uvw, vis, freq, cell = _case(rng, R=512)   # exactly one R tile
     ref = np.asarray(imager.dirty_image_sr(uvw, vis, freq, cell,
                                            npix=npix))
