@@ -93,20 +93,23 @@ def _load(path):
 def _store(path, main, meta):
     os.makedirs(path, exist_ok=True)
     fmt = _write_format()
+    # remove the OTHER format's files BEFORE writing: _load prefers sct,
+    # so a crash between writing npz and removing a stale TABLE.sct would
+    # silently serve pre-mutation data forever; remove-first turns that
+    # crash window into a loud missing-store error instead
+    stale = (SCT,) if fmt == "npz" else (MAIN, META)
+    for name in stale:
+        f = os.path.join(path, name)
+        if os.path.isfile(f):
+            os.remove(f)
     if fmt == "sct":
         from smartcal_tpu import native
         cols = {"MAIN/" + k: v for k, v in main.items()}
         cols.update({"META/" + k: v for k, v in meta.items()})
         native.sct_write(os.path.join(path, SCT), cols)
-        stale = (MAIN, META)                  # don't leave a two-format store
     else:
         np.savez(os.path.join(path, MAIN), **main)
         np.savez(os.path.join(path, META), **meta)
-        stale = (SCT,)
-    for name in stale:
-        f = os.path.join(path, name)
-        if os.path.isfile(f):
-            os.remove(f)
 
 
 class MSInfo(NamedTuple):

@@ -263,14 +263,63 @@ class _SctReader:
         return self.col(idx)[1]
 
 
+def _py_parse_header(f):
+    """Pure-python mirror of sct.cc parse_header: [(name, dtype, shape,
+    offset, nbytes)].  Keeps SCT stores READABLE on hosts without a C++
+    toolchain (writes fall back to npz there, but data written elsewhere
+    must still open)."""
+    import struct
+
+    if f.read(4) != b"SCT1":
+        raise IOError("bad SCT magic")
+    (ncols,) = struct.unpack("<I", f.read(4))
+    cols = []
+    for _ in range(ncols):
+        (name_len,) = struct.unpack("<I", f.read(4))
+        name = f.read(name_len).decode()
+        dtype_code, ndim = struct.unpack("<II", f.read(8))
+        dims = struct.unpack(f"<{ndim}Q", f.read(8 * ndim)) if ndim else ()
+        (nbytes,) = struct.unpack("<Q", f.read(8))
+        cols.append([name, CODE_DTYPES[dtype_code], tuple(dims), 0, nbytes])
+    off = f.tell()
+    for c in cols:
+        off = (off + 63) // 64 * 64
+        c[3] = off
+        off += c[4]
+    return cols
+
+
+def _py_read(path: str, only: Optional[str] = None):
+    out = {}
+    with open(path, "rb") as f:
+        for name, dtype, shape, offset, nbytes in _py_parse_header(f):
+            if only is not None and name != only:
+                continue
+            f.seek(offset)
+            buf = f.read(nbytes)
+            if len(buf) != nbytes:
+                raise IOError(f"truncated SCT column {name} in {path}")
+            out[name] = np.frombuffer(buf, dtype).reshape(shape).copy()
+    if only is not None:
+        if only not in out:
+            raise KeyError(f"column {only} not in {path}")
+        return out[only]
+    return out
+
+
 def sct_read(path: str) -> dict:
-    """Read an SCT file back into ``{name: ndarray}``."""
+    """Read an SCT file back into ``{name: ndarray}`` (native reader when
+    available, pure-python otherwise — the format must never need g++)."""
+    if lib() is None:
+        return _py_read(path)
     with _SctReader(path) as r:
         return dict(r.col(i) for i in range(r.ncols))
 
 
 def sct_read_one(path: str, name: str) -> np.ndarray:
     """Read a single named column without touching the other payloads."""
+    if lib() is None:
+        return _py_read(path, only=name)
     with _SctReader(path) as r:
         return r.read_one(name)
 

@@ -55,6 +55,30 @@ def test_sct_bool_and_strided(tmp_path):
     np.testing.assert_array_equal(back["S"], strided)
 
 
+def test_sct_python_reader_matches_native(tmp_path, rng):
+    """The pure-python fallback reader (no-toolchain hosts) decodes a
+    native-written file identically, whole-table and single-column."""
+    cols = {
+        "MAIN/DATA": (rng.standard_normal((6, 1, 4))
+                      + 1j * rng.standard_normal((6, 1, 4))
+                      ).astype(np.complex64),
+        "META/CHAN_FREQ": np.asarray([42e6]),
+        "META/N_ANTENNA": np.int64(4),
+    }
+    path = str(tmp_path / "t.sct")
+    native.sct_write(path, cols)
+    via_py = native._py_read(path)
+    via_native = native.sct_read(path)
+    assert set(via_py) == set(via_native)
+    for k in via_py:
+        np.testing.assert_array_equal(via_py[k], via_native[k])
+        assert via_py[k].dtype == via_native[k].dtype
+    np.testing.assert_array_equal(
+        native._py_read(path, only="META/CHAN_FREQ"), cols["META/CHAN_FREQ"])
+    with pytest.raises(KeyError):
+        native._py_read(path, only="NOPE")
+
+
 def test_sct_bad_file_raises(tmp_path):
     bad = tmp_path / "bad.sct"
     bad.write_bytes(b"not a table")

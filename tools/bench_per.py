@@ -31,17 +31,14 @@ def bench_device(size, batch, iters, obs_dim=128, n_actions=4):
 
     spec = rp.transition_spec(obs_dim, n_actions)
     buf = rp.replay_init(size, spec)
-    tr = {k: jnp.zeros(shape, dtype) for k, (shape, dtype) in spec.items()}
-
-    @jax.jit
-    def fill(buf, key):
-        e = jax.random.uniform(key, ())
-        return rp.replay_add(buf, tr, error=e)
-
-    key = jax.random.PRNGKey(0)
-    for i in range(size):
-        key, k = jax.random.split(key)
-        buf = fill(buf, k)
+    # fill in ONE batched dispatch (per-transition replay_add would copy
+    # the whole buffer size times just for setup)
+    trs = {k: jnp.zeros((size,) + shape, dtype)
+           for k, (shape, dtype) in spec.items()}
+    errors = jax.random.uniform(jax.random.PRNGKey(0), (size,))
+    pri = jnp.minimum((jnp.abs(errors) + rp.PER_EPSILON) ** rp.PER_ALPHA,
+                      100.0)
+    buf = jax.jit(rp.replay_add_batch)(buf, trs, priority=pri)
     jax.block_until_ready(buf.priority)
 
     @jax.jit
