@@ -93,23 +93,31 @@ def _load(path):
 def _store(path, main, meta):
     os.makedirs(path, exist_ok=True)
     fmt = _write_format()
-    # remove the OTHER format's files BEFORE writing: _load prefers sct,
-    # so a crash between writing npz and removing a stale TABLE.sct would
-    # silently serve pre-mutation data forever; remove-first turns that
-    # crash window into a loud missing-store error instead
-    stale = (SCT,) if fmt == "npz" else (MAIN, META)
-    for name in stale:
-        f = os.path.join(path, name)
-        if os.path.isfile(f):
-            os.remove(f)
+    # Crash-window discipline per branch (_load PREFERS sct):
+    #  * writing sct over an npz store: write first, remove after — a
+    #    failed/interrupted sct_write must not destroy the npz original,
+    #    and once TABLE.sct lands readers already see the new data.
+    #  * writing npz over an sct store: remove TABLE.sct FIRST — with it
+    #    present, a crash after savez would leave readers silently serving
+    #    the stale pre-mutation sct forever; remove-first turns that
+    #    window into a loud missing-store error instead.
     if fmt == "sct":
         from smartcal_tpu import native
         cols = {"MAIN/" + k: v for k, v in main.items()}
         cols.update({"META/" + k: v for k, v in meta.items()})
         native.sct_write(os.path.join(path, SCT), cols)
+        stale = (MAIN, META)
     else:
+        f = os.path.join(path, SCT)
+        if os.path.isfile(f):
+            os.remove(f)
         np.savez(os.path.join(path, MAIN), **main)
         np.savez(os.path.join(path, META), **meta)
+        stale = ()
+    for name in stale:
+        f = os.path.join(path, name)
+        if os.path.isfile(f):
+            os.remove(f)
 
 
 class MSInfo(NamedTuple):

@@ -264,22 +264,38 @@ class _SctReader:
 
 
 def _py_parse_header(f):
-    """Pure-python mirror of sct.cc parse_header: [(name, dtype, shape,
-    offset, nbytes)].  Keeps SCT stores READABLE on hosts without a C++
-    toolchain (writes fall back to npz there, but data written elsewhere
-    must still open)."""
+    """Pure-python mirror of sct.cc parse_header (same field order, limits,
+    and 64-byte payload alignment): [(name, dtype, shape, offset, nbytes)].
+    Keeps SCT stores READABLE on hosts without a C++ toolchain (writes fall
+    back to npz there, but data written elsewhere must still open).  All
+    corruption surfaces as IOError, like the native path."""
     import struct
+
+    def read_exact(n):
+        buf = f.read(n)
+        if len(buf) != n:
+            raise IOError("truncated SCT header")
+        return buf
 
     if f.read(4) != b"SCT1":
         raise IOError("bad SCT magic")
-    (ncols,) = struct.unpack("<I", f.read(4))
+    (ncols,) = struct.unpack("<I", read_exact(4))
+    if ncols > 1 << 20:
+        raise IOError(f"bad SCT header: ncols={ncols}")
     cols = []
     for _ in range(ncols):
-        (name_len,) = struct.unpack("<I", f.read(4))
-        name = f.read(name_len).decode()
-        dtype_code, ndim = struct.unpack("<II", f.read(8))
-        dims = struct.unpack(f"<{ndim}Q", f.read(8 * ndim)) if ndim else ()
-        (nbytes,) = struct.unpack("<Q", f.read(8))
+        (name_len,) = struct.unpack("<I", read_exact(4))
+        if name_len > 4096:
+            raise IOError(f"bad SCT header: name_len={name_len}")
+        name = read_exact(name_len).decode()
+        dtype_code, ndim = struct.unpack("<II", read_exact(8))
+        if ndim > 16:
+            raise IOError(f"bad SCT header: ndim={ndim}")
+        if dtype_code not in CODE_DTYPES:
+            raise IOError(f"bad SCT header: dtype code {dtype_code}")
+        dims = (struct.unpack(f"<{ndim}Q", read_exact(8 * ndim))
+                if ndim else ())
+        (nbytes,) = struct.unpack("<Q", read_exact(8))
         cols.append([name, CODE_DTYPES[dtype_code], tuple(dims), 0, nbytes])
     off = f.tell()
     for c in cols:

@@ -103,20 +103,31 @@ def replay_add(buf: ReplayState, transition: dict,
 
 
 def replay_add_batch(buf: ReplayState, transitions: dict,
-                     priority: Optional[jnp.ndarray] = None) -> ReplayState:
+                     priority: Optional[jnp.ndarray] = None,
+                     errors: Optional[jnp.ndarray] = None,
+                     error_clip: float = 100.0) -> ReplayState:
     """Store a leading-axis batch of transitions at consecutive ring slots.
 
     TPU-native extension for synchronous parallel actors (the reference
     ingests actor buffers transition-by-transition under a lock,
     ``distributed_per_sac.py:44-57``); one scatter stores the whole batch.
+    Priorities follow ``replay_add``'s rules: explicit ``priority`` wins,
+    else per-transition ``errors`` -> ``min((|e|+eps)^alpha, clip)``, else
+    the max current priority (clip when the buffer is untouched).
     """
     B = next(iter(transitions.values())).shape[0]
     idx = (buf.cntr + jnp.arange(B)) % buf.size
     data = {k: v.at[idx].set(jnp.asarray(transitions[k], v.dtype))
             for k, v in buf.data.items()}
     if priority is None:
-        pmax = jnp.max(buf.priority)
-        priority = jnp.full((B,), jnp.where(pmax == 0.0, 100.0, pmax))
+        if errors is None:
+            pmax = jnp.max(buf.priority)
+            priority = jnp.full((B,), jnp.where(pmax == 0.0, error_clip,
+                                                pmax))
+        else:
+            priority = jnp.minimum(
+                (jnp.abs(jnp.asarray(errors, jnp.float32))
+                 + PER_EPSILON) ** PER_ALPHA, error_clip)
     else:
         priority = jnp.broadcast_to(jnp.asarray(priority, jnp.float32), (B,))
     return ReplayState(

@@ -169,6 +169,21 @@ class TestSolver:
                / np.linalg.norm(np.asarray(V)))
         assert rel < 0.12
 
+    def test_planes_chi2_matches_einsum(self, problem, rng):
+        """The planes-major line-search objective equals the einsum
+        formulation sum|V - predict|^2 on random operands."""
+        K, N, Tc = 3, 6, 4
+        B = N * (N - 1) // 2
+        cfg = solver.SolverConfig(n_stations=N, n_dirs=K)
+        J = jnp.asarray(rng.standard_normal((K, 2 * N, 2, 2)), jnp.float32)
+        V5 = jnp.asarray(rng.standard_normal((Tc, B, 2, 2, 2)), jnp.float32)
+        C5 = jnp.asarray(rng.standard_normal((K, Tc, B, 2, 2, 2)),
+                         jnp.float32)
+        r = V5 - solver.predict_vis_sr(J, C5, N)
+        ref = float(jnp.sum(r * r))
+        got = float(solver._chi2_planes(J, V5, C5, cfg))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
     def test_host_segmented_matches_fused(self, problem):
         """solve_admm_host (bounded dispatches, lbfgs_resume segments) walks
         the same trajectory as the fused solve_admm: same J/Z/residual to

@@ -36,9 +36,7 @@ def bench_device(size, batch, iters, obs_dim=128, n_actions=4):
     trs = {k: jnp.zeros((size,) + shape, dtype)
            for k, (shape, dtype) in spec.items()}
     errors = jax.random.uniform(jax.random.PRNGKey(0), (size,))
-    pri = jnp.minimum((jnp.abs(errors) + rp.PER_EPSILON) ** rp.PER_ALPHA,
-                      100.0)
-    buf = jax.jit(rp.replay_add_batch)(buf, trs, priority=pri)
+    buf = jax.jit(rp.replay_add_batch)(buf, trs, errors=errors)
     jax.block_until_ready(buf.priority)
 
     @jax.jit
