@@ -287,7 +287,10 @@ def _py_parse_header(f):
         (name_len,) = struct.unpack("<I", read_exact(4))
         if name_len > 4096:
             raise IOError(f"bad SCT header: name_len={name_len}")
-        name = read_exact(name_len).decode()
+        try:
+            name = read_exact(name_len).decode()
+        except UnicodeDecodeError as e:
+            raise IOError(f"bad SCT header: undecodable name ({e})")
         dtype_code, ndim = struct.unpack("<II", read_exact(8))
         if ndim > 16:
             raise IOError(f"bad SCT header: ndim={ndim}")

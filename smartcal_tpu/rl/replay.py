@@ -36,6 +36,17 @@ PER_BETA0 = 0.4
 PER_BETA_INCREMENT = 1e-4
 
 
+def priority_from_errors(errors, error_clip: float = 100.0):
+    """Store-time priority rule min((|e|+eps)^alpha, clip)
+    (``PER.store_transition``, enet_sac.py:237-243).  NOTE the deliberate
+    asymmetry with :func:`replay_update_priorities`, which follows the
+    reference's ``batch_update`` in clipping the ERROR before the exponent
+    (enet_sac.py:314-323)."""
+    errors = jnp.asarray(errors, jnp.float32)
+    return jnp.minimum((jnp.abs(errors) + PER_EPSILON) ** PER_ALPHA,
+                       error_clip)
+
+
 class ReplayState(NamedTuple):
     data: dict                 # field -> (size, ...) arrays
     cntr: jnp.ndarray          # () int32 total stores
@@ -92,8 +103,7 @@ def replay_add(buf: ReplayState, transition: dict,
             pmax = jnp.max(buf.priority)
             priority = jnp.where(pmax == 0.0, error_clip, pmax)
         else:
-            priority = jnp.minimum((jnp.abs(error) + PER_EPSILON) ** PER_ALPHA,
-                                   error_clip)
+            priority = priority_from_errors(error, error_clip)
     return ReplayState(
         data=data,
         cntr=buf.cntr + 1,
@@ -125,9 +135,7 @@ def replay_add_batch(buf: ReplayState, transitions: dict,
             priority = jnp.full((B,), jnp.where(pmax == 0.0, error_clip,
                                                 pmax))
         else:
-            priority = jnp.minimum(
-                (jnp.abs(jnp.asarray(errors, jnp.float32))
-                 + PER_EPSILON) ** PER_ALPHA, error_clip)
+            priority = priority_from_errors(errors, error_clip)
     else:
         priority = jnp.broadcast_to(jnp.asarray(priority, jnp.float32), (B,))
     return ReplayState(

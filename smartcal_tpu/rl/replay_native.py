@@ -58,7 +58,9 @@ class NativePER:
 
     # -- storing ----------------------------------------------------------
     def _priority_from_error(self, error) -> float:
-        # replay.replay_add: min((|e|+eps)^alpha, clip)
+        # pure-python twin of replay.priority_from_errors (a jnp call per
+        # store would defeat the host-side design; drift is caught by
+        # tests/test_native.py::test_native_per_priority_rules_and_checkpoint)
         return float(min((abs(float(error)) + PER_EPSILON) ** PER_ALPHA,
                          self.error_clip))
 

@@ -77,6 +77,8 @@ class SACState(NamedTuple):
     alpha: jnp.ndarray
     rho: jnp.ndarray            # hint-constraint dual variable
     learn_counter: jnp.ndarray
+    log_alpha: Any = None       # learned-temperature parameter + its Adam
+    alpha_opt: Any = None       # state (reference enet_sac.py:506-510)
 
 
 def _nets(cfg: SACConfig):
@@ -99,6 +101,9 @@ def sac_init(key, cfg: SACConfig) -> SACState:
     c2_params = critic.init(k2, obs, act)["params"]
     opt_a = optax.adam(cfg.lr_a)
     opt_c = optax.adam(cfg.lr_c)
+    # learned temperature: the reference optimizes log_alpha with its own
+    # Adam starting from 0 (alpha = 1), enet_sac.py:506-510
+    log_alpha = jnp.asarray(0.0, jnp.float32)
     return SACState(
         actor_params=actor_params,
         c1_params=c1_params,
@@ -108,9 +113,12 @@ def sac_init(key, cfg: SACConfig) -> SACState:
         actor_opt=opt_a.init(actor_params),
         c1_opt=opt_c.init(c1_params),
         c2_opt=opt_c.init(c2_params),
-        alpha=jnp.asarray(cfg.alpha, jnp.float32),
+        alpha=jnp.asarray(1.0 if cfg.learn_alpha else cfg.alpha,
+                          jnp.float32),
         rho=jnp.asarray(0.0, jnp.float32),
         learn_counter=jnp.asarray(0, jnp.int32),
+        log_alpha=log_alpha,
+        alpha_opt=optax.adam(cfg.alpha_lr).init(log_alpha),
     )
 
 
@@ -215,23 +223,32 @@ def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
 
         # --- dual/temperature updates every 10 learn calls (enet_sac.py:608-617)
         alpha, rho = st.alpha, st.rho
+        log_alpha, alpha_opt = st.log_alpha, st.alpha_opt
         if cfg.use_hint or cfg.learn_alpha:
+            opt_alpha = optax.adam(cfg.alpha_lr)
+
             def dual_update(_):
                 mu, ls = actor.apply({"params": actor_params}, s)
                 acts, lp = gaussian_sample(mu, ls, k_dual)
-                new_alpha = alpha
+                new_alpha, new_la, new_aopt = alpha, log_alpha, alpha_opt
                 new_rho = rho
                 if cfg.learn_alpha:
+                    # alpha_loss = -(log_alpha * (logp + target_entropy))
+                    # (enet_sac.py:608-613); its gradient wrt log_alpha is
+                    # the mean below — one Adam step, alpha = exp(log_alpha)
                     target_entropy = -float(cfg.n_actions)
-                    new_alpha = jnp.maximum(
-                        0.0, alpha + cfg.alpha_lr
-                        * jnp.mean(target_entropy + lp))
+                    g_la = -jnp.mean(lp + target_entropy)
+                    upd, new_aopt = opt_alpha.update(g_la, alpha_opt,
+                                                     log_alpha)
+                    new_la = optax.apply_updates(log_alpha, upd)
+                    new_alpha = jnp.exp(new_la)
                 if cfg.use_hint:
                     new_rho = rho + cfg.admm_rho * _hint_gap(cfg, acts, hint)
-                return new_alpha, new_rho
+                return new_alpha, new_rho, new_la, new_aopt
 
-            alpha, rho = lax.cond(st.learn_counter % 10 == 0, dual_update,
-                                  lambda _: (alpha, rho), operand=None)
+            alpha, rho, log_alpha, alpha_opt = lax.cond(
+                st.learn_counter % 10 == 0, dual_update,
+                lambda _: (alpha, rho, log_alpha, alpha_opt), operand=None)
 
         # --- PER priority refresh from TD error
         if cfg.prioritized:
@@ -249,6 +266,7 @@ def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
             actor_opt=actor_opt, c1_opt=c1_opt, c2_opt=c2_opt,
             alpha=alpha, rho=rho,
             learn_counter=st.learn_counter + 1,
+            log_alpha=log_alpha, alpha_opt=alpha_opt,
         )
         metrics = {"critic_loss": closs, "actor_loss": aloss,
                    "alpha": alpha, "rho": rho}
@@ -315,5 +333,13 @@ class SACAgent:
         prefix = prefix if prefix is not None else self.name_prefix
         with open(f"{prefix}sac_state.pkl", "rb") as f:
             host = pickle.load(f)
-        self.state = jax.tree_util.tree_map(jnp.asarray, host)
+        st = jax.tree_util.tree_map(jnp.asarray, host)
+        if st.log_alpha is None:
+            # checkpoint predates the optimizer-on-log-alpha state: resume
+            # the temperature from the saved alpha with a fresh Adam state
+            log_alpha = jnp.log(jnp.maximum(st.alpha, 1e-8))
+            st = st._replace(
+                log_alpha=log_alpha,
+                alpha_opt=optax.adam(self.cfg.alpha_lr).init(log_alpha))
+        self.state = st
         self.buffer = rp.load_replay(f"{prefix}replaymem_sac.pkl")

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from smartcal_tpu.rl import replay as rp
 from smartcal_tpu.rl import sac
@@ -137,12 +138,15 @@ def test_sac_hint_dual_update():
 
 
 def test_sac_learned_alpha():
-    """learn_alpha=True: alpha follows the reference's clamped gradient-
-    sign update alpha <- max(0, alpha + lr * mean(target_entropy + logpi))
-    every 10 learn calls (enet_sac.py:608-613) and never goes negative."""
+    """learn_alpha=True mirrors the reference's optimizer-on-log-alpha
+    (enet_sac.py:506-510, 608-613): log_alpha starts at 0 (alpha = 1), one
+    Adam step on alpha_loss = -(log_alpha * (logpi + target_entropy))
+    every 10 learn calls, alpha = exp(log_alpha) — always positive."""
     cfg = sac.SACConfig(obs_dim=6, n_actions=2, batch_size=4, mem_size=16,
                         learn_alpha=True, alpha=0.03, alpha_lr=0.1)
     st = sac.sac_init(jax.random.PRNGKey(0), cfg)
+    assert float(st.alpha) == 1.0            # exp(0), reference init
+    assert float(st.log_alpha) == 0.0
     buf = rp.replay_init(cfg.mem_size, _spec())
     rng = np.random.default_rng(2)
     for i in range(8):
@@ -152,15 +156,20 @@ def test_sac_learned_alpha():
     # counter 0 -> temperature update fires on the first learn call
     st2, buf, m = sac.learn(cfg, st, buf, jax.random.PRNGKey(3))
     assert float(st2.alpha) != float(st.alpha)
-    assert float(st2.alpha) >= 0.0
+    assert float(st2.alpha) > 0.0
+    np.testing.assert_allclose(float(st2.alpha),
+                               np.exp(float(st2.log_alpha)), rtol=1e-6)
+    # first Adam step moves log_alpha by ~lr in the gradient-sign direction
+    assert abs(float(st2.log_alpha)) == pytest.approx(cfg.alpha_lr, rel=0.2)
     # counters 1..9 -> alpha frozen between the every-10 updates
     st3, buf, _ = sac.learn(cfg, st2, buf, jax.random.PRNGKey(4))
     assert float(st3.alpha) == float(st2.alpha)
-    # ten learn calls later the update fires again; alpha stays clamped
+    # ten learn calls later the update fires again; alpha stays positive
     for k in range(8):
         st3, buf, _ = sac.learn(cfg, st3, buf, jax.random.PRNGKey(5 + k))
     st4, buf, _ = sac.learn(cfg, st3, buf, jax.random.PRNGKey(20))
-    assert float(st4.alpha) >= 0.0
+    assert float(st4.alpha) > 0.0
+    assert float(st4.log_alpha) != float(st3.log_alpha)
     assert int(st4.learn_counter) == 11
 
 
@@ -200,3 +209,31 @@ def test_agent_wrapper_roundtrip(tmp_path):
         np.testing.assert_allclose(np.asarray(p1), np.asarray(p2))
     finally:
         os.chdir(old)
+
+
+def test_old_checkpoint_migrates_learned_alpha(tmp_path):
+    """A pre-log_alpha SACState pickle (log_alpha/alpha_opt = None) loads
+    and resumes learn_alpha=True training instead of crashing in optax."""
+    import pickle
+
+    cfg = sac.SACConfig(obs_dim=6, n_actions=2, batch_size=4, mem_size=16,
+                        learn_alpha=True, alpha=0.5)
+    agent = sac.SACAgent(cfg, seed=0, name_prefix=str(tmp_path) + "/old_")
+    # simulate the old checkpoint: strip the temperature fields
+    old = jax.device_get(agent.state)._replace(log_alpha=None,
+                                               alpha_opt=None,
+                                               alpha=jnp.asarray(0.5))
+    with open(str(tmp_path) + "/old_sac_state.pkl", "wb") as f:
+        pickle.dump(old, f)
+    rp.save_replay(agent.buffer, str(tmp_path) + "/old_replaymem_sac.pkl")
+
+    agent.load_models()
+    np.testing.assert_allclose(float(agent.state.log_alpha), np.log(0.5),
+                               rtol=1e-6)
+    obs = np.ones(6, np.float32)
+    for i in range(6):
+        agent.store_transition(obs, np.zeros(2, np.float32), 0.1, obs,
+                               False, np.zeros(2))
+    agent.learn()                       # counter 0 -> alpha update fires
+    assert int(agent.state.learn_counter) == 1
+    assert float(agent.state.alpha) > 0.0

@@ -1,0 +1,108 @@
+"""Summarize + plot the demixing hint/no-hint learning-curve sweep.
+
+Reads ``results/demix_curves/{hint,nohint}_seed*.jsonl`` (one
+``event=episode`` record per episode, written by
+``smartcal_tpu.train.demix_sac --metrics``), writes
+``results/demix_curves/summary.json`` and ``learning_curves.png``.
+
+This is the demixing-workload counterpart of the elasticnet sweep
+(results/enet_sweep*), reproducing the reference's reward-curve
+comparison (demixing_rl/README.md:12-14 "hint agent shows increase in
+reward indicating learning", figures/calibration_rewards.png).
+"""
+
+import glob
+import json
+import os
+import re
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))), "results", "demix_curves")
+
+
+def load_runs():
+    runs = {}
+    for path in sorted(glob.glob(os.path.join(OUT, "*_seed*.jsonl"))):
+        m = re.match(r"(hint|nohint)_seed(\d+)", os.path.basename(path))
+        if not m:
+            continue
+        scores = []
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("event") == "episode":
+                    scores.append(float(rec["score"]))
+        if scores:
+            runs[(m.group(1), int(m.group(2)))] = np.asarray(scores)
+    return runs
+
+
+def moving_avg(x, w=20):
+    if len(x) < w:
+        return np.asarray([np.mean(x)])
+    c = np.cumsum(np.concatenate([[0.0], x]))
+    return (c[w:] - c[:-w]) / w
+
+
+def main():
+    runs = load_runs()
+    if not runs:
+        raise SystemExit(f"no runs found under {OUT}")
+    summary = []
+    for (mode, seed), sc in sorted(runs.items()):
+        ma = moving_avg(sc)
+        summary.append({
+            "mode": mode, "seed": seed, "episodes": len(sc),
+            "first20_mean": round(float(np.mean(sc[:20])), 4),
+            "last20_mean": round(float(np.mean(sc[-20:])), 4),
+            "max_moving_avg20": round(float(np.max(ma)), 4),
+        })
+    # cross-seed median of the final moving-average window, per mode
+    agg = {}
+    for mode in ("hint", "nohint"):
+        tails = [np.mean(sc[-20:]) for (m, _), sc in runs.items()
+                 if m == mode]
+        starts = [np.mean(sc[:20]) for (m, _), sc in runs.items()
+                  if m == mode]
+        if tails:
+            agg[mode] = {"median_last20": round(float(np.median(tails)), 4),
+                         "median_first20": round(float(np.median(starts)), 4),
+                         "n_runs": len(tails)}
+    with open(os.path.join(OUT, "summary.json"), "w") as f:
+        json.dump({"per_run": summary, "aggregate": agg}, f, indent=1)
+    print(json.dumps(agg))
+
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(8, 4.5))
+        colors = {"hint": "tab:blue", "nohint": "tab:orange"}
+        for (mode, seed), sc in sorted(runs.items()):
+            ma = moving_avg(sc)
+            ax.plot(np.arange(len(ma)), ma, color=colors[mode], alpha=0.35,
+                    lw=0.8)
+        for mode in colors:
+            group = [moving_avg(sc) for (m, _), sc in runs.items()
+                     if m == mode]
+            if group:
+                n = min(len(g) for g in group)
+                med = np.median(np.stack([g[:n] for g in group]), axis=0)
+                ax.plot(np.arange(n), med, color=colors[mode], lw=2.2,
+                        label=f"{mode} (median of {len(group)})")
+        ax.set_xlabel("episode")
+        ax.set_ylabel("score (20-episode moving average)")
+        ax.set_title("Demixing SAC: hint vs no-hint learning curves")
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(os.path.join(OUT, "learning_curves.png"), dpi=120)
+        print("wrote learning_curves.png")
+    except Exception as e:  # matplotlib optional
+        print(f"plot skipped: {type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    main()
