@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import pickle
 
+import jax
 import numpy as np
 
 from ..envs import DemixingEnv
@@ -126,6 +127,13 @@ def run_warmup_loop(env, agent, args, scores, to_flat, n_actions,
         agent.save_models()
         with open(f"{args.prefix}_scores.pkl", "wb") as fh:
             pickle.dump(scores, fh)
+        if (i + 1) % 20 == 0:
+            # bound live compiled executables: long hint-mode runs segfault
+            # the XLA CPU client near episode ~43 otherwise (the same
+            # deterministic crash the test suite hit in round 1 —
+            # tests/conftest.py clears per module for the same reason);
+            # costs one recompile pass per clear
+            jax.clear_caches()
     mlog.close()
     return scores
 
