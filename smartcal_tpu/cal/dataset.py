@@ -25,6 +25,7 @@ features cannot drift apart.
 from __future__ import annotations
 
 import math
+import os
 from typing import List, NamedTuple, Optional
 
 import jax.numpy as jnp
@@ -90,15 +91,144 @@ class CalSky(NamedTuple):
     rho: np.ndarray
 
 
+DATA_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "data")
+
+
+def ateam_paths():
+    """Checked-in real A-team catalogue (CasA/CygA/HerA/TauA/VirA, 533
+    sources in 5 clusters) — the reference's ``demixing/base.{sky,cluster,
+    rho}`` converted through skyio by ``tools/convert_ateam.py``."""
+    return (os.path.join(DATA_DIR, "ateam.sky"),
+            os.path.join(DATA_DIR, "ateam.cluster"),
+            os.path.join(DATA_DIR, "ateam.rho"))
+
+
+_ATEAM_CENTER_CACHE: list = []
+
+
+def _ateam_cluster_centers(K):
+    """Per-cluster (ra, dec) centers of the first K-1 fixture clusters,
+    from the unit-vector mean of member-source directions (the role of the
+    reference's measures-based ``get_cluster_centers``,
+    generate_data.py:789).  Cached: the checked-in fixture is immutable,
+    and the real-data hot path calls this per featurization."""
+    if not _ATEAM_CENTER_CACHE:
+        sky_p, clus_p, _ = ateam_paths()
+        S = skyio.parse_sky_model(sky_p)
+        clusters = skyio.parse_cluster_file(clus_p)
+        for _, names in clusters:
+            info = np.stack([S[nm] for nm in names])
+            ra = np.asarray(coords.hms_to_rad(info[:, 0], info[:, 1],
+                                              info[:, 2]))
+            dec = np.asarray([coords.dms_to_rad(*row[3:6]) for row in info])
+            x = np.mean(np.cos(dec) * np.cos(ra))
+            y = np.mean(np.cos(dec) * np.sin(ra))
+            z = np.mean(np.sin(dec))
+            _ATEAM_CENTER_CACHE.append(
+                (math.atan2(y, x) % (2 * math.pi),
+                 math.atan2(z, math.hypot(x, y))))
+    return _ATEAM_CENTER_CACHE[:K - 1]
+
+
+def _ateam_fixture_sky(ra0, dec0, lst0, f0, K, rho_path=None) -> CalSky:
+    """Real-A-team default sky: the fixture's first K-1 clusters plus a
+    unit point source at the phase center standing in for the LINC target
+    download (generate_data.py:760-776 concatenates the converted target
+    model with base.*; the download itself is out of scope, zero egress)."""
+    sky_p, clus_p, rho_p = ateam_paths()
+    full = skyio.build_sky_arrays(sky_p, clus_p, ra0, dec0)
+    keep = np.asarray(full.cluster) < K - 1
+    lmn = np.concatenate([np.asarray(full.lmn)[keep],
+                          [[0.0, 0.0, 0.0]]])
+    flux_coef = np.concatenate([np.asarray(full.flux_coef)[keep],
+                                [[0.0, 0.0, 0.0, 0.0]]])   # log(1.0) target
+    f0s = np.concatenate([np.asarray(full.f0)[keep], [f0]])
+    gauss = np.concatenate([np.asarray(full.gauss)[keep],
+                            [[0.0, 0.0, 0.0]]])
+    is_gauss = np.concatenate([np.asarray(full.is_gauss)[keep], [False]])
+    cluster = np.concatenate([np.asarray(full.cluster)[keep], [K - 1]])
+    sky = coherency.SkyArrays(lmn=lmn, flux_coef=flux_coef, f0=f0s,
+                              gauss=gauss, is_gauss=is_gauss,
+                              cluster=cluster, n_clusters=K)
+
+    sep, azl, ell = [], [], []
+    for ra, dec in _ateam_cluster_centers(K):
+        sep.append(math.degrees(float(
+            coords.angular_separation(ra0, dec0, ra, dec))))
+        az, el = coords.azel_from_radec(ra, dec, lst0, obs_mod.LOFAR_LAT)
+        azl.append(math.degrees(float(az)))
+        ell.append(math.degrees(float(el)))
+    az0, el0 = coords.azel_from_radec(ra0, dec0, lst0, obs_mod.LOFAR_LAT)
+    sep.append(0.0)
+    azl.append(math.degrees(float(az0)))
+    ell.append(math.degrees(float(el0)))
+
+    if rho_path is None:
+        rho_spec, _ = skyio.read_rho(rho_p, 5)
+        rho = np.concatenate([np.asarray(rho_spec)[:K - 1], [10.0]])
+    else:
+        # a user rho file may carry K rows (incl. target) or K-1
+        # outlier-only rows (fixture style: target rho defaults to 10.0)
+        rows = len(skyio._data_lines(rho_path))
+        if rows == K:
+            rho = np.asarray(skyio.read_rho(rho_path, K)[0])
+        elif rows == K - 1:
+            rho_spec, _ = skyio.read_rho(rho_path, K - 1)
+            rho = np.concatenate([np.asarray(rho_spec), [10.0]])
+        else:
+            raise ValueError(
+                f"rho file {rho_path} has {rows} rows; expected K={K} "
+                f"(incl. target) or K-1={K - 1} (outliers only)")
+    return CalSky(sky, np.asarray(sep, np.float32),
+                  np.asarray(azl, np.float32),
+                  np.asarray(ell, np.float32),
+                  np.asarray(rho, np.float32))
+
+
+def assemble_real_sky(target_skymodel, outdir, num_patches=1):
+    """The reference's real-data sky assembly (generate_data.py:760-776):
+    convert a user-supplied DP3/makesourcedb TARGET model and concatenate
+    it after the A-team fixture, target cluster(s) last.
+
+    Returns ``(sky_path, cluster_path, rho_path, K)`` ready for
+    :func:`get_info_from_dataset` — K = 5 A-team clusters + the target
+    patches.  (The LINC download that produces ``target_skymodel`` is out
+    of scope — zero egress; any DP3-format sky model works.)
+    """
+    at_sky, at_clus, at_rho = ateam_paths()
+    tmp_sky = os.path.join(outdir, "target.sky")
+    tmp_clus = os.path.join(outdir, "target.cluster")
+    tmp_rho = os.path.join(outdir, "target.rho")
+    n_target = skyio.convert_dp3_skymodel(
+        target_skymodel, tmp_sky, tmp_clus, tmp_rho, start_cluster=6,
+        num_patches=num_patches)
+    out = []
+    for base, tmp, name in ((at_sky, tmp_sky, "sky.txt"),
+                            (at_clus, tmp_clus, "cluster.txt"),
+                            (at_rho, tmp_rho, "admm_rho.txt")):
+        dst = os.path.join(outdir, name)
+        with open(dst, "w") as fh:
+            for src in (base, tmp):
+                with open(src) as sf:
+                    fh.write(sf.read())
+        out.append(dst)
+    return out[0], out[1], out[2], 5 + n_target
+
+
 def calibration_sky(ra0, dec0, t0, f0, K=6, sky_path=None,
-                    cluster_path=None, rho_path=None, seed=0) -> CalSky:
+                    cluster_path=None, rho_path=None, seed=0,
+                    synthetic=False) -> CalSky:
     """Build the calibration sky for a real pointing.
 
-    With ``sky_path``/``cluster_path`` the user supplies the target model
+    With ``sky_path``/``cluster_path`` the user supplies the full model
     (the role of the LINC download + base.sky concatenation,
-    generate_data.py:760-776); otherwise the stand-in is K-1 synthetic
-    A-team clusters + one point source at the phase center (the data are
-    normalized to unit scale first, so flux 1.0 is the right magnitude).
+    generate_data.py:760-776).  Otherwise the default is the REAL A-team
+    catalogue fixture (``ateam_paths``) with a unit point source standing
+    in for the target — matching the reference's real-data evaluation sky
+    up to the downloaded target model.  ``synthetic=True`` selects the
+    older synthesized stand-in (K-1 random A-team-like clusters), kept for
+    tests and for K > 6.
     """
     lst0 = obs_mod.OMEGA_EARTH * t0 % (2 * math.pi)
     if (sky_path is None) != (cluster_path is None):
@@ -133,6 +263,10 @@ def calibration_sky(ra0, dec0, t0, f0, K=6, sky_path=None,
                       np.asarray(rho, np.float32))
 
     n_ateam = K - 1
+    if (not synthetic and n_ateam <= 5
+            and os.path.exists(ateam_paths()[0])):
+        return _ateam_fixture_sky(ra0, dec0, lst0, f0, K, rho_path=rho_path)
+
     if n_ateam > len(obs_mod.ATEAM_DIRS):
         raise ValueError(f"K={K} exceeds the {len(obs_mod.ATEAM_DIRS)}"
                          " A-team clusters of the fallback sky")
@@ -178,14 +312,17 @@ def get_info_from_dataset(mslist: List[str], timesec: float, Ninf: int = 64,
                           rho_path: Optional[str] = None,
                           n_poly: int = 2, admm_iters: int = 10,
                           lbfgs_iters: int = 8, init_iters: int = 30,
-                          rng=None, workdir: str = "."):
+                          rng=None, workdir: str = ".",
+                          synthetic: bool = False):
     """Featurize a ``timesec``-second slice of a real (or MS-shaped
     synthetic) observation for the demixing recommender.
 
     Returns the K x (Ninf^2 + 8) float32 vector of
     generate_data.py:835-858.  The MSs may be casacore MSs (when
     python-casacore is installed) or npz stores — both go through
-    cal.ms_io transparently.
+    cal.ms_io transparently.  The calibration sky defaults to the real
+    A-team fixture (see :func:`calibration_sky`); ``synthetic=True``
+    selects the synthesized stand-in clusters instead.
     """
     rng = rng or np.random.default_rng(0)
     sub = ms_io.extract_dataset(mslist, timesec, Nf=Nf, rng=rng,
@@ -217,7 +354,7 @@ def get_info_from_dataset(mslist: List[str], timesec: float, Ninf: int = 64,
 
     cal = calibration_sky(info.ra0, info.dec0, info.t0, f0, K=K,
                           sky_path=sky_path, cluster_path=cluster_path,
-                          rho_path=rho_path)
+                          rho_path=rho_path, synthetic=synthetic)
     if cal.sky.n_clusters != K:
         # a user-supplied cluster file must match the trained model's K —
         # a silent override would only surface as an opaque Dense-kernel

@@ -299,7 +299,16 @@ def _py_parse_header(f):
         dims = (struct.unpack(f"<{ndim}Q", read_exact(8 * ndim))
                 if ndim else ())
         (nbytes,) = struct.unpack("<Q", read_exact(8))
-        cols.append([name, CODE_DTYPES[dtype_code], tuple(dims), 0, nbytes])
+        dtype = CODE_DTYPES[dtype_code]
+        itemsize = np.dtype(dtype).itemsize
+        count = 1
+        for d in dims:
+            count *= d
+        if nbytes % itemsize or count * itemsize != nbytes:
+            raise IOError(
+                f"bad SCT header: column {name} dims {dims} x itemsize "
+                f"{itemsize} disagree with nbytes={nbytes}")
+        cols.append([name, dtype, tuple(dims), 0, nbytes])
     off = f.tell()
     for c in cols:
         off = (off + 63) // 64 * 64

@@ -107,8 +107,20 @@ class NativePER:
         u = rng.random(batch_size) if uniforms is None else \
             np.asarray(uniforms, np.float64)
         idx, pri = self.tree.sample_stratified(batch_size, u)
+        # A stratified walk can overshoot into the unfilled suffix of a
+        # partially-filled buffer (fp rounding in the tree descent), landing
+        # on a zero-priority leaf whose probs=0 would make the IS weight
+        # infinite and poison the loss with NaNs.  Clamp the leaf into the
+        # filled prefix and re-read its true priority, then floor priorities
+        # so probs stays strictly positive even if total is degenerate.
+        filled = self.filled
+        over = idx >= filled
+        if np.any(over) or np.any(pri <= 0.0):
+            idx = np.minimum(idx, max(filled - 1, 0))
+            leaves = self.tree.leaves()
+            pri = leaves[idx]
         total = self.tree.total()
-        probs = pri / total
+        probs = np.maximum(pri / max(total, 1e-300), 1e-12)
         is_w = (batch_size * probs) ** (-self.beta)
         is_w = is_w / np.max(is_w)
         batch = {k: v[idx] for k, v in self.data.items()}

@@ -30,6 +30,7 @@ from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 
@@ -56,13 +57,39 @@ class SACConfig:
     hint_distance: str = "mse"    # 'mse' | 'kld'
     learn_alpha: bool = False
     alpha_lr: float = 1e-4
+    # 'reference': clamped SGD directly on alpha, exactly the reference rule
+    #   alpha = max(0, alpha + alpha_lr*mean(target_entropy + logpi))
+    #   starting from the ``alpha`` argument (enet_sac.py:500,613).
+    # 'sac_v2': Adam on log_alpha with alpha = exp(log_alpha) starting at 1
+    #   — a DELIBERATE DEVIATION from the reference (no log_alpha/Adam exists
+    #   there); kept because it cannot collapse to alpha=0 and is the
+    #   standard Haarnoja et al. v2 formulation.
+    alpha_rule: str = "reference"
     prioritized: bool = False
     error_clip: float = 100.0     # PER absolute_error_upper (enet_sac.py:212)
+    # PER backend: 'hbm' = fused device prefix-sum (sample + learn +
+    # priority update in ONE jitted step) — the measured end-to-end winner
+    # (results/per_bench.json e2e section; the host C++ tree wins the
+    # standalone sample+update microbenchmark but loses the full train
+    # step to its host<->device hops).  'native' = host C++ sum tree +
+    # learn_from_batch, for payloads too large for HBM or host-driven
+    # ingestion loops (the distributed learner).
+    replay_backend: str = "hbm"
     # dict-obs (radio) variants: when img_shape is set, obs_dim must equal
     # H*W + meta_dim and the CNN+metadata towers are used (calib_sac.py,
     # demix_sac.py); use_image=False drops the CNN branch (demixing_fuzzy)
     img_shape: Optional[Tuple[int, int]] = None
     use_image: bool = True
+
+    def __post_init__(self):
+        if self.alpha_rule not in ("reference", "sac_v2"):
+            raise ValueError(
+                f"alpha_rule must be 'reference' or 'sac_v2', got "
+                f"{self.alpha_rule!r}")
+        if self.replay_backend not in ("hbm", "native"):
+            raise ValueError(
+                f"replay_backend must be 'hbm' or 'native', got "
+                f"{self.replay_backend!r}")
 
 
 class SACState(NamedTuple):
@@ -101,9 +128,16 @@ def sac_init(key, cfg: SACConfig) -> SACState:
     c2_params = critic.init(k2, obs, act)["params"]
     opt_a = optax.adam(cfg.lr_a)
     opt_c = optax.adam(cfg.lr_c)
-    # learned temperature: the reference optimizes log_alpha with its own
-    # Adam starting from 0 (alpha = 1), enet_sac.py:506-510
+    # learned temperature: under the 'reference' rule alpha itself is the
+    # optimized variable, initialized from the alpha argument
+    # (enet_sac.py:500) and updated by clamped SGD (enet_sac.py:613); the
+    # log_alpha/Adam pair below is only used by the 'sac_v2' deviation,
+    # where alpha starts at exp(0) = 1.
     log_alpha = jnp.asarray(0.0, jnp.float32)
+    if cfg.learn_alpha and cfg.alpha_rule == "sac_v2":
+        alpha0 = 1.0
+    else:
+        alpha0 = cfg.alpha
     return SACState(
         actor_params=actor_params,
         c1_params=c1_params,
@@ -113,8 +147,7 @@ def sac_init(key, cfg: SACConfig) -> SACState:
         actor_opt=opt_a.init(actor_params),
         c1_opt=opt_c.init(c1_params),
         c2_opt=opt_c.init(c2_params),
-        alpha=jnp.asarray(1.0 if cfg.learn_alpha else cfg.alpha,
-                          jnp.float32),
+        alpha=jnp.asarray(alpha0, jnp.float32),
         rho=jnp.asarray(0.0, jnp.float32),
         learn_counter=jnp.asarray(0, jnp.int32),
         log_alpha=log_alpha,
@@ -148,6 +181,131 @@ def _hint_gap(cfg: SACConfig, actions, hints):
     return jnp.maximum(0.0, d - cfg.hint_threshold) ** 2
 
 
+def learn_from_batch(cfg: SACConfig, st: SACState, batch: dict, is_w,
+                     key) -> Tuple[SACState, dict]:
+    """The SAC learn core on an ALREADY-SAMPLED batch.
+
+    The integration point for external replay backends (the host-side
+    native sum tree of :mod:`smartcal_tpu.rl.replay_native`, the
+    distributed learner's ingestion stream): callers sample wherever the
+    priorities live, run this jitted core, then push ``metrics['td']``
+    (|Q1 - y| per transition) back into their priority store.
+    :func:`learn` wraps it with the fused HBM replay sample/update.
+    """
+    actor, critic = _nets(cfg)
+    opt_a = optax.adam(cfg.lr_a)
+    opt_c = optax.adam(cfg.lr_c)
+    k_next, k_pi, k_dual = jax.random.split(key, 3)
+    s = batch["state"]
+    a = batch["action"]
+    r = cfg.reward_scale * batch["reward"][:, None]
+    s2 = batch["new_state"]
+    done = batch["done"][:, None]
+    hint = batch["hint"]
+
+    # --- target value (enet_sac.py:569-575)
+    mu2, ls2 = actor.apply({"params": st.actor_params}, s2)
+    a2, lp2 = gaussian_sample(mu2, ls2, k_next)
+    q1t = critic.apply({"params": st.t1_params}, s2, a2)
+    q2t = critic.apply({"params": st.t2_params}, s2, a2)
+    min_t = jnp.minimum(q1t, q2t) - st.alpha * lp2
+    y = r + cfg.gamma * jnp.where(done, 0.0, min_t)
+    y = lax.stop_gradient(y)
+
+    # --- critic update (enet_sac.py:577-587)
+    def critic_loss(c1p, c2p):
+        q1 = critic.apply({"params": c1p}, s, a)
+        q2 = critic.apply({"params": c2p}, s, a)
+        if cfg.prioritized:
+            l = rp.per_mse(q1, y, is_w) + rp.per_mse(q2, y, is_w)
+        else:
+            l = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+        return l, (q1, q2)
+
+    (closs, (q1, q2)), (g1, g2) = jax.value_and_grad(
+        critic_loss, argnums=(0, 1), has_aux=True)(st.c1_params,
+                                                   st.c2_params)
+    u1, c1_opt = opt_c.update(g1, st.c1_opt, st.c1_params)
+    c1_params = optax.apply_updates(st.c1_params, u1)
+    u2, c2_opt = opt_c.update(g2, st.c2_opt, st.c2_params)
+    c2_params = optax.apply_updates(st.c2_params, u2)
+
+    # --- actor update with hint ADMM penalty (enet_sac.py:589-605)
+    def actor_loss(ap):
+        mu, ls = actor.apply({"params": ap}, s)
+        acts, lp = gaussian_sample(mu, ls, k_pi)
+        qa = jnp.minimum(critic.apply({"params": c1_params}, s, acts),
+                         critic.apply({"params": c2_params}, s, acts))
+        loss = jnp.mean(st.alpha * lp - qa)
+        if cfg.use_hint:
+            gfun = _hint_gap(cfg, acts, hint)
+            loss = (loss + 0.5 * cfg.admm_rho * gfun * gfun
+                    + st.rho * gfun)
+        return loss
+
+    aloss, ga = jax.value_and_grad(actor_loss)(st.actor_params)
+    ua, actor_opt = opt_a.update(ga, st.actor_opt, st.actor_params)
+    actor_params = optax.apply_updates(st.actor_params, ua)
+
+    # --- dual/temperature updates every 10 learn calls (enet_sac.py:608-617)
+    alpha, rho = st.alpha, st.rho
+    log_alpha, alpha_opt = st.log_alpha, st.alpha_opt
+    if cfg.use_hint or cfg.learn_alpha:
+        opt_alpha = optax.adam(cfg.alpha_lr)
+
+        def dual_update(_):
+            mu, ls = actor.apply({"params": actor_params}, s)
+            acts, lp = gaussian_sample(mu, ls, k_dual)
+            new_alpha, new_la, new_aopt = alpha, log_alpha, alpha_opt
+            new_rho = rho
+            if cfg.learn_alpha:
+                target_entropy = -float(cfg.n_actions)
+                if cfg.alpha_rule == "reference":
+                    # the reference's clamped SGD directly on alpha:
+                    # alpha = max(0, alpha + lr*mean(target_entropy -
+                    # (-logpi))) (enet_sac.py:613)
+                    new_alpha = jnp.maximum(
+                        0.0, alpha + cfg.alpha_lr
+                        * jnp.mean(target_entropy + lp))
+                else:
+                    # 'sac_v2' deviation: Adam on log_alpha against
+                    # alpha_loss = -(log_alpha*(logp + target_entropy)),
+                    # alpha = exp(log_alpha) — not in the reference
+                    g_la = -jnp.mean(lp + target_entropy)
+                    upd, new_aopt = opt_alpha.update(g_la, alpha_opt,
+                                                     log_alpha)
+                    new_la = optax.apply_updates(log_alpha, upd)
+                    new_alpha = jnp.exp(new_la)
+            if cfg.use_hint:
+                new_rho = rho + cfg.admm_rho * _hint_gap(cfg, acts, hint)
+            return new_alpha, new_rho, new_la, new_aopt
+
+        alpha, rho, log_alpha, alpha_opt = lax.cond(
+            st.learn_counter % 10 == 0, dual_update,
+            lambda _: (alpha, rho, log_alpha, alpha_opt), operand=None)
+
+    # --- TD error (the PER priority signal; callers with external
+    # priority stores consume metrics['td'])
+    td = jnp.abs(q1 - y).squeeze(-1)
+
+    # --- soft target update (enet_sac.py:523-542)
+    lerp = lambda t, o: jax.tree_util.tree_map(
+        lambda a_, b_: cfg.tau * a_ + (1.0 - cfg.tau) * b_, o, t)
+    st_new = SACState(
+        actor_params=actor_params,
+        c1_params=c1_params, c2_params=c2_params,
+        t1_params=lerp(st.t1_params, c1_params),
+        t2_params=lerp(st.t2_params, c2_params),
+        actor_opt=actor_opt, c1_opt=c1_opt, c2_opt=c2_opt,
+        alpha=alpha, rho=rho,
+        learn_counter=st.learn_counter + 1,
+        log_alpha=log_alpha, alpha_opt=alpha_opt,
+    )
+    metrics = {"critic_loss": closs, "actor_loss": aloss,
+               "alpha": alpha, "rho": rho, "td": td}
+    return st_new, metrics
+
+
 def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
           key) -> Tuple[SACState, rp.ReplayState, dict]:
     """One SAC learn step, sampling from (and possibly re-prioritising) ``buf``.
@@ -155,13 +313,10 @@ def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
     No-op (identity state) while the buffer holds fewer than ``batch_size``
     transitions, so it can sit unconditionally inside a scanned train loop.
     """
-    actor, critic = _nets(cfg)
-    opt_a = optax.adam(cfg.lr_a)
-    opt_c = optax.adam(cfg.lr_c)
 
     def do_learn(args):
         st, buf, key = args
-        k_samp, k_next, k_pi, k_dual = jax.random.split(key, 4)
+        k_samp, k_core = jax.random.split(key)
 
         if cfg.prioritized:
             batch, idx, is_w, buf2 = rp.replay_sample_per(
@@ -170,111 +325,16 @@ def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
             batch, idx = rp.replay_sample_uniform(buf, k_samp, cfg.batch_size)
             is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
 
-        s = batch["state"]
-        a = batch["action"]
-        r = cfg.reward_scale * batch["reward"][:, None]
-        s2 = batch["new_state"]
-        done = batch["done"][:, None]
-        hint = batch["hint"]
-
-        # --- target value (enet_sac.py:569-575)
-        mu2, ls2 = actor.apply({"params": st.actor_params}, s2)
-        a2, lp2 = gaussian_sample(mu2, ls2, k_next)
-        q1t = critic.apply({"params": st.t1_params}, s2, a2)
-        q2t = critic.apply({"params": st.t2_params}, s2, a2)
-        min_t = jnp.minimum(q1t, q2t) - st.alpha * lp2
-        y = r + cfg.gamma * jnp.where(done, 0.0, min_t)
-        y = lax.stop_gradient(y)
-
-        # --- critic update (enet_sac.py:577-587)
-        def critic_loss(c1p, c2p):
-            q1 = critic.apply({"params": c1p}, s, a)
-            q2 = critic.apply({"params": c2p}, s, a)
-            if cfg.prioritized:
-                l = rp.per_mse(q1, y, is_w) + rp.per_mse(q2, y, is_w)
-            else:
-                l = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
-            return l, (q1, q2)
-
-        (closs, (q1, q2)), (g1, g2) = jax.value_and_grad(
-            critic_loss, argnums=(0, 1), has_aux=True)(st.c1_params,
-                                                       st.c2_params)
-        u1, c1_opt = opt_c.update(g1, st.c1_opt, st.c1_params)
-        c1_params = optax.apply_updates(st.c1_params, u1)
-        u2, c2_opt = opt_c.update(g2, st.c2_opt, st.c2_params)
-        c2_params = optax.apply_updates(st.c2_params, u2)
-
-        # --- actor update with hint ADMM penalty (enet_sac.py:589-605)
-        def actor_loss(ap):
-            mu, ls = actor.apply({"params": ap}, s)
-            acts, lp = gaussian_sample(mu, ls, k_pi)
-            qa = jnp.minimum(critic.apply({"params": c1_params}, s, acts),
-                             critic.apply({"params": c2_params}, s, acts))
-            loss = jnp.mean(st.alpha * lp - qa)
-            if cfg.use_hint:
-                gfun = _hint_gap(cfg, acts, hint)
-                loss = (loss + 0.5 * cfg.admm_rho * gfun * gfun
-                        + st.rho * gfun)
-            return loss
-
-        aloss, ga = jax.value_and_grad(actor_loss)(st.actor_params)
-        ua, actor_opt = opt_a.update(ga, st.actor_opt, st.actor_params)
-        actor_params = optax.apply_updates(st.actor_params, ua)
-
-        # --- dual/temperature updates every 10 learn calls (enet_sac.py:608-617)
-        alpha, rho = st.alpha, st.rho
-        log_alpha, alpha_opt = st.log_alpha, st.alpha_opt
-        if cfg.use_hint or cfg.learn_alpha:
-            opt_alpha = optax.adam(cfg.alpha_lr)
-
-            def dual_update(_):
-                mu, ls = actor.apply({"params": actor_params}, s)
-                acts, lp = gaussian_sample(mu, ls, k_dual)
-                new_alpha, new_la, new_aopt = alpha, log_alpha, alpha_opt
-                new_rho = rho
-                if cfg.learn_alpha:
-                    # alpha_loss = -(log_alpha * (logp + target_entropy))
-                    # (enet_sac.py:608-613); its gradient wrt log_alpha is
-                    # the mean below — one Adam step, alpha = exp(log_alpha)
-                    target_entropy = -float(cfg.n_actions)
-                    g_la = -jnp.mean(lp + target_entropy)
-                    upd, new_aopt = opt_alpha.update(g_la, alpha_opt,
-                                                     log_alpha)
-                    new_la = optax.apply_updates(log_alpha, upd)
-                    new_alpha = jnp.exp(new_la)
-                if cfg.use_hint:
-                    new_rho = rho + cfg.admm_rho * _hint_gap(cfg, acts, hint)
-                return new_alpha, new_rho, new_la, new_aopt
-
-            alpha, rho, log_alpha, alpha_opt = lax.cond(
-                st.learn_counter % 10 == 0, dual_update,
-                lambda _: (alpha, rho, log_alpha, alpha_opt), operand=None)
-
-        # --- PER priority refresh from TD error
+        st_new, metrics = learn_from_batch(cfg, st, batch, is_w, k_core)
         if cfg.prioritized:
-            td = jnp.abs(q1 - y).squeeze(-1)
-            buf2 = rp.replay_update_priorities(buf2, idx, td, cfg.error_clip)
-
-        # --- soft target update (enet_sac.py:523-542)
-        lerp = lambda t, o: jax.tree_util.tree_map(
-            lambda a_, b_: cfg.tau * a_ + (1.0 - cfg.tau) * b_, o, t)
-        st_new = SACState(
-            actor_params=actor_params,
-            c1_params=c1_params, c2_params=c2_params,
-            t1_params=lerp(st.t1_params, c1_params),
-            t2_params=lerp(st.t2_params, c2_params),
-            actor_opt=actor_opt, c1_opt=c1_opt, c2_opt=c2_opt,
-            alpha=alpha, rho=rho,
-            learn_counter=st.learn_counter + 1,
-            log_alpha=log_alpha, alpha_opt=alpha_opt,
-        )
-        metrics = {"critic_loss": closs, "actor_loss": aloss,
-                   "alpha": alpha, "rho": rho}
-        return st_new, buf2, metrics
+            buf2 = rp.replay_update_priorities(buf2, idx, metrics["td"],
+                                               cfg.error_clip)
+        return st_new, buf2, {k: v for k, v in metrics.items() if k != "td"}
 
     def no_learn(args):
         st, buf, _ = args
-        zeros = {"critic_loss": jnp.asarray(0.0), "actor_loss": jnp.asarray(0.0),
+        zeros = {"critic_loss": jnp.asarray(0.0),
+                 "actor_loss": jnp.asarray(0.0),
                  "alpha": st.alpha, "rho": st.rho}
         return st, buf, zeros
 
@@ -293,16 +353,27 @@ class SACAgent:
         self.key = jax.random.PRNGKey(seed)
         self.key, k0 = jax.random.split(self.key)
         self.state = sac_init(k0, cfg)
-        self.buffer = rp.replay_init(
-            cfg.mem_size, rp.transition_spec(cfg.obs_dim, cfg.n_actions))
+        self.native = cfg.prioritized and cfg.replay_backend == "native"
+        spec = rp.transition_spec(cfg.obs_dim, cfg.n_actions)
+        if self.native:
+            from .replay_native import NativePER
+
+            self.buffer = NativePER(cfg.mem_size, spec,
+                                    error_clip=cfg.error_clip)
+            self._rng = np.random.default_rng(seed + 1)
+            self._core = jax.jit(
+                lambda st, b, w, k: learn_from_batch(cfg, st, b, w, k))
+        else:
+            self.buffer = rp.replay_init(cfg.mem_size, spec)
+            self._learn = jax.jit(
+                lambda st, buf, key: learn(cfg, st, buf, key))
+            self._add = jax.jit(
+                lambda buf, tr: rp.replay_add(buf, tr,
+                                              priority=None if cfg.prioritized
+                                              else jnp.asarray(1.0)))
         self.name_prefix = name_prefix
         self._choose = jax.jit(
             lambda st, obs, key: choose_action(cfg, st, obs, key))
-        self._learn = jax.jit(lambda st, buf, key: learn(cfg, st, buf, key))
-        self._add = jax.jit(
-            lambda buf, tr: rp.replay_add(buf, tr,
-                                          priority=None if cfg.prioritized
-                                          else jnp.asarray(1.0)))
         self.last_metrics = {}
 
     def _next_key(self):
@@ -316,18 +387,40 @@ class SACAgent:
     def store_transition(self, state, action, reward, state_, done, hint):
         tr = {"state": state, "action": action, "reward": reward,
               "new_state": state_, "done": done, "hint": hint}
-        self.buffer = self._add(self.buffer, tr)
+        if self.native:
+            self.buffer.store(tr)      # max-priority init (enet_sac.py:63-64)
+        else:
+            self.buffer = self._add(self.buffer, tr)
 
     def learn(self):
-        self.state, self.buffer, m = self._learn(self.state, self.buffer,
-                                                 self._next_key())
+        if self.native:
+            if not self.buffer.ready(self.cfg.batch_size):
+                # same metrics contract as the HBM path's no_learn branch
+                self.last_metrics = {
+                    "critic_loss": jnp.asarray(0.0),
+                    "actor_loss": jnp.asarray(0.0),
+                    "alpha": self.state.alpha, "rho": self.state.rho}
+                return
+            batch, idx, is_w = self.buffer.sample(self.cfg.batch_size,
+                                                  self._rng)
+            self.state, m = self._core(
+                self.state, {k: jnp.asarray(v) for k, v in batch.items()},
+                jnp.asarray(is_w), self._next_key())
+            self.buffer.update_priorities(idx, jax.device_get(m["td"]))
+            m = {k: v for k, v in m.items() if k != "td"}
+        else:
+            self.state, self.buffer, m = self._learn(
+                self.state, self.buffer, self._next_key())
         self.last_metrics = m
 
     def save_models(self, prefix: Optional[str] = None):
         prefix = prefix if prefix is not None else self.name_prefix
         with open(f"{prefix}sac_state.pkl", "wb") as f:
             pickle.dump(jax.device_get(self.state), f)
-        rp.save_replay(self.buffer, f"{prefix}replaymem_sac.pkl")
+        if self.native:
+            self.buffer.save(f"{prefix}replaymem_sac.pkl")
+        else:
+            rp.save_replay(self.buffer, f"{prefix}replaymem_sac.pkl")
 
     def load_models(self, prefix: Optional[str] = None):
         prefix = prefix if prefix is not None else self.name_prefix
@@ -342,4 +435,9 @@ class SACAgent:
                 log_alpha=log_alpha,
                 alpha_opt=optax.adam(self.cfg.alpha_lr).init(log_alpha))
         self.state = st
-        self.buffer = rp.load_replay(f"{prefix}replaymem_sac.pkl")
+        if self.native:
+            from .replay_native import NativePER
+
+            self.buffer = NativePER.load(f"{prefix}replaymem_sac.pkl")
+        else:
+            self.buffer = rp.load_replay(f"{prefix}replaymem_sac.pkl")

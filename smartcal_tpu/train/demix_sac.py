@@ -39,6 +39,10 @@ def main(argv=None):
     p.add_argument("--stations", type=int, default=14)
     p.add_argument("--npix", type=int, default=128)
     p.add_argument("--small", action="store_true")
+    p.add_argument("--medium", action="store_true",
+                   help="N=stations but thinner time/freq axes + lighter "
+                   "inner solves — the learning dynamics of the default "
+                   "config at ~8x less compute (CPU-tractable sweeps)")
     p.add_argument("--load", action="store_true")
     p.add_argument("--prefix", type=str, default="demix_sac")
     p.add_argument("--metrics", type=str, default=None,
@@ -46,13 +50,7 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
-    if args.small:
-        backend = RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
-                               admm_iters=30, lbfgs_iters=3, init_iters=5,
-                               npix=32)
-    else:
-        backend = RadioBackend(n_stations=args.stations, admm_iters=30,
-                               npix=args.npix)
+    backend = make_backend(args)
     env = DemixingEnv(K=args.K, provide_hint=args.use_hint,
                       provide_influence=args.provide_influence,
                       backend=backend, seed=args.seed)
@@ -85,6 +83,33 @@ def main(argv=None):
     return run_warmup_loop(
         env, agent, args, scores, to_flat, n_actions=args.K,
         scale_reward=lambda r: r * 10 if r > 0 else r, rng=rng)
+
+
+def make_backend(args):
+    """Backend-size tiers shared by the demixing-family drivers (SAC,
+    TD3, fuzzy): ``--small`` (test-speed), ``--medium`` (N=stations with
+    thinner time/freq axes + lighter inner solves — the same learning
+    dynamics at ~8x less compute, for CPU-tractable sweeps), default
+    (reference-like N/Nf/T)."""
+    if getattr(args, "small", False):
+        return RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                            admm_iters=30, lbfgs_iters=3, init_iters=5,
+                            npix=32)
+    if getattr(args, "medium", False):
+        return RadioBackend(n_stations=args.stations, n_freqs=2,
+                            n_times=10, tdelta=5, admm_iters=30,
+                            lbfgs_iters=4, init_iters=10, npix=args.npix)
+    return RadioBackend(n_stations=args.stations, admm_iters=30,
+                        npix=args.npix)
+
+
+def _clear_every(default=20):
+    import os
+
+    try:
+        return max(1, int(os.environ.get("SMARTCAL_CLEAR_EVERY", default)))
+    except ValueError:
+        return default
 
 
 def run_warmup_loop(env, agent, args, scores, to_flat, n_actions,
@@ -127,12 +152,15 @@ def run_warmup_loop(env, agent, args, scores, to_flat, n_actions,
         agent.save_models()
         with open(f"{args.prefix}_scores.pkl", "wb") as fh:
             pickle.dump(scores, fh)
-        if (i + 1) % 20 == 0:
+        if (i + 1) % _clear_every() == 0:
             # bound live compiled executables: long hint-mode runs segfault
             # the XLA CPU client near episode ~43 otherwise (the same
             # deterministic crash the test suite hit in round 1 —
             # tests/conftest.py clears per module for the same reason);
-            # costs one recompile pass per clear
+            # costs one recompile pass per clear.  SMARTCAL_CLEAR_EVERY
+            # widens the interval for long sweeps where the recompile tax
+            # dominates (the crash rate scales with live-executable count,
+            # which stays bounded either way).
             jax.clear_caches()
     mlog.close()
     return scores

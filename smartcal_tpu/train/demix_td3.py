@@ -1,0 +1,99 @@
+"""Demixing (direction selection) TD3 training driver.
+
+Mirrors ``demixing_rl/main_td3.py`` + ``demix_td3.py``: CNN+metadata TD3
+with prioritized replay (always on in the reference, demix_td3.py:381) and
+the full adaptive-rho ADMM hint loop in the actor update
+(demix_td3.py:547-600 — the enet_td3.py:310-361 machinery on the demixing
+env).  Reference hyperparameters (main_td3.py:18-20): gamma 0.99, batch 64,
+tau 0.005, mem 4096, lr_a/lr_c 1e-3, actor interval 2, warmup 200 steps,
+noise 0.1, admm_rho 0.1 (demix_td3.py:400).
+
+One deliberate repair: the reference driver constructs the agent with
+``n_actions=K-1`` (main_td3.py:18) while its own env consumes
+``action[K-1]`` as the max-ADMM-iterations channel (demixingenv.py:104-113)
+— an out-of-range read if ever stepped.  Here the agent emits the env's
+full K-dimensional action like the SAC driver does, and the TD3 warmup is
+the agent's own ``time_step < warmup`` phase (rl/td3.py:choose_action), so
+the driver loop never injects driver-level random actions.
+
+Usage:
+    python -m smartcal_tpu.train.demix_td3 --iteration 30 --seed 0
+        [--use_hint] [--provide_influence] [--small]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+
+import numpy as np
+
+from ..envs import DemixingEnv
+from ..rl import td3
+from ..rl.networks import flatten_obs
+from .demix_sac import make_backend, run_warmup_loop
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--iteration", type=int, default=30,
+                   help="max episodes (reference n_games=30)")
+    p.add_argument("--steps", type=int, default=7)
+    p.add_argument("--K", type=int, default=6)
+    p.add_argument("--warmup", type=int, default=200,
+                   help="agent warmup steps (pure noise actions)")
+    p.add_argument("--use_hint", action="store_true")
+    p.add_argument("--provide_influence", action="store_true")
+    p.add_argument("--stations", type=int, default=14)
+    p.add_argument("--npix", type=int, default=128)
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--medium", action="store_true",
+                   help="see demix_sac --medium")
+    p.add_argument("--load", action="store_true")
+    p.add_argument("--prefix", type=str, default="demix_td3")
+    p.add_argument("--metrics", type=str, default=None)
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--memory", type=int, default=4096)
+    args = p.parse_args(argv)
+
+    rng = np.random.default_rng(args.seed)
+    backend = make_backend(args)
+    env = DemixingEnv(K=args.K, provide_hint=args.use_hint,
+                      provide_influence=args.provide_influence,
+                      backend=backend, seed=args.seed)
+    npix = backend.npix
+    if args.provide_influence:
+        obs_dim = npix * npix + 3 * args.K + 2
+        img_shape = (npix, npix)
+    else:
+        obs_dim = 3 * args.K + 2
+        img_shape = None
+    agent_cfg = td3.TD3Config(
+        obs_dim=obs_dim, n_actions=args.K, gamma=0.99, tau=0.005,
+        batch_size=args.batch_size, mem_size=args.memory,
+        lr_a=1e-3, lr_c=1e-3,
+        update_actor_interval=2, warmup=args.warmup, noise=0.1,
+        use_hint=args.use_hint, admm_rho=0.1, prioritized=True,
+        error_clip=100.0, img_shape=img_shape)
+    agent = td3.TD3Agent(agent_cfg, seed=args.seed, name_prefix=args.prefix)
+    scores = []
+    if args.load:
+        agent.load_models()
+        with open(f"{args.prefix}_scores.pkl", "rb") as fh:
+            scores = pickle.load(fh)
+
+    def to_flat(o):
+        return (flatten_obs(o) if args.provide_influence
+                else np.asarray(o["metadata"], np.float32))
+
+    # the agent's own warmup phase supplies the exploration noise
+    # (td3.choose_action) — no driver-level random-action window
+    args.warmup = 0
+    return run_warmup_loop(
+        env, agent, args, scores, to_flat, n_actions=args.K,
+        scale_reward=lambda r: r, rng=rng)
+
+
+if __name__ == "__main__":
+    main()

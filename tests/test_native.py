@@ -323,3 +323,45 @@ def test_native_per_rejects_non_pow2():
 
     with pytest.raises(ValueError):
         NativePER(10, rp.transition_spec(2, 1))
+
+
+def test_native_per_partial_fill_no_nan_weights(rng):
+    """A stratified draw at u=1.0 on a partially-filled buffer walks into
+    the unfilled (zero-priority) suffix; the IS weights must stay finite
+    (the zero-priority leaf is clamped back into the filled prefix)."""
+    from smartcal_tpu.rl import replay as rp
+    from smartcal_tpu.rl.replay_native import NativePER
+
+    spec = rp.transition_spec(2, 1)
+    buf = NativePER(8, spec)
+    tr = {k: np.zeros(shape) for k, (shape, _) in spec.items()}
+    for i in range(3):                    # filled=3 of 8
+        t = dict(tr)
+        t["state"] = np.full(2, i, np.float32)
+        buf.store(t, error=0.1 * (i + 1))
+    # u=1.0 in the last segment maxes the walk value; fp rounding can land
+    # on the boundary leaf — force the worst case deterministically
+    b, idx, w = buf.sample(4, np.random.default_rng(0),
+                           uniforms=[1.0, 1.0, 1.0, 1.0])
+    assert np.all(np.isfinite(w))
+    assert np.all(idx < 3)
+    assert np.all(w > 0)
+
+
+def test_sct_header_dims_nbytes_mismatch_raises_ioerror(tmp_path):
+    """_py_read reports a dims/nbytes disagreement as IOError like the
+    native reader, not as a numpy ValueError."""
+    import struct
+
+    path = tmp_path / "corrupt.sct"
+    name = b"col"
+    # dtype code for float64 per CODE_DTYPES, ndim=1, dims=(4,) but
+    # nbytes=17 (neither a multiple of 8 nor 4*8)
+    code = next(c for c, dt in native.CODE_DTYPES.items()
+                if np.dtype(dt) == np.float64)
+    hdr = (b"SCT1" + struct.pack("<I", 1) + struct.pack("<I", len(name))
+           + name + struct.pack("<II", code, 1) + struct.pack("<Q", 4)
+           + struct.pack("<Q", 17))
+    path.write_bytes(hdr + b"\x00" * 256)
+    with pytest.raises(IOError):
+        native._py_read(str(path))

@@ -32,6 +32,59 @@ def test_calib_ddpg_driver():
     assert np.all(np.isfinite(scores))
 
 
+def test_demix_td3_driver_hint_per():
+    """VERDICT r2 item 3: the demixing TD3 path — CNN/metadata TD3 with PER
+    and the adaptive-rho ADMM hint loop wired to DemixingEnv
+    (reference demixing_rl/main_td3.py + demix_td3.py)."""
+    import os
+
+    from smartcal_tpu.train import demix_td3
+
+    scores = demix_td3.main(
+        ["--iteration", "2", "--steps", "2", "--K", "4", "--small",
+         "--use_hint", "--warmup", "2", "--batch_size", "4",
+         "--memory", "64", "--seed", "0"])
+    assert len(scores) == 2
+    assert np.all(np.isfinite(scores))
+    assert os.path.exists("demix_td3td3_state.pkl")
+    assert os.path.exists("demix_td3_scores.pkl")
+
+
+def test_demix_td3_learn_fires_on_env_transitions():
+    """The TD3 learn step actually updates the actor on demixing-env
+    transitions (batch reachable, PER priorities refreshed)."""
+    import jax
+    import jax.numpy as jnp
+
+    from smartcal_tpu.envs import DemixingEnv
+    from smartcal_tpu.envs.radio import RadioBackend
+    from smartcal_tpu.rl import td3
+
+    backend = RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                           admm_iters=2, lbfgs_iters=2, init_iters=2,
+                           npix=16)
+    env = DemixingEnv(K=4, provide_hint=True, backend=backend, seed=0)
+    cfg = td3.TD3Config(obs_dim=3 * 4 + 2, n_actions=4, batch_size=4,
+                        mem_size=16, warmup=2, use_hint=True, admm_rho=0.1,
+                        prioritized=True)
+    agent = td3.TD3Agent(cfg, seed=0)
+    obs = env.reset()
+    flat = np.asarray(obs["metadata"], np.float32)
+    for _ in range(5):
+        a = np.asarray(agent.choose_action(flat)).squeeze()
+        obs2, r, done, hint, info = env.step(a)
+        flat2 = np.asarray(obs2["metadata"], np.float32)
+        agent.store_transition(flat, a, r, flat2, done, hint)
+        agent.learn()
+        flat = flat2
+    p0 = jax.flatten_util.ravel_pytree(
+        td3.td3_init(jax.random.PRNGKey(0), cfg).actor_params)[0]
+    p1 = jax.flatten_util.ravel_pytree(agent.state.actor_params)[0]
+    assert int(agent.state.learn_counter) >= 1
+    assert not np.allclose(np.asarray(p0), np.asarray(p1))
+    assert np.all(np.isfinite(np.asarray(p1)))
+
+
 def test_demix_fuzzy_sac_driver():
     from smartcal_tpu.train import demix_fuzzy_sac
 

@@ -1,0 +1,117 @@
+"""Host-segmented vs fused ADMM solve overhead (VERDICT r2 item 8).
+
+Times ``solver.solve_admm`` (one fused XLA program) against
+``solver.solve_admm_host`` (bounded per-ADMM-iteration dispatches with
+exact L-BFGS resume) on the same problem, at sizes where BOTH run on the
+chip (the fused program trips the device watchdog above roughly
+total_iters x work ~ 2-3e7 units; see envs/radio.py:_use_host_solver).
+The measured per-dispatch overhead and the largest fused-runnable size
+give the routing threshold a provenance beyond the two data points it was
+calibrated from.
+
+Usage:
+    PYTHONPATH=/root/repo:$PYTHONPATH python tools/bench_host_seg.py \
+        [--stations 40] [--nf 8] [--repeat 3] [--cpu]
+
+Writes results/host_seg_bench.json.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run_case(backend_kwargs, admm_iters, repeat):
+    import jax
+    import jax.numpy as jnp
+
+    from smartcal_tpu.cal import solver
+    from smartcal_tpu.envs.radio import RadioBackend
+
+    be = RadioBackend(**backend_kwargs)
+    ep, _ = be.new_demixing_episode(jax.random.PRNGKey(0), 6)
+    rho = jnp.ones(6, jnp.float32)
+    cfg = be._solver_cfg(ep.n_dirs)
+
+    out = {"config": {**backend_kwargs, "admm_iters": admm_iters,
+                      "lbfgs_iters": cfg.lbfgs_iters,
+                      "init_iters": cfg.init_iters}}
+    work = (be.n_stations ** 2) * be.n_freqs * be.n_times
+    total_iters = cfg.init_iters + admm_iters * cfg.lbfgs_iters
+    out["work_units"] = float(total_iters * work)
+
+    for name, fn in (
+            ("fused", lambda: solver.solve_admm(
+                ep.V, ep.Ccal, ep.obs.freqs, ep.f0, rho, cfg,
+                n_chunks=be.n_chunks, admm_iters=jnp.asarray(admm_iters))),
+            ("host_segmented", lambda: solver.solve_admm_host(
+                ep.V, ep.Ccal, ep.obs.freqs, ep.f0, rho, cfg,
+                n_chunks=be.n_chunks, admm_iters=admm_iters))):
+        try:
+            t0 = time.time()
+            r = fn()
+            jax.block_until_ready(r.residual)
+            compile_s = time.time() - t0
+            times = []
+            for _ in range(repeat):
+                t0 = time.time()
+                r = fn()
+                jax.block_until_ready(r.residual)
+                times.append(time.time() - t0)
+            out[name] = {"compile_s": round(compile_s, 2),
+                         "steady_s": round(float(np.median(times)), 3),
+                         "sigma_res": round(float(r.sigma_res), 3),
+                         "sigma_data": round(float(r.sigma_data), 3)}
+        except Exception as e:  # device watchdog / OOM — record, keep going
+            out[name] = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+    f = out.get("fused", {}).get("steady_s")
+    h = out.get("host_segmented", {}).get("steady_s")
+    if f and h:
+        out["host_over_fused"] = round(h / f, 3)
+        out["dispatch_overhead_s"] = round(h - f, 3)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stations", type=int, default=40)
+    ap.add_argument("--nf", type=int, default=8)
+    ap.add_argument("--times", type=int, default=20)
+    ap.add_argument("--tdelta", type=int, default=10)
+    ap.add_argument("--admm", type=int, default=10)
+    ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    case = run_case(dict(n_stations=args.stations, n_freqs=args.nf,
+                         n_times=args.times, tdelta=args.tdelta,
+                         admm_iters=args.admm, lbfgs_iters=8,
+                         init_iters=30),
+                    admm_iters=args.admm, repeat=args.repeat)
+    case["platform"] = jax.devices()[0].platform
+    print(json.dumps(case, indent=1))
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "results", "host_seg_bench.json")
+    existing = []
+    if os.path.exists(out):
+        with open(out) as fh:
+            existing = json.load(fh)
+            if isinstance(existing, dict):
+                existing = [existing]
+    existing.append(case)
+    with open(out, "w") as fh:
+        json.dump(existing, fh, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -84,11 +84,105 @@ def bench_native(size, batch, iters, obs_dim=128, n_actions=4):
             "platform": "host"}
 
 
+def _e2e_cfg(size, batch, obs_dim, n_actions):
+    from smartcal_tpu.rl import sac
+
+    return sac.SACConfig(obs_dim=obs_dim, n_actions=n_actions,
+                         batch_size=batch, mem_size=size, prioritized=True,
+                         error_clip=100.0)
+
+
+def bench_e2e_device(size, batch, iters, obs_dim=420, n_actions=2):
+    """Full train step, fused HBM design: one jitted
+    sample + learn + priority-update (rl.sac.learn on a prioritized
+    buffer) — the path every in-framework driver uses."""
+    import jax
+    import jax.numpy as jnp
+
+    from smartcal_tpu.rl import replay as rp
+    from smartcal_tpu.rl import sac
+
+    cfg = _e2e_cfg(size, batch, obs_dim, n_actions)
+    st = sac.sac_init(jax.random.PRNGKey(0), cfg)
+    spec = rp.transition_spec(obs_dim, n_actions)
+    buf = rp.replay_init(size, spec)
+    trs = {k: jnp.zeros((size,) + shape, dtype)
+           for k, (shape, dtype) in spec.items()}
+    errors = jax.random.uniform(jax.random.PRNGKey(1), (size,))
+    buf = jax.jit(rp.replay_add_batch)(buf, trs, errors=errors)
+
+    step = jax.jit(lambda st, buf, k: sac.learn(cfg, st, buf, k))
+    key = jax.random.PRNGKey(2)
+    st, buf, m = step(st, buf, key)      # compile
+    jax.block_until_ready(m["critic_loss"])
+    t0 = time.time()
+    for _ in range(iters):
+        key, k = jax.random.split(key)
+        st, buf, m = step(st, buf, k)
+    jax.block_until_ready(m["critic_loss"])
+    dt = time.time() - t0
+    return {"design": "device_prefix_sum", "stage": "e2e_train_step",
+            "size": size, "batch": batch, "iters": iters,
+            "obs_dim": obs_dim,
+            "train_step_us": round(dt / iters * 1e6, 1),
+            "platform": jax.devices()[0].platform}
+
+
+def bench_e2e_native(size, batch, iters, obs_dim=420, n_actions=2):
+    """Full train step, host-tree design: NativePER.sample (C++ walk) ->
+    jitted learn_from_batch on device -> host priority update from the
+    returned TD errors — includes the host<->device hops the fused design
+    avoids."""
+    import jax
+    import jax.numpy as jnp
+
+    from smartcal_tpu.rl import replay as rp
+    from smartcal_tpu.rl import sac
+    from smartcal_tpu.rl.replay_native import NativePER
+
+    cfg = _e2e_cfg(size, batch, obs_dim, n_actions)
+    st = sac.sac_init(jax.random.PRNGKey(0), cfg)
+    spec = rp.transition_spec(obs_dim, n_actions)
+    buf = NativePER(size, spec)
+    rng = np.random.default_rng(0)
+    tr = {k: np.zeros(shape, np.dtype(dtype))
+          for k, (shape, dtype) in spec.items()}
+    for _ in range(size):
+        buf.store(tr, error=rng.random())
+
+    core = jax.jit(lambda st, b, w, k: sac.learn_from_batch(cfg, st, b, w, k))
+    key = jax.random.PRNGKey(2)
+    b, idx, w = buf.sample(batch, rng)
+    st, m = core(st, {k: jnp.asarray(v) for k, v in b.items()},
+                 jnp.asarray(w), key)    # compile
+    jax.block_until_ready(m["critic_loss"])
+    t0 = time.time()
+    for _ in range(iters):
+        key, k = jax.random.split(key)
+        b, idx, w = buf.sample(batch, rng)
+        st, m = core(st, {kk: jnp.asarray(v) for kk, v in b.items()},
+                     jnp.asarray(w), k)
+        buf.update_priorities(idx, np.asarray(m["td"]))
+    jax.block_until_ready(m["critic_loss"])
+    dt = time.time() - t0
+    return {"design": "native_sumtree", "stage": "e2e_train_step",
+            "size": size, "batch": batch, "iters": iters,
+            "obs_dim": obs_dim,
+            "train_step_us": round(dt / iters * 1e6, 1),
+            "platform": "host+" + jax.devices()[0].platform}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=16384)
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--e2e_obs_dim", type=int, default=420,
+                    help="observation dim for the end-to-end train-step "
+                         "benchmark (420 = elasticnet reference state; "
+                         "use 16404 for the demixing CNN scale)")
+    ap.add_argument("--e2e_iters", type=int, default=100)
+    ap.add_argument("--skip_e2e", action="store_true")
     ap.add_argument("--cpu", action="store_true",
                     help="force the device design onto CPU")
     args = ap.parse_args()
@@ -109,11 +203,33 @@ def main():
                        "(standalone sample+update; the device design "
                        "additionally fuses into the jitted train step)"}
     print(json.dumps(summary))
+
+    e2e_rows, e2e_summary = [], None
+    if not args.skip_e2e:
+        e2e_rows = [
+            bench_e2e_native(args.size, args.batch, args.e2e_iters,
+                             obs_dim=args.e2e_obs_dim),
+            bench_e2e_device(args.size, args.batch, args.e2e_iters,
+                             obs_dim=args.e2e_obs_dim)]
+        for r in e2e_rows:
+            print(json.dumps(r))
+        er = (e2e_rows[0]["train_step_us"]
+              / max(e2e_rows[1]["train_step_us"], 1e-9))
+        e2e_summary = {
+            "native_over_device_time_ratio": round(er, 3),
+            "winner": "device_prefix_sum" if er > 1 else "native_sumtree",
+            "note": "FULL train step: sample + SAC learn + priority "
+                    "update.  This is the number the default follows "
+                    "(SACConfig.prioritized uses the winner's backend)."}
+        print(json.dumps(e2e_summary))
+
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "results", "per_bench.json")
     try:
         with open(out, "w") as f:
-            json.dump({"rows": rows, "summary": summary}, f, indent=1)
+            json.dump({"rows": rows, "summary": summary,
+                       "e2e_rows": e2e_rows, "e2e_summary": e2e_summary},
+                      f, indent=1)
     except OSError:
         pass
 

@@ -1,0 +1,30 @@
+#!/bin/bash
+# One serialized TPU session (ONE client at a time — the axon tunnel wedges
+# on concurrent backend init).  Run when the tunnel is up:
+#   bash tools/chip_session.sh 2>&1 | tee /tmp/chip_session.log
+# Captures, in order:
+#  1. BENCH_r03 payload: bench.py (enet steps/s + batched + N=62 calib
+#     episode wall-clock with per-stage breakdown)
+#  2. PER end-to-end decision (tools/bench_per.py, elasticnet + demixing
+#     obs scales)
+#  3. Host-segmentation overhead at N=40 where fused + segmented both run
+#     (tools/bench_host_seg.py)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+probe=$(timeout 150 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+if [ "$probe" != "axon" ] && [ "$probe" != "tpu" ]; then
+  echo "TPU not reachable (probe: '$probe') — aborting chip session" >&2
+  exit 1
+fi
+
+echo "=== 1. bench.py (BENCH_r03 payload) ==="
+BENCH_PLATFORM=tpu python bench.py || echo "bench.py failed rc=$?"
+
+echo "=== 2. PER end-to-end (elasticnet scale) ==="
+python tools/bench_per.py --e2e_iters 100 || echo "bench_per failed rc=$?"
+
+echo "=== 3. host-segmentation overhead (N=40, both paths on chip) ==="
+python tools/bench_host_seg.py --stations 40 --nf 8 --admm 10 \
+  || echo "bench_host_seg failed rc=$?"
+echo "=== chip session complete ==="

@@ -1,0 +1,64 @@
+"""Convert the reference's checked-in A-team sky models into the repo
+fixture ``smartcal_tpu/data/ateam.{sky,cluster,rho}``.
+
+Provenance: ``/root/reference/demixing/base.{sky,cluster,rho}`` — the
+LOFAR A-team catalogue (CasA, CygA, HerA, TauA, VirA; 533 sources in 5
+clusters) that ``generate_data.py:771-776`` concatenates with the
+downloaded target model before real-data calibration.  The conversion goes
+parse -> write through :mod:`smartcal_tpu.cal.skyio`, i.e. the fixture is
+this framework's own serialization of the catalogue *data* (Q/U/V, SI1/SI2
+and RM are zero for every row, verified below, so the 9-field writer is
+lossless).
+
+Run from the repo root (needs /root/reference present):
+    python tools/convert_ateam.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from smartcal_tpu.cal import coords, skyio  # noqa: E402
+
+REF = "/root/reference/demixing"
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "smartcal_tpu", "data")
+
+
+def main():
+    sky = skyio.parse_sky_model(f"{REF}/base.sky")
+    clusters = skyio.parse_cluster_file(f"{REF}/base.cluster")
+    # base.rho is the 3-column 'id hybrid rho' variant (no spatial column)
+    rho = np.asarray([float(ln.split()[2])
+                      for ln in skyio._data_lines(f"{REF}/base.rho")])
+
+    rows = []
+    for _, names in clusters:
+        for nm in names:
+            f = sky[nm]
+            # the 9-field writer drops Q/U/V, SI1/SI2, RM — assert they are
+            # actually zero so the conversion is lossless
+            assert np.all(f[[7, 8, 9, 11, 12, 13]] == 0.0), (nm, f)
+            ra = coords.hms_to_rad(f[0], f[1], f[2])
+            dec = coords.dms_to_rad(f[3], f[4], f[5])
+            rows.append((nm, float(ra), float(dec), f[6], f[10],
+                         f[14], f[15], f[16], f[17]))
+
+    os.makedirs(OUT, exist_ok=True)
+    skyio.write_sky_model(f"{OUT}/ateam.sky", rows)
+    # keep cluster-file line order (CasA, CygA, HerA, TauA, VirA) with
+    # sequential ids; the original ids 2..6 only existed to leave id 1 free
+    # for the concatenated target cluster
+    skyio.write_cluster_file(
+        f"{OUT}/ateam.cluster",
+        [(i + 1, names) for i, (_, names) in enumerate(clusters)])
+    skyio.write_rho(f"{OUT}/ateam.rho", rho, 0.05 * rho,
+                    ids=list(range(1, len(rho) + 1)))
+    print(f"wrote {len(rows)} sources / {len(clusters)} clusters to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

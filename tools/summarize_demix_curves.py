@@ -11,18 +11,20 @@ comparison (demixing_rl/README.md:12-14 "hint agent shows increase in
 reward indicating learning", figures/calibration_rewards.png).
 """
 
+import argparse
 import glob
 import json
 import os
 import re
+import sys
 
 import numpy as np
 
-OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
-    __file__))), "results", "demix_curves")
+DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "results", "demix_curves")
 
 
-def load_runs():
+def load_runs(OUT):
     runs = {}
     for path in sorted(glob.glob(os.path.join(OUT, "*_seed*.jsonl"))):
         m = re.match(r"(hint|nohint)_seed(\d+)", os.path.basename(path))
@@ -47,7 +49,10 @@ def moving_avg(x, w=20):
 
 
 def main():
-    runs = load_runs()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("sweep_dir", nargs="?", default=DEFAULT_DIR)
+    OUT = ap.parse_args().sweep_dir
+    runs = load_runs(OUT)
     if not runs:
         raise SystemExit(f"no runs found under {OUT}")
     summary = []
@@ -70,9 +75,39 @@ def main():
             agg[mode] = {"median_last20": round(float(np.median(tails)), 4),
                          "median_first20": round(float(np.median(starts)), 4),
                          "n_runs": len(tails)}
+    # same-seed paired deltas + exact tests (tools/enet_hint_stats.py
+    # machinery) on BOTH the tail level and the learning speed
+    paired = None
+    seeds = sorted({s for (m, s) in runs if m == "hint"}
+                   & {s for (m, s) in runs if m == "nohint"})
+    if seeds:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from enet_hint_stats import sign_test_p, wilcoxon_exact_p
+
+        def stats_of(fn):
+            deltas = [fn(runs[("hint", s)]) - fn(runs[("nohint", s)])
+                      for s in seeds]
+            return {"deltas": [round(float(d), 4) for d in deltas],
+                    "median_delta": round(float(np.median(deltas)), 4),
+                    "n_positive": int(sum(d > 0 for d in deltas)),
+                    "sign_p": sign_test_p(deltas),
+                    "wilcoxon_p": wilcoxon_exact_p(deltas)}
+
+        paired = {
+            "n_pairs": len(seeds),
+            # final performance: median of the last quarter of episodes
+            "tail_median": stats_of(
+                lambda sc: float(np.median(sc[-max(20, len(sc) // 4):]))),
+            # learning speed: mean over the whole run (area under the curve
+            # — an agent that reaches the plateau earlier scores higher)
+            "auc_mean": stats_of(lambda sc: float(np.mean(sc))),
+        }
     with open(os.path.join(OUT, "summary.json"), "w") as f:
-        json.dump({"per_run": summary, "aggregate": agg}, f, indent=1)
+        json.dump({"per_run": summary, "aggregate": agg,
+                   "paired": paired}, f, indent=1)
     print(json.dumps(agg))
+    if paired:
+        print("paired:", json.dumps(paired))
 
     try:
         import matplotlib

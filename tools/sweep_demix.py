@@ -1,0 +1,84 @@
+"""Multi-seed hint/no-hint DEMIXING SAC sweep at reference-like scale.
+
+VERDICT r2 item 2: demonstrate (or honestly refute) the reference's
+headline demixing claim — the hint-constrained agent learns faster
+(``demixing_rl/README.md:12-14``) — at K=6, N>=14, >=5 seeds x >=500
+episodes.  The round-2 artifact (2 seeds x 100 episodes on the N=6 toy
+config, ``results/demix_curves/``) was too easy a task to separate the
+modes.
+
+This sweep drives the REAL ``train.demix_sac`` episode loop (same env,
+same agent config: batch 256, mem 16000, KLD hint distance) on the
+default backend scale N=14/Nf=3/T=20 (B=91 baselines, 2 solution
+intervals, 2^(K-1)=32-lane exhaustive AIC hint sweep per episode).
+
+Writes per-episode JSONL + summary in the demix_curves format so
+``tools/summarize_demix_curves.py`` can aggregate.
+
+Usage:
+    python tools/sweep_demix.py --outdir results/demix_curves_r3 \
+        [--seeds 5] [--episodes 500] [--stations 14] [--platform cpu]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seeds", default=5, type=int)
+    p.add_argument("--episodes", default=500, type=int)
+    p.add_argument("--warmup", default=30, type=int)
+    p.add_argument("--steps", default=7, type=int)
+    p.add_argument("--K", default=6, type=int)
+    p.add_argument("--stations", default=14, type=int)
+    p.add_argument("--outdir", default="results/demix_curves_r3")
+    p.add_argument("--platform", default=None, choices=["cpu", "axon"])
+    p.add_argument("--modes", default="nohint,hint")
+    p.add_argument("--medium", action="store_true",
+                   help="pass --medium to demix_sac (N=14 with thinner "
+                   "time/freq axes; CPU-tractable)")
+    p.add_argument("--seed0", default=0, type=int,
+                   help="first seed (parallel shards of the sweep)")
+    args = p.parse_args()
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from smartcal_tpu.train import demix_sac
+
+    os.makedirs(args.outdir, exist_ok=True)
+    t_start = time.time()
+    # seed-major order: a truncated sweep still has paired hint/no-hint
+    # runs for every completed seed
+    for seed in range(args.seed0, args.seed0 + args.seeds):
+        for mode in args.modes.split(","):
+            use_hint = mode == "hint"
+            tag = f"{mode}_seed{seed}"
+            dst = os.path.join(args.outdir, f"{tag}.jsonl")
+            if os.path.exists(dst):
+                print(f"skip {tag} (exists)", flush=True)
+                continue
+            t0 = time.time()
+            argv = ["--seed", str(seed), "--iteration", str(args.episodes),
+                    "--warmup", str(args.warmup), "--steps", str(args.steps),
+                    "--K", str(args.K), "--stations", str(args.stations),
+                    "--prefix", os.path.join(args.outdir, f"{tag}_ck"),
+                    "--metrics", dst]
+            if use_hint:
+                argv.append("--use_hint")
+            if args.medium:
+                argv.append("--medium")
+            demix_sac.main(argv)
+            print(f"[{time.time() - t_start:7.0f}s] DONE {tag} "
+                  f"({time.time() - t0:.0f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
