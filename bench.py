@@ -37,6 +37,15 @@ from smartcal_tpu.envs import enet
 from smartcal_tpu.rl import replay as rp
 from smartcal_tpu.rl import sac
 from smartcal_tpu.train.enet_sac import make_episode_fn
+from smartcal_tpu.utils import enable_compilation_cache
+
+# Warm-cache state is recorded in the calib extra ("compile_cache_warm")
+# because first_episode_incl_compile_s is only comparable across rounds
+# when both runs were equally cold.
+_CACHE_DIR = os.environ.get("SMARTCAL_COMPILE_CACHE_DIR",
+                            "/tmp/smartcal_jax_cache")
+_CACHE_WAS_WARM = bool(os.path.isdir(_CACHE_DIR) and os.listdir(_CACHE_DIR))
+enable_compilation_cache(_CACHE_DIR)
 
 STEPS_PER_EPISODE = 5
 TIMED_EPISODES = 20  # 100 timed env steps, same as the reference measurement
@@ -193,6 +202,7 @@ def bench_calib_episode():
         "vs_baseline": None,
         "scale": "N=62 B=1891 Nf=8 Tdelta=10 K=6 npix=128",
         "first_episode_incl_compile_s": round(t_first, 2),
+        "compile_cache_warm": _CACHE_WAS_WARM,
         "stage_breakdown": stages,
     }
 

@@ -1,1 +1,2 @@
-from .metrics import JsonlLogger, profiler_trace  # noqa: F401
+from .metrics import (JsonlLogger, enable_compilation_cache,  # noqa: F401
+                      profiler_trace)

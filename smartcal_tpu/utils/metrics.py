@@ -58,3 +58,40 @@ def profiler_trace(trace_dir: Optional[str]):
 
     with jax.profiler.trace(trace_dir):
         yield
+
+
+def enable_compilation_cache(path: str = "/tmp/smartcal_jax_cache",
+                             min_compile_secs: float = 2.0) -> bool:
+    """Turn on JAX's persistent compilation cache (idempotent).
+
+    The radio-solver programs take minutes to compile on the single-core
+    CPU host (jit_solve_admm was measured at 3m24s); across pytest
+    processes, sweep runs, and bench invocations the SAME programs are
+    rebuilt from scratch every time because each process has a fresh
+    in-memory cache.  The persistent cache keys on the serialized HLO +
+    compile options, so re-runs deserialize instead.  Only compiles
+    slower than ``min_compile_secs`` are persisted — trivial kernels
+    would bloat the directory for no win.  Returns False (and changes
+    nothing) if this jax build lacks the config knobs.
+
+    SMARTCAL_NO_COMPILE_CACHE=1 disables (e.g. when debugging suspected
+    stale-cache miscompiles).
+    """
+    import os
+
+    if os.environ.get("SMARTCAL_NO_COMPILE_CACHE", "") == "1":
+        return False
+    import jax
+
+    try:
+        # threshold FIRST: if only the dir knob existed, setting it last
+        # would leave the cache active with the default (persist
+        # everything) threshold after we report False
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("SMARTCAL_COMPILE_CACHE_DIR",
+                                         path))
+        return True
+    except (AttributeError, ValueError):
+        return False

@@ -25,6 +25,16 @@ assert jax.devices()[0].platform == "cpu", (
     "tests must run on the virtual CPU mesh, got " + str(jax.devices()))
 assert jax.device_count() == 8, "expected 8 virtual CPU devices"
 
+# Persistent compilation cache: the expensive programs (solver, meshes)
+# recompile identically on every suite run — deserialize instead.  The
+# single-core full-suite run measured 40 min cold; the cache removes the
+# XLA-compile share on every subsequent run.  Disable with
+# SMARTCAL_NO_COMPILE_CACHE=1 when debugging suspected stale-cache
+# miscompiles.
+from smartcal_tpu.utils import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

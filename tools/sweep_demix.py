@@ -52,6 +52,9 @@ def main():
         jax.config.update("jax_platforms", args.platform)
 
     from smartcal_tpu.train import demix_sac
+    from smartcal_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
 
     os.makedirs(args.outdir, exist_ok=True)
     t_start = time.time()

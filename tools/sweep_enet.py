@@ -27,6 +27,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
+from smartcal_tpu.utils import enable_compilation_cache
+
+enable_compilation_cache()
+
 from smartcal_tpu.envs import enet
 from smartcal_tpu.rl import replay as rp
 from smartcal_tpu.rl import sac
