@@ -67,13 +67,14 @@ class SACConfig:
     alpha_rule: str = "reference"
     prioritized: bool = False
     error_clip: float = 100.0     # PER absolute_error_upper (enet_sac.py:212)
-    # PER backend: 'hbm' = fused device prefix-sum (sample + learn +
-    # priority update in ONE jitted step) — the measured end-to-end winner
-    # (results/per_bench.json e2e section; the host C++ tree wins the
-    # standalone sample+update microbenchmark but loses the full train
-    # step to its host<->device hops).  'native' = host C++ sum tree +
-    # learn_from_batch, for payloads too large for HBM or host-driven
-    # ingestion loops (the distributed learner).
+    # PER backend (measured both ways, results/per_bench.json): 'hbm' =
+    # fused device prefix-sum — sample + learn + priority update in ONE
+    # jitted step, the default whenever an accelerator is present (no
+    # host<->device hop per learn; scan-able).  'native' = host C++ sum
+    # tree + learn_from_batch — wins on no-accelerator hosts (CPU e2e
+    # 0.49x the fused step's wall; the O(log n) walk beats a 16k cumsum
+    # on one core) and suits host-driven ingestion loops or payloads too
+    # large for HBM.  Chip-regime e2e capture: tools/chip_session.sh.
     replay_backend: str = "hbm"
     # dict-obs (radio) variants: when img_shape is set, obs_dim must equal
     # H*W + meta_dim and the CNN+metadata towers are used (calib_sac.py,

@@ -8,19 +8,24 @@ Design B — host-side native sum tree (rl.replay_native + native/sumtree.cc):
 the reference's O(log n) pointer-chase in C++, storage in host numpy,
 minibatch crosses to the device per learn step.
 
-Run:  PYTHONPATH=/root/repo:$PYTHONPATH python tools/bench_per.py
-      [--size 16384] [--batch 256] [--iters 200] [--cpu]
+Run:  python tools/bench_per.py [--size 16384] [--batch 256]
+      [--iters 200] [--cpu] [--e2e_obs_dim 420] [--skip_e2e]
 
-Prints one JSON line per measurement plus a summary, and overwrites
-results/per_bench.json (in-repo, cwd-independent) with the latest run.
+Prints one JSON line per measurement plus summaries, and APPENDS a
+platform-tagged entry to the measurement history in
+results/per_bench.json (atomic replace; corrupt history is set aside as
+.corrupt and restarted).
 """
 
 import argparse
 import json
 import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def bench_device(size, batch, iters, obs_dim=128, n_actions=4):
@@ -219,17 +224,38 @@ def main():
             "native_over_device_time_ratio": round(er, 3),
             "winner": "device_prefix_sum" if er > 1 else "native_sumtree",
             "note": "FULL train step: sample + SAC learn + priority "
-                    "update.  This is the number the default follows "
-                    "(SACConfig.prioritized uses the winner's backend)."}
+                    "update, on THIS platform.  The shipped default "
+                    "(SACConfig.replay_backend='hbm') follows the "
+                    "accelerator-regime winner; select 'native' "
+                    "per-run on no-accelerator hosts."}
         print(json.dumps(e2e_summary))
 
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "results", "per_bench.json")
     try:
-        with open(out, "w") as f:
-            json.dump({"rows": rows, "summary": summary,
-                       "e2e_rows": e2e_rows, "e2e_summary": e2e_summary},
-                      f, indent=1)
+        doc = {"measurements": []}
+        if os.path.exists(out):
+            try:
+                with open(out) as f:
+                    loaded = json.load(f)
+                if isinstance(loaded, dict) and "measurements" in loaded:
+                    doc = loaded
+                elif isinstance(loaded, dict):   # pre-round-3 flat layout
+                    doc = {"measurements": [{"label": "legacy", **loaded}]}
+            except ValueError:
+                # truncated/corrupt history: keep it aside, start fresh
+                os.replace(out, out + ".corrupt")
+        import jax
+
+        doc["measurements"].append({
+            "label": f"{jax.devices()[0].platform}"
+                     f"_{time.strftime('%Y%m%d_%H%M')}",
+            "rows": rows, "summary": summary,
+            "e2e_rows": e2e_rows, "e2e_summary": e2e_summary})
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, out)     # atomic: no torn/lost history on kill
     except OSError:
         pass
 
