@@ -256,6 +256,10 @@ def main():
         "value": round(value, 2),
         "unit": "env-steps/sec/chip",
         "vs_baseline": round(value / baseline, 2),
+        # contention context: on the single-core host a concurrent sweep
+        # halves the measured rate — loadavg>~1.5 means this number
+        # understates the uncontended throughput
+        "host_load_avg_1m": round(os.getloadavg()[0], 2),
     }
     if platform != "tpu":
         out["platform"] = f"cpu ({note})"
