@@ -156,28 +156,37 @@ class DemixingEnv:
 
     def get_hint(self):
         """Exhaustive AIC sweep -> softmin expectation
-        (demixingenv.py:301-336), batched on device."""
+        (demixingenv.py:301-336), batched on device.
+
+        ALL 2^(K-1) configurations enter the batched solve at a FIXED lane
+        count; low-elevation configs run as target-only lanes whose result
+        is discarded (their AIC keeps the reference's fixed 1e5,
+        demixingenv.py:311-315).  A variable valid-lane count would change
+        the vmapped program's shape per episode and recompile the
+        multi-minute solver program for every distinct count — the padded
+        static shape compiles once per process (and once ever with the
+        persistent cache), which on the single-core host dominates the
+        few wasted lanes.
+        """
         n_cfg = 2 ** (self.K - 1)
-        masks, valid_idx = {}, []
+        masks = np.zeros((n_cfg, self.K), np.float32)
+        valid = np.zeros(n_cfg, bool)
         AIC = np.full(n_cfg, 1e5)   # low-elevation configs keep the fixed AIC
         for idx in range(n_cfg):
             bits = scalar_to_kvec(idx, self.K - 1)
             chosen_el = self.elevation[:-1][bits > 0]
             if not np.any(chosen_el < 1.0):
                 masks[idx] = self._mask(np.where(bits > 0)[0].tolist())
-                valid_idx.append(idx)
-        # only valid configurations enter the batched sweep — excluded ones
-        # would burn a full solver lane each just to have their AIC
-        # overwritten (the reference skips the sagecal call the same way,
-        # demixingenv.py:311-315)
+                valid[idx] = True
+            else:
+                masks[idx] = self._mask([])          # dummy target-only lane
         sigma_res = np.asarray(self.backend.hint_sweep(
-            self.ep, self.rho, np.stack([masks[i] for i in valid_idx]),
-            admm_iters=self.maxiter))
+            self.ep, self.rho, masks, admm_iters=self.maxiter))
 
         N = self.backend.n_stations
-        for lane, idx in enumerate(valid_idx):
+        for idx in np.where(valid)[0]:
             ksel = int(masks[idx].sum())
-            AIC[idx] = ((N * sigma_res[lane] / self.std_data) ** 2
+            AIC[idx] = ((N * sigma_res[idx] / self.std_data) ** 2
                         + ksel * N)
         probs = np.exp(-AIC / self.tau)
         probs /= probs.sum()
