@@ -65,6 +65,7 @@ class RadioBackend:
         self.init_iters = init_iters
         self.polytype = polytype
         self.npix = npix
+        self._sweep_fns = {}     # (n_dirs, n_masks, batch) -> jitted sweep
 
     # -- episode construction ------------------------------------------------
 
@@ -221,19 +222,44 @@ class RadioBackend:
         reward and std_data use, so the hint's AIC residual term is on the
         same scale as the reward the agent is trained on (a full-pol RMS
         here would rescale it against the ksel*N complexity penalty)."""
-        def one(mask):
-            res = self.calibrate(ep, rho, mask=mask, admm_iters=admm_iters)
-            return self.noise_std(res.residual)
-
         masks = jnp.asarray(masks, jnp.float32)
-        n = masks.shape[0]
+        n = int(masks.shape[0])
         batch = min(batch, n)
-        pad = (-n) % batch
-        padded = jnp.concatenate(
-            [masks, jnp.zeros((pad,) + masks.shape[1:], masks.dtype)])
-        chunks = padded.reshape(-1, batch, masks.shape[1])
-        out = jax.lax.map(jax.vmap(one), chunks).reshape(-1)
-        return out[:n]
+        # One jitted program per (n_dirs, n, batch), with EVERY per-episode
+        # value (V, C, freqs, f0, rho, masks, iteration count) as a traced
+        # ARGUMENT.  The previous eager lax.map closed over the episode
+        # arrays, embedding them as constants — a fresh trace + XLA compile
+        # of the multi-minute solver program EVERY episode (and per maxiter
+        # value), which dominated hint-arm wall-clock (~2-3 min/episode on
+        # the CPU host, vs seconds of actual solve work).
+        key = (ep.n_dirs, n, batch)
+        fn = self._sweep_fns.get(key)
+        if fn is None:
+            cfg = self._solver_cfg(ep.n_dirs)
+            n_chunks = self.n_chunks
+            pad = (-n) % batch
+
+            @jax.jit
+            def fn(V, C, freqs, f0, rho_, masks_, iters):
+                def one(mask):
+                    Cm = C * mask[None, :, None, None, None]
+                    res = solver.solve_admm(V, Cm, freqs, f0, rho_, cfg,
+                                            n_chunks=n_chunks,
+                                            admm_iters=iters)
+                    stds = jax.vmap(solver.stokes_i_std)(res.residual)
+                    return jnp.sqrt(jnp.mean(stds ** 2))
+
+                padded = jnp.concatenate(
+                    [masks_, jnp.zeros((pad,) + masks_.shape[1:],
+                                       masks_.dtype)])
+                chunks = padded.reshape(-1, batch, masks_.shape[1])
+                return jax.lax.map(jax.vmap(one), chunks).reshape(-1)[:n]
+
+            self._sweep_fns[key] = fn
+        iters = self.admm_iters if admm_iters is None else admm_iters
+        return fn(ep.V, ep.Ccal, ep.obs.freqs, jnp.asarray(ep.f0),
+                  jnp.asarray(rho, jnp.float32), masks,
+                  jnp.asarray(iters))
 
     def influence_image(self, ep: Episode, result: solver.SolveResult,
                         rho, rho_spatial, npix=None):
