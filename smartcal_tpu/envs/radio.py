@@ -47,7 +47,7 @@ class RadioBackend:
 
     def __init__(self, n_stations=14, n_freqs=3, n_times=20, tdelta=10,
                  n_poly=2, admm_iters=10, lbfgs_iters=8, init_iters=30,
-                 polytype=0, npix=128):
+                 polytype=0, npix=128, hint_batch=8):
         if n_times <= 0 or n_times % tdelta != 0:
             raise ValueError(
                 f"n_times={n_times} must be a positive multiple of "
@@ -65,6 +65,11 @@ class RadioBackend:
         self.init_iters = init_iters
         self.polytype = polytype
         self.npix = npix
+        # hint-sweep vmap width: on accelerators wide lanes win; on CPU
+        # vmapped while_loops cost every lane the worst lane's iteration
+        # count (and cond becomes select), so hint_batch=1 (sequential
+        # lax.map, per-lane early exit) is faster on one core
+        self.hint_batch = hint_batch
         self._sweep_fns = {}     # (n_dirs, n_masks, batch) -> jitted sweep
 
     # -- episode construction ------------------------------------------------
@@ -211,7 +216,7 @@ class RadioBackend:
         return total_iters * work > 1e7
 
     def hint_sweep(self, ep: Episode, rho, masks, admm_iters=None,
-                   batch=8):
+                   batch=None):
         """Batched masked calibrations (the exhaustive AIC hint): the
         2^(K-1) configurations run as vmapped batches of ``batch`` masks
         (lax.map over batches bounds memory) instead of the reference's 32
@@ -224,7 +229,7 @@ class RadioBackend:
         here would rescale it against the ksel*N complexity penalty)."""
         masks = jnp.asarray(masks, jnp.float32)
         n = int(masks.shape[0])
-        batch = min(batch, n)
+        batch = min(self.hint_batch if batch is None else batch, n)
         # One jitted program per (n_dirs, n, batch), with EVERY per-episode
         # value (V, C, freqs, f0, rho, masks, iteration count) as a traced
         # ARGUMENT.  The previous eager lax.map closed over the episode
@@ -249,6 +254,10 @@ class RadioBackend:
                     stds = jax.vmap(solver.stokes_i_std)(res.residual)
                     return jnp.sqrt(jnp.mean(stds ** 2))
 
+                if batch == 1:
+                    # sequential lanes, no vmap: while_loops keep their
+                    # per-lane early exits and cond stays a real branch
+                    return jax.lax.map(one, masks_)
                 padded = jnp.concatenate(
                     [masks_, jnp.zeros((pad,) + masks_.shape[1:],
                                        masks_.dtype)])

@@ -87,18 +87,27 @@ def main(argv=None):
 
 def make_backend(args):
     """Backend-size tiers shared by the demixing-family drivers (SAC,
-    TD3, fuzzy): ``--small`` (test-speed), ``--medium`` (N=stations with
-    thinner time/freq axes + lighter inner solves — the same learning
-    dynamics at ~8x less compute, for CPU-tractable sweeps), default
-    (reference-like N/Nf/T)."""
+    TD3, fuzzy): ``--small`` (test-speed), ``--light`` (N=stations, one
+    solution interval, minimum useful inner solves — measured 1.3 s/solve
+    on the single-core host, the only tier whose 32-config hint sweep
+    allows multi-seed paired sweeps there), ``--medium`` (N=stations with
+    thinner time/freq axes — the default config's learning dynamics at
+    ~8x less compute; 3.35 s/solve measured), default (reference-like
+    N/Nf/T)."""
     if getattr(args, "small", False):
         return RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
                             admm_iters=30, lbfgs_iters=3, init_iters=5,
                             npix=32)
+    if getattr(args, "light", False):
+        return RadioBackend(n_stations=args.stations, n_freqs=2,
+                            n_times=5, tdelta=5, admm_iters=30,
+                            lbfgs_iters=3, init_iters=8, npix=args.npix,
+                            hint_batch=1)
     if getattr(args, "medium", False):
         return RadioBackend(n_stations=args.stations, n_freqs=2,
                             n_times=10, tdelta=5, admm_iters=30,
-                            lbfgs_iters=4, init_iters=10, npix=args.npix)
+                            lbfgs_iters=4, init_iters=10, npix=args.npix,
+                            hint_batch=1)
     return RadioBackend(n_stations=args.stations, admm_iters=30,
                         npix=args.npix)
 

@@ -48,6 +48,9 @@ def main(argv=None):
     p.add_argument("--stations", type=int, default=14)
     p.add_argument("--npix", type=int, default=128)
     p.add_argument("--small", action="store_true")
+    p.add_argument("--light", action="store_true",
+                   help="see make_backend: one solution interval, "
+                        "minimum useful solver iterations")
     p.add_argument("--medium", action="store_true",
                    help="see demix_sac --medium")
     p.add_argument("--load", action="store_true")

@@ -43,6 +43,9 @@ def main():
     p.add_argument("--medium", action="store_true",
                    help="pass --medium to demix_sac (N=14 with thinner "
                    "time/freq axes; CPU-tractable)")
+    p.add_argument("--light", action="store_true",
+                   help="pass --light to demix_sac (one solution "
+                   "interval, minimum solver iterations)")
     p.add_argument("--seed0", default=0, type=int,
                    help="first seed (parallel shards of the sweep)")
     args = p.parse_args()
@@ -78,6 +81,8 @@ def main():
                 argv.append("--use_hint")
             if args.medium:
                 argv.append("--medium")
+            if args.light:
+                argv.append("--light")
             demix_sac.main(argv)
             print(f"[{time.time() - t_start:7.0f}s] DONE {tag} "
                   f"({time.time() - t0:.0f}s)", flush=True)
