@@ -43,6 +43,9 @@ def main(argv=None):
                    help="N=stations but thinner time/freq axes + lighter "
                    "inner solves — the learning dynamics of the default "
                    "config at ~8x less compute (CPU-tractable sweeps)")
+    p.add_argument("--light", action="store_true",
+                   help="see make_backend: one solution interval, "
+                        "minimum useful solver iterations")
     p.add_argument("--load", action="store_true")
     p.add_argument("--prefix", type=str, default="demix_sac")
     p.add_argument("--metrics", type=str, default=None,
