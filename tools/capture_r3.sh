@@ -1,0 +1,119 @@
+#!/bin/bash
+# Round-3 chip-capture retry loop.  The axon tunnel is intermittently
+# UNAVAILABLE (2026-07-31: server-side compiles run 10-25 min; the backend
+# drops between/during long compiles), so each remaining capture retries in
+# a FRESH process with a bounded timeout until its output artifact exists.
+# Serialized — ONE TPU client at a time, and the host stays otherwise idle
+# so timed sections are uncontended (bench.py's load_avg caveat).
+#
+#   bash tools/capture_r3.sh 2>&1 | tee -a /tmp/capture_r3.log
+#
+# Captures (skipping any whose artifact already validates):
+#  1. results/calib_episode_r3.json   — N=62 calib episode wall-clock
+#  2. results/host_seg_bench.json     — fused vs segmented at N=40
+#  3. results/per_bench.json e2e TPU  — PER end-to-end train-step decision
+#  4. results/bench_primary_r3.json   — clean uncontended primary re-run
+set -uo pipefail
+cd "$(dirname "$0")/.." || exit 1
+rm -f /tmp/bench_primary_r3.out   # never promote a stale prior-session run
+
+ATTEMPT_TIMEOUT=${ATTEMPT_TIMEOUT:-3000}   # 50 min: compiles alone can eat 25
+MAX_ATTEMPTS=${MAX_ATTEMPTS:-4}
+BACKOFF=${BACKOFF:-120}
+
+try_capture () {
+  local name="$1" check="$2"; shift 2
+  if eval "$check"; then echo "[capture] $name: already done, skipping"; return 0; fi
+  for i in $(seq 1 "$MAX_ATTEMPTS"); do
+    echo "[capture] $name: attempt $i/$MAX_ATTEMPTS ($(date -u +%H:%M:%S))"
+    timeout --kill-after=30 "$ATTEMPT_TIMEOUT" "$@" && rc=0 || rc=$?
+    if eval "$check"; then echo "[capture] $name: DONE"; return 0; fi
+    echo "[capture] $name: attempt $i failed rc=$rc"
+    if [ "$i" -lt "$MAX_ATTEMPTS" ]; then sleep "$BACKOFF"; fi
+  done
+  echo "[capture] $name: GAVE UP after $MAX_ATTEMPTS attempts"
+  return 1
+}
+
+# per_bench.json layout (tools/bench_per.py:250-254): {"measurements":
+# [{"label": "<platform>_<ts>", "rows": [...], "e2e_rows": [...]}]}
+tpu_e2e_done () {
+  python - <<'EOF'
+import json, sys
+try:
+    doc = json.load(open("results/per_bench.json"))
+except Exception:
+    sys.exit(1)
+for m in doc.get("measurements", []):
+    label = m.get("label", "")
+    # labels get hand-renamed after landing (e.g. "round2_tpu_standalone"),
+    # so match the platform anywhere in the label, not just the prefix
+    if any(p in label for p in ("tpu", "axon")) and any(
+            r.get("stage") == "e2e_train_step" for r in m.get("e2e_rows", [])):
+        sys.exit(0)
+sys.exit(1)
+EOF
+}
+
+# host_seg_bench.json is a LIST of cases; success = a TPU-platform case
+# whose host_segmented path produced a steady-state time (it runs after
+# fused, so its presence means the session survived the whole case; fused
+# may carry either steady_s or the recorded watchdog error — both are the
+# evidence this capture exists to collect).
+host_seg_done () {
+  python - <<'EOF'
+import json, sys
+try:
+    cases = json.load(open("results/host_seg_bench.json"))
+except Exception:
+    sys.exit(1)
+if isinstance(cases, dict):
+    cases = [cases]
+for c in cases:
+    if c.get("platform") in ("tpu", "axon") and \
+            c.get("host_segmented", {}).get("steady_s") is not None:
+        sys.exit(0)
+sys.exit(1)
+EOF
+}
+
+# The primary re-run writes its raw line to /tmp; validation + promotion to
+# results/ happens HERE (not in the attempt command) so timeout signals
+# python directly (exec) instead of an intermediate bash that would orphan
+# a still-running TPU client into the next attempt.  Validation: no CPU
+# fallback ("platform" key appears only then, and the probe is NOT forced
+# so it really checks the device) AND uncontended (load < 1.2 — the whole
+# point of the re-run; the chip-session number had load 1.5).
+primary_done () {
+  test -f results/bench_primary_r3.json && return 0
+  python - <<'EOF'
+import json, sys
+try:
+    with open("/tmp/bench_primary_r3.out") as fh:
+        line = fh.readlines()[-1]
+    out = json.loads(line)
+except Exception:
+    sys.exit(1)
+if out.get("metric") != "enet_sac_env_steps_per_sec" or "platform" in out:
+    sys.exit(1)          # "platform" key is only added on CPU fallback
+if out.get("host_load_avg_1m", 9.9) >= 1.2:
+    sys.exit(1)          # contended — not the clean number we came for
+with open("results/bench_primary_r3.json", "w") as fh:
+    json.dump(out, fh, indent=1)
+sys.exit(0)
+EOF
+}
+
+try_capture "calib_episode"  "test -f results/calib_episode_r3.json" \
+  python tools/capture_calib_episode.py
+
+try_capture "host_seg"       "host_seg_done" \
+  python tools/bench_host_seg.py --stations 40 --nf 8 --admm 10
+
+try_capture "per_e2e_tpu"    "tpu_e2e_done" \
+  python tools/bench_per.py --e2e_iters 100
+
+try_capture "primary_clean"  "primary_done" \
+  bash -c 'exec env BENCH_SKIP_CALIB=1 BENCH_PROBE_ATTEMPTS=1 python bench.py > /tmp/bench_primary_r3.out 2>/tmp/bench_primary_r3.err'
+
+echo "[capture] all done ($(date -u +%H:%M:%S))"
