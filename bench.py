@@ -36,7 +36,7 @@ import numpy as np
 from smartcal_tpu.envs import enet
 from smartcal_tpu.rl import replay as rp
 from smartcal_tpu.rl import sac
-from smartcal_tpu.train.enet_sac import make_episode_fn
+from smartcal_tpu.train.enet_sac import make_episode_block_fn, make_episode_fn
 from smartcal_tpu.utils import enable_compilation_cache
 
 # Warm-cache state is recorded in the calib extra ("compile_cache_warm")
@@ -158,6 +158,45 @@ def bench_batched_throughput(n_envs: int = 16, timed_steps: int = 60):
     }
 
 
+def bench_epblock_throughput(block: int = 20, timed_blocks: int = 3):
+    """Sequential 1:1 protocol with episode-block dispatch.
+
+    Same computation and learning dynamics as the primary metric (strictly
+    sequential episodes, one learn per env step), but ``block`` whole
+    episodes run per device dispatch (`make_episode_block_fn`) instead of
+    one — on the chip the per-episode round trip over the tunnel dominates
+    the small enet program, so this measures the framework without that
+    dispatch tax.  Reported as an extra; the primary keeps the rounds-1/2
+    per-episode-dispatch protocol for comparability.
+    """
+    env_cfg, agent_cfg = bench_configs()
+    key = jax.random.PRNGKey(0)
+    key, k0 = jax.random.split(key)
+    agent_state = sac.sac_init(k0, agent_cfg)
+    buf = rp.replay_init(agent_cfg.mem_size,
+                         rp.transition_spec(env_cfg.obs_dim, 2))
+    block_fn = make_episode_block_fn(env_cfg, agent_cfg, STEPS_PER_EPISODE,
+                                     False, block)
+    # one untimed block: compile + fill the buffer past batch_size
+    # (block*steps = 100 >= 64) so the timed blocks run learn() live
+    agent_state, buf, key, scores = block_fn(agent_state, buf, key)
+    jax.block_until_ready(scores)
+
+    t0 = time.time()
+    for _ in range(timed_blocks):
+        agent_state, buf, key, scores = block_fn(agent_state, buf, key)
+    jax.block_until_ready(scores)
+    wall = time.time() - t0
+    return {
+        "metric": "enet_sac_env_steps_per_sec_epblock",
+        "value": round(timed_blocks * block * STEPS_PER_EPISODE / wall, 2),
+        "unit": "env-steps/sec/chip",
+        "vs_baseline": None,
+        "episodes_per_dispatch": block,
+        "note": "sequential 1:1 protocol, whole-episode lax.scan blocks",
+    }
+
+
 def bench_calib_episode():
     """Calibration episode wall-clock at LOFAR scale (N=62, B=1891, Nf=8)."""
     from smartcal_tpu.envs.radio import RadioBackend
@@ -267,7 +306,9 @@ def main():
         # never let the optional extras discard the measured primary metric
         out["extra"] = []
         extras = [(bench_batched_throughput,
-                   "enet_sac_env_steps_per_sec_batched")]
+                   "enet_sac_env_steps_per_sec_batched"),
+                  (bench_epblock_throughput,
+                   "enet_sac_env_steps_per_sec_epblock")]
         if platform == "tpu":
             extras.append((bench_calib_episode, "calib_episode_wall_clock"))
         else:

@@ -18,20 +18,44 @@ cd "$(dirname "$0")/.." || exit 1
 rm -f /tmp/bench_primary_r3.out   # never promote a stale prior-session run
 
 ATTEMPT_TIMEOUT=${ATTEMPT_TIMEOUT:-3000}   # 50 min: compiles alone can eat 25
-MAX_ATTEMPTS=${MAX_ATTEMPTS:-4}
-BACKOFF=${BACKOFF:-120}
+MAX_ATTEMPTS=${MAX_ATTEMPTS:-12}           # dead-tunnel probes are cheap (~2.5 min)
+HEAVY_MAX=${HEAVY_MAX:-4}                  # full attempts are not (up to 50 min each)
+BACKOFF=${BACKOFF:-300}
 
+# Healthy backend init is fast (<1 min observed); a sick tunnel hangs
+# ~25-27 min and then fails UNAVAILABLE.  Gate every heavy attempt on a
+# 150 s probe so dead-tunnel cycles cost ~2.5 min, not 27.  (Probe and
+# attempt are sequential — never two TPU clients at once.)
+tunnel_ok () {
+  local p
+  p=$(timeout --kill-after=15 150 python -c \
+      "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+  [ "$p" = "axon" ] || [ "$p" = "tpu" ]
+}
+
+# Probe failures and heavy-attempt failures count SEPARATELY: probes are
+# ~2.5 min (12 allowed), heavy attempts can burn ATTEMPT_TIMEOUT+BACKOFF
+# each (4 allowed) — otherwise a tunnel that passes the probe but drops
+# mid-capture could loop for ~11 h on one item.
 try_capture () {
   local name="$1" check="$2"; shift 2
+  local probes=0 heavies=0 rc
   if eval "$check"; then echo "[capture] $name: already done, skipping"; return 0; fi
-  for i in $(seq 1 "$MAX_ATTEMPTS"); do
-    echo "[capture] $name: attempt $i/$MAX_ATTEMPTS ($(date -u +%H:%M:%S))"
+  while [ "$probes" -lt "$MAX_ATTEMPTS" ] && [ "$heavies" -lt "$HEAVY_MAX" ]; do
+    if ! tunnel_ok; then
+      probes=$((probes + 1))
+      echo "[capture] $name: probe $probes/$MAX_ATTEMPTS found tunnel dead ($(date -u +%H:%M:%S))"
+      sleep "$BACKOFF"
+      continue
+    fi
+    heavies=$((heavies + 1))
+    echo "[capture] $name: attempt $heavies/$HEAVY_MAX ($(date -u +%H:%M:%S))"
     timeout --kill-after=30 "$ATTEMPT_TIMEOUT" "$@" && rc=0 || rc=$?
     if eval "$check"; then echo "[capture] $name: DONE"; return 0; fi
-    echo "[capture] $name: attempt $i failed rc=$rc"
-    if [ "$i" -lt "$MAX_ATTEMPTS" ]; then sleep "$BACKOFF"; fi
+    echo "[capture] $name: attempt $heavies failed rc=$rc"
+    sleep "$BACKOFF"
   done
-  echo "[capture] $name: GAVE UP after $MAX_ATTEMPTS attempts"
+  echo "[capture] $name: GAVE UP (probes=$probes heavies=$heavies)"
   return 1
 }
 
