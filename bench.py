@@ -52,6 +52,17 @@ TIMED_EPISODES = 20  # 100 timed env steps, same as the reference measurement
 FALLBACK_BASELINE = 4.16  # tools/reference_baseline.json, torch CPU
 
 
+def load_baseline():
+    """The torch-reference steps/s measured on this host class
+    (tools/measure_reference.py), shared by every 1:1-protocol metric."""
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "tools", "reference_baseline.json")
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            return json.load(f)["value"]
+    return FALLBACK_BASELINE
+
+
 def probe_backend():
     """(platform, note): 'tpu' if the backend initializes within a
     bounded time, else 'cpu' with a note explaining why.
@@ -187,11 +198,14 @@ def bench_epblock_throughput(block: int = 20, timed_blocks: int = 3):
         agent_state, buf, key, scores = block_fn(agent_state, buf, key)
     jax.block_until_ready(scores)
     wall = time.time() - t0
+    value = timed_blocks * block * STEPS_PER_EPISODE / wall
     return {
         "metric": "enet_sac_env_steps_per_sec_epblock",
-        "value": round(timed_blocks * block * STEPS_PER_EPISODE / wall, 2),
+        "value": round(value, 2),
         "unit": "env-steps/sec/chip",
-        "vs_baseline": None,
+        # same 1:1 sequential protocol as the primary, so the torch
+        # reference baseline is directly comparable
+        "vs_baseline": round(value / load_baseline(), 2),
         "episodes_per_dispatch": block,
         "note": "sequential 1:1 protocol, whole-episode lax.scan blocks",
     }
@@ -283,12 +297,7 @@ def main():
     steps = TIMED_EPISODES * STEPS_PER_EPISODE
     value = steps / wall
 
-    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "tools", "reference_baseline.json")
-    baseline = FALLBACK_BASELINE
-    if os.path.exists(baseline_path):
-        with open(baseline_path) as f:
-            baseline = json.load(f)["value"]
+    baseline = load_baseline()
 
     out = {
         "metric": "enet_sac_env_steps_per_sec",

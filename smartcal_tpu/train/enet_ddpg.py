@@ -13,11 +13,11 @@ import jax.numpy as jnp
 from ..envs import enet
 from ..rl import ddpg
 from ..rl import replay as rp
+from .blocks import make_block_fn
 
 
-def make_episode_fn(env_cfg: enet.EnetConfig, cfg: ddpg.DDPGConfig,
-                    steps: int):
-    @jax.jit
+def _make_episode_body(env_cfg: enet.EnetConfig, cfg: ddpg.DDPGConfig,
+                       steps: int):
     def run_episode(agent_state, buf, key):
         k_reset, k_scan = jax.random.split(key)
         env_state, obs = enet.reset(env_cfg, k_reset)
@@ -42,6 +42,17 @@ def make_episode_fn(env_cfg: enet.EnetConfig, cfg: ddpg.DDPGConfig,
         return agent_state, buf, jnp.mean(rewards)
 
     return run_episode
+
+
+def make_episode_fn(env_cfg: enet.EnetConfig, cfg: ddpg.DDPGConfig,
+                    steps: int):
+    return jax.jit(_make_episode_body(env_cfg, cfg, steps))
+
+
+def make_episode_block_fn(env_cfg: enet.EnetConfig, cfg: ddpg.DDPGConfig,
+                          steps: int, block: int):
+    """``block`` sequential episodes per dispatch (see train.blocks)."""
+    return make_block_fn(_make_episode_body(env_cfg, cfg, steps), block)
 
 
 def train_fused(seed=0, episodes=1000, steps=5, M=20, N=20, quiet=False,

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from ..envs import enet
 from ..rl import replay as rp
 from ..rl import sac
+from .blocks import make_block_fn
 
 
 def _make_episode_body(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
@@ -94,21 +95,8 @@ def make_episode_block_fn(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
     Returns ``(agent_state, buf, key, scores[block])`` with the advanced
     key, so a driver can continue the exact same chain across blocks.
     """
-    body = _make_episode_body(env_cfg, agent_cfg, steps, use_hint)
-
-    @jax.jit
-    def run_block(agent_state, buf, key):
-        def one(carry, _):
-            agent_state, buf, key = carry
-            key, k = jax.random.split(key)
-            agent_state, buf, score = body(agent_state, buf, k)
-            return (agent_state, buf, key), score
-
-        (agent_state, buf, key), scores = jax.lax.scan(
-            one, (agent_state, buf, key), None, length=block)
-        return agent_state, buf, key, scores
-
-    return run_block
+    return make_block_fn(
+        _make_episode_body(env_cfg, agent_cfg, steps, use_hint), block)
 
 
 def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,

@@ -15,11 +15,11 @@ import jax.numpy as jnp
 from ..envs import enet
 from ..rl import replay as rp
 from ..rl import td3
+from .blocks import make_block_fn
 
 
-def make_episode_fn(env_cfg: enet.EnetConfig, cfg: td3.TD3Config,
-                    steps: int, use_hint: bool):
-    @jax.jit
+def _make_episode_body(env_cfg: enet.EnetConfig, cfg: td3.TD3Config,
+                       steps: int, use_hint: bool):
     def run_episode(agent_state, buf, key):
         k_reset, k_noise, k_scan = jax.random.split(key, 3)
         env_state, obs = enet.reset(env_cfg, k_reset)
@@ -53,6 +53,18 @@ def make_episode_fn(env_cfg: enet.EnetConfig, cfg: td3.TD3Config,
         return agent_state, buf, jnp.mean(rewards)
 
     return run_episode
+
+
+def make_episode_fn(env_cfg: enet.EnetConfig, cfg: td3.TD3Config,
+                    steps: int, use_hint: bool):
+    return jax.jit(_make_episode_body(env_cfg, cfg, steps, use_hint))
+
+
+def make_episode_block_fn(env_cfg: enet.EnetConfig, cfg: td3.TD3Config,
+                          steps: int, use_hint: bool, block: int):
+    """``block`` sequential episodes per dispatch (see train.blocks)."""
+    return make_block_fn(_make_episode_body(env_cfg, cfg, steps, use_hint),
+                         block)
 
 
 def train_fused(seed=0, episodes=1000, steps=4, use_hint=True,

@@ -54,6 +54,54 @@ def test_block_matches_per_episode_chain():
                                rtol=1e-4, atol=1e-5)
 
 
+def _chain_parity(ep_fn, blk_fn, st, buf, key, block):
+    """Per-episode chain vs one block dispatch: identical scores/state."""
+    st_a, buf_a, key_a = st, buf, key
+    scores_a = []
+    for _ in range(block):
+        key_a, k = jax.random.split(key_a)
+        st_a, buf_a, s = ep_fn(st_a, buf_a, k)
+        scores_a.append(float(s))
+    st_b, buf_b, key_b, scores_b = blk_fn(st, buf, key)
+    np.testing.assert_allclose(np.asarray(scores_b), np.asarray(scores_a),
+                               rtol=1e-4, atol=1e-5)
+    assert int(buf_b.cntr) == int(buf_a.cntr)
+    np.testing.assert_array_equal(np.asarray(key_b), np.asarray(key_a))
+
+
+def test_block_matches_per_episode_td3():
+    from smartcal_tpu.rl import td3
+    from smartcal_tpu.train import enet_td3
+
+    env_cfg = enet.EnetConfig(M=6, N=6)
+    cfg = td3.TD3Config(obs_dim=env_cfg.obs_dim, n_actions=2, batch_size=8,
+                        mem_size=64, warmup=4)
+    key = jax.random.PRNGKey(1)
+    key, k0 = jax.random.split(key)
+    st = td3.td3_init(k0, cfg)
+    buf = rp.replay_init(cfg.mem_size, rp.transition_spec(env_cfg.obs_dim, 2))
+    _chain_parity(enet_td3.make_episode_fn(env_cfg, cfg, 2, use_hint=False),
+                  enet_td3.make_episode_block_fn(env_cfg, cfg, 2,
+                                                 use_hint=False, block=3),
+                  st, buf, key, 3)
+
+
+def test_block_matches_per_episode_ddpg():
+    from smartcal_tpu.rl import ddpg
+    from smartcal_tpu.train import enet_ddpg
+
+    env_cfg = enet.EnetConfig(M=6, N=6)
+    cfg = ddpg.DDPGConfig(obs_dim=env_cfg.obs_dim, n_actions=2, batch_size=8,
+                          mem_size=64)
+    key = jax.random.PRNGKey(2)
+    key, k0 = jax.random.split(key)
+    st = ddpg.ddpg_init(k0, cfg)
+    buf = rp.replay_init(cfg.mem_size, rp.transition_spec(env_cfg.obs_dim, 2))
+    _chain_parity(enet_ddpg.make_episode_fn(env_cfg, cfg, 2),
+                  enet_ddpg.make_episode_block_fn(env_cfg, cfg, 2, block=3),
+                  st, buf, key, 3)
+
+
 def test_train_fused_block_mode(tmp_path, monkeypatch):
     """block>1 produces the same per-episode score stream layout, including
     a non-multiple episode count (remainder runs per-episode)."""
