@@ -13,9 +13,10 @@
 #  2. results/host_seg_bench.json     — fused vs segmented at N=40
 #  3. results/per_bench.json e2e TPU  — PER end-to-end train-step decision
 #  4. results/bench_primary_r3.json   — clean uncontended primary re-run
+#  5. results/bench_extras_r3.json    — on-chip batched + epblock extras
 set -uo pipefail
 cd "$(dirname "$0")/.." || exit 1
-rm -f /tmp/bench_primary_r3.out   # never promote a stale prior-session run
+rm -f /tmp/bench_primary_r3.out /tmp/bench_extras_r3.out  # never promote stale prior-session runs
 
 ATTEMPT_TIMEOUT=${ATTEMPT_TIMEOUT:-3000}   # 50 min: compiles alone can eat 25
 MAX_ATTEMPTS=${MAX_ATTEMPTS:-12}           # dead-tunnel probes are cheap (~2.5 min)
@@ -137,7 +138,37 @@ try_capture "host_seg"       "host_seg_done" \
 try_capture "per_e2e_tpu"    "tpu_e2e_done" \
   python tools/bench_per.py --e2e_iters 100
 
+# extras validation: a TPU-platform run (no "platform" key) whose epblock
+# extra carries a value
+extras_done () {
+  test -f results/bench_extras_r3.json && return 0
+  python - <<'EOF'
+import json, sys
+try:
+    with open("/tmp/bench_extras_r3.out") as fh:
+        out = json.loads(fh.readlines()[-1])
+except Exception:
+    sys.exit(1)
+if "platform" in out:
+    sys.exit(1)          # CPU fallback
+ep = [e for e in out.get("extra", [])
+      if e.get("metric") == "enet_sac_env_steps_per_sec_epblock"
+      and "value" in e]
+if not ep:
+    sys.exit(1)
+with open("results/bench_extras_r3.json", "w") as fh:
+    json.dump(out, fh, indent=1)
+sys.exit(0)
+EOF
+}
+
+# BENCH_SKIP_EXTRAS: primary ONLY — an extra that wedges after the primary
+# was measured would discard it (the process gets timeout-killed before
+# its single JSON line prints)
 try_capture "primary_clean"  "primary_done" \
-  bash -c 'exec env BENCH_SKIP_CALIB=1 BENCH_PROBE_ATTEMPTS=1 python bench.py > /tmp/bench_primary_r3.out 2>/tmp/bench_primary_r3.err'
+  bash -c 'exec env BENCH_SKIP_EXTRAS=1 BENCH_PROBE_ATTEMPTS=1 python bench.py > /tmp/bench_primary_r3.out 2>/tmp/bench_primary_r3.err'
+
+try_capture "extras_tpu"     "extras_done" \
+  bash -c 'exec env BENCH_SKIP_CALIB=1 BENCH_PROBE_ATTEMPTS=1 python bench.py > /tmp/bench_extras_r3.out 2>/tmp/bench_extras_r3.err'
 
 echo "[capture] all done ($(date -u +%H:%M:%S))"

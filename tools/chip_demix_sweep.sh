@@ -1,0 +1,36 @@
+#!/bin/bash
+# Staged chip-scale demixing hint experiment (the discrimination run from
+# results/demix_curves_r3/README.md: "environment too clean" vs "N=62
+# scale required").  Runs ONE paired seed of the light-depth sweep at the
+# LOFAR station count on the chip, probe-gated like tools/capture_r3.sh.
+# Fire when the tunnel is healthy and no other TPU client is running:
+#
+#   bash tools/chip_demix_sweep.sh [SEED] [EPISODES] 2>&1 | tee -a /tmp/chip_demix.log
+#
+# Cost estimate: the hint arm is ~32 masked solves/episode; at N=62 light
+# depth each fused solve is seconds on the chip, so one 100-episode paired
+# seed is roughly 1-3 h of tunnel time.  Artifacts land in
+# results/demix_curves_n62/ and are analyzed by summarize_demix_curves.py.
+set -uo pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+SEED=${1:-0}
+EPISODES=${2:-100}
+OUTDIR=results/demix_curves_n62
+
+probe=$(timeout --kill-after=15 150 python -c \
+  "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+if [ "$probe" != "axon" ] && [ "$probe" != "tpu" ]; then
+  echo "TPU not reachable (probe: '$probe') — aborting chip demix sweep" >&2
+  exit 1
+fi
+
+mkdir -p "$OUTDIR"
+SMARTCAL_CLEAR_EVERY=100 python tools/sweep_demix.py --light \
+  --stations 62 --seed0 "$SEED" --seeds 1 --episodes "$EPISODES" \
+  --platform axon --outdir "$OUTDIR" || {
+    echo "sweep failed — NOT summarizing partial artifacts" >&2
+    echo "(delete the truncated <tag>.jsonl before re-running its tag)" >&2
+    exit 1
+  }
+python tools/summarize_demix_curves.py "$OUTDIR"
