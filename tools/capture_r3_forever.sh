@@ -1,0 +1,28 @@
+#!/bin/bash
+# Outer restart loop for tools/capture_r3.sh: a single pass gives each
+# capture a bounded probe/heavy budget, so an item that gave up early
+# (e.g. calib at the head of the list) would never see a tunnel that
+# recovers hours later.  This wrapper re-runs the pass until every
+# artifact exists (done items are skipped instantly by their checks) or
+# the wrapper is killed at session end.
+set -uo pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+all_done () {
+  test -f results/calib_episode_r3.json || return 1
+  test -f results/bench_primary_r3.json || return 1
+  test -f results/bench_extras_r3.json  || return 1
+  # host_seg + per_e2e validate inside capture_r3.sh; approximate here
+  # with file presence (a pass re-runs them if their checks disagree)
+  test -f results/host_seg_bench.json   || return 1
+  return 0
+}
+
+pass=0
+while true; do
+  pass=$((pass + 1))
+  echo "[forever] pass $pass ($(date -u +%H:%M:%S))"
+  bash tools/capture_r3.sh
+  if all_done; then echo "[forever] all artifacts captured"; break; fi
+  sleep 120
+done
