@@ -170,6 +170,49 @@ def bench_batched_throughput(n_envs: int = 16, timed_steps: int = 60):
     }
 
 
+def bench_batched_block_throughput(n_envs: int = 16,
+                                   episodes_per_dispatch: int = 20,
+                                   timed_dispatches: int = 2):
+    """Batched envs AND whole-episode scan blocks: the ceiling mode.
+
+    Combines the two dispatch-amortizations — 16 vmapped dp-sharded envs
+    per vector step (bench_batched_throughput) and whole episodes scanned
+    inside one program (bench_epblock_throughput) — so one dispatch runs
+    episodes_per_dispatch full episodes of the entire env batch.  Same
+    1-learn-per-vector-step ratio as the batched metric.
+    """
+    from smartcal_tpu.parallel import make_mesh, make_parallel_sac
+
+    env_cfg, agent_cfg = bench_configs()
+    mesh = make_mesh((1,), ("dp",), devices=jax.devices()[:1])
+    init_fn, _, _, run_block = make_parallel_sac(
+        env_cfg, agent_cfg, mesh, n_envs=n_envs,
+        episode_block=(STEPS_PER_EPISODE, episodes_per_dispatch))
+    st = init_fn(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(1)
+    key, k = jax.random.split(key)
+    st, scores = run_block(st, k)          # compile + fill
+    jax.block_until_ready(scores)
+
+    t0 = time.time()
+    for _ in range(timed_dispatches):
+        key, k = jax.random.split(key)
+        st, scores = run_block(st, k)
+    jax.block_until_ready(scores)
+    wall = time.time() - t0
+    steps = timed_dispatches * episodes_per_dispatch * STEPS_PER_EPISODE
+    return {
+        "metric": "enet_sac_env_steps_per_sec_batched_epblock",
+        "value": round(n_envs * steps / wall, 2),
+        "unit": "env-steps/sec/chip",
+        "vs_baseline": None,
+        "n_envs": n_envs,
+        "episodes_per_dispatch": episodes_per_dispatch,
+        "note": "vmapped env batch x whole-episode scan blocks, "
+                "1 learn per vector step",
+    }
+
+
 def bench_epblock_throughput(block: int = 20, timed_blocks: int = 3):
     """Sequential 1:1 protocol with episode-block dispatch.
 
@@ -341,7 +384,9 @@ def main():
         extras = [(bench_batched_throughput,
                    "enet_sac_env_steps_per_sec_batched"),
                   (bench_epblock_throughput,
-                   "enet_sac_env_steps_per_sec_epblock")]
+                   "enet_sac_env_steps_per_sec_epblock"),
+                  (bench_batched_block_throughput,
+                   "enet_sac_env_steps_per_sec_batched_epblock")]
         if os.environ.get("BENCH_SKIP_CALIB"):
             out["extra"].append({"metric": "calib_episode_wall_clock",
                                  "skipped": "BENCH_SKIP_CALIB=1"})

@@ -46,12 +46,23 @@ class ParallelTrainState(NamedTuple):
 
 
 def make_parallel_sac(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
-                      mesh: Mesh, n_envs: int, use_hint: bool = False):
-    """Build (init_fn, train_step_fn) with shardings bound to ``mesh``.
+                      mesh: Mesh, n_envs: int, use_hint: bool = False,
+                      episode_block=None):
+    """Build (init_fn, train_step_fn, reset_envs_fn) with shardings bound
+    to ``mesh``.
 
     ``n_envs`` must be divisible by the ``dp`` axis size.  One train step =
     every env advances one step (vmapped, dp-sharded), the transition batch
     is stored, and one SAC learn step runs.
+
+    ``episode_block=(steps_per_episode, episodes_per_dispatch)`` appends a
+    fourth return value: a jitted ``run_block(st, key) -> (st, scores)``
+    that scans whole episodes (reset + steps, exactly the host cadence of
+    the per-step API) inside ONE dispatch — the dp-sharded analogue of
+    ``train.blocks`` (dispatch round trips dominate the small enet
+    programs on the chip; see bench.py round-3 capture).  ``scores`` has
+    shape (episodes_per_dispatch,), each the mean step reward of that
+    episode across the env batch.
     """
     if n_envs % mesh.shape["dp"] != 0:
         raise ValueError(f"n_envs={n_envs} not divisible by dp axis "
@@ -144,7 +155,32 @@ def make_parallel_sac(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
     reset_envs_jit = jax.jit(reset_envs,
                              in_shardings=(shardings, repl),
                              out_shardings=shardings)
-    return init_fn, train_step_jit, reset_envs_jit
+    if episode_block is None:
+        return init_fn, train_step_jit, reset_envs_jit
+
+    steps_pe, eps_pd = (int(v) for v in episode_block)
+
+    def run_block(st: ParallelTrainState, key):
+        def one_episode(carry, k):
+            st = carry
+            k_reset, k_steps = jax.random.split(k)
+            st = reset_envs(st, k_reset)
+
+            def one_step(st, ks):
+                st, metrics = train_step(st, ks)
+                return st, metrics["mean_reward"]
+
+            st, mean_rs = jax.lax.scan(
+                one_step, st, jax.random.split(k_steps, steps_pe))
+            return st, jnp.mean(mean_rs)
+
+        keys = jax.random.split(key, eps_pd)
+        return jax.lax.scan(one_episode, st, keys)
+
+    run_block_jit = jax.jit(run_block,
+                            in_shardings=(shardings, repl),
+                            out_shardings=(shardings, repl))
+    return init_fn, train_step_jit, reset_envs_jit, run_block_jit
 
 
 def episode_scores(metrics_list, steps_per_episode: int):
