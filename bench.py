@@ -397,7 +397,23 @@ def main():
             # fallback turn the whole bench into a hang
             out["extra"].append({"metric": "calib_episode_wall_clock",
                                  "skipped": "no TPU (CPU fallback active)"})
+        # time budget across extras: if a driver-side timeout killed the
+        # process mid-extra, the already-measured primary (printed only at
+        # the end) would be lost — skip remaining extras instead.  Chip
+        # compiles can eat 10-25 min each on a cold cache, CPU block modes
+        # minutes; 1500 s keeps the full set on a warm cache.
+        try:
+            extras_budget = float(os.environ.get("BENCH_EXTRAS_BUDGET_S",
+                                                 "1500"))
+        except ValueError:
+            extras_budget = 1500.0
+        t_extras = time.time()
         for fn, name in extras:
+            if time.time() - t_extras > extras_budget:
+                out["extra"].append({"metric": name,
+                                     "skipped": "extras time budget "
+                                                f"({extras_budget:.0f}s) spent"})
+                continue
             try:
                 out["extra"].append(fn())
             except Exception as e:  # noqa: BLE001 — report, don't drop
