@@ -21,7 +21,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..cal import influence as influence_mod
 from ..cal import solver
@@ -58,8 +58,74 @@ def solve_admm_sharded(mesh: Mesh, V, C, freqs, f0, rho,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P()),
         out_specs=out_specs,
-        check_rep=False)
+        check_vma=False)
     return sharded(V, C, jnp.asarray(freqs), jnp.asarray(rho))
+
+
+def solve_admm_sharded2d(mesh: Mesh, Vb, Cb, freqs_b, f0_b, rho,
+                         cfg: solver.SolverConfig, dp_axis: str = "dp",
+                         fp_axis: str = "fp",
+                         n_chunks: Optional[int] = None,
+                         admm_iters=None, freq_range=None):
+    """Batched frequency-consensus solves on a 2D (dp x fp) mesh.
+
+    The v5e-16 operating point (BASELINE.md): a BATCH of independent
+    episodes sharded over ``dp`` while each episode's frequency axis is
+    sharded over ``fp`` — the ADMM Z-update psums over ``fp`` only, so
+    consensus never crosses episode boundaries.  Vb (E, Nf, T, B, 2, 2, 2),
+    Cb (E, Nf, K, T*B, 4, 2), freqs_b (E, Nf), f0_b (E,); E must divide by
+    the dp size and Nf by the fp size; rho (K,) is shared.
+
+    The reference reaches this regime by scheduling one sagecal-mpi job
+    per episode side by side (calibration/docal.sh); here it is one SPMD
+    program on one mesh.
+    """
+    ndp, nfp = mesh.shape[dp_axis], mesh.shape[fp_axis]
+    if Vb.shape[0] % ndp != 0:
+        raise ValueError(f"E={Vb.shape[0]} not divisible by {dp_axis}={ndp}")
+    if Vb.shape[1] % nfp != 0:
+        raise ValueError(f"Nf={Vb.shape[1]} not divisible by "
+                         f"{fp_axis}={nfp}")
+    # Bernstein basis band edges are PER EPISODE (each episode's own
+    # global band — a single shared range would build every episode a
+    # different basis than its own per-episode solve uses), carried as
+    # vmapped scalars; an explicit freq_range applies to all episodes.
+    E = Vb.shape[0]
+    if cfg.polytype == 1:
+        if freq_range is not None:
+            flo = jnp.full((E,), freq_range[0], jnp.float32)
+            fhi = jnp.full((E,), freq_range[1], jnp.float32)
+        else:
+            fa = jnp.asarray(freqs_b, jnp.float32)
+            flo, fhi = fa.min(axis=1), fa.max(axis=1)
+    else:
+        flo = fhi = jnp.zeros((E,), jnp.float32)  # unused by polytype 0
+
+    fn = partial(solver.solve_admm, cfg=cfg, axis_name=fp_axis,
+                 n_chunks=n_chunks, admm_iters=admm_iters)
+    use_range = cfg.polytype == 1
+
+    def one(v, c, f, f0, lo, hi, r):
+        return fn(v, c, f, f0, r,
+                  freq_range=(lo, hi) if use_range else None)
+
+    # per-episode outputs batch over the leading dp axis; within an
+    # episode the layout matches solve_admm_sharded
+    out_specs = solver.SolveResult(
+        J=P(dp_axis, fp_axis), Z=P(dp_axis), residual=P(dp_axis, fp_axis),
+        sigma_res=P(dp_axis), sigma_data=P(dp_axis),
+        final_cost=P(dp_axis, fp_axis))
+    sharded = shard_map(
+        jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0, None)),
+        mesh=mesh,
+        in_specs=(P(dp_axis, fp_axis), P(dp_axis, fp_axis),
+                  P(dp_axis, fp_axis), P(dp_axis), P(dp_axis), P(dp_axis),
+                  P()),
+        out_specs=out_specs,
+        check_vma=False)
+    return sharded(Vb, Cb, jnp.asarray(freqs_b),
+                   jnp.asarray(f0_b, jnp.float32), flo, fhi,
+                   jnp.asarray(rho))
 
 
 def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
@@ -96,7 +162,7 @@ def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
         vis=P(None, axis) if perdir else P(axis), llr=P(axis))
     sharded = shard_map(local, mesh=mesh,
                        in_specs=(P(axis), P(axis), P(axis)),
-                       out_specs=out_specs, check_rep=False)
+                       out_specs=out_specs, check_vma=False)
     res = sharded(R4, C4, J)
     # local results concatenate along the chunk-major sample axis, which is
     # exactly the global time-major order

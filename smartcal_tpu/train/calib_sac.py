@@ -36,6 +36,13 @@ def main(argv=None):
     p.add_argument("--npix", type=int, default=128)
     p.add_argument("--small", action="store_true",
                    help="tiny shapes for smoke runs")
+    p.add_argument("--medium", action="store_true",
+                   help="N=stations, thinner time/freq axes + lighter "
+                        "inner solves (CPU-tractable sweeps; see "
+                        "demix_sac.make_backend)")
+    p.add_argument("--light", action="store_true",
+                   help="one solution interval, minimum useful solver "
+                        "iterations (multi-seed CPU sweeps)")
     p.add_argument("--load", action="store_true")
     p.add_argument("--prefix", type=str, default="calib_sac")
     p.add_argument("--metrics", type=str, default=None,
@@ -46,6 +53,12 @@ def main(argv=None):
         backend = RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
                                admm_iters=2, lbfgs_iters=3, init_iters=5,
                                npix=32)
+    elif args.light or args.medium:
+        # same CPU-tractable tiers as the demixing sweep (the two envs
+        # share the backend, so the measured per-solve costs in
+        # results/demix_curves_r3/README.md apply here too)
+        from .demix_sac import make_backend
+        backend = make_backend(args)
     else:
         backend = RadioBackend(n_stations=args.stations, npix=args.npix)
     env = CalibEnv(M=args.M, provide_hint=args.use_hint, backend=backend,

@@ -1,0 +1,268 @@
+"""Strict in-memory emulation of the ``casacore.tables`` API surface the
+cal/ms_io.py casacore adapter uses, backed by the checked-in layout
+contract ``tests/fixtures/lofar_ms_layout.json``.
+
+Purpose (VERDICT r3 item 6): python-casacore is not installable in this
+image, so the adapter's real-MS branches (``ms_io.py`` ``_casa_*``) had
+never executed.  This fake serves a synthetic MS with the REAL LOFAR
+layout — row axis first from getcol, (nchan, ncorr) data cells,
+autocorrelation rows present, baseline order shuffled within each time
+block — and is STRICT: requesting a column or subtable the fixture does
+not declare raises, so any adapter drift away from the real layout fails
+the contract tests instead of passing silently.
+
+The emulated surface (only what the adapter touches):
+    tables.table(path, readonly=) -> Table
+    tables.makecoldesc(name, desc) -> dict
+    Table.query(sortlist=, columns=) -> Table view (putcol writes through
+        the sort mapping to the underlying rows, as casacore reference
+        tables do)
+    Table.getcol/putcol/colnames/nrows/close/getcoldesc/addcols
+    Table[i] -> row dict
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+_FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "lofar_ms_layout.json")
+
+with open(_FIXTURE) as _fh:
+    LAYOUT = json.load(_fh)
+
+_DTYPES = {"float64": np.float64, "float32": np.float32, "int32": np.int32,
+           "complex64": np.complex64, "bool": np.bool_}
+
+# path -> _Store; populated by make_lofar_ms()
+REGISTRY: dict = {}
+
+
+class _Store:
+    """One MS: main-table columns + subtable columns, with the declared
+    layout tracked so getcol can be strict."""
+
+    def __init__(self, main, subtables):
+        self.main = main                      # {col: row-major ndarray}
+        self.subtables = subtables            # {name: {col: ndarray}}
+        self.declared_main = set(LAYOUT["main"]["columns"])
+        self.addable = set(LAYOUT["main"].get("data_columns_addable", []))
+
+
+def _resolve(path):
+    """(store, table_name): subtables are opened as <ms>/<SUBTABLE>."""
+    path = os.path.normpath(str(path))
+    if path in REGISTRY:
+        return REGISTRY[path], None
+    parent, name = os.path.split(path)
+    parent = os.path.normpath(parent)
+    if parent in REGISTRY:
+        if name not in LAYOUT["subtables"]:
+            raise RuntimeError(f"undeclared subtable {name!r} — not in the "
+                               "LOFAR layout fixture")
+        return REGISTRY[parent], name
+    raise RuntimeError(f"Table {path} does not exist")
+
+
+class table:  # noqa: N801 - casacore's own casing
+    def __init__(self, path, readonly=True, **_):
+        self._store, self._sub = _resolve(path)
+        self._readonly = readonly
+        self._rows = None                     # query views set this
+        self._cols = None
+
+    # -- internals ----------------------------------------------------------
+    def _colmap(self):
+        if self._sub is not None:
+            return self._store.subtables[self._sub]
+        return self._store.main
+
+    def _declared(self, name):
+        if self._sub is not None:
+            return name in LAYOUT["subtables"][self._sub]["columns"]
+        return (name in self._store.declared_main
+                or name in self._colmap())    # addcols() extends the layout
+
+    # -- casacore API -------------------------------------------------------
+    def query(self, sortlist="", columns=""):
+        if self._sub is not None:
+            raise RuntimeError("query on a subtable is not part of the "
+                               "contract")
+        cols = self._colmap()
+        order = np.arange(len(cols["TIME"]))
+        if sortlist:
+            keys = [k.strip() for k in sortlist.split(",")]
+            for k in keys:
+                if not self._declared(k):
+                    raise RuntimeError(f"sort key {k!r} undeclared")
+            # np.lexsort: last key is primary
+            order = np.lexsort(tuple(cols[k] for k in reversed(keys)))
+        view = table.__new__(table)
+        view._store, view._sub = self._store, None
+        view._readonly = self._readonly
+        view._rows = order
+        view._cols = ([c.strip() for c in columns.split(",") if c.strip()]
+                      if columns else None)
+        return view
+
+    def getcol(self, name):
+        if self._cols is not None and name not in self._cols:
+            raise RuntimeError(f"column {name!r} not selected in query")
+        if not self._declared(name):
+            raise RuntimeError(f"column {name!r} undeclared in the LOFAR "
+                               "layout fixture")
+        arr = self._colmap()[name]
+        if self._rows is not None:
+            arr = arr[self._rows]
+        return arr.copy()
+
+    def putcol(self, name, value):
+        if self._readonly:
+            raise RuntimeError("table opened readonly")
+        if self._cols is not None and name not in self._cols:
+            raise RuntimeError(f"column {name!r} not selected in query")
+        if not self._declared(name):
+            raise RuntimeError(f"column {name!r} undeclared")
+        cols = self._colmap()
+        cur = cols[name]
+        value = np.asarray(value, cur.dtype)
+        if self._rows is not None:
+            # write through the sort mapping, like a casacore reference table
+            cur[self._rows] = value
+        else:
+            if value.shape != cur.shape:
+                raise RuntimeError(f"putcol shape {value.shape} != "
+                                   f"{cur.shape}")
+            cols[name] = value
+
+    def colnames(self):
+        return list(self._colmap().keys())
+
+    def nrows(self):
+        cols = self._colmap()
+        first = next(iter(cols.values()))
+        return len(first) if self._rows is None else len(self._rows)
+
+    def getcoldesc(self, name):
+        if not self._declared(name):
+            raise RuntimeError(f"column {name!r} undeclared")
+        arr = self._colmap()[name]
+        return {"name": name, "valueType": str(arr.dtype),
+                "shape": list(arr.shape[1:])}
+
+    def addcols(self, desc):
+        name = desc["name"]
+        if self._sub is not None:
+            raise RuntimeError("addcols on a subtable is not part of the "
+                               "contract")
+        if name not in self._store.addable:
+            raise RuntimeError(
+                f"adding {name!r} is outside the fixture contract "
+                f"(addable: {sorted(self._store.addable)})")
+        vt = str(desc.get("valueType", "complex64"))
+        ref_dtype = _DTYPES.get(
+            vt, np.complex64 if "complex" in vt else np.float64)
+        shape = tuple(desc.get("shape", []))
+        n = self.nrows()
+        self._colmap()[name] = np.zeros((n,) + shape, ref_dtype)
+
+    def __getitem__(self, i):
+        cols = self._colmap()
+        rows = self._rows if self._rows is not None else np.arange(
+            len(next(iter(cols.values()))))
+        return {k: v[rows[i]] for k, v in cols.items()}
+
+    def close(self):
+        pass
+
+
+def makecoldesc(name, desc):
+    out = dict(desc)
+    out["name"] = name
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fixture-true MS builder
+# ---------------------------------------------------------------------------
+
+def make_lofar_ms(path, n_stations=7, n_times=4, nchan=8, freq0=120e6,
+                  chan_width=48828.125, ra0=1.2, dec0=0.9, seed=0):
+    """Create a registry-backed fake LOFAR MS at ``path``.
+
+    Layout per the fixture: (B + N) rows per time including
+    autocorrelations, TIME-ordered blocks with the baseline order inside
+    each block SHUFFLED (the adapter must sort, not assume), DATA cells
+    (nchan, 4) complex64 with a deterministic value pattern
+    ``val(t, p, q, c, corr)`` the contract tests can predict.
+    """
+    rng = np.random.default_rng(seed)
+    t0 = float(LAYOUT["typical"]["time_epoch_s"])
+    interval = float(LAYOUT["typical"]["interval_s"])
+    p, q = np.triu_indices(n_stations, 0)     # incl. autocorr
+    npair = p.size
+
+    times, a1, a2, uvw, data = [], [], [], [], []
+    for t in range(n_times):
+        perm = rng.permutation(npair)          # shuffled inside the block
+        pp, qq = p[perm], q[perm]
+        times.append(np.full(npair, t0 + t * interval))
+        a1.append(pp)
+        a2.append(qq)
+        uvw.append(np.stack([(pp - qq) * 100.0,
+                             (pp + qq) * 10.0 + t,
+                             np.zeros(npair)], axis=1))
+        cell = (value_pattern(t, pp, qq)[:, None, None]
+                + 1j * np.arange(nchan)[None, :, None]
+                + np.arange(4)[None, None, :] * 0.25)
+        data.append(cell)
+    nrows = n_times * npair
+    main = {
+        "TIME": np.concatenate(times).astype(np.float64),
+        "ANTENNA1": np.concatenate(a1).astype(np.int32),
+        "ANTENNA2": np.concatenate(a2).astype(np.int32),
+        "UVW": np.concatenate(uvw).astype(np.float64),
+        "INTERVAL": np.full(nrows, interval, np.float64),
+        "EXPOSURE": np.full(nrows, interval, np.float64),
+        "DATA": np.concatenate(data).astype(np.complex64),
+        "FLAG": np.zeros((nrows, nchan, 4), np.bool_),
+        "WEIGHT": np.ones((nrows, 4), np.float32),
+    }
+    freqs = freq0 + chan_width * np.arange(nchan)
+    subtables = {
+        "SPECTRAL_WINDOW": {
+            "CHAN_FREQ": freqs[None, :].astype(np.float64),
+            "REF_FREQUENCY": np.asarray([freqs.mean()], np.float64),
+        },
+        "FIELD": {
+            "PHASE_DIR": np.asarray([[[ra0, dec0]]], np.float64),
+        },
+    }
+    # validate what we built against the declared fixture before serving it
+    for name, spec in LAYOUT["main"]["columns"].items():
+        arr = main[name]
+        assert arr.dtype == _DTYPES[spec["dtype"]], (name, arr.dtype)
+        want = tuple(nchan if s == "nchan" else 4 if s == "ncorr" else s
+                     for s in spec["cell_shape"])
+        assert arr.shape[1:] == want, (name, arr.shape, want)
+    for sub, spec in LAYOUT["subtables"].items():
+        for name, cspec in spec["columns"].items():
+            arr = subtables[sub][name]
+            assert arr.dtype == _DTYPES[cspec["dtype"]], (sub, name)
+            assert arr.ndim == len(cspec["getcol_shape"]), (sub, name)
+
+    os.makedirs(path, exist_ok=True)
+    # the table.dat marker is how ms_io recognizes a casacore MS on disk
+    with open(os.path.join(path, "table.dat"), "wb") as fh:
+        fh.write(b"\0")
+    REGISTRY[os.path.normpath(str(path))] = _Store(main, subtables)
+    return path
+
+
+def value_pattern(t, p, q):
+    """Deterministic channel-0 real part: row identity the tests predict."""
+    return (np.asarray(t) * 1000.0 + np.asarray(p) * 10.0
+            + np.asarray(q)).astype(np.float64)

@@ -17,7 +17,8 @@ from smartcal_tpu.cal import solver
 from smartcal_tpu.envs.radio import RadioBackend
 from smartcal_tpu.parallel import make_mesh
 from smartcal_tpu.parallel.sharded_cal import (influence_sharded,
-                                               solve_admm_sharded)
+                                               solve_admm_sharded,
+                                               solve_admm_sharded2d)
 
 N_STATIONS = 6
 NFREQ = 4
@@ -60,6 +61,81 @@ def test_solve_admm_sharded_matches_single_device(episode):
             / max(np.linalg.norm(np.asarray(ref.residual)), 1e-12)) < 1e-3
     assert float(out.sigma_res) == pytest.approx(float(ref.sigma_res),
                                                  rel=1e-3)
+
+
+@pytest.mark.parametrize("polytype", [0, 1])
+def test_solve_admm_sharded2d_matches_per_episode(episode, polytype):
+    """The 2D (dp x fp) batched solve equals each episode's own solve:
+    dp only batches, fp carries the consensus psum (VERDICT r3 item 7 —
+    the v5e-16 mesh shape on the 8-device virtual CPU mesh).  polytype=1
+    checks the per-episode Bernstein band-edge plumbing: each episode's
+    basis must use its OWN band, not a shared union range."""
+    backend, ep0, mdl = episode
+    ep1, _ = backend.new_demixing_episode(jax.random.PRNGKey(11), K)
+    cfg = backend._solver_cfg(K)._replace(polytype=polytype)
+    rho = jnp.asarray(mdl.rho)
+
+    mesh2d = make_mesh((2, 4), ("dp", "fp"))
+    Vb = jnp.stack([ep0.V, ep1.V])
+    Cb = jnp.stack([ep0.Ccal, ep1.Ccal])
+    freqs_b = jnp.stack([jnp.asarray(ep0.obs.freqs),
+                         jnp.asarray(ep1.obs.freqs)])
+    f0_b = jnp.asarray([ep0.f0, ep1.f0])
+    out = solve_admm_sharded2d(mesh2d, Vb, Cb, freqs_b, f0_b, rho, cfg,
+                               n_chunks=backend.n_chunks)
+
+    for i, ep in enumerate((ep0, ep1)):
+        ref = solver.solve_admm(ep.V, ep.Ccal, ep.obs.freqs, ep.f0, rho,
+                                cfg, n_chunks=backend.n_chunks)
+        # same reduction-order tolerance story as the 1D sharded test
+        np.testing.assert_allclose(np.asarray(out.Z[i]),
+                                   np.asarray(ref.Z), rtol=5e-3, atol=5e-4)
+        np.testing.assert_allclose(np.asarray(out.J[i]),
+                                   np.asarray(ref.J), rtol=5e-3, atol=5e-4)
+        assert float(out.sigma_res[i]) == pytest.approx(
+            float(ref.sigma_res), rel=1e-3)
+
+
+@pytest.mark.slow
+def test_solve_admm_sharded_lofar_scale():
+    """N=62 (B=1891) sharded solve on the 8-device mesh — the BASELINE
+    v5e-16 workload shape at minimum iteration depth (slow tier)."""
+    backend = RadioBackend(n_stations=62, n_freqs=8, n_times=4, tdelta=2,
+                           admm_iters=2, lbfgs_iters=2, init_iters=3,
+                           npix=8)
+    ep, mdl = backend.new_demixing_episode(jax.random.PRNGKey(5), K)
+    cfg = backend._solver_cfg(K)
+    mesh = make_mesh((8,), ("fp",))
+    out = solve_admm_sharded(mesh, ep.V, ep.Ccal, ep.obs.freqs, ep.f0,
+                             jnp.asarray(mdl.rho), cfg, axis="fp",
+                             n_chunks=backend.n_chunks)
+    assert np.asarray(out.J).shape[0] == 8
+    assert np.all(np.isfinite(np.asarray(out.J)))
+    assert np.isfinite(float(out.sigma_res))
+    # the solve must actually reduce the residual below the data level
+    assert float(out.sigma_res) < float(out.sigma_data)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_16_devices():
+    """16-device readiness: the full dryrun (SAC train step + distributed
+    demixing learner + 1D fp solve + 2D dp x fp solve) in a fresh
+    subprocess with 16 virtual CPU devices (VERDICT r3 item 7)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    env.pop("JAX_PLATFORMS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(16); "
+         "print('DRYRUN16 OK')"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=1800)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "DRYRUN16 OK" in r.stdout
 
 
 @pytest.mark.parametrize("perdir", [False, True])

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Outer restart loop for tools/capture_round.sh (round 4): a single pass
+# gives each capture a bounded probe/heavy budget, so an item that gave up
+# early (e.g. calib at the head of the list) would never see a tunnel that
+# recovers hours later.  This wrapper re-runs the pass until EVERY check
+# validates (done items are skipped instantly) or the wrapper is killed at
+# session end.  Doneness uses the same tools/chip_checks.py predicates as
+# the pass itself (ADVICE r3: the r3 wrapper approximated per_e2e/host_seg
+# with file presence and could exit with the chip measurement missing).
+set -uo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export CAPTURE_ROUND=${CAPTURE_ROUND:-r4}
+
+all_done () {
+  test -f "results/calib_episode_${CAPTURE_ROUND}.json" || return 1
+  test -f "results/bench_primary_${CAPTURE_ROUND}.json" || return 1
+  test -f "results/bench_extras_${CAPTURE_ROUND}.json"  || return 1
+  python tools/chip_checks.py host_seg || return 1
+  python tools/chip_checks.py per_e2e  || return 1
+  return 0
+}
+
+pass=0
+while true; do
+  pass=$((pass + 1))
+  echo "[forever] pass $pass ($(date -u +%H:%M:%S))"
+  bash tools/capture_round.sh
+  if all_done; then echo "[forever] all artifacts captured"; break; fi
+  sleep 120
+done

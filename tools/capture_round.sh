@@ -1,0 +1,93 @@
+#!/bin/bash
+# Round-agnostic chip-capture pass (generalizes r3's capture_r3.sh; ADVICE
+# r3 asked for no baked-in round names).  The axon tunnel is intermittently
+# UNAVAILABLE (server-side compiles run 10-25 min; the backend drops
+# between/during long compiles), so each remaining capture retries in a
+# FRESH process with a bounded timeout until its output artifact validates
+# (tools/chip_checks.py — shared with the forever wrapper).  Serialized —
+# ONE TPU client at a time, and the host stays otherwise idle so timed
+# sections are uncontended (bench.py's load_avg caveat).
+#
+#   CAPTURE_ROUND=r4 bash tools/capture_round.sh 2>&1 | tee -a /tmp/capture_r4.log
+#
+# Captures (skipping any whose artifact already validates):
+#  1. results/calib_episode_${R}.json — N=62 calib episode wall-clock
+#  2. results/host_seg_bench.json     — fused vs segmented at N=40 (chip case)
+#  3. results/per_bench.json e2e TPU  — PER end-to-end train-step decision
+#  4. results/bench_primary_${R}.json — clean uncontended primary
+#  5. results/bench_extras_${R}.json  — on-chip batched + epblock extras
+set -uo pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+R=${CAPTURE_ROUND:-r4}
+rm -f "/tmp/bench_primary_${R}.out" "/tmp/bench_extras_${R}.out"  # never promote stale prior-session runs
+
+ATTEMPT_TIMEOUT=${ATTEMPT_TIMEOUT:-3000}   # 50 min: compiles alone can eat 25
+MAX_ATTEMPTS=${MAX_ATTEMPTS:-12}           # dead-tunnel probes are cheap (~2.5 min)
+HEAVY_MAX=${HEAVY_MAX:-4}                  # full attempts are not (up to 50 min each)
+BACKOFF=${BACKOFF:-300}
+
+# Healthy backend init is fast (<1 min observed); a sick tunnel hangs
+# ~25-27 min and then fails UNAVAILABLE.  Gate every heavy attempt on a
+# 150 s probe so dead-tunnel cycles cost ~2.5 min, not 27.  (Probe and
+# attempt are sequential — never two TPU clients at once.)
+tunnel_ok () {
+  local p
+  p=$(timeout --kill-after=15 150 python -c \
+      "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+  [ "$p" = "axon" ] || [ "$p" = "tpu" ]
+}
+
+# Probe failures and heavy-attempt failures count SEPARATELY: probes are
+# ~2.5 min (12 allowed), heavy attempts can burn ATTEMPT_TIMEOUT+BACKOFF
+# each (4 allowed) — otherwise a tunnel that passes the probe but drops
+# mid-capture could loop for ~11 h on one item.
+try_capture () {
+  local name="$1" check="$2"; shift 2
+  local probes=0 heavies=0 rc
+  if eval "$check"; then echo "[capture] $name: already done, skipping"; return 0; fi
+  while [ "$probes" -lt "$MAX_ATTEMPTS" ] && [ "$heavies" -lt "$HEAVY_MAX" ]; do
+    if ! tunnel_ok; then
+      probes=$((probes + 1))
+      echo "[capture] $name: probe $probes/$MAX_ATTEMPTS found tunnel dead ($(date -u +%H:%M:%S))"
+      sleep "$BACKOFF"
+      continue
+    fi
+    heavies=$((heavies + 1))
+    echo "[capture] $name: attempt $heavies/$HEAVY_MAX ($(date -u +%H:%M:%S))"
+    # single-core host: hold the window lock so cooperating CPU jobs
+    # (tools/wait_no_chip.sh between sweep units) pause during timed
+    # sections — a concurrent sweep halves the measured rate and trips
+    # the load<1.2 uncontended gate
+    touch /tmp/tpu_window.lock
+    timeout --kill-after=30 "$ATTEMPT_TIMEOUT" "$@" && rc=0 || rc=$?
+    rm -f /tmp/tpu_window.lock
+    if eval "$check"; then echo "[capture] $name: DONE"; return 0; fi
+    echo "[capture] $name: attempt $heavies failed rc=$rc"
+    sleep "$BACKOFF"
+  done
+  echo "[capture] $name: GAVE UP (probes=$probes heavies=$heavies)"
+  return 1
+}
+
+try_capture "calib_episode"  "test -f results/calib_episode_${R}.json" \
+  python tools/capture_calib_episode.py --out "results/calib_episode_${R}.json"
+
+try_capture "host_seg"       "python tools/chip_checks.py host_seg" \
+  python tools/bench_host_seg.py --stations 40 --nf 8 --admm 10
+
+try_capture "per_e2e_tpu"    "python tools/chip_checks.py per_e2e" \
+  python tools/bench_per.py --e2e_iters 100
+
+# BENCH_SKIP_EXTRAS: primary ONLY — an extra that wedges after the primary
+# was measured would discard the single end-of-process JSON line (the
+# in-bench partial flush to /tmp is a second line of defense).  exec so
+# timeout signals python directly instead of an intermediate bash that
+# would orphan a still-running TPU client into the next attempt.
+try_capture "primary_clean"  "python tools/chip_checks.py primary /tmp/bench_primary_${R}.out ${R}" \
+  bash -c "exec env BENCH_SKIP_EXTRAS=1 BENCH_PROBE_ATTEMPTS=1 python bench.py > /tmp/bench_primary_${R}.out 2>/tmp/bench_primary_${R}.err"
+
+try_capture "extras_tpu"     "python tools/chip_checks.py extras /tmp/bench_extras_${R}.out ${R}" \
+  bash -c "exec env BENCH_SKIP_CALIB=1 BENCH_PROBE_ATTEMPTS=1 python bench.py > /tmp/bench_extras_${R}.out 2>/tmp/bench_extras_${R}.err"
+
+echo "[capture] pass complete ($(date -u +%H:%M:%S))"

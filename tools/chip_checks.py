@@ -1,0 +1,127 @@
+"""Shared artifact-validation checks for the chip-capture scripts.
+
+One place for every "is this capture already landed?" predicate, so the
+per-pass capture script (tools/capture_round.sh) and the outer restart
+wrapper (tools/capture_r4_forever.sh) can never disagree about doneness
+(ADVICE r3: the r3 wrapper omitted the per_e2e check and could declare
+victory with the PER chip measurement still missing).
+
+Usage (exit code 0 = done / promoted, 1 = not yet):
+    python tools/chip_checks.py per_e2e
+    python tools/chip_checks.py host_seg
+    python tools/chip_checks.py primary /tmp/bench_primary_r4.out r4
+    python tools/chip_checks.py extras  /tmp/bench_extras_r4.out  r4
+"""
+
+import json
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS = os.path.join(REPO, "results")
+
+
+def per_e2e_done() -> bool:
+    """A TPU-platform measurement with an e2e_train_step row exists
+    (layout: tools/bench_per.py — measurements[].{label,rows,e2e_rows})."""
+    try:
+        doc = json.load(open(os.path.join(RESULTS, "per_bench.json")))
+    except Exception:
+        return False
+    for m in doc.get("measurements", []):
+        label = m.get("label", "")
+        # labels get hand-renamed after landing ("round2_tpu_standalone"),
+        # so match the platform anywhere in the label
+        if any(p in label for p in ("tpu", "axon")) and any(
+                r.get("stage") == "e2e_train_step"
+                for r in m.get("e2e_rows", [])):
+            return True
+    return False
+
+
+def host_seg_done() -> bool:
+    """A TPU-platform case whose host_segmented path has a steady-state
+    time (it runs after fused, so its presence proves the whole case)."""
+    try:
+        cases = json.load(open(os.path.join(RESULTS, "host_seg_bench.json")))
+    except Exception:
+        return False
+    if isinstance(cases, dict):
+        cases = [cases]
+    return any(c.get("platform") in ("tpu", "axon")
+               and c.get("host_segmented", {}).get("steady_s") is not None
+               for c in cases)
+
+
+def _load_last_json_line(path: str):
+    with open(path) as fh:
+        return json.loads(fh.readlines()[-1])
+
+
+def primary_done(tmpfile: str, rnd: str) -> bool:
+    """Validate + promote a clean uncontended on-chip primary.
+
+    Validation: not a CPU fallback (the "platform" key only appears then,
+    and the capture command does NOT force the platform, so it really
+    checked the device) AND uncontended (load < 1.2).  On success the
+    payload is promoted to results/bench_primary_<rnd>.json and copied to
+    results/latest_chip_capture.json (the round-agnostic pointer bench.py
+    surfaces on future CPU fallbacks).
+    """
+    final = os.path.join(RESULTS, f"bench_primary_{rnd}.json")
+    if not os.path.exists(final):
+        try:
+            out = _load_last_json_line(tmpfile)
+        except Exception:
+            return False
+        if out.get("metric") != "enet_sac_env_steps_per_sec" \
+                or "platform" in out:
+            return False
+        if out.get("host_load_avg_1m", 9.9) >= 1.2:
+            return False  # contended — not the clean number we came for
+        with open(final, "w") as fh:
+            json.dump(out, fh, indent=1)
+    shutil.copyfile(final, os.path.join(RESULTS, "latest_chip_capture.json"))
+    return True
+
+
+def extras_done(tmpfile: str, rnd: str) -> bool:
+    """Validate + promote an on-chip extras run: a TPU-platform payload
+    whose epblock extra carries a value."""
+    final = os.path.join(RESULTS, f"bench_extras_{rnd}.json")
+    if os.path.exists(final):
+        return True
+    try:
+        out = _load_last_json_line(tmpfile)
+    except Exception:
+        return False
+    if "platform" in out:
+        return False  # CPU fallback
+    if not any(e.get("metric") == "enet_sac_env_steps_per_sec_epblock"
+               and "value" in e for e in out.get("extra", [])):
+        return False
+    with open(final, "w") as fh:
+        json.dump(out, fh, indent=1)
+    return True
+
+
+def main(argv):
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    cmd, args = argv[0], argv[1:]
+    if cmd == "per_e2e":
+        return 0 if per_e2e_done() else 1
+    if cmd == "host_seg":
+        return 0 if host_seg_done() else 1
+    if cmd == "primary":
+        return 0 if primary_done(*args) else 1
+    if cmd == "extras":
+        return 0 if extras_done(*args) else 1
+    print(f"unknown check {cmd!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

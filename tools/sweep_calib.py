@@ -1,0 +1,95 @@
+"""Multi-seed hint/no-hint CALIBRATION SAC learning-curve sweep.
+
+VERDICT r3 item 3: CalibEnv (ADMM-rho tuning — the reference's core
+workload, ``calibration/main_sac.py``) is the one capability with no
+empirical learning demonstration in ``results/``.  This sweep drives the
+REAL ``train.calib_sac`` episode loop (M=10 directions, 2M=20 actions,
+batch 32, mem 10000, rewards > 1 scaled x10 — main_sac.py parity) at a
+CPU-tractable backend tier and records per-episode JSONL in the
+demix_curves format so ``tools/summarize_demix_curves.py`` aggregates it
+unchanged (same paired statistics + plot).
+
+Reference behavior to match: reward (sigma_data/sigma_res + influence
+term) improves over ~50 games x 4 steps (``calibration/main_sac.py:8-21``,
+``calibenv.py:170``).
+
+Usage:
+    python tools/sweep_calib.py --outdir results/calib_curves \
+        [--seeds 5] [--episodes 120] [--light | --medium] [--platform cpu]
+
+Cooperates with the chip-capture loop: between runs it waits on
+``tools/wait_no_chip.sh`` so timed on-chip windows stay uncontended.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(TOOLS))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seeds", default=5, type=int)
+    p.add_argument("--episodes", default=120, type=int)
+    p.add_argument("--steps", default=4, type=int)
+    p.add_argument("--M", default=10, type=int)
+    p.add_argument("--stations", default=14, type=int)
+    p.add_argument("--npix", default=128, type=int)
+    p.add_argument("--outdir", default="results/calib_curves")
+    p.add_argument("--platform", default=None, choices=["cpu", "axon"])
+    p.add_argument("--modes", default="nohint,hint")
+    p.add_argument("--medium", action="store_true")
+    p.add_argument("--light", action="store_true")
+    p.add_argument("--seed0", default=0, type=int,
+                   help="first seed (parallel shards of the sweep)")
+    args = p.parse_args()
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from smartcal_tpu.train import calib_sac
+    from smartcal_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    os.makedirs(args.outdir, exist_ok=True)
+    t_start = time.time()
+    # seed-major order: a truncated sweep still has paired hint/no-hint
+    # runs for every completed seed
+    for seed in range(args.seed0, args.seed0 + args.seeds):
+        for mode in args.modes.split(","):
+            use_hint = mode == "hint"
+            tag = f"{mode}_seed{seed}"
+            dst = os.path.join(args.outdir, f"{tag}.jsonl")
+            if os.path.exists(dst):
+                print(f"skip {tag} (exists)", flush=True)
+                continue
+            # yield to an active chip-capture window (single-core host)
+            subprocess.run(["bash", os.path.join(TOOLS, "wait_no_chip.sh")],
+                           check=False)
+            t0 = time.time()
+            argv = ["--seed", str(seed), "--episodes", str(args.episodes),
+                    "--steps", str(args.steps), "--M", str(args.M),
+                    "--stations", str(args.stations),
+                    "--npix", str(args.npix),
+                    "--prefix", os.path.join(args.outdir, f"{tag}_ck"),
+                    "--metrics", dst]
+            if use_hint:
+                argv.append("--use_hint")
+            if args.medium:
+                argv.append("--medium")
+            if args.light:
+                argv.append("--light")
+            calib_sac.main(argv)
+            print(f"[{time.time() - t_start:7.0f}s] DONE {tag} "
+                  f"({time.time() - t0:.0f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Pause while a chip-capture heavy attempt holds the window lock
+# (tools/capture_round.sh).  Long CPU jobs on this single-core host call
+# this BETWEEN units (seeds, episodes-batches) so timed on-chip sections
+# stay uncontended without any tighter coordination.  A stale lock (owner
+# killed between touch and rm) expires after 60 min.
+LOCK=/tmp/tpu_window.lock
+while [ -f "$LOCK" ]; do
+  # expire stale locks: heavy attempts are bounded at 50 min
+  if [ -n "$(find "$LOCK" -mmin +60 2>/dev/null)" ]; then
+    rm -f "$LOCK"; break
+  fi
+  sleep 60
+done
