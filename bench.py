@@ -5,13 +5,19 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 Primary workload = the reference `elasticnet/main_sac.py` configuration
 (N=M=20, batch 64, mem 1024, 5 steps/episode): every env step runs the full
 inner L-BFGS elastic-net solve + influence eigen-state, and every loop
-iteration also runs the SAC learn step.  Here the whole loop is one jitted
-lax.scan per episode on the TPU.
+iteration also runs the SAC learn step.  Since round 4 the primary runs 20
+whole episodes per device dispatch (episode-block lax.scan — same
+sequential 1:1 computation, parity-tested in tests/test_epblock.py); the
+rounds-1/2/3 one-dispatch-per-episode number is the per_episode_dispatch
+extra.
 
 Baseline = the reference implementation itself (torch, this host's CPU —
 upstream publishes no numbers; see BASELINE.md), measured by
-tools/measure_reference.py with the identical protocol: warm-up until the
-replay buffer reaches batch_size, then time N timed steps.
+tools/measure_reference.py: warm-up until the replay buffer reaches
+batch_size, then time 100 steps.  The per_episode_dispatch extra keeps
+that protocol exactly; the primary runs the same sequential computation
+with a one-block (100-step) warm-up and 200 timed steps in 2 dispatches —
+steps/sec is dispatch-amortized but the per-step work is identical.
 
 ``extra`` carries BASELINE.md metric #2 — calibration-episode wall-clock at
 the REFERENCE scale (N=62 stations, B=1891 baselines, Nf=8 sub-bands,
@@ -49,7 +55,13 @@ _CACHE_WAS_WARM = bool(os.path.isdir(_CACHE_DIR) and os.listdir(_CACHE_DIR))
 enable_compilation_cache(_CACHE_DIR)
 
 STEPS_PER_EPISODE = 5
-TIMED_EPISODES = 20  # 100 timed env steps, same as the reference measurement
+# per_episode_dispatch extra only (the rounds-1/2/3 primary): 100 timed
+# env steps, matching the tools/measure_reference.py torch measurement.
+# The round-4+ primary times PRIMARY_TIMED_BLOCKS x PRIMARY_BLOCK whole
+# episodes per device dispatch instead (same sequential 1:1 computation).
+TIMED_EPISODES = 20
+PRIMARY_BLOCK = 20
+PRIMARY_TIMED_BLOCKS = 2
 FALLBACK_BASELINE = 4.16  # tools/reference_baseline.json, torch CPU
 
 
@@ -213,17 +225,14 @@ def bench_batched_block_throughput(n_envs: int = 16,
     }
 
 
-def bench_epblock_throughput(block: int = 20, timed_blocks: int = 3):
-    """Sequential 1:1 protocol with episode-block dispatch.
+def measure_epblock(block: int, timed_blocks: int, trace_dir=None):
+    """ONE episode-block measurement: sequential 1:1 computation (one
+    learn per env step, whole episodes), ``block`` episodes per device
+    dispatch, ``timed_blocks`` timed dispatches after a compile+fill
+    block.  Shared by the round-4+ primary and the epblock extra so the
+    two can never drift apart."""
+    from smartcal_tpu.utils import profiler_trace
 
-    Same computation and learning dynamics as the primary metric (strictly
-    sequential episodes, one learn per env step), but ``block`` whole
-    episodes run per device dispatch (`make_episode_block_fn`) instead of
-    one — on the chip the per-episode round trip over the tunnel dominates
-    the small enet program, so this measures the framework without that
-    dispatch tax.  Reported as an extra; the primary keeps the rounds-1/2
-    per-episode-dispatch protocol for comparability.
-    """
     env_cfg, agent_cfg = bench_configs()
     key = jax.random.PRNGKey(0)
     key, k0 = jax.random.split(key)
@@ -238,11 +247,20 @@ def bench_epblock_throughput(block: int = 20, timed_blocks: int = 3):
     jax.block_until_ready(scores)
 
     t0 = time.time()
-    for _ in range(timed_blocks):
-        agent_state, buf, key, scores = block_fn(agent_state, buf, key)
-    jax.block_until_ready(scores)
+    with profiler_trace(trace_dir):
+        for _ in range(timed_blocks):
+            agent_state, buf, key, scores = block_fn(agent_state, buf, key)
+        jax.block_until_ready(scores)
     wall = time.time() - t0
-    value = timed_blocks * block * STEPS_PER_EPISODE / wall
+    return timed_blocks * block * STEPS_PER_EPISODE / wall
+
+
+def bench_epblock_throughput(block: int = 20, timed_blocks: int = 3):
+    """Sequential 1:1 protocol with episode-block dispatch — the SAME
+    protocol as the round-4+ primary (shared measure_epblock), kept as an
+    extra so the capture validation and round-over-round extras history
+    stay continuous."""
+    value = measure_epblock(block, timed_blocks)
     return {
         "metric": "enet_sac_env_steps_per_sec_epblock",
         "value": round(value, 2),
@@ -252,6 +270,41 @@ def bench_epblock_throughput(block: int = 20, timed_blocks: int = 3):
         "vs_baseline": round(value / load_baseline(), 2),
         "episodes_per_dispatch": block,
         "note": "sequential 1:1 protocol, whole-episode lax.scan blocks",
+    }
+
+
+def bench_per_episode_dispatch():
+    """The rounds-1/2/3 primary protocol (one device dispatch per episode),
+    kept as an extra for cross-round comparability after the round-4
+    primary moved to episode-block dispatch.  On the chip this is
+    dominated by the per-episode host round trip over the tunnel — that
+    dispatch tax is exactly what the epblock primary removes."""
+    env_cfg, agent_cfg = bench_configs()
+    key = jax.random.PRNGKey(0)
+    key, k0 = jax.random.split(key)
+    agent_state = sac.sac_init(k0, agent_cfg)
+    buf = rp.replay_init(agent_cfg.mem_size,
+                         rp.transition_spec(env_cfg.obs_dim, 2))
+    episode_fn = make_episode_fn(env_cfg, agent_cfg, STEPS_PER_EPISODE,
+                                 use_hint=False)
+    while int(buf.cntr) < agent_cfg.batch_size:
+        key, k = jax.random.split(key)
+        agent_state, buf, score = episode_fn(agent_state, buf, k)
+    jax.block_until_ready(score)
+
+    t0 = time.time()
+    for _ in range(TIMED_EPISODES):
+        key, k = jax.random.split(key)
+        agent_state, buf, score = episode_fn(agent_state, buf, k)
+    jax.block_until_ready(score)
+    wall = time.time() - t0
+    value = TIMED_EPISODES * STEPS_PER_EPISODE / wall
+    return {
+        "metric": "enet_sac_env_steps_per_sec_per_episode_dispatch",
+        "value": round(value, 2),
+        "unit": "env-steps/sec/chip",
+        "vs_baseline": round(value / load_baseline(), 2),
+        "note": "rounds-1/2/3 primary protocol: one dispatch per episode",
     }
 
 
@@ -346,45 +399,27 @@ def main():
     if platform != "tpu":
         # wedge-proof: measure on CPU rather than hang on a dead tunnel
         jax.config.update("jax_platforms", "cpu")
-    env_cfg, agent_cfg = bench_configs()
 
-    key = jax.random.PRNGKey(0)
-    key, k0 = jax.random.split(key)
-    agent_state = sac.sac_init(k0, agent_cfg)
-    buf = rp.replay_init(agent_cfg.mem_size,
-                         rp.transition_spec(env_cfg.obs_dim, 2))
-    episode_fn = make_episode_fn(env_cfg, agent_cfg, STEPS_PER_EPISODE,
-                                 use_hint=False)
-
-    # warm-up: compile + fill the buffer past batch_size so learn() is live
-    while int(buf.cntr) < agent_cfg.batch_size:
-        key, k = jax.random.split(key)
-        agent_state, buf, score = episode_fn(agent_state, buf, k)
-    jax.block_until_ready(score)
-
+    # Round-4 primary protocol: SAME sequential 1:1 computation as rounds
+    # 1-3 (strictly sequential episodes, one learn per env step — parity
+    # with the reference loop is tested in tests/test_epblock.py), but
+    # PRIMARY_BLOCK whole episodes run per device dispatch via lax.scan.
+    # The old one-dispatch-per-episode number is reported as the
+    # per_episode_dispatch extra; on the chip that protocol measured the
+    # tunnel round trip, not the framework (VERDICT r3 item 2).
     # BENCH_TRACE_DIR=<dir> captures a jax.profiler trace of the timed
-    # section (answers "where does the step spend its time"; view with
-    # tensorboard --logdir <dir>)
-    from smartcal_tpu.utils import profiler_trace
-
-    t0 = time.time()
-    with profiler_trace(os.environ.get("BENCH_TRACE_DIR")):
-        for _ in range(TIMED_EPISODES):
-            key, k = jax.random.split(key)
-            agent_state, buf, score = episode_fn(agent_state, buf, k)
-        jax.block_until_ready(score)
-    wall = time.time() - t0
-
-    steps = TIMED_EPISODES * STEPS_PER_EPISODE
-    value = steps / wall
-
+    # section (view with tensorboard --logdir <dir>).
+    value = measure_epblock(PRIMARY_BLOCK, PRIMARY_TIMED_BLOCKS,
+                            os.environ.get("BENCH_TRACE_DIR"))
     baseline = load_baseline()
+    dispatch = f"episode_block({PRIMARY_BLOCK})"
 
     out = {
         "metric": "enet_sac_env_steps_per_sec",
         "value": round(value, 2),
         "unit": "env-steps/sec/chip",
         "vs_baseline": round(value / baseline, 2),
+        "dispatch": dispatch,
         # contention context: on the single-core host a concurrent sweep
         # halves the measured rate — loadavg>~1.5 means this number
         # understates the uncontended throughput
@@ -423,10 +458,21 @@ def main():
             try:
                 with open(os.path.join(results_dir, cap)) as f:
                     prior = json.load(f)
+                # rounds-1/2/3 captures predate the episode-block primary
+                # and carry no "dispatch" field — label the protocol so a
+                # tunnel-bound per-episode number is never read as the
+                # chip value of the (much faster) epblock primary
+                prior_dispatch = prior.get("dispatch",
+                                           "per_episode_dispatch")
                 out["prior_tpu_capture"] = {
                     "value": prior["value"], "unit": prior["unit"],
                     "vs_baseline": prior["vs_baseline"],
                     "source": f"results/{cap}",
+                    "dispatch": prior_dispatch,
+                    **({"protocol_mismatch":
+                        "prior capture used a different dispatch protocol "
+                        "than this run's primary; values not comparable"}
+                       if prior_dispatch != dispatch else {}),
                     **({"caveat": prior["caveat"]} if "caveat" in prior
                        else {})}
                 break
@@ -449,12 +495,17 @@ def main():
         pass
     if not os.environ.get("BENCH_SKIP_EXTRAS"):
         out["extra"] = []
-        extras = [(bench_batched_throughput,
-                   "enet_sac_env_steps_per_sec_batched"),
-                  (bench_epblock_throughput,
+        # epblock first: chip_checks.extras_done requires it for artifact
+        # promotion, and on a cold chip cache the earlier extras' compiles
+        # can exhaust the extras time budget
+        extras = [(bench_epblock_throughput,
                    "enet_sac_env_steps_per_sec_epblock"),
+                  (bench_batched_throughput,
+                   "enet_sac_env_steps_per_sec_batched"),
                   (bench_batched_block_throughput,
-                   "enet_sac_env_steps_per_sec_batched_epblock")]
+                   "enet_sac_env_steps_per_sec_batched_epblock"),
+                  (bench_per_episode_dispatch,
+                   "enet_sac_env_steps_per_sec_per_episode_dispatch")]
         if os.environ.get("BENCH_SKIP_CALIB"):
             out["extra"].append({"metric": "calib_episode_wall_clock",
                                  "skipped": "BENCH_SKIP_CALIB=1"})

@@ -46,6 +46,11 @@ def main():
     p.add_argument("--light", action="store_true",
                    help="pass --light to demix_sac (one solution "
                    "interval, minimum solver iterations)")
+    p.add_argument("--provide_influence", action="store_true",
+                   help="pass --provide_influence to demix_sac (full "
+                   "image observations — the harder-regime sweep where "
+                   "the hint plausibly binds, VERDICT r3 item 4)")
+    p.add_argument("--npix", default=128, type=int)
     p.add_argument("--seed0", default=0, type=int,
                    help="first seed (parallel shards of the sweep)")
     args = p.parse_args()
@@ -71,14 +76,22 @@ def main():
             if os.path.exists(dst):
                 print(f"skip {tag} (exists)", flush=True)
                 continue
+            # yield to an active chip-capture window (single-core host)
+            import subprocess
+            subprocess.run(["bash", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "wait_no_chip.sh")], check=False)
             t0 = time.time()
             argv = ["--seed", str(seed), "--iteration", str(args.episodes),
                     "--warmup", str(args.warmup), "--steps", str(args.steps),
                     "--K", str(args.K), "--stations", str(args.stations),
+                    "--npix", str(args.npix),
                     "--prefix", os.path.join(args.outdir, f"{tag}_ck"),
                     "--metrics", dst]
             if use_hint:
                 argv.append("--use_hint")
+            if args.provide_influence:
+                argv.append("--provide_influence")
             if args.medium:
                 argv.append("--medium")
             if args.light:
