@@ -1,0 +1,16 @@
+#!/bin/bash
+# Round-4 scheduler for the single CPU core: wait for the CalibEnv sweep
+# (tools/sweep_calib.py) to finish, then run the harder-regime demixing
+# hint pair (VERDICT r3 item 4) — K=6 with provide_influence image
+# observations at npix=64 (npix=128 measured ~190 s/episode on this core,
+# results/demix_curves_r4/README.md), one paired seed at 50 episodes.
+# Both sweeps yield to chip-capture windows between runs.
+set -uo pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+while pgrep -f "tools/sweep_calib.py" > /dev/null; do sleep 120; done
+
+SMARTCAL_CLEAR_EVERY=50 exec nice -n 19 python tools/sweep_demix.py \
+  --light --provide_influence --npix 64 --K 6 --stations 14 \
+  --seeds "${DEMIX_SEEDS:-2}" --episodes 50 --warmup 15 \
+  --outdir results/demix_curves_r4 --platform cpu

@@ -70,9 +70,18 @@ def main():
             if os.path.exists(dst):
                 print(f"skip {tag} (exists)", flush=True)
                 continue
-            # yield to an active chip-capture window (single-core host)
-            subprocess.run(["bash", os.path.join(TOOLS, "wait_no_chip.sh")],
-                           check=False)
+            # yield to an active chip-capture window (single-core host);
+            # package-anchored path: CWD- and __file__-independent
+            import smartcal_tpu
+            hook = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(smartcal_tpu.__file__))),
+                "tools", "wait_no_chip.sh")
+            if os.path.isfile(hook):
+                subprocess.run(["bash", hook], check=False)
+            else:
+                print(f"WARNING: chip-window hook missing at {hook}; "
+                      "running without the yield", flush=True)
             t0 = time.time()
             argv = ["--seed", str(seed), "--episodes", str(args.episodes),
                     "--steps", str(args.steps), "--M", str(args.M),

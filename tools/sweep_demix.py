@@ -76,11 +76,22 @@ def main():
             if os.path.exists(dst):
                 print(f"skip {tag} (exists)", flush=True)
                 continue
-            # yield to an active chip-capture window (single-core host)
+            # yield to an active chip-capture window (single-core host);
+            # resolve the hook from the package location — CWD- and
+            # __file__-independent (exec() harnesses have neither the
+            # script path nor a guaranteed repo-root CWD)
             import subprocess
-            subprocess.run(["bash", os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                "wait_no_chip.sh")], check=False)
+
+            import smartcal_tpu
+            hook = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(smartcal_tpu.__file__))),
+                "tools", "wait_no_chip.sh")
+            if os.path.isfile(hook):
+                subprocess.run(["bash", hook], check=False)
+            else:
+                print(f"WARNING: chip-window hook missing at {hook}; "
+                      "running without the yield", flush=True)
             t0 = time.time()
             argv = ["--seed", str(seed), "--iteration", str(args.episodes),
                     "--warmup", str(args.warmup), "--steps", str(args.steps),
