@@ -72,3 +72,16 @@ def pytest_collection_modifyitems(config, items):
         if "slow" in item.keywords and \
                 item.name.split("[")[0] not in explicit:
             item.add_marker(skip_slow)
+
+
+def load_tool_module(name):
+    """Import a script from tools/ by path (the tools are not a package;
+    shared by the host-side tool unit tests)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

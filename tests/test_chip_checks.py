@@ -1,0 +1,135 @@
+"""Unit tests for the chip-capture artifact validation (tools/chip_checks.py).
+
+The round's headline numbers are promoted by these predicates inside the
+unattended capture loop (tools/capture_round.sh + capture_r4_forever.sh),
+so a validation bug silently loses or mislabels a chip window.  Pure
+host-side JSON logic — no JAX, runs in milliseconds.
+"""
+
+import json
+import os
+
+import pytest
+
+from conftest import load_tool_module
+
+chip_checks = load_tool_module("chip_checks")
+
+
+@pytest.fixture()
+def results_dir(tmp_path, monkeypatch):
+    d = tmp_path / "results"
+    d.mkdir()
+    monkeypatch.setattr(chip_checks, "RESULTS", str(d))
+    return d
+
+
+def _write(path, doc):
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+
+
+# -- per_e2e ---------------------------------------------------------------
+
+def test_per_e2e_requires_tpu_label_and_e2e_rows(results_dir):
+    assert not chip_checks.per_e2e_done()          # no file
+    _write(results_dir / "per_bench.json", {"measurements": [
+        {"label": "cpu_123", "e2e_rows": [{"stage": "e2e_train_step"}]}]})
+    assert not chip_checks.per_e2e_done()          # wrong platform
+    _write(results_dir / "per_bench.json", {"measurements": [
+        {"label": "round2_tpu_standalone", "e2e_rows": []}]})
+    assert not chip_checks.per_e2e_done()          # standalone only
+    _write(results_dir / "per_bench.json", {"measurements": [
+        {"label": "round4_axon_e2e",
+         "e2e_rows": [{"stage": "e2e_train_step", "us": 123}]}]})
+    assert chip_checks.per_e2e_done()
+
+
+# -- host_seg --------------------------------------------------------------
+
+def test_host_seg_requires_tpu_steady_state(results_dir):
+    assert not chip_checks.host_seg_done()
+    _write(results_dir / "host_seg_bench.json", [
+        {"platform": "cpu", "host_segmented": {"steady_s": 417.4}}])
+    assert not chip_checks.host_seg_done()         # CPU measurement only
+    _write(results_dir / "host_seg_bench.json", [
+        {"platform": "cpu", "host_segmented": {"steady_s": 417.4}},
+        {"platform": "axon", "host_segmented": {"steady_s": None}}])
+    assert not chip_checks.host_seg_done()         # chip case incomplete
+    _write(results_dir / "host_seg_bench.json", [
+        {"platform": "axon", "host_segmented": {"steady_s": 12.3}}])
+    assert chip_checks.host_seg_done()
+    # a single dict (not a list) is accepted too
+    _write(results_dir / "host_seg_bench.json",
+           {"platform": "tpu", "host_segmented": {"steady_s": 9.9}})
+    assert chip_checks.host_seg_done()
+
+
+# -- primary ---------------------------------------------------------------
+
+GOOD_PRIMARY = {"metric": "enet_sac_env_steps_per_sec", "value": 120.0,
+                "unit": "env-steps/sec/chip", "vs_baseline": 28.8,
+                "dispatch": "episode_block(20)", "host_load_avg_1m": 0.3}
+
+
+def test_primary_rejects_cpu_fallback_and_contention(results_dir,
+                                                     tmp_path):
+    tmpfile = str(tmp_path / "out.json")
+    _write(tmpfile, dict(GOOD_PRIMARY, platform="cpu (fallback)"))
+    assert not chip_checks.primary_done(tmpfile, "r9")
+    _write(tmpfile, dict(GOOD_PRIMARY, host_load_avg_1m=1.5))
+    assert not chip_checks.primary_done(tmpfile, "r9")
+    _write(tmpfile, dict(GOOD_PRIMARY, metric="something_else"))
+    assert not chip_checks.primary_done(tmpfile, "r9")
+    assert not os.path.exists(results_dir / "bench_primary_r9.json")
+    assert not os.path.exists(results_dir / "latest_chip_capture.json")
+
+
+def test_primary_promotes_and_maintains_latest_pointer(results_dir,
+                                                       tmp_path):
+    tmpfile = str(tmp_path / "out.json")
+    _write(tmpfile, GOOD_PRIMARY)
+    assert chip_checks.primary_done(tmpfile, "r9")
+    promoted = json.load(open(results_dir / "bench_primary_r9.json"))
+    assert promoted["value"] == 120.0
+    latest = json.load(open(results_dir / "latest_chip_capture.json"))
+    assert latest == promoted
+    # idempotent re-check: final artifact exists -> done without tmpfile
+    os.remove(tmpfile)
+    assert chip_checks.primary_done(tmpfile, "r9")
+    # the last line of a multi-line tmpfile is the JSON payload
+    with open(tmpfile, "w") as fh:
+        fh.write("some warning line\n")
+        fh.write(json.dumps(dict(GOOD_PRIMARY, value=140.0)) + "\n")
+    assert chip_checks.primary_done(tmpfile, "r10")
+    assert json.load(open(results_dir /
+                          "bench_primary_r10.json"))["value"] == 140.0
+
+
+# -- extras ----------------------------------------------------------------
+
+def test_extras_requires_tpu_epblock_value(results_dir, tmp_path):
+    tmpfile = str(tmp_path / "extras.json")
+    base = {"metric": "enet_sac_env_steps_per_sec", "value": 100.0}
+    _write(tmpfile, dict(base, platform="cpu (fallback)", extra=[
+        {"metric": "enet_sac_env_steps_per_sec_epblock", "value": 70.0}]))
+    assert not chip_checks.extras_done(tmpfile, "r9")
+    _write(tmpfile, dict(base, extra=[
+        {"metric": "enet_sac_env_steps_per_sec_epblock",
+         "skipped": "extras time budget spent"}]))
+    assert not chip_checks.extras_done(tmpfile, "r9")   # no value
+    _write(tmpfile, dict(base, extra=[
+        {"metric": "enet_sac_env_steps_per_sec_epblock", "value": 150.0}]))
+    assert chip_checks.extras_done(tmpfile, "r9")
+    assert json.load(open(results_dir / "bench_extras_r9.json"))
+
+
+# -- CLI -------------------------------------------------------------------
+
+def test_cli_exit_codes(results_dir, tmp_path):
+    assert chip_checks.main(["per_e2e"]) == 1
+    assert chip_checks.main([]) == 2
+    assert chip_checks.main(["nonsense"]) == 2
+    tmpfile = str(tmp_path / "p.json")
+    _write(tmpfile, GOOD_PRIMARY)
+    assert chip_checks.main(["primary", tmpfile, "r8"]) == 0

@@ -1,0 +1,85 @@
+"""Unit tests for the sweep summarizer (tools/summarize_demix_curves.py).
+
+This tool produces the paired statistics for BOTH round-4 headline
+artifacts (results/calib_curves, results/demix_curves_r4), so its delta
+logic — including truncation of a boundary-cut run to the common length —
+must be right.  Pure numpy, no JAX.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from conftest import load_tool_module
+
+summ = load_tool_module("summarize_demix_curves")
+
+
+def test_moving_avg_window_and_short_input():
+    x = np.arange(40, dtype=float)
+    ma = summ.moving_avg(x, w=20)
+    assert len(ma) == 21
+    assert ma[0] == np.mean(x[:20])
+    assert ma[-1] == np.mean(x[-20:])
+    short = summ.moving_avg(np.asarray([1.0, 3.0]), w=20)
+    assert len(short) == 1 and short[0] == 2.0
+
+
+def test_load_runs_parses_episode_records(tmp_path):
+    for tag, scores in (("hint_seed0", [1.0, 2.0]),
+                        ("nohint_seed0", [0.5]),
+                        ("hint_seed12", [3.0])):
+        with open(tmp_path / f"{tag}.jsonl", "w") as fh:
+            for s in scores:
+                fh.write(json.dumps({"event": "episode", "score": s}) + "\n")
+            fh.write(json.dumps({"event": "other", "score": 99}) + "\n")
+    (tmp_path / "not_a_run.jsonl").write_text("{}\n")
+    runs = summ.load_runs(str(tmp_path))
+    assert set(runs) == {("hint", 0), ("nohint", 0), ("hint", 12)}
+    np.testing.assert_allclose(runs[("hint", 0)], [1.0, 2.0])
+
+
+def _mk_runs(deltas, n=100, base=0.0):
+    """Paired runs where the hint arm's scores sit ``delta`` above the
+    nohint arm throughout — every paired statistic equals delta."""
+    runs = {}
+    for s, d in enumerate(deltas):
+        ramp = base + np.linspace(0.0, 1.0, n)
+        runs[("nohint", s)] = ramp
+        runs[("hint", s)] = ramp + d
+    return runs
+
+
+def test_summarize_paired_deltas_and_tests():
+    runs = _mk_runs([0.1, 0.2, 0.3, 0.4, 0.5])
+    per_run, agg, paired = summ.summarize(runs)
+    assert len(per_run) == 10
+    assert agg["hint"]["n_runs"] == 5
+    assert paired["n_pairs"] == 5
+    np.testing.assert_allclose(paired["auc_mean"]["deltas"],
+                               [0.1, 0.2, 0.3, 0.4, 0.5], atol=1e-4)
+    assert paired["auc_mean"]["n_positive"] == 5
+    # 5/5 positive: exact sign test reaches its floor p = 2 * 0.5^5
+    assert paired["auc_mean"]["sign_p"] <= 0.0625 + 1e-9
+    np.testing.assert_allclose(paired["tail_median"]["median_delta"], 0.3,
+                               atol=1e-4)
+
+
+def test_summarize_truncates_boundary_cut_pairs():
+    """A seed whose hint arm was cut at the round boundary must compare
+    the COMMON window, not a 100-episode tail vs a 30-episode tail."""
+    runs = _mk_runs([0.0])
+    # hint arm truncated mid-learning; identical to nohint over the
+    # common prefix -> every paired delta must be exactly 0
+    runs[("hint", 0)] = runs[("hint", 0)][:30]
+    _, _, paired = summ.summarize(runs)
+    assert paired["auc_mean"]["deltas"] == [0.0]
+    assert paired["tail_median"]["deltas"] == [0.0]
+
+
+def test_summarize_no_pairs():
+    runs = {("hint", 0): np.ones(10)}
+    _, agg, paired = summ.summarize(runs)
+    assert paired is None
+    assert "nohint" not in agg

@@ -48,13 +48,13 @@ def moving_avg(x, w=20):
     return (c[w:] - c[:-w]) / w
 
 
-def main():
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("sweep_dir", nargs="?", default=DEFAULT_DIR)
-    OUT = ap.parse_args().sweep_dir
-    runs = load_runs(OUT)
-    if not runs:
-        raise SystemExit(f"no runs found under {OUT}")
+def summarize(runs):
+    """(per_run, aggregate, paired) summaries from {(mode, seed): scores}.
+
+    Paired deltas TRUNCATE both arms of a seed to the shorter length so a
+    run cut off by a round boundary compares like-for-like windows (the
+    sweep READMEs rely on this; comparing a 150-episode tail against a
+    90-episode tail would mix learning stages)."""
     summary = []
     for (mode, seed), sc in sorted(runs.items()):
         ma = moving_avg(sc)
@@ -81,12 +81,17 @@ def main():
     seeds = sorted({s for (m, s) in runs if m == "hint"}
                    & {s for (m, s) in runs if m == "nohint"})
     if seeds:
-        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        here = os.path.dirname(os.path.abspath(__file__))
+        if here not in sys.path:   # summarize() is reusable — no dup spam
+            sys.path.insert(0, here)
         from enet_hint_stats import sign_test_p, wilcoxon_exact_p
 
         def stats_of(fn):
-            deltas = [fn(runs[("hint", s)]) - fn(runs[("nohint", s)])
-                      for s in seeds]
+            deltas = []
+            for s in seeds:
+                h, n = runs[("hint", s)], runs[("nohint", s)]
+                ln = min(len(h), len(n))
+                deltas.append(fn(h[:ln]) - fn(n[:ln]))
             return {"deltas": [round(float(d), 4) for d in deltas],
                     "median_delta": round(float(np.median(deltas)), 4),
                     "n_positive": int(sum(d > 0 for d in deltas)),
@@ -102,6 +107,17 @@ def main():
             # — an agent that reaches the plateau earlier scores higher)
             "auc_mean": stats_of(lambda sc: float(np.mean(sc))),
         }
+    return summary, agg, paired
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("sweep_dir", nargs="?", default=DEFAULT_DIR)
+    OUT = ap.parse_args().sweep_dir
+    runs = load_runs(OUT)
+    if not runs:
+        raise SystemExit(f"no runs found under {OUT}")
+    summary, agg, paired = summarize(runs)
     with open(os.path.join(OUT, "summary.json"), "w") as f:
         json.dump({"per_run": summary, "aggregate": agg,
                    "paired": paired}, f, indent=1)
