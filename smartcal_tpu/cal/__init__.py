@@ -8,4 +8,4 @@ einsum/segment-sum kernels that XLA can tile onto the MXU.
 """
 
 from smartcal_tpu.cal import (coords, consensus, coherency, dataset,  # noqa: F401
-                              kernels, ms_io, skyio)
+                              fits_io, kernels, ms_io, skyio)

@@ -106,3 +106,19 @@ def image_noise_std(img):
     """sigma of an image, the env observation statistic
     (calibenv.py:148-166 reads np.std of FITS data)."""
     return jnp.std(img)
+
+
+def image_to_fits(path, img, obs, freq=None, cell=None, **kw):
+    """Write a device image to a radio FITS file with the observation's
+    WCS (the excon-output contract a reference user expects; headers per
+    cal/fits_io.write_image).  ``freq`` defaults to the highest sub-band
+    (the one default_cell sizes pixels for), ``cell`` to default_cell."""
+    from smartcal_tpu.cal import fits_io
+
+    freqs = np.asarray(obs.freqs)
+    freq = float(freqs[-1]) if freq is None else float(freq)
+    cell = (float(default_cell(obs.uvw, freq)) if cell is None
+            else float(cell))
+    return fits_io.write_image(path, np.asarray(img), ra0=float(obs.ra0),
+                               dec0=float(obs.dec0), cell_rad=cell,
+                               freq=freq, **kw)

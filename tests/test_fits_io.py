@@ -1,0 +1,184 @@
+"""First-party FITS image I/O (cal/fits_io.py): byte-level format checks,
+round trips, and calmean.sh-parity weighted averaging.  Pure numpy."""
+
+import math
+
+import numpy as np
+import pytest
+
+from smartcal_tpu.cal import fits_io
+
+
+def test_roundtrip_and_layout(tmp_path):
+    rng = np.random.default_rng(0)
+    img = rng.standard_normal((16, 8)).astype(np.float32)  # ny=16, nx=8
+    p = str(tmp_path / "img.fits")
+    fits_io.write_image(p, img, ra0=1.2, dec0=0.9, cell_rad=2e-5,
+                        freq=135e6, bmaj=0.01, bmin=0.005, bpa=30.0,
+                        object_name="TEST")
+    back, hdr = fits_io.read_image(p)
+    np.testing.assert_array_equal(back, img)
+    assert hdr["NAXIS"] == 4 and hdr["NAXIS1"] == 8 and hdr["NAXIS2"] == 16
+    assert hdr["CTYPE1"] == "RA---SIN"
+    assert hdr["CRVAL1"] == pytest.approx(math.degrees(1.2))
+    assert hdr["CRVAL3"] == pytest.approx(135e6)
+    assert hdr["BPA"] == pytest.approx(30.0)
+    assert hdr["OBJECT"] == "TEST"
+
+    # FITS structure: 2880-byte records, big-endian float32 payload
+    raw = open(p, "rb").read()
+    assert len(raw) % fits_io.BLOCK == 0
+    assert raw[:6] == b"SIMPLE"
+    data_start = len(raw) - ((img.size * 4 + fits_io.BLOCK - 1)
+                             // fits_io.BLOCK) * fits_io.BLOCK
+    first = np.frombuffer(raw[data_start:data_start + 4], ">f4")[0]
+    assert first == img[0, 0]
+
+
+def test_header_string_quoting_and_comment_slash(tmp_path):
+    p = str(tmp_path / "q.fits")
+    fits_io.write_image(p, np.zeros((4, 4), np.float32),
+                        extra={"TELESCOP": "LO'FAR/X", "SEQ": 7,
+                               "FLAG": True})
+    _, hdr = fits_io.read_image(p)
+    assert hdr["TELESCOP"] == "LO'FAR/X"   # quote escape + slash in string
+    assert hdr["SEQ"] == 7
+    assert hdr["FLAG"] is True
+
+
+def test_read_bitpix16_with_scaling(tmp_path):
+    """Hand-crafted 16-bit FITS with BSCALE/BZERO (a layout external
+    tools may emit)."""
+    cards = [
+        f"{'SIMPLE':<8}= {'T':>20}", f"{'BITPIX':<8}= {16:>20}",
+        f"{'NAXIS':<8}= {2:>20}", f"{'NAXIS1':<8}= {3:>20}",
+        f"{'NAXIS2':<8}= {2:>20}", f"{'BSCALE':<8}= {0.5:>20}",
+        f"{'BZERO':<8}= {10.0:>20}", "END",
+    ]
+    header = b"".join(f"{c:<80}".encode() for c in cards)
+    header += b" " * ((-len(header)) % fits_io.BLOCK)
+    vals = np.arange(6, dtype=">i2").reshape(2, 3)
+    payload = vals.tobytes()
+    payload += b"\0" * ((-len(payload)) % fits_io.BLOCK)
+    p = tmp_path / "scaled.fits"
+    p.write_bytes(header + payload)
+    data, hdr = fits_io.read_image(str(p))
+    np.testing.assert_allclose(data, np.arange(6).reshape(2, 3) * 0.5 + 10)
+
+
+def test_fits_mean_weighting_and_beam(tmp_path):
+    """calmean parity: inverse-variance weights, circular BPA mean,
+    weighted FREQ, variance gate."""
+    paths = []
+    stds = [0.001, 0.002]
+    bpas = [350.0, 10.0]
+    freqs = [100e6, 140e6]
+    rng = np.random.default_rng(1)
+    for i, (s, bpa, f) in enumerate(zip(stds, bpas, freqs)):
+        img = np.zeros((16, 16), np.float32)
+        img[1:10, 1:10] = rng.standard_normal((9, 9)).astype(np.float32) * s
+        p = str(tmp_path / f"in{i}.fits")
+        fits_io.write_image(p, img, freq=f, bmaj=0.01 * (i + 1),
+                            bmin=0.005, bpa=bpa)
+        paths.append(p)
+    # a rejected image FIRST in the list: std in the box far above vmax —
+    # its header/WCS must not leak into the output (the base header comes
+    # from the first ACCEPTED image)
+    junk = np.full((16, 16), 0.0, np.float32)
+    junk[1:10, 1:10] = rng.standard_normal((9, 9)).astype(np.float32) * 10
+    pj = str(tmp_path / "junk.fits")
+    fits_io.write_image(pj, junk, ra0=2.9, freq=999e6, bmaj=9.9, bmin=9.9,
+                        bpa=90.0)
+    paths.insert(0, pj)
+
+    out = str(tmp_path / "bar.fits")
+    fits_io.fits_mean(paths, out, vmax=0.01)
+    mean, hdr = fits_io.read_image(out)
+    assert hdr["NIMAGES"] == 2                      # junk rejected
+    # the rejected first image's WCS did not become the output frame
+    assert hdr["CRVAL1"] == pytest.approx(0.0)
+    # weights: sigma_i = 1/std_i^2 computed from the written images
+    imgs = [fits_io.read_image(p)[0] for p in paths[1:]]
+    sig = [1.0 / float(np.std(im[1:10, 1:10])) ** 2 for im in imgs]
+    want = (imgs[0] * sig[0] + imgs[1] * sig[1]) / sum(sig)
+    np.testing.assert_allclose(mean, want.astype(np.float32), atol=1e-6)
+    # BPA weighted circular mean of 350 and 10 degrees sits between
+    # them across the wrap (never the naive arithmetic ~180)
+    want_bpa = math.degrees(math.atan2(
+        sig[0] * math.sin(math.radians(350)) + sig[1] * math.sin(
+            math.radians(10)),
+        sig[0] * math.cos(math.radians(350)) + sig[1] * math.cos(
+            math.radians(10))))
+    assert hdr["BPA"] == pytest.approx(want_bpa, abs=1e-6)
+    w_freq = (freqs[0] * sig[0] + freqs[1] * sig[1]) / sum(sig)
+    assert hdr["CRVAL3"] == pytest.approx(w_freq, rel=1e-6)
+    assert hdr["RESTFREQ"] == pytest.approx(w_freq, rel=1e-6)
+    # weighted beam major axis
+    w_bmaj = (0.01 * sig[0] + 0.02 * sig[1]) / sum(sig)
+    assert hdr["BMAJ"] == pytest.approx(w_bmaj, rel=1e-6)
+
+
+def test_long_keyword_rejected(tmp_path):
+    """An over-long extra keyword must fail loudly, never truncate into a
+    collision with a standard card (RESTFREQX -> RESTFREQ)."""
+    with pytest.raises(ValueError, match="exceeds 8"):
+        fits_io.write_image(str(tmp_path / "x.fits"),
+                            np.zeros((4, 4), np.float32),
+                            extra={"RESTFREQX": 1.0})
+
+
+def test_fits_mean_all_rejected(tmp_path):
+    """Every input rejected: zero image in the first input's frame,
+    consistent CRVAL3/RESTFREQ (no 0-Hz RESTFREQ next to a real CRVAL3)."""
+    img = np.zeros((8, 8), np.float32)
+    img[1:4, 1:4] = 100.0 * np.arange(9, dtype=np.float32).reshape(3, 3)
+    p = str(tmp_path / "r.fits")
+    fits_io.write_image(p, img, freq=123e6)
+    out = str(tmp_path / "none.fits")
+    fits_io.fits_mean([p], out, vmax=0.01)
+    mean, hdr = fits_io.read_image(out)
+    assert hdr["NIMAGES"] == 0
+    np.testing.assert_array_equal(mean, 0.0)
+    assert hdr["CRVAL3"] == pytest.approx(123e6)
+    assert hdr["RESTFREQ"] == pytest.approx(123e6)
+
+
+def test_fits_mean_accept_all_mode(tmp_path):
+    """vmax=1.0 reproduces the shipped script's short-circuited
+    accept-all behavior (wt hardcoded 0.99999): every image weighted
+    equally regardless of content."""
+    paths = []
+    for i in range(3):
+        img = np.full((8, 8), float(i), np.float32)
+        img[1:4, 1:4] += np.linspace(0, 0.5, 9).reshape(3, 3)
+        p = str(tmp_path / f"m{i}.fits")
+        fits_io.write_image(p, img, freq=100e6)
+        paths.append(p)
+    out = str(tmp_path / "mean.fits")
+    fits_io.fits_mean(paths, out, vmax=1.0)
+    mean, hdr = fits_io.read_image(out)
+    assert hdr["NIMAGES"] == 3
+
+
+def test_imager_image_to_fits_roundtrip(tmp_path):
+    """The device imager's output writes straight to FITS and reads back
+    (the excon -> env.reset FITS contract, calibenv.py:148-158)."""
+    import jax
+
+    from smartcal_tpu.envs.radio import RadioBackend
+
+    backend = RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                           admm_iters=2, lbfgs_iters=3, init_iters=4,
+                           npix=16)
+    from smartcal_tpu.cal import imager
+
+    ep, mdl = backend.new_demixing_episode(jax.random.PRNGKey(2), 3)
+    img = np.asarray(backend.data_image(ep))
+    p = str(tmp_path / "data.fits")
+    imager.image_to_fits(p, img, ep.obs)
+    back, hdr = fits_io.read_image(p)
+    np.testing.assert_array_equal(back, img.astype(np.float32))
+    assert hdr["CRVAL1"] == pytest.approx(math.degrees(ep.obs.ra0))
+    assert hdr["CRVAL3"] == pytest.approx(
+        float(np.asarray(ep.obs.freqs)[-1]))
+    assert hdr["CDELT2"] > 0
