@@ -22,6 +22,15 @@ cd "$(dirname "$0")/.." || exit 1
 R=${CAPTURE_ROUND:-r4}
 rm -f "/tmp/bench_primary_${R}.out" "/tmp/bench_extras_${R}.out"  # never promote stale prior-session runs
 
+# never leave sweeps frozen or the window lock held if this pass dies
+# between the STOP/CONT pair in try_capture.  INT/TERM are trapped
+# explicitly: bash exits WITHOUT running an EXIT trap on an untrapped
+# group SIGINT (the Ctrl-C case — verified on this host's bash 5.2)
+SWEEP_PAT='python[^ ]* [^ ]*tools/sweep_(calib|demix)\.py'
+cleanup () { pkill -CONT -f "$SWEEP_PAT" 2>/dev/null; rm -f /tmp/tpu_window.lock; }
+trap 'cleanup' EXIT
+trap 'cleanup; exit 130' INT TERM
+
 ATTEMPT_TIMEOUT=${ATTEMPT_TIMEOUT:-3000}   # 50 min: compiles alone can eat 25
 MAX_ATTEMPTS=${MAX_ATTEMPTS:-12}           # dead-tunnel probes are cheap (~2.5 min)
 HEAVY_MAX=${HEAVY_MAX:-4}                  # full attempts are not (up to 50 min each)
@@ -57,10 +66,13 @@ try_capture () {
     echo "[capture] $name: attempt $heavies/$HEAVY_MAX ($(date -u +%H:%M:%S))"
     # single-core host: hold the window lock so cooperating CPU jobs
     # (tools/wait_no_chip.sh between sweep units) pause during timed
-    # sections — a concurrent sweep halves the measured rate and trips
-    # the load<1.2 uncontended gate
+    # sections, AND SIGSTOP any sweep mid-run — a sweep unit lasts up to
+    # hours, so the between-units lock alone leaves a rare tunnel window
+    # contended (the load<1.2 uncontended gate would waste it)
     touch /tmp/tpu_window.lock
+    pkill -STOP -f "$SWEEP_PAT" 2>/dev/null || true
     timeout --kill-after=30 "$ATTEMPT_TIMEOUT" "$@" && rc=0 || rc=$?
+    pkill -CONT -f "$SWEEP_PAT" 2>/dev/null || true
     rm -f /tmp/tpu_window.lock
     if eval "$check"; then echo "[capture] $name: DONE"; return 0; fi
     echo "[capture] $name: attempt $heavies failed rc=$rc"
