@@ -76,6 +76,13 @@ def main():
             if os.path.exists(dst):
                 print(f"skip {tag} (exists)", flush=True)
                 continue
+            # in-flight runs write <tag>.jsonl.partial and rename on
+            # completion (VERDICT r4 item 8): a snapshot taken mid-run can
+            # never be mistaken for a finished run, and a restarted sweep
+            # re-runs rather than skips a truncated one
+            part = dst + ".partial"
+            if os.path.exists(part):
+                os.remove(part)
             # yield to an active chip-capture window (single-core host);
             # resolve the hook from the package location — CWD- and
             # __file__-independent (exec() harnesses have neither the
@@ -98,7 +105,7 @@ def main():
                     "--K", str(args.K), "--stations", str(args.stations),
                     "--npix", str(args.npix),
                     "--prefix", os.path.join(args.outdir, f"{tag}_ck"),
-                    "--metrics", dst]
+                    "--metrics", part]
             if use_hint:
                 argv.append("--use_hint")
             if args.provide_influence:
@@ -108,6 +115,7 @@ def main():
             if args.light:
                 argv.append("--light")
             demix_sac.main(argv)
+            os.rename(part, dst)
             print(f"[{time.time() - t_start:7.0f}s] DONE {tag} "
                   f"({time.time() - t0:.0f}s)", flush=True)
 
