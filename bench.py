@@ -33,6 +33,7 @@ primary metric.
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -63,6 +64,85 @@ TIMED_EPISODES = 20
 PRIMARY_BLOCK = 20
 PRIMARY_TIMED_BLOCKS = 2
 FALLBACK_BASELINE = 4.16  # tools/reference_baseline.json, torch CPU
+
+
+WINDOW_LOCK = "/tmp/tpu_window.lock"
+
+
+def _pause_competitors():
+    """Take the chip-window lock and SIGSTOP any running sweep so timed
+    sections are uncontended on the single-core host (VERDICT r4 weak 1:
+    the round-4 CPU-fallback primary read 4x under its own extras purely
+    from self-contention with a background learning-curve sweep — the
+    sweeps only yielded to *capture-script* windows, never to a bare
+    ``python bench.py``).  Returns the stopped pids for
+    ``_resume_competitors``.  A detached insurance shell CONTs the pids
+    later even if this process is SIGKILLed mid-bench (driver-side
+    timeouts), so a dead bench can never leave the sweeps frozen."""
+    try:
+        open(WINDOW_LOCK, "w").close()
+    except OSError:
+        pass
+    try:
+        r = subprocess.run(["pgrep", "-f", r"tools/sweep_(calib|demix)\.py"],
+                           capture_output=True, text=True, timeout=10)
+        pids = [int(x) for x in r.stdout.split() if x.isdigit()
+                and int(x) != os.getpid()]
+    except Exception:
+        pids = []
+    stopped = []
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGSTOP)
+            stopped.append(pid)
+        except OSError:
+            pass
+    insurance = None
+    if stopped:
+        try:
+            insurance = subprocess.Popen(
+                ["bash", "-c", "sleep 5400; kill -CONT "
+                 + " ".join(map(str, stopped)) + " 2>/dev/null"],
+                start_new_session=True,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        except Exception:
+            insurance = None
+    return stopped, insurance
+
+
+def _resume_competitors(stopped, insurance):
+    for pid in stopped:
+        try:
+            os.kill(pid, signal.SIGCONT)
+        except OSError:
+            pass
+    # cancel the insurance shell on the clean path: a live one would
+    # SIGCONT the same pids ~90 min later, potentially into the middle
+    # of a LATER capture attempt's timed window
+    if insurance is not None:
+        try:
+            insurance.kill()
+        except Exception:
+            pass
+    try:
+        os.remove(WINDOW_LOCK)
+    except OSError:
+        pass
+
+
+def _settle_load(threshold=1.2, max_wait_s=240.0):
+    """1-min loadavg is a trailing indicator: after the sweeps are paused
+    it decays toward the truly-uncontended level with a ~1 min time
+    constant, so a measurement taken immediately would read stale
+    contention.  Wait (bounded) for it to cross the uncontended
+    threshold; return the final value — the caller records it and flags
+    the run contended if it never settled."""
+    t0 = time.time()
+    load = os.getloadavg()[0]
+    while load >= threshold and time.time() - t0 < max_wait_s:
+        time.sleep(15)
+        load = os.getloadavg()[0]
+    return load
 
 
 def load_baseline():
@@ -234,6 +314,13 @@ def measure_epblock(block: int, timed_blocks: int, trace_dir=None):
     from smartcal_tpu.utils import profiler_trace
 
     env_cfg, agent_cfg = bench_configs()
+    # the single warm-up block must fill the replay buffer past
+    # batch_size or the timed blocks would measure a window where learn()
+    # is not yet live — a silent protocol change (ADVICE r4 item 5)
+    assert block * STEPS_PER_EPISODE >= agent_cfg.batch_size, (
+        f"warm-up block too small: {block} episodes x {STEPS_PER_EPISODE} "
+        f"steps < batch_size {agent_cfg.batch_size}; learn() would be "
+        "dead during the timed section")
     key = jax.random.PRNGKey(0)
     key, k0 = jax.random.split(key)
     agent_state = sac.sac_init(k0, agent_cfg)
@@ -376,15 +463,40 @@ def bench_calib_episode():
         "stage_breakdown": stages,
     }
     # hardware-utilization estimate for the dominant stage (VERDICT r3
-    # item 8): modeled FLOPs of the solve / measured calibrate seconds,
-    # and an MFU %% against the v5e peak when on chip.  The solve is fp32
+    # item 8): FLOPs of the solve / measured calibrate seconds, and an
+    # MFU %% against the v5e peak when on chip.  The solve is fp32
     # split-real einsums, so bf16 peak (197 TF) overstates the attainable
     # roofline ~4x — both references are reported.
-    flops = _solve_flops_estimate(backend, ep)
+    #
+    # VERDICT r4 item 5: the per-eval FLOP numerator is MEASURED — the
+    # exact batched value_and_grad + line-search jvp the L-BFGS driver
+    # runs are lowered shape-only and counted by XLA cost_analysis
+    # (solver.cost_eval_flops); only the iteration/probe counts stay
+    # analytic (1 value_and_grad + ~1.5 jvp probes per iteration).  The
+    # hand model (112 flop/sample forward unit) is reported alongside
+    # with its ratio: it counts only the core prediction matmuls, so it
+    # understates the executed flops ~3x at both N=14 and N=62.
+    flops_model = _solve_flops_estimate(backend, ep)
     cal_s = stages.get("calibrate_s")
+    out["solve_flops_model"] = flops_model
+    try:
+        from smartcal_tpu.cal.solver import cost_eval_flops
+        check = cost_eval_flops(
+            backend._solver_cfg(ep.n_dirs), backend.n_freqs,
+            backend.n_chunks, backend.tdelta,
+            backend.n_stations * (backend.n_stations - 1) // 2)
+        total_iters = (backend.init_iters
+                       + backend.admm_iters * backend.lbfgs_iters)
+        flops = total_iters * (check["xla_value_and_grad_flops"]
+                               + 1.5 * check["xla_linesearch_jvp_flops"])
+        out["solve_flops_xla_measured"] = flops
+        out["flops_check"] = check
+        out["flops_model_over_measured"] = round(flops_model / flops, 3)
+    except Exception as e:  # noqa: BLE001 — the check must never kill a capture
+        out["flops_check"] = {"error": f"{type(e).__name__}: {e}"}
+        flops = flops_model
     if flops and cal_s:
         achieved = flops / cal_s
-        out["solve_flops_model"] = flops
         out["solve_gflops_per_s"] = round(achieved / 1e9, 1)
         if jax.devices()[0].platform in ("tpu", "axon"):
             out["solve_mfu_pct_vs_v5e_bf16_peak"] = round(
@@ -395,10 +507,23 @@ def bench_calib_episode():
 
 
 def main():
+    stopped, insurance = _pause_competitors()
+    try:
+        _measured_main()
+    finally:
+        _resume_competitors(stopped, insurance)
+
+
+def _measured_main():
     platform, note = probe_backend()
     if platform != "tpu":
         # wedge-proof: measure on CPU rather than hang on a dead tunnel
         jax.config.update("jax_platforms", "cpu")
+    # uncontended-window gate (VERDICT r4 item 4): the competitors are
+    # paused; wait for the trailing 1-min loadavg to actually settle
+    # before timing anything, and flag the payload loudly if it never
+    # does (chip_checks refuses to promote a primary with load >= 1.2)
+    settled_load = _settle_load()
 
     # Round-4 primary protocol: SAME sequential 1:1 computation as rounds
     # 1-3 (strictly sequential episodes, one learn per env step — parity
@@ -420,11 +545,22 @@ def main():
         "unit": "env-steps/sec/chip",
         "vs_baseline": round(value / baseline, 2),
         "dispatch": dispatch,
-        # contention context: on the single-core host a concurrent sweep
-        # halves the measured rate — loadavg>~1.5 means this number
-        # understates the uncontended throughput
-        "host_load_avg_1m": round(os.getloadavg()[0], 2),
+        # gate value = the WORSE of (settled pre-measurement load, load
+        # right after the timed section): sweeps are SIGSTOPped and the
+        # trailing 1-min average was given time to decay before timing,
+        # so >= 1.2 on either side means something beyond the known
+        # background jobs contended the window — flagged, and
+        # chip_checks refuses to promote it.  Both components are
+        # reported so a mid-run arrival is distinguishable from a
+        # never-settled start.
+        "host_load_avg_1m": round(max(settled_load, os.getloadavg()[0]), 2),
+        "host_load_pre_timed_1m": round(settled_load, 2),
+        "host_load_post_timed_1m": round(os.getloadavg()[0], 2),
     }
+    if out["host_load_avg_1m"] >= 1.2:
+        out["contended"] = ("loadavg exceeded 1.2 around the timed section "
+                            "with sweeps paused; treat the value as a "
+                            "lower bound")
     if platform != "tpu":
         out["platform"] = f"cpu ({note})"
         # the tunnel is intermittent (see results/refscale_tpu.md): when a
@@ -528,6 +664,13 @@ def main():
             extras_budget = 1500.0
         t_extras = time.time()
         for fn, name in extras:
+            # keep the window-lock mtime fresh: cooperating CPU jobs
+            # expire a stale lock by age, and a cold-chip extra can
+            # outlive the expiry window
+            try:
+                open(WINDOW_LOCK, "w").close()
+            except OSError:
+                pass
             if time.time() - t_extras > extras_budget:
                 out["extra"].append({"metric": name,
                                      "skipped": "extras time budget "

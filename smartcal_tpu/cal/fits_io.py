@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import math
 import os
+import re
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -51,7 +52,15 @@ def _card(key: str, value, comment: str = "") -> bytes:
     elif isinstance(value, (float, np.floating)):
         body = f"= {float(value):>20.13E}"
     else:                                      # string
-        s = str(value).replace("'", "''")[:67]
+        # never truncate silently (same policy as over-length keywords),
+        # and never truncate AFTER escaping — cutting a doubled '' pair
+        # in half would leave an unbalanced quote (ADVICE r4 item 1).
+        # No CONTINUE-card support, so an unrepresentable value raises.
+        s = str(value).replace("'", "''")
+        if len(s) > 67:
+            raise ValueError(
+                f"FITS string value for {key} exceeds 67 characters "
+                f"after quote escaping: {str(value)!r}")
         body = f"= '{s:<8}'"
     text = f"{key:<8}{body}"
     if comment:
@@ -103,41 +112,61 @@ def write_image(path, data, *, ra0: float = 0.0, dec0: float = 0.0,
         raise ValueError(f"expected 2-D image, got shape {img.shape}")
     ny, nx = img.shape
     cdelt = math.degrees(cell_rad)
-    cards: List[bytes] = [
-        _card("SIMPLE", True, "first-party smartcal_tpu writer"),
-        _card("BITPIX", -32),
-        _card("NAXIS", 4),
-        _card("NAXIS1", nx),
-        _card("NAXIS2", ny),
-        _card("NAXIS3", 1),
-        _card("NAXIS4", 1),
-        _card("CTYPE1", "RA---SIN"),
-        _card("CRVAL1", math.degrees(ra0)),
-        _card("CDELT1", -cdelt),
-        _card("CRPIX1", nx // 2 + 1.0),
-        _card("CUNIT1", "deg"),
-        _card("CTYPE2", "DEC--SIN"),
-        _card("CRVAL2", math.degrees(dec0)),
-        _card("CDELT2", cdelt),
-        _card("CRPIX2", ny // 2 + 1.0),
-        _card("CUNIT2", "deg"),
-        _card("CTYPE3", "FREQ"),
-        _card("CRVAL3", float(freq)),
-        _card("CDELT3", float(dfreq)),
-        _card("CRPIX3", 1.0),
-        _card("CUNIT3", "Hz"),
-        _card("CTYPE4", "STOKES"),
-        _card("CRVAL4", 1.0),
-        _card("CDELT4", 1.0),
-        _card("CRPIX4", 1.0),
-        _card("BUNIT", bunit),
+    std: List[Tuple[str, object, str]] = [
+        ("SIMPLE", True, "first-party smartcal_tpu writer"),
+        ("BITPIX", -32, ""),
+        ("NAXIS", 4, ""),
+        ("NAXIS1", nx, ""),
+        ("NAXIS2", ny, ""),
+        ("NAXIS3", 1, ""),
+        ("NAXIS4", 1, ""),
+        ("CTYPE1", "RA---SIN", ""),
+        ("CRVAL1", math.degrees(ra0), ""),
+        ("CDELT1", -cdelt, ""),
+        ("CRPIX1", nx // 2 + 1.0, ""),
+        ("CUNIT1", "deg", ""),
+        ("CTYPE2", "DEC--SIN", ""),
+        ("CRVAL2", math.degrees(dec0), ""),
+        ("CDELT2", cdelt, ""),
+        ("CRPIX2", ny // 2 + 1.0, ""),
+        ("CUNIT2", "deg", ""),
+        ("CTYPE3", "FREQ", ""),
+        ("CRVAL3", float(freq), ""),
+        ("CDELT3", float(dfreq), ""),
+        ("CRPIX3", 1.0, ""),
+        ("CUNIT3", "Hz", ""),
+        ("CTYPE4", "STOKES", ""),
+        ("CRVAL4", 1.0, ""),
+        ("CDELT4", 1.0, ""),
+        ("CRPIX4", 1.0, ""),
+        ("BUNIT", bunit, ""),
     ]
     if object_name:
-        cards.append(_card("OBJECT", object_name))
+        std.append(("OBJECT", object_name, ""))
     for key, val in ((("BMAJ", bmaj), ("BMIN", bmin), ("BPA", bpa))):
         if val is not None:
-            cards.append(_card(key, float(val)))
-    for key, val in (extra or {}).items():
+            std.append((key, float(val), ""))
+    # ``extra`` entries matching a standard card OVERRIDE it in place
+    # (single card, original position) instead of appending a duplicate —
+    # fits_mean uses this to carry an accepted base header's CRPIX /
+    # CDELT1 / etc through to the output (ADVICE r4 item 2).  Structural
+    # cards stay derived from the actual payload no matter what.
+    structural = {"SIMPLE", "BITPIX"}
+
+    def _is_structural(k: str) -> bool:
+        # every NAXISn (any n, plus bare NAXIS) is payload-derived: a
+        # carried-through NAXIS5 card from a 5-axis input would declare
+        # an axis this 4-axis writer does not emit
+        return k in structural or re.fullmatch(r"NAXIS\d*", k) is not None
+
+    extra_d = {str(k).upper(): v for k, v in (extra or {}).items()
+               if not _is_structural(str(k).upper())}
+    cards: List[bytes] = []
+    for key, val, com in std:
+        if key in extra_d:
+            val = extra_d.pop(key)
+        cards.append(_card(key, val, com))
+    for key, val in extra_d.items():
         cards.append(_card(key, val))
     cards.append(f"{'END':<80}".encode("ascii"))
     header = _pad(b"".join(cards))
@@ -278,6 +307,21 @@ def fits_mean(paths: List[str], out: str, vmax: float = 0.01,
         beam = {"bmaj": bmaj / beam_wgt, "bmin": bmin / beam_wgt,
                 "bpa": math.degrees(math.atan2(bpay / beam_wgt,
                                                bpax / beam_wgt))}
+    # carry the base header's remaining cards through (the reference's
+    # calmean copies the full first header): every card not computed
+    # above rides along as an in-place override, so an externally
+    # produced input with an off-center CRPIX or non-square CDELT1 keeps
+    # a truthful WCS in the output (ADVICE r4 item 2).  Excluded: cards
+    # re-derived from the payload (structural ones are dropped by
+    # write_image itself), the weight-averaged quantities, and
+    # BSCALE/BZERO — read_image already applied them to the pixels.
+    computed = {"BSCALE", "BZERO", "EXTEND", "CRVAL3", "RESTFREQ",
+                "NIMAGES"}
+    if beam:
+        computed |= {"BMAJ", "BMIN", "BPA"}
+    for key, val in hdr.items():
+        if key not in computed and key not in extra:
+            extra[key] = val
     write_image(
         out, mean,
         ra0=math.radians(float(hdr.get("CRVAL1", 0.0))),

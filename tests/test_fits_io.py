@@ -182,3 +182,51 @@ def test_imager_image_to_fits_roundtrip(tmp_path):
     assert hdr["CRVAL3"] == pytest.approx(
         float(np.asarray(ep.obs.freqs)[-1]))
     assert hdr["CDELT2"] > 0
+
+
+def test_overlong_string_value_raises(tmp_path):
+    """String values that cannot fit a single card raise instead of
+    silently truncating (possibly mid doubled-quote) — ADVICE r4 item 1:
+    the same never-truncate-silently policy as over-length keywords."""
+    img = np.zeros((4, 4), np.float32)
+    with pytest.raises(ValueError, match="67 characters"):
+        fits_io.write_image(str(tmp_path / "a.fits"), img,
+                            extra={"LONGVAL": "x" * 70})
+    # escaping can push a representable-looking value over the limit:
+    # 40 quotes escape to 80 chars — must raise, never emit a split pair
+    with pytest.raises(ValueError, match="67 characters"):
+        fits_io.write_image(str(tmp_path / "b.fits"), img,
+                            extra={"QUOTED": "'" * 40})
+    # a value at exactly the limit still round-trips
+    p = fits_io.write_image(str(tmp_path / "c.fits"), img,
+                            extra={"EDGEVAL": "y" * 67})
+    _, hdr = fits_io.read_image(p)
+    assert hdr["EDGEVAL"] == "y" * 67
+
+
+def test_fits_mean_carries_base_header(tmp_path):
+    """fits_mean carries the accepted base image's non-computed cards
+    (OBJECT, off-center CRPIX, non-square CDELT1) into the output — the
+    reference calmean copies the full first header (ADVICE r4 item 2)."""
+    rng = np.random.default_rng(5)
+    paths = []
+    for i in range(2):
+        img = rng.normal(0.0, 1e-3, (16, 16)).astype(np.float32)
+        p = str(tmp_path / f"in{i}.fits")
+        fits_io.write_image(
+            p, img, freq=120e6, object_name="3C196",
+            extra={"CRPIX1": 3.0, "CRPIX2": 5.0, "CDELT1": -2e-3,
+                   "TELESCOP": "LOFAR"})
+        paths.append(p)
+    out = str(tmp_path / "mean.fits")
+    fits_io.fits_mean(paths, out, vmax=1.0)
+    _, hdr = fits_io.read_image(out)
+    assert hdr["OBJECT"] == "3C196"
+    assert hdr["TELESCOP"] == "LOFAR"
+    assert hdr["CRPIX1"] == pytest.approx(3.0)
+    assert hdr["CRPIX2"] == pytest.approx(5.0)
+    assert hdr["CDELT1"] == pytest.approx(-2e-3)
+    # overridden cards appear ONCE (in-place override, no duplicates)
+    with open(out, "rb") as fh:
+        raw = fh.read(2880 * 2).decode("ascii", "replace")
+    assert raw.count("CRPIX1") == 1

@@ -27,7 +27,14 @@ rm -f "/tmp/bench_primary_${R}.out" "/tmp/bench_extras_${R}.out"  # never promot
 # explicitly: bash exits WITHOUT running an EXIT trap on an untrapped
 # group SIGINT (the Ctrl-C case — verified on this host's bash 5.2)
 SWEEP_PAT='python[^ ]* [^ ]*tools/sweep_(calib|demix)\.py'
-cleanup () { pkill -CONT -f "$SWEEP_PAT" 2>/dev/null; rm -f /tmp/tpu_window.lock; }
+TOUCHER=""
+cleanup () {
+  pkill -CONT -f "$SWEEP_PAT" 2>/dev/null
+  # the lock-toucher subshell must die with us, or it would re-create
+  # the lock every 300 s forever and freeze every cooperating sweep
+  [ -n "$TOUCHER" ] && kill "$TOUCHER" 2>/dev/null
+  rm -f /tmp/tpu_window.lock
+}
 trap 'cleanup' EXIT
 trap 'cleanup; exit 130' INT TERM
 
@@ -70,9 +77,16 @@ try_capture () {
     # hours, so the between-units lock alone leaves a rare tunnel window
     # contended (the load<1.2 uncontended gate would waste it)
     touch /tmp/tpu_window.lock
+    # re-touch the lock while the attempt runs: wait_no_chip.sh expires
+    # stale locks by AGE, and a raised ATTEMPT_TIMEOUT would otherwise
+    # outlive the fixed expiry and lose the window mid-capture (ADVICE
+    # r4 item 4)
+    ( while true; do sleep 300; touch /tmp/tpu_window.lock; done ) &
+    TOUCHER=$!
     pkill -STOP -f "$SWEEP_PAT" 2>/dev/null || true
     timeout --kill-after=30 "$ATTEMPT_TIMEOUT" "$@" && rc=0 || rc=$?
     pkill -CONT -f "$SWEEP_PAT" 2>/dev/null || true
+    kill "$TOUCHER" 2>/dev/null
     rm -f /tmp/tpu_window.lock
     if eval "$check"; then echo "[capture] $name: DONE"; return 0; fi
     echo "[capture] $name: attempt $heavies failed rc=$rc"
@@ -101,5 +115,13 @@ try_capture "primary_clean"  "python tools/chip_checks.py primary /tmp/bench_pri
 
 try_capture "extras_tpu"     "python tools/chip_checks.py extras /tmp/bench_extras_${R}.out ${R}" \
   bash -c "exec env BENCH_SKIP_CALIB=1 BENCH_PROBE_ATTEMPTS=1 python bench.py > /tmp/bench_extras_${R}.out 2>/tmp/bench_extras_${R}.err"
+
+# optional (runs only after the five core captures): the solve-eval
+# microbench — planes vs one-hot formulation of the inner cost+grad at
+# N=62 on the chip (VERDICT r4 item 6 evidence; two variants only to
+# bound server-side compiles per attempt)
+try_capture "solve_eval_tpu" "test -f results/solve_eval_tpu.json" \
+  python tools/bench_solve_eval.py --variants planes,onehot --repeat 30 \
+    --out results/solve_eval_tpu.json
 
 echo "[capture] pass complete ($(date -u +%H:%M:%S))"

@@ -70,18 +70,23 @@ def primary_done(tmpfile: str, rnd: str) -> bool:
     surfaces on future CPU fallbacks).
     """
     final = os.path.join(RESULTS, f"bench_primary_{rnd}.json")
-    if not os.path.exists(final):
-        try:
-            out = _load_last_json_line(tmpfile)
-        except Exception:
-            return False
-        if out.get("metric") != "enet_sac_env_steps_per_sec" \
-                or "platform" in out:
-            return False
-        if out.get("host_load_avg_1m", 9.9) >= 1.2:
-            return False  # contended — not the clean number we came for
-        with open(final, "w") as fh:
-            json.dump(out, fh, indent=1)
+    if os.path.exists(final):
+        # doneness probe only: do NOT refresh the latest_chip_capture
+        # pointer here — a still-running older-round capture loop would
+        # stomp a newer round's pointer with stale numbers on every probe
+        # (ADVICE r4 item 3); the pointer is written once, at promotion
+        return True
+    try:
+        out = _load_last_json_line(tmpfile)
+    except Exception:
+        return False
+    if out.get("metric") != "enet_sac_env_steps_per_sec" \
+            or "platform" in out:
+        return False
+    if out.get("host_load_avg_1m", 9.9) >= 1.2:
+        return False  # contended — not the clean number we came for
+    with open(final, "w") as fh:
+        json.dump(out, fh, indent=1)
     shutil.copyfile(final, os.path.join(RESULTS, "latest_chip_capture.json"))
     return True
 
