@@ -84,8 +84,12 @@ def _pause_competitors():
     except OSError:
         pass
     try:
-        r = subprocess.run(["pgrep", "-f", r"tools/sweep_(calib|demix)\.py"],
-                           capture_output=True, text=True, timeout=10)
+        # anchored like capture_round.sh's SWEEP_PAT: an unanchored
+        # pattern would also freeze innocent processes whose argv merely
+        # mentions the path (an editor, a tail -f)
+        r = subprocess.run(
+            ["pgrep", "-f", r"python[^ ]* [^ ]*tools/sweep_(calib|demix)\.py"],
+            capture_output=True, text=True, timeout=10)
         pids = [int(x) for x in r.stdout.split() if x.isdigit()
                 and int(x) != os.getpid()]
     except Exception:
@@ -489,6 +493,11 @@ def bench_calib_episode():
                        + backend.admm_iters * backend.lbfgs_iters)
         flops = total_iters * (check["xla_value_and_grad_flops"]
                                + 1.5 * check["xla_linesearch_jvp_flops"])
+        if not np.isfinite(flops) or flops <= 0:
+            # cost_analysis returns NaN when the 'flops' key is absent
+            # (possible across XLA versions); NaN would sail through the
+            # truthiness gate below and poison the JSON payload
+            raise ValueError(f"non-finite XLA flop count {flops}")
         out["solve_flops_xla_measured"] = flops
         out["flops_check"] = check
         out["flops_model_over_measured"] = round(flops_model / flops, 3)

@@ -184,6 +184,37 @@ class TestSolver:
         got = float(solver._chi2_planes(J, V5, C5, cfg))
         np.testing.assert_allclose(got, ref, rtol=1e-5)
 
+    def test_onehot_chi2_matches_einsum(self, problem, rng):
+        """The PRODUCTION objective (`_chi2_planes_onehot`, matmul-based
+        station expansion — what both ADMM drivers evaluate) equals the
+        einsum formulation sum|V - predict|^2 in value AND gradient, so
+        a swapped onehot_p/onehot_q or conjugate-sign error cannot hide
+        behind the loose end-to-end solve tolerance."""
+        K, N, Tc = 3, 6, 4
+        B = N * (N - 1) // 2
+        cfg = solver.SolverConfig(n_stations=N, n_dirs=K)
+        J = jnp.asarray(rng.standard_normal((K, 2 * N, 2, 2)), jnp.float32)
+        V5 = jnp.asarray(rng.standard_normal((Tc, B, 2, 2, 2)), jnp.float32)
+        C5 = jnp.asarray(rng.standard_normal((K, Tc, B, 2, 2, 2)),
+                         jnp.float32)
+        Vp = jnp.transpose(V5, (2, 3, 4, 0, 1))
+        Cp = jnp.transpose(C5, (0, 3, 4, 5, 1, 2))
+        oh_p, oh_q = solver._baseline_onehots(N)
+
+        def ref_fn(Jx):
+            r = V5 - solver.predict_vis_sr(Jx, C5, N)
+            return jnp.sum(r * r)
+
+        def got_fn(Jx):
+            return solver._chi2_planes_onehot(Jx, Vp, Cp, oh_p, oh_q, cfg)
+
+        ref_v, ref_g = jax.value_and_grad(ref_fn)(J)
+        got_v, got_g = jax.value_and_grad(got_fn)(J)
+        np.testing.assert_allclose(float(got_v), float(ref_v), rtol=1e-5)
+        scale = float(jnp.max(jnp.abs(ref_g))) + 1e-20
+        np.testing.assert_allclose(np.asarray(got_g) / scale,
+                                   np.asarray(ref_g) / scale, atol=2e-5)
+
     def test_host_segmented_matches_fused(self, problem):
         """solve_admm_host (bounded dispatches, lbfgs_resume segments) walks
         the same trajectory as the fused solve_admm: same J/Z/residual to
@@ -320,3 +351,25 @@ def test_make_observation_mixed_pointing_above_horizon():
     with pytest.raises(ValueError, match="never rises"):
         observation.make_observation(jax.random.PRNGKey(0), n_stations=6,
                                      n_freqs=1, n_times=2, dec0=-1.2)
+
+
+def test_cost_eval_flops_cross_check():
+    """The XLA-counted FLOPs of the solver's inner evaluation units
+    (bench.py's measured MFU numerator, VERDICT r4 item 5) are finite,
+    scale-consistent, and within the analytic model's stated ~2-4x
+    envelope: the 112-flop/sample model counts only the core prediction
+    matmuls, so model/xla lands well below 1 but never below ~0.1."""
+    cfg = solver.SolverConfig(n_stations=6, n_dirs=2, n_poly=2,
+                              lbfgs_iters=2, init_iters=2, admm_iters=2)
+    check = solver.cost_eval_flops(cfg, Nf=2, Ts=2, td=3, B=15)
+    assert check["xla_value_and_grad_flops"] > 0
+    assert check["xla_linesearch_jvp_flops"] > 0
+    assert 0.1 < check["vag_model_over_xla"] < 1.5
+    assert 0.1 < check["jvp_model_over_xla"] < 1.5
+    # the count scales ~linearly with the baseline count (B follows N:
+    # N=6 -> 15 baselines, N=8 -> 28, a 1.87x step)
+    cfg8 = cfg._replace(n_stations=8)
+    check2 = solver.cost_eval_flops(cfg8, Nf=2, Ts=2, td=3, B=28)
+    ratio = (check2["xla_value_and_grad_flops"]
+             / check["xla_value_and_grad_flops"])
+    assert 1.5 < ratio < 2.3

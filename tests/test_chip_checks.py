@@ -133,3 +133,35 @@ def test_cli_exit_codes(results_dir, tmp_path):
     tmpfile = str(tmp_path / "p.json")
     _write(tmpfile, GOOD_PRIMARY)
     assert chip_checks.main(["primary", tmpfile, "r8"]) == 0
+
+
+def test_primary_probe_does_not_stomp_newer_pointer(results_dir, tmp_path):
+    """A doneness re-probe of an OLDER round must not overwrite a newer
+    round's latest_chip_capture.json pointer (ADVICE r4 item 3: a
+    still-running old capture loop probes its artifact every pass)."""
+    tmpfile = str(tmp_path / "out.json")
+    _write(tmpfile, GOOD_PRIMARY)
+    assert chip_checks.primary_done(tmpfile, "r9")
+    _write(tmpfile, dict(GOOD_PRIMARY, value=150.0))
+    assert chip_checks.primary_done(tmpfile, "r10")
+    assert json.load(open(results_dir
+                          / "latest_chip_capture.json"))["value"] == 150.0
+    # the r9 loop keeps probing its (existing) artifact: pointer untouched
+    assert chip_checks.primary_done(str(tmp_path / "gone.json"), "r9")
+    assert json.load(open(results_dir
+                          / "latest_chip_capture.json"))["value"] == 150.0
+
+
+def test_solve_eval_requires_tpu_platform(results_dir):
+    """solve_eval_done rejects (and deletes) a CPU-fallback artifact so
+    the capture loop retries on chip, and accepts a TPU payload."""
+    path = results_dir / "solve_eval_tpu.json"
+    assert not chip_checks.solve_eval_done()
+    with open(path, "w") as fh:
+        json.dump({"platform": "cpu", "variants": {"onehot": {}}}, fh)
+    assert not chip_checks.solve_eval_done()
+    assert not path.exists()          # fallback artifact removed
+    with open(path, "w") as fh:
+        json.dump({"platform": "axon", "variants": {"onehot": {}}}, fh)
+    assert chip_checks.solve_eval_done()
+    assert path.exists()

@@ -1,0 +1,185 @@
+"""Micro-benchmark of the ADMM solver's inner evaluation unit.
+
+VERDICT r4 item 6: the batched cost+grad eval is the dominant term of the
+N=62 calibration stage (28 ms/eval measured on chip in the round-1
+logical layout; `results/refscale_tpu.md`).  This tool times the exact
+vmapped value_and_grad + line-search jvp units at reference scale under
+each candidate formulation so layout work is measured, not guessed:
+
+  * ``planes``    — the shipped `_chi2_planes` objective (operands in the
+    solver's logical layout, planes transpose inside the cost fn — what
+    the L-BFGS loop runs today)
+  * ``pretrans``  — the same math with the coherency/data planes
+    transposes HOISTED out of the eval (transposed operands prepared
+    once, as a loop-invariant), isolating how much of the eval is layout
+    shuffling rather than arithmetic
+
+Usage:
+    python tools/bench_solve_eval.py [--stations 62] [--nf 8] [--dirs 6] \
+        [--repeat 30] [--platform cpu|axon] [--out results/solve_eval.json]
+
+Emits one JSON dict with per-variant {value_and_grad_ms, jvp_ms} plus
+shapes and platform.  Runs standalone on CPU; on the chip it is a
+candidate for spare capture-loop time (cheap: a few compiles + seconds
+of steady-state timing).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--stations", default=62, type=int)
+    p.add_argument("--nf", default=8, type=int)
+    p.add_argument("--dirs", default=6, type=int)
+    p.add_argument("--ts", default=2, type=int)
+    p.add_argument("--td", default=10, type=int)
+    p.add_argument("--repeat", default=30, type=int)
+    p.add_argument("--platform", default=None, choices=["cpu", "axon"])
+    p.add_argument("--out", default=None)
+    p.add_argument("--variants", default="planes,pretrans,onehot",
+                   help="comma list; chip runs use planes,onehot to bound "
+                   "the number of server-side compiles per attempt")
+    args = p.parse_args()
+    want = set(args.variants.split(","))
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from smartcal_tpu.cal import solver
+    from smartcal_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    N, K, Nf, Ts, td = args.stations, args.dirs, args.nf, args.ts, args.td
+    B = N * (N - 1) // 2
+    cfg = solver.SolverConfig(n_stations=N, n_dirs=K, n_poly=3,
+                              lbfgs_iters=8, init_iters=30, admm_iters=10)
+    rng = np.random.default_rng(0)
+    f32 = np.float32
+    x = jnp.asarray(rng.normal(0, 0.3, (Nf, Ts, K * 2 * N * 2 * 2)), f32)
+    d = jnp.asarray(rng.normal(0, 0.1, x.shape), f32)
+    alpha = jnp.full((Nf, Ts), 0.3, f32)
+    V5 = jnp.asarray(rng.normal(0, 1, (Nf, Ts, td, B, 2, 2, 2)), f32)
+    C5 = jnp.asarray(rng.normal(0, 1, (Nf, Ts, K, td, B, 2, 2, 2)), f32)
+    pr = jnp.asarray(rng.normal(0, 0.3, (Nf, Ts, K, 2 * N, 2, 2)), f32)
+    hr = jnp.asarray(np.full(K, 2.5), f32)
+
+    def time_fn(fn, *operands):
+        out = fn(*operands)
+        jax.block_until_ready(out)
+        t0 = time.time()
+        for _ in range(args.repeat):
+            out = fn(*operands)
+        jax.block_until_ready(out)
+        return (time.time() - t0) / args.repeat * 1e3
+
+    results = {
+        "scale": f"N={N} B={B} Nf={Nf} Ts={Ts} td={td} K={K}",
+        "platform": jax.devices()[0].platform,
+        "repeat": args.repeat,
+        "variants": {},
+    }
+
+    # --- planes: the shipped objective exactly as the L-BFGS loop sees it
+    def vag_planes(xx, v, c, p, h):
+        return jax.value_and_grad(
+            lambda q: solver._cost_fn(q, v, c, p, h, cfg))(xx)
+
+    def jvp_planes(xx, dd, aa, v, c, p, h):
+        return jax.jvp(
+            lambda a: solver._cost_fn(xx + a * dd, v, c, p, h, cfg),
+            (aa,), (jnp.ones_like(aa),))
+
+    vv = lambda f, ia: jax.jit(jax.vmap(jax.vmap(f, in_axes=ia),
+                                        in_axes=ia))
+    ia5 = (0, 0, 0, 0, None)
+    ia7 = (0, 0, 0, 0, 0, 0, None)
+    if "planes" in want:
+        results["variants"]["planes"] = {
+            "value_and_grad_ms": round(time_fn(
+                vv(vag_planes, ia5), x, V5, C5, pr, hr), 3),
+            "jvp_ms": round(time_fn(
+                vv(jvp_planes, ia7), x, d, alpha, V5, C5, pr, hr), 3),
+        }
+
+    # --- pretrans: planes transposes hoisted out of the timed eval
+    Cp = jnp.transpose(C5, (0, 1, 2, 5, 6, 7, 3, 4))  # (Nf,Ts,K,j,l,c,Tc,B)
+    Vp = jnp.transpose(V5, (0, 1, 4, 5, 6, 2, 3))     # (Nf,Ts,i,m,c,Tc,B)
+    Cp = jax.block_until_ready(Cp)
+    Vp = jax.block_until_ready(Vp)
+
+    def vag_pre(xx, vp, cp, p, h):
+        return jax.value_and_grad(
+            lambda q: solver._cost_fn_pretrans(q, vp, cp, p, h, cfg))(xx)
+
+    def jvp_pre(xx, dd, aa, vp, cp, p, h):
+        return jax.jvp(
+            lambda a: solver._cost_fn_pretrans(xx + a * dd, vp, cp, p, h,
+                                               cfg),
+            (aa,), (jnp.ones_like(aa),))
+
+    if "pretrans" in want and hasattr(solver, "_cost_fn_pretrans"):
+        results["variants"]["pretrans"] = {
+            "value_and_grad_ms": round(time_fn(
+                vv(vag_pre, ia5), x, Vp, Cp, pr, hr), 3),
+            "jvp_ms": round(time_fn(
+                vv(jvp_pre, ia7), x, d, alpha, Vp, Cp, pr, hr), 3),
+        }
+        # parity: both formulations agree on the value
+        if "planes" in want:
+            v_a = vv(vag_planes, ia5)(x, V5, C5, pr, hr)[0]
+            v_b = vv(vag_pre, ia5)(x, Vp, Cp, pr, hr)[0]
+            results["parity_max_rel"] = float(
+                jnp.max(jnp.abs(v_a - v_b) / (jnp.abs(v_a) + 1e-20)))
+
+    # --- onehot: pretrans + matmul station expansion (scatter-free
+    # backward — gathers transpose to scatter-adds, one-hot matmuls
+    # transpose to matmuls)
+    if "onehot" in want and hasattr(solver, "_cost_fn_onehot"):
+        oh = solver._baseline_onehots(N)
+
+        def vag_oh(xx, vp, cp, p, h):
+            return jax.value_and_grad(
+                lambda q: solver._cost_fn_onehot(q, vp, cp, oh, p, h,
+                                                 cfg))(xx)
+
+        def jvp_oh(xx, dd, aa, vp, cp, p, h):
+            return jax.jvp(
+                lambda a: solver._cost_fn_onehot(xx + a * dd, vp, cp, oh,
+                                                 p, h, cfg),
+                (aa,), (jnp.ones_like(aa),))
+
+        results["variants"]["onehot"] = {
+            "value_and_grad_ms": round(time_fn(
+                vv(vag_oh, ia5), x, Vp, Cp, pr, hr), 3),
+            "jvp_ms": round(time_fn(
+                vv(jvp_oh, ia7), x, d, alpha, Vp, Cp, pr, hr), 3),
+        }
+        if "planes" in want:
+            v_a = vv(vag_planes, ia5)(x, V5, C5, pr, hr)
+            v_c = vv(vag_oh, ia5)(x, Vp, Cp, pr, hr)
+            results["parity_onehot_val_max_rel"] = float(
+                jnp.max(jnp.abs(v_a[0] - v_c[0])
+                        / (jnp.abs(v_a[0]) + 1e-20)))
+            results["parity_onehot_grad_max_rel"] = float(
+                jnp.max(jnp.abs(v_a[1] - v_c[1]))
+                / (float(jnp.max(jnp.abs(v_a[1]))) + 1e-20))
+
+    print(json.dumps(results))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -120,7 +120,7 @@ try_capture "extras_tpu"     "python tools/chip_checks.py extras /tmp/bench_extr
 # microbench — planes vs one-hot formulation of the inner cost+grad at
 # N=62 on the chip (VERDICT r4 item 6 evidence; two variants only to
 # bound server-side compiles per attempt)
-try_capture "solve_eval_tpu" "test -f results/solve_eval_tpu.json" \
+try_capture "solve_eval_tpu" "python tools/chip_checks.py solve_eval" \
   python tools/bench_solve_eval.py --variants planes,onehot --repeat 30 \
     --out results/solve_eval_tpu.json
 

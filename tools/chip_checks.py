@@ -111,6 +111,25 @@ def extras_done(tmpfile: str, rnd: str) -> bool:
     return True
 
 
+def solve_eval_done() -> bool:
+    """The solve-eval microbench landed ON CHIP: the artifact must carry
+    a TPU platform string — a CPU-fallback run (axon init failing inside
+    the tool degrades to CPU with only a warning) must not be promoted
+    as the chip comparison."""
+    try:
+        doc = json.load(open(os.path.join(RESULTS, "solve_eval_tpu.json")))
+    except Exception:
+        return False
+    ok = doc.get("platform") in ("tpu", "axon") and doc.get("variants")
+    if not ok:
+        # remove the fallback artifact so the capture loop retries
+        try:
+            os.remove(os.path.join(RESULTS, "solve_eval_tpu.json"))
+        except OSError:
+            pass
+    return bool(ok)
+
+
 def main(argv):
     if not argv:
         print(__doc__, file=sys.stderr)
@@ -124,6 +143,8 @@ def main(argv):
         return 0 if primary_done(*args) else 1
     if cmd == "extras":
         return 0 if extras_done(*args) else 1
+    if cmd == "solve_eval":
+        return 0 if solve_eval_done() else 1
     print(f"unknown check {cmd!r}", file=sys.stderr)
     return 2
 
