@@ -23,12 +23,18 @@ def main():
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "results", "calib_episode_r3.json"))
+    ap.add_argument("--allow_cpu", action="store_true",
+                    help="deliberate CPU-anchor measurement (forces the "
+                    "cpu platform; artifact carries platform='cpu' — "
+                    "never promoted as a chip capture)")
     args = ap.parse_args()
 
     import jax
+    if args.allow_cpu:
+        jax.config.update("jax_platforms", "cpu")
 
     platform = jax.devices()[0].platform
-    if platform not in ("tpu", "axon"):
+    if platform not in ("tpu", "axon") and not args.allow_cpu:
         # N=62 x Nf=8 takes hours on one CPU core; a CPU artifact labeled
         # as the chip number would be worse than no artifact
         print(f"platform is {platform!r}, not a TPU — refusing to capture",

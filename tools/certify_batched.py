@@ -1,0 +1,157 @@
+"""Curve-parity certification of the batched throughput modes.
+
+VERDICT r4 item 9: the vmapped-env modes (the headline 155-2186
+env-steps/s numbers) run ONE learn step per *vector* step — a 1:n_envs
+learn:env-step ratio, versus the reference's sequential 1:1 loop
+(`elasticnet/main_sac.py:47-76`).  Fast is only useful if it still
+trains, so this tool produces the certification artifact: same-seed
+sequential vs batched learning curves on equal env-step budgets, with
+final-window score statistics.
+
+Protocol (per seed): sequential = the jitted 1:1 episode loop
+(`train.enet_sac.make_episode_fn`, the bench primary's computation);
+batched = `parallel.make_parallel_sac` with n_envs vmapped envs in
+episode-block mode.  Both see the same total env-steps; scores are
+normalized to MEAN STEP REWARD per episode so the two protocols are
+directly comparable (a sequential episode score is the sum of its
+steps' rewards).
+
+Usage:
+    python tools/certify_batched.py [--seeds 3] [--episodes 150] \
+        [--n_envs 16] [--outdir results/batched_parity] [--platform cpu]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEPS = 5   # reference episode length (elasticnet/enetenv.py loop bound)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seeds", default=3, type=int)
+    p.add_argument("--episodes", default=150, type=int,
+                   help="sequential episodes per seed; the batched arm "
+                   "gets the same TOTAL env-steps")
+    p.add_argument("--n_envs", default=16, type=int)
+    p.add_argument("--outdir", default="results/batched_parity")
+    p.add_argument("--platform", default=None, choices=["cpu", "axon"])
+    p.add_argument("--final_window", default=30, type=int)
+    args = p.parse_args()
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import numpy as np
+
+    from smartcal_tpu.envs import enet
+    from smartcal_tpu.parallel import make_mesh, make_parallel_sac
+    from smartcal_tpu.rl import replay as rp
+    from smartcal_tpu.rl import sac
+    from smartcal_tpu.train.enet_sac import make_episode_fn
+    from smartcal_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    os.makedirs(args.outdir, exist_ok=True)
+
+    env_cfg = enet.EnetConfig(M=20, N=20)
+    agent_cfg = sac.SACConfig(obs_dim=env_cfg.obs_dim, n_actions=2,
+                              gamma=0.99, tau=0.005, batch_size=64,
+                              mem_size=1024, lr_a=1e-3, lr_c=1e-3,
+                              reward_scale=20.0, alpha=0.03)
+
+    runs = {"config": {"episodes": args.episodes, "n_envs": args.n_envs,
+                       "steps_per_episode": STEPS,
+                       "final_window": args.final_window},
+            "seeds": {}}
+    for seed in range(args.seeds):
+        t0 = time.time()
+        # ---- sequential 1:1 (mean step reward per episode)
+        key = jax.random.PRNGKey(seed)
+        key, k0 = jax.random.split(key)
+        agent_state = sac.sac_init(k0, agent_cfg)
+        buf = rp.replay_init(agent_cfg.mem_size,
+                             rp.transition_spec(env_cfg.obs_dim, 2))
+        episode_fn = make_episode_fn(env_cfg, agent_cfg, STEPS,
+                                     use_hint=False)
+        seq = []
+        for _ in range(args.episodes):
+            key, k = jax.random.split(key)
+            agent_state, buf, score = episode_fn(agent_state, buf, k)
+            seq.append(float(score) / STEPS)
+
+        # ---- batched (episode-block; scores are already mean step
+        # reward per episode across the env batch)
+        mesh = make_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        n_vec_episodes = max(1, args.episodes // args.n_envs)
+        init_fn, _, _, run_block = make_parallel_sac(
+            env_cfg, agent_cfg, mesh, n_envs=args.n_envs,
+            episode_block=(STEPS, n_vec_episodes))
+        st = init_fn(jax.random.PRNGKey(seed))
+        key_b = jax.random.PRNGKey(1000 + seed)
+        key_b, kb = jax.random.split(key_b)
+        st, scores_b = run_block(st, kb)
+        bat = [float(s) for s in np.asarray(scores_b)]
+
+        w = args.final_window
+        runs["seeds"][seed] = {
+            "sequential_mean_step_reward": seq,
+            "batched_mean_step_reward": bat,
+            "seq_final_mean": float(np.mean(seq[-w:])),
+            "seq_first_mean": float(np.mean(seq[:w])),
+            # the batched arm has episodes/n_envs vector episodes; its
+            # final window is scaled to the same env-step fraction
+            "bat_final_mean": float(np.mean(
+                bat[-max(1, w // args.n_envs):])),
+            "bat_first_mean": float(np.mean(
+                bat[:max(1, w // args.n_envs)])),
+            "wall_s": round(time.time() - t0, 1),
+        }
+        print(f"seed {seed}: seq final {runs['seeds'][seed]['seq_final_mean']:.3f} "
+              f"batched final {runs['seeds'][seed]['bat_final_mean']:.3f} "
+              f"({runs['seeds'][seed]['wall_s']}s)", flush=True)
+
+    import numpy as np  # noqa: F811 — local scope for aggregates
+    seqf = [r["seq_final_mean"] for r in runs["seeds"].values()]
+    batf = [r["bat_final_mean"] for r in runs["seeds"].values()]
+    runs["aggregate"] = {
+        "seq_final_mean": float(np.mean(seqf)),
+        "seq_final_std": float(np.std(seqf)),
+        "bat_final_mean": float(np.mean(batf)),
+        "bat_final_std": float(np.std(batf)),
+        "bat_minus_seq": float(np.mean(batf) - np.mean(seqf)),
+    }
+    out_json = os.path.join(args.outdir, "parity.json")
+    with open(out_json, "w") as fh:
+        json.dump(runs, fh, indent=1)
+
+    # curve figure: env-step-aligned mean step reward
+    from smartcal_tpu.train.plots import _plt
+    plt = _plt()
+    fig = plt.figure(figsize=(7, 4))
+    for seed, r in runs["seeds"].items():
+        xs = np.arange(len(r["sequential_mean_step_reward"])) * STEPS
+        plt.plot(xs, r["sequential_mean_step_reward"], alpha=0.5,
+                 color="C0",
+                 label="sequential 1:1" if seed == 0 else None)
+        xb = (np.arange(len(r["batched_mean_step_reward"])) + 1) \
+            * STEPS * args.n_envs
+        plt.plot(xb, r["batched_mean_step_reward"], alpha=0.8, color="C1",
+                 marker="o", ms=3,
+                 label=f"batched n={args.n_envs}" if seed == 0 else None)
+    plt.xlabel("env steps")
+    plt.ylabel("mean step reward")
+    plt.legend()
+    fig.tight_layout()
+    fig.savefig(os.path.join(args.outdir, "parity.png"), dpi=110)
+    plt.close(fig)
+    print(json.dumps(runs["aggregate"]))
+
+
+if __name__ == "__main__":
+    main()
