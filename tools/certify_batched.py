@@ -11,10 +11,10 @@ final-window score statistics.
 Protocol (per seed): sequential = the jitted 1:1 episode loop
 (`train.enet_sac.make_episode_fn`, the bench primary's computation);
 batched = `parallel.make_parallel_sac` with n_envs vmapped envs in
-episode-block mode.  Both see the same total env-steps; scores are
-normalized to MEAN STEP REWARD per episode so the two protocols are
-directly comparable (a sequential episode score is the sum of its
-steps' rewards).
+episode-block mode.  Both see the same total env-steps, and both score
+units are MEAN STEP REWARD per episode already (`enet_sac`'s episode
+body returns ``jnp.mean(rewards)``; the trainer's block scores are the
+env-batch mean of the same quantity) — directly comparable.
 
 Usage:
     python tools/certify_batched.py [--seeds 3] [--episodes 150] \
@@ -83,7 +83,7 @@ def main():
         for _ in range(args.episodes):
             key, k = jax.random.split(key)
             agent_state, buf, score = episode_fn(agent_state, buf, k)
-            seq.append(float(score) / STEPS)
+            seq.append(float(score))   # already mean step reward
 
         # ---- batched (episode-block; scores are already mean step
         # reward per episode across the env batch)
