@@ -208,7 +208,7 @@ def _cubic_choose(phi, a, fa, fad, b, fb, fbd):
 
 
 def strong_wolfe_cubic(fun: Callable, x: jnp.ndarray, d: jnp.ndarray,
-                       lr: float = 1.0) -> jnp.ndarray:
+                       lr: float = 1.0, phi_maker=None) -> jnp.ndarray:
     """Fletcher strong-Wolfe line search with cubic interpolation.
 
     Behavioural twin of ``lbfgsnew.py:192-316`` (bracket, ``_linesearch_zoom``
@@ -224,7 +224,13 @@ def strong_wolfe_cubic(fun: Callable, x: jnp.ndarray, d: jnp.ndarray,
     t1, t2, t3 = 9.0, 0.1, 0.5
     alpha1 = 10.0 * lr
 
-    phi = _phi_maker(fun, x, d)
+    # phi_maker lets an objective with structure supply a cheaper
+    # phi(alpha) -> (value, directional derivative): the calibration
+    # model is bilinear in the parameters, so its chi^2 along d is an
+    # EXACT quartic whose five coefficients cost ~3 model evaluations
+    # once — after which every probe here is O(1)
+    # (cal/solver._quartic_phi_maker).  Contract identical to _phi_maker.
+    phi = (phi_maker or _phi_maker)(fun, x, d)
 
     phi_0, gphi_0 = phi(jnp.asarray(0.0, dtype))
     tol = jnp.minimum(phi_0 * 0.01, 1e-6)
@@ -393,7 +399,8 @@ class LBFGSResult(NamedTuple):
 
 
 def _solve_loop(fun: Callable, use_line_search: bool, tolerance_grad: float,
-                tolerance_change: float, lr: float, iter_cap):
+                tolerance_change: float, lr: float, iter_cap,
+                phi_maker=None):
     """(cond, body) of the L-BFGS while_loop over the carry
     (x, loss, g, hist, it, stop, diverged) — shared by lbfgs_solve and
     lbfgs_resume so a segmented solve walks the IDENTICAL trajectory."""
@@ -413,7 +420,7 @@ def _solve_loop(fun: Callable, use_line_search: bool, tolerance_grad: float,
                        jnp.minimum(1.0, 1.0 / jnp.sum(jnp.abs(g))) * lr,
                        lr)
         if use_line_search:
-            t = strong_wolfe_cubic(fun, x, d, lr=lr)
+            t = strong_wolfe_cubic(fun, x, d, lr=lr, phi_maker=phi_maker)
         else:
             t = t0
 
@@ -444,11 +451,11 @@ def _solve_loop(fun: Callable, use_line_search: bool, tolerance_grad: float,
     return cond, body
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4, 7))
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4, 7, 8))
 def lbfgs_solve(fun: Callable, x0: jnp.ndarray, max_iters: int = 200,
                 history_size: int = 7, use_line_search: bool = True,
                 tolerance_grad: float = 1e-5, tolerance_change: float = 1e-9,
-                lr: float = 1.0) -> LBFGSResult:
+                lr: float = 1.0, phi_maker=None) -> LBFGSResult:
     """Minimise ``fun(x)`` by L-BFGS with strong-Wolfe cubic line search.
 
     One ``lax.while_loop`` replaces the reference's 20x ``step(closure)``
@@ -462,7 +469,8 @@ def lbfgs_solve(fun: Callable, x0: jnp.ndarray, max_iters: int = 200,
     hist0 = history_init(x0.shape[0], history_size, dtype)
 
     cond, body = _solve_loop(fun, use_line_search, tolerance_grad,
-                             tolerance_change, lr, max_iters)
+                             tolerance_change, lr, max_iters,
+                             phi_maker=phi_maker)
     init = (x0, loss0, g0, hist0, jnp.asarray(0, jnp.int32),
             jnp.sum(jnp.abs(g0)) <= tolerance_grad,
             jnp.isnan(loss0))
@@ -472,11 +480,11 @@ def lbfgs_solve(fun: Callable, x0: jnp.ndarray, max_iters: int = 200,
                        diverged=diverged)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2, 3, 6))
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 6, 7))
 def lbfgs_resume(fun: Callable, res: LBFGSResult, extra_iters: int,
                  use_line_search: bool = True, tolerance_grad: float = 1e-5,
                  tolerance_change: float = 1e-9,
-                 lr: float = 1.0) -> LBFGSResult:
+                 lr: float = 1.0, phi_maker=None) -> LBFGSResult:
     """Continue a (vmappable) ``lbfgs_solve`` for up to ``extra_iters`` more
     iterations — the SAME while_loop body over the carry recovered from the
     result, so ``solve(30)`` and ``solve(10)`` + 2x ``resume(10)`` walk
@@ -485,7 +493,8 @@ def lbfgs_resume(fun: Callable, res: LBFGSResult, extra_iters: int,
     RPC-tunnel watchdogs; see cal/solver.solve_admm_host)."""
     cap = res.n_iters + extra_iters
     cond, body = _solve_loop(fun, use_line_search, tolerance_grad,
-                             tolerance_change, lr, cap)
+                             tolerance_change, lr, cap,
+                             phi_maker=phi_maker)
     init = (res.x, res.loss, res.grad, res.hist, res.n_iters, res.stop,
             res.diverged)
     x, loss, g, hist, it, stop, diverged = lax.while_loop(cond, body, init)

@@ -74,14 +74,15 @@ def main():
     pr = jnp.asarray(rng.normal(0, 0.3, (Nf, Ts, K, 2 * N, 2, 2)), f32)
     hr = jnp.asarray(np.full(K, 2.5), f32)
 
-    def time_fn(fn, *operands):
+    def time_fn(fn, *operands, rep=None):
+        rep = rep or args.repeat
         out = fn(*operands)
         jax.block_until_ready(out)
         t0 = time.time()
-        for _ in range(args.repeat):
+        for _ in range(rep):
             out = fn(*operands)
         jax.block_until_ready(out)
-        return (time.time() - t0) / args.repeat * 1e3
+        return (time.time() - t0) / rep * 1e3
 
     results = {
         "scale": f"N={N} B={B} Nf={Nf} Ts={Ts} td={td} K={K}",
@@ -174,6 +175,35 @@ def main():
             results["parity_onehot_grad_max_rel"] = float(
                 jnp.max(jnp.abs(v_a[1] - v_c[1]))
                 / (float(jnp.max(jnp.abs(v_a[1]))) + 1e-20))
+
+    # --- solve8: END-TO-END 8-iteration vmapped L-BFGS solve, jvp-probe
+    # line search vs the exact-quartic phi (the production line search) —
+    # measures what the formulation changes buy at the solve level, not
+    # just per-eval
+    if "solve8" in want and hasattr(solver, "_quartic_phi_maker"):
+        from smartcal_tpu.ops import lbfgs as lb
+
+        oh = solver._baseline_onehots(N)
+
+        def solve_with(pm_builder):
+            def one(xx, vp, cp, p):
+                fun = lambda q: solver._cost_fn_onehot(q, vp, cp, oh, p,
+                                                       hr, cfg)
+                pm = pm_builder(vp, cp, p) if pm_builder else None
+                r = lb.lbfgs_solve(fun, xx, max_iters=8,
+                                   use_line_search=True, phi_maker=pm)
+                return r.x, r.loss
+            return jax.jit(jax.vmap(jax.vmap(one)))
+
+        quartic = lambda vp, cp, p: solver._quartic_phi_maker(
+            vp, cp, oh, p, hr, cfg)
+        for name, builder in (("solve8_jvp_phi", None),
+                              ("solve8_quartic_phi", quartic)):
+            fn = solve_with(builder)
+            ms = time_fn(fn, x, Vp, Cp, pr, rep=max(1, args.repeat // 10))
+            loss = float(jnp.mean(fn(x, Vp, Cp, pr)[1]))
+            results["variants"][name] = {
+                "solve8_ms": round(ms, 1), "mean_loss": round(loss, 4)}
 
     print(json.dumps(results))
     if args.out:
