@@ -405,12 +405,14 @@ def _solve_flops_estimate(backend, ep):
     ``while_loop`` body ONCE, and the solver is loop-dominated — so this
     models the dominant op instead: applying the Jones solutions to the
     model coherencies, z_pq = J_p C_k J_q^H, two split-real 2x2 complex
-    matmuls (~112 flop) per (direction, baseline-sample, sub-band).  Each
-    L-BFGS iteration evaluates the gradient (~2 cost-equivalents by
-    reverse-mode) plus ~1.5 line-search cost/directional evaluations;
-    ADMM dual/consensus updates are lower-order.  Good to ~2x — enough to
-    place MFU in hardware terms (the VERDICT r3 item 8 ask), not a
-    profiler-grade count."""
+    matmuls (~112 flop) per (direction, baseline-sample, sub-band).  Per
+    L-BFGS iteration: the gradient eval (~2 cost-equivalents by
+    reverse-mode) plus the quartic line-search coefficient build (~1.5
+    cost-equivalents net of the shared forward); ADMM dual/consensus
+    updates are lower-order.  This HAND model is reported for continuity
+    only — the XLA-measured per-iteration count (cost_eval_flops) is
+    ~7x larger and is what MFU is quoted from; their ratio is in the
+    payload (flops_model_over_measured)."""
     B = backend.n_stations * (backend.n_stations - 1) // 2
     samples = backend.n_freqs * backend.n_times * B
     cost_flops = samples * ep.n_dirs * 112
