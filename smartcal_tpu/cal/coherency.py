@@ -117,6 +117,47 @@ def predict_coherencies_sr(uu, vv, ww, sky: SkyArrays, freq,
                     fdelta_over_freq=float(fdelta / freq) if smear else 0.0)
 
 
+@partial(jax.jit, static_argnames=("n_clusters", "smear"))
+def _predict_multi(uvw_scaled, fofs, lmn, flux_coef, f0, gauss, is_gauss,
+                   cluster, n_clusters, freqs, smear=False):
+    """Batched core: uvw_scaled (Nf, T, 3) PRE-scaled by 2 pi f/c (scaled
+    eagerly by the wrapper, outside this jit — an in-jit scale fuses into
+    the phase accumulation as an fma and shifts the f32-wrapped DFT
+    phases off the single-band path's values); fofs (Nf,) = fdelta/f
+    (zeros when not smearing)."""
+    def one(us, f, fof):
+        return _predict(us, lmn, flux_coef, f0, gauss, is_gauss,
+                        cluster, n_clusters, f, smear=smear,
+                        fdelta_over_freq=fof)
+
+    return jax.vmap(one)(uvw_scaled, freqs, fofs)
+
+
+def predict_coherencies_multi_sr(uu, vv, ww, sky: SkyArrays, freqs,
+                                 smear=False, fdelta=180e3):
+    """Split-real coherencies for ALL sub-bands: (Nf, K, T, 4, 2) in ONE
+    device dispatch (the vmapped form of :func:`predict_coherencies_sr`,
+    removing the envs' per-frequency python loop).
+
+    Numerically matched to stacking the single-band calls: the per-band
+    uvw scale factors are computed on host with the SAME f32 scalar
+    arithmetic as the single-band wrapper (NEP-50: python floats are
+    weak against the f32 channel frequencies), so the (huge,
+    f32-wrapped) DFT phases agree with the loop path's.
+    """
+    freqs32 = np.asarray(freqs, np.float32)
+    scales = jnp.asarray(2.0 * np.pi * freqs32 / C_LIGHT, jnp.float32)
+    fofs = jnp.asarray(fdelta / np.asarray(freqs, np.float64) if smear
+                       else np.zeros_like(freqs32), jnp.float32)
+    uvw = jnp.stack([jnp.asarray(uu), jnp.asarray(vv), jnp.asarray(ww)],
+                    axis=-1).astype(jnp.float32)
+    uvw_scaled = uvw[None, :, :] * scales[:, None, None]   # eager, like
+    return _predict_multi(uvw_scaled, fofs, sky.lmn,       # the 1-band path
+                          sky.flux_coef, sky.f0, sky.gauss, sky.is_gauss,
+                          sky.cluster, sky.n_clusters,
+                          jnp.asarray(freqs, jnp.float32), smear=smear)
+
+
 def predict_coherencies(uu, vv, ww, sky: SkyArrays, freq,
                         smear=False, fdelta=180e3):
     """Complex host-edge wrapper: returns C (K, T, 4) complex64."""

@@ -82,6 +82,18 @@ def consensus_hadd_scalars(rho_spectral, rho_spatial, freqs, f0, fidx,
     return jax.vmap(per_dir)(rho, alpha)
 
 
+def consensus_hadd_all(rho_spectral, rho_spatial, freqs, f0, n_poly=2,
+                       polytype=1):
+    """(Nf, K) consensus scalars for EVERY sub-band in one call — the
+    vmapped form of :func:`consensus_hadd_scalars` over the frequency
+    index, so multi-band influence consumers pay one device dispatch
+    instead of Nf."""
+    nf = jnp.asarray(freqs).shape[0]
+    return jax.vmap(lambda fi: consensus_hadd_scalars(
+        rho_spectral, rho_spatial, freqs, f0, fi, n_poly=n_poly,
+        polytype=polytype))(jnp.arange(nf))
+
+
 class InfluenceResult(NamedTuple):
     vis: jnp.ndarray   # (T*B, 4, 2) influence visibilities [XX, XY, YX, YY]
     llr: jnp.ndarray   # (Ts, K) per-chunk log-likelihood ratios
@@ -145,6 +157,39 @@ def influence_visibilities(R, C, J, hadd, n_stations, n_chunks,
         v = jnp.repeat(vis_b[:, None, :, :, :], Td, axis=1)
         vis = v.reshape(T * B, 4, 2) * scale
     return InfluenceResult(vis=vis, llr=llr)
+
+
+@partial(jax.jit, static_argnames=("n_stations", "n_chunks", "npix",
+                                   "use_pallas"))
+def influence_images_multi(residual, C, J, hadd_all, freqs, uvw, cell,
+                           n_stations, n_chunks, npix, use_pallas=True):
+    """Per-sub-band Stokes-I influence dirty images in ONE device dispatch.
+
+    The envs' host loop over sub-bands (residual_to_kernel ->
+    influence_visibilities -> dirty image, once per frequency) costs O(Nf)
+    dispatches with a host sync between each; here the frequency axis is a
+    ``lax.map`` axis inside one jit (lax.map, not vmap: the body stays
+    unbatched so the Pallas imager — which has no batching rule — remains
+    usable per lane).
+
+    residual (Nf, T, B, 2, 2, 2) solver residuals; C (Nf, K, T*B, 4, 2);
+    J (Nf, Ts, K, 2N, 2, 2); hadd_all (Nf, K) per-band consensus scalars
+    (:func:`consensus_hadd_all`); freqs (Nf,); uvw (T*B, 3) meters; cell
+    static pixel size.  Returns (Nf, npix, npix).  ``use_pallas=False``
+    forces the XLA imager (required inside GSPMD/shard_map programs).
+    """
+    from smartcal_tpu.cal import imager, solver  # lazy: solver is a consumer
+
+    def one(args):
+        resid, c, j, hadd, f = args
+        Rk = solver.residual_to_kernel(resid)
+        inf = influence_visibilities(Rk, c, j, hadd, n_stations, n_chunks)
+        ivis = stokes_i_influence(inf.vis)
+        if use_pallas:
+            return imager.dirty_image_sr(uvw, ivis, f, cell, npix=npix)
+        return imager.dirty_image_sr_xla(uvw, ivis, f, cell, npix=npix)
+
+    return lax.map(one, (residual, C, J, hadd_all, jnp.asarray(freqs)))
 
 
 class PerdirSummary(NamedTuple):

@@ -24,6 +24,7 @@ from __future__ import annotations
 import math
 from typing import NamedTuple, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -102,6 +103,32 @@ def shapelet_coherency_sr(coeff, uu, vv, freq, beta, flux=1.0,
     C = C.at[:, 0, :].set(vis)
     C = C.at[:, 3, :].set(vis)
     return C
+
+
+@jax.jit
+def _shapelet_coherency_multi(coeff, uu, vv, scales, beta, flux, l0, m0):
+    def one(s):
+        vis = flux * shapelet_uv_sr(coeff, uu * s, vv * s, beta,
+                                    l0=l0, m0=m0)
+        R = vis.shape[0]
+        C = jnp.zeros((R, 4, 2), jnp.float32)
+        return C.at[:, 0, :].set(vis).at[:, 3, :].set(vis)
+
+    return jax.vmap(one)(scales)
+
+
+def shapelet_coherency_multi_sr(coeff, uu, vv, freqs, beta, flux=1.0,
+                                l0=0.0, m0=0.0):
+    """(Nf, R, 4, 2) shapelet coherencies for ALL sub-bands in one
+    dispatch — the vmapped form of :func:`shapelet_coherency_sr`, with
+    the per-band wavelength scales rounded on host exactly like the
+    single-band wrapper so the two paths agree to float round-off."""
+    C_LIGHT = 299792458.0
+    scales = jnp.asarray(np.asarray(freqs, np.float64) / C_LIGHT,
+                         jnp.float32)
+    return _shapelet_coherency_multi(jnp.asarray(coeff, jnp.float32),
+                                     jnp.asarray(uu), jnp.asarray(vv),
+                                     scales, beta, flux, l0, m0)
 
 
 class ShapeletModel(NamedTuple):

@@ -17,6 +17,8 @@ stays on device.
 import math
 from typing import NamedTuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from smartcal_tpu.cal import observation as obs_mod
@@ -431,3 +433,28 @@ def add_noise(key, V, snr):
     noise -= noise.mean()
     scale = snr * np.linalg.norm(V) / max(np.linalg.norm(noise), 1e-30)
     return V + noise * scale, float(scale)
+
+
+@jax.jit
+def _apply_noise(V, noise, snr):
+    nv = jnp.sqrt(jnp.sum(V * V))
+    nn = jnp.sqrt(jnp.sum(noise * noise))
+    scale = snr * nv / jnp.maximum(nn, 1e-30)
+    return V + noise * scale, scale
+
+
+def add_noise_device(key, V, snr):
+    """:func:`add_noise` with the norm/scale/add on DEVICE.
+
+    The noise draw keeps the host Generator (byte-identical stream to
+    ``add_noise`` for the same key), but the signal array never
+    round-trips to host: the legacy path's ``np.asarray(V)`` forced a
+    device sync in the middle of episode construction.  Returns
+    ``(V + scaled noise, scale)`` as device values; matches ``add_noise``
+    to float32 reduction-order round-off (~1e-7 relative on the scale).
+    """
+    rng = _rng_of(key, salt=5)
+    noise = rng.standard_normal(np.shape(V)).astype(np.float32)
+    noise -= noise.mean()
+    return _apply_noise(jnp.asarray(V), jnp.asarray(noise),
+                        jnp.float32(snr))

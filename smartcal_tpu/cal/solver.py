@@ -206,9 +206,15 @@ def _baseline_onehots(n_stations, dtype=jnp.float32):
     baseline.  Multiplying J planes by these reproduces the
     ``J4[:, p_idx]`` gather as a matmul — whose autodiff TRANSPOSE is
     another matmul (MXU) instead of the scatter-add a gather transposes
-    to, the dominant non-elementwise op in the eval's backward pass."""
-    p_idx, q_idx = baseline_indices(n_stations)
-    eye = jnp.eye(n_stations, dtype=dtype)
+    to, the dominant non-elementwise op in the eval's backward pass.
+
+    Built with NUMPY on host (constants under jit either way): the
+    shape-only `cost_eval_flops` helper calls this outside any jit, and
+    an eager ``jnp.eye`` there would execute on the default backend —
+    which can be a wedged TPU tunnel when the helper is meant to stay
+    CPU-side."""
+    p_idx, q_idx = np.triu_indices(n_stations, 1)  # kernels.baseline_indices
+    eye = np.eye(n_stations, dtype=np.dtype(dtype))  # order, host-side
     return eye[:, p_idx], eye[:, q_idx]          # each (N, B)
 
 
@@ -285,7 +291,7 @@ def _quartic_phi_maker(Vp, Cp, onehots, prior, half_rho, cfg: SolverConfig):
     ``R(alpha) = R0 - alpha P1 - alpha^2 P2`` with
     ``R0 = V - F(J,J)``, ``P1 = F(D,J) + F(J,D)``, ``P2 = F(D,D)`` —
     and ``phi(alpha) = |R(alpha)|^2 + prior`` is an exact degree-4
-    polynomial.  Its five coefficients cost three bilinear model
+    polynomial.  Its five coefficients cost four bilinear model
     evaluations ONCE per line search; afterwards every strong-Wolfe /
     zoom probe (`ops.lbfgs.strong_wolfe_cubic` executes up to ~15 of
     them per search) is O(1) scalar arithmetic instead of a full-model
@@ -302,21 +308,27 @@ def _quartic_phi_maker(Vp, Cp, onehots, prior, half_rho, cfg: SolverConfig):
         K = cfg.n_dirs
         J = x.reshape(K, 2 * cfg.n_stations, 2, 2)
         D = d.reshape(J.shape)
-        # polarization identity: F(J+D, J+D) = F(J,J) + [F(D,J)+F(J,D)]
-        # + F(D,D), so the cross term P1 comes from THREE bilinear
-        # evaluations instead of four.  The subtraction costs ~1e-6
-        # relative round-off on P1 (f32, |ms| / |p1| rarely beyond
-        # ~100x) — the same order as the jvp probes this replaces.
+        # cross term P1 = F(D,J) + F(J,D) from the two MIXED bilinear
+        # evaluations directly (four model evals total).  The previous
+        # three-eval polarization-identity form
+        # P1 = F(J+D,J+D) - F(J,J) - F(D,D) cancels CATASTROPHICALLY in
+        # f32 once |D| << |J| (late L-BFGS iterations: |p1| ~ |D|/|J| of
+        # |ms|, so at |D| ~ 1e-4 |J| the subtraction keeps ~no bits),
+        # feeding the Wolfe probes a wrong c1 slope exactly when the
+        # search needs small-step accuracy.  One extra bilinear eval
+        # buys an exact-to-round-off P1 at every step scale
+        # (tests/test_calib_pipeline.py pins the small-step regime).
         m0 = _model_bilinear(J, J, Cp, onehot_p, onehot_q, cfg)
         m2 = _model_bilinear(D, D, Cp, onehot_p, onehot_q, cfg)
-        ms = _model_bilinear(J + D, J + D, Cp, onehot_p, onehot_q, cfg)
+        mdj = _model_bilinear(D, J, Cp, onehot_p, onehot_q, cfg)
+        mjd = _model_bilinear(J, D, Cp, onehot_p, onehot_q, cfg)
         c0 = c1 = c2 = c3 = c4 = jnp.asarray(0.0, x.dtype)
         for i in range(2):
             for m in range(2):
                 for comp in range(2):
                     r0 = Vp[i, m, comp] - m0[i][m][comp]
                     p2 = m2[i][m][comp]
-                    p1 = ms[i][m][comp] - m0[i][m][comp] - p2
+                    p1 = mdj[i][m][comp] + mjd[i][m][comp]
                     c0 = c0 + jnp.sum(r0 * r0)
                     c1 = c1 - 2.0 * jnp.sum(r0 * p1)
                     c2 = c2 + jnp.sum(p1 * p1) - 2.0 * jnp.sum(r0 * p2)
@@ -567,10 +579,16 @@ def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
 # sequence; only XLA fusion boundaries differ) — tests/test_cal_backend.py
 # asserts it.
 
-@partial(jax.jit, static_argnames=("cfg", "iters", "init_phase"))
+@partial(jax.jit, static_argnames=("cfg", "iters", "init_phase"),
+         donate_argnames=("x0",))
 def _seg_start(x0, V6, C7, prior, rho, cfg, iters, init_phase):
     """Open a vmapped (Nf, Ts) L-BFGS solve for ``iters`` iterations;
-    init_phase drops the consensus prior term (chi2-only)."""
+    init_phase drops the consensus prior term (chi2-only).
+
+    ``x0`` (the (Nf, Ts, K*2N*2*2) solution carry) is DONATED: the driver
+    never reads the pre-segment iterate again, so on accelerators the
+    output state reuses its HBM instead of allocating a fresh buffer per
+    segment (no-op on CPU, where donation is unsupported)."""
     half_rho = jnp.zeros_like(rho) if init_phase else 0.5 * rho
     Vp, Cp = _eval_operands(V6, C7)
     onehots = _baseline_onehots(cfg.n_stations, V6.dtype)
@@ -585,8 +603,14 @@ def _seg_start(x0, V6, C7, prior, rho, cfg, iters, init_phase):
     return jax.vmap(jax.vmap(one))(x0, Vp, Cp, prior)
 
 
-@partial(jax.jit, static_argnames=("cfg", "iters", "init_phase"))
+@partial(jax.jit, static_argnames=("cfg", "iters", "init_phase"),
+         donate_argnames=("res",))
 def _seg_resume(res, V6, C7, prior, rho, cfg, iters, init_phase):
+    """Resume segment: the incoming L-BFGS state ``res`` (x, gradient,
+    curvature history — the big per-segment carry) is DONATED into the
+    outgoing state of identical structure, so segment N+1's state
+    overwrites segment N's buffers in place on accelerators instead of
+    doubling the carry footprint at every dispatch."""
     half_rho = jnp.zeros_like(rho) if init_phase else 0.5 * rho
     Vp, Cp = _eval_operands(V6, C7)
     onehots = _baseline_onehots(cfg.n_stations, V6.dtype)
@@ -600,10 +624,12 @@ def _seg_resume(res, V6, C7, prior, rho, cfg, iters, init_phase):
     return jax.vmap(jax.vmap(one))(res, Vp, Cp, prior)
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("Y",))
 def _host_consensus(J, Y, bfull, Bi, rho, cfg):
     """Z and dual updates after an outer iteration's inner solves (the
-    shared _z_update/_bz formulas, one bounded dispatch)."""
+    shared _z_update/_bz formulas, one bounded dispatch).  The dual ``Y``
+    — a full (Nf, Ts, K, 2N, 2, 2) consensus buffer — is donated into
+    its own update (in-place on accelerators)."""
     Z = _z_update(bfull, Bi, rho, J, Y)
     Y = Y + rho[None, None, :, None, None, None] * (J - _bz(bfull, Z))
     return Z, Y, _bz(bfull, Z) - Y / rho[None, None, :, None, None, None]
@@ -696,6 +722,17 @@ def simulate_vis_sr(J, C, n_stations, Ts):
     return V.reshape(-1, B_count, 2, 2, 2)
 
 
+@partial(jax.jit, static_argnames=("n_stations", "Ts"))
+def simulate_vis_multi_sr(J, C, n_stations, Ts):
+    """All-sub-band :func:`simulate_vis_sr` in ONE dispatch.
+
+    J : (Nf, Ts, K, 2N, 2, 2); C : (Nf, K, T*B, 4, 2).
+    Returns (Nf, T, B, 2, 2, 2) — the vmapped form of the envs'
+    per-frequency corruption loop (O(Nf) dispatches -> O(1))."""
+    return jax.vmap(
+        lambda j, c: simulate_vis_sr(j, c, n_stations, Ts))(J, C)
+
+
 def residual_to_kernel(residual):
     """(T, B, 2, 2, 2) solver residual -> kernel-convention R (2BT, 2, 2):
     sample ck = t*B + b occupies rows 2ck:2ck+2 (see cal/kernels.py)."""
@@ -717,7 +754,7 @@ def cost_eval_flops(cfg: SolverConfig, Nf: int, Ts: int, td: int, B: int):
     from (VERDICT r4 item 5): lower the EXACT batched evaluation
     functions the L-BFGS driver runs — the vmapped ``value_and_grad``
     of ``_cost_fn_onehot`` (one per iteration) and the quartic
-    line-search coefficient build (`_quartic_phi_maker`, three bilinear
+    line-search coefficient build (`_quartic_phi_maker`, four bilinear
     model evaluations once per iteration; the probes themselves are
     O(1)) — and read ``compiled.cost_analysis()['flops']``.  Shape-only
     (``ShapeDtypeStruct``) on the CPU backend: no data, no execution,
@@ -752,7 +789,8 @@ def cost_eval_flops(cfg: SolverConfig, Nf: int, Ts: int, td: int, B: int):
 
     def setup_one(xx, dd, aa, v, c, p, h):
         # the production line search: build the quartic coefficients
-        # (three bilinear model evals) and take one (O(1)) probe
+        # (four bilinear model evals, see _quartic_phi_maker) and take
+        # one (O(1)) probe
         pm = _quartic_phi_maker(v, c, onehots, p, h, cfg)
         return pm(None, xx, dd)(aa)
 
@@ -760,7 +798,13 @@ def cost_eval_flops(cfg: SolverConfig, Nf: int, Ts: int, td: int, B: int):
 
     def _flops(fn, in_axes, *avals):
         f = jax.vmap(jax.vmap(fn, in_axes=in_axes), in_axes=in_axes)
-        compiled = jax.jit(f, backend="cpu").lower(*avals).compile()
+        # pin lowering to an explicit CPU device: the jit(backend="cpu")
+        # kwarg this used is removed in newer JAX; default_device steers
+        # the shape-only lower+compile the same way on every pin, and
+        # never initializes the (possibly wedged-tunnel) TPU backend
+        cpu = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu):
+            compiled = jax.jit(f).lower(*avals).compile()
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
@@ -773,12 +817,14 @@ def cost_eval_flops(cfg: SolverConfig, Nf: int, Ts: int, td: int, B: int):
         "xla_value_and_grad_flops": xla_vag,
         "xla_linesearch_setup_flops": xla_setup,
         "model_value_and_grad_flops": 3.0 * model_cost,
-        "model_linesearch_setup_flops": 3.0 * model_cost,
+        # four bilinear model evaluations since the exact-P1 fix
+        # (m0, m2, and the two mixed terms — see _quartic_phi_maker)
+        "model_linesearch_setup_flops": 4.0 * model_cost,
         "counted_on": "cpu-backend HLO cost_analysis",
     }
     if np.isfinite(xla_vag) and xla_vag > 0:
         out["vag_model_over_xla"] = round(3.0 * model_cost / xla_vag, 3)
     if np.isfinite(xla_setup) and xla_setup > 0:
-        out["setup_model_over_xla"] = round(3.0 * model_cost / xla_setup,
+        out["setup_model_over_xla"] = round(4.0 * model_cost / xla_setup,
                                             3)
     return out

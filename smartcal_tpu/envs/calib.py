@@ -32,15 +32,25 @@ def _to_unit(rho):
 
 
 class CalibEnv:
-    """Gym-style env (reset/step), dict observations {'img', 'sky'}."""
+    """Gym-style env (reset/step), dict observations {'img', 'sky'}.
+
+    ``prefetch=True`` double-buffers episode construction: after each
+    reset, the NEXT episode's simulation (host draws + device dispatches)
+    is scheduled on the backend's worker thread, so it overlaps this
+    episode's calibrate/influence work (the env-side half of the
+    backend's pipelined episode path).  Deterministic — the upcoming
+    reset key is a pure function of the seed stream.
+    """
 
     def __init__(self, M=5, provide_hint=False, backend: Optional[
-            radio.RadioBackend] = None, seed=0):
+            radio.RadioBackend] = None, seed=0, prefetch=False):
         self.M = M
         self.K = 0
         self.provide_hint = provide_hint
         self.hint = None
         self.backend = backend or radio.RadioBackend()
+        self.prefetch = prefetch
+        self._pf_tag = None
         self._key = jax.random.PRNGKey(seed)
         self.rho_spectral = np.ones(M, np.float32)
         self.rho_spatial = np.ones(M, np.float32)
@@ -101,12 +111,30 @@ class CalibEnv:
             return obs, reward, done, self.hint, info
         return obs, reward, done, info
 
+    def _build_episode(self, key):
+        rng = radio.observation.host_rng(key, salt=21)
+        K = int(rng.integers(2, self.M + 1))
+        ep, mdl = self.backend.new_calib_episode(key, K, self.M)
+        return K, ep, mdl
+
+    def _prefetch_tag(self, key):
+        # namespaced per env INSTANCE: two envs sharing a backend (and
+        # possibly a seed stream) must never collide in the registry
+        return (f"{type(self).__name__}-{id(self)}-"
+                + np.asarray(key).tobytes().hex())
+
     def reset(self):
         key = self._next_key()
-        rng = radio.observation.host_rng(key, salt=21)
-        self.K = int(rng.integers(2, self.M + 1))
-        self.ep, self.mdl = self.backend.new_calib_episode(key, self.K,
-                                                           self.M)
+        got = (self.backend.take_prefetched(self._prefetch_tag(key))
+               if self.prefetch else None)
+        self.K, self.ep, self.mdl = got or self._build_episode(key)
+        if self.prefetch:
+            # the key the NEXT reset will draw (split is deterministic):
+            # build that episode on the worker while this one calibrates
+            nxt = jax.random.split(self._key)[1]
+            self._pf_tag = self._prefetch_tag(nxt)
+            self.backend.prefetch_episode(
+                self._pf_tag, lambda k=nxt: self._build_episode(k))
         self.rho_spectral = np.ones(self.M, np.float32)
         self.rho_spatial = np.ones(self.M, np.float32)
         self.rho_spectral[:self.K] = self.mdl.rho
@@ -134,4 +162,6 @@ class CalibEnv:
         print(self.rho_spectral, self.rho_spatial)
 
     def close(self):
-        pass
+        if self._pf_tag is not None:
+            self.backend.discard_prefetched(self._pf_tag)
+            self._pf_tag = None

@@ -43,11 +43,14 @@ class DemixingEnv:
 
     def __init__(self, K=6, provide_hint=False, provide_influence=False,
                  backend: Optional[radio.RadioBackend] = None, seed=0,
-                 tau=100.0):
+                 tau=100.0, prefetch=False):
         self.K = K
         self.provide_hint = provide_hint
         self.provide_influence = provide_influence
         self.backend = backend or radio.RadioBackend(admm_iters=30)
+        # double-buffered episode construction (see CalibEnv.prefetch)
+        self.prefetch = prefetch
+        self._pf_tag = None
         self.tau = tau
         self._key = jax.random.PRNGKey(seed)
         self.ep = None
@@ -128,9 +131,23 @@ class DemixingEnv:
             return obs, reward, done, self.hint, info
         return obs, reward, done, info
 
+    def _prefetch_tag(self, key):
+        # namespaced per env INSTANCE (see CalibEnv._prefetch_tag)
+        return (f"{type(self).__name__}-{id(self)}-"
+                + np.asarray(key).tobytes().hex())
+
     def reset(self):
         key = self._next_key()
-        self.ep, self.mdl = self.backend.new_demixing_episode(key, self.K)
+        got = (self.backend.take_prefetched(self._prefetch_tag(key))
+               if self.prefetch else None)
+        self.ep, self.mdl = got or self.backend.new_demixing_episode(
+            key, self.K)
+        if self.prefetch:
+            nxt = jax.random.split(self._key)[1]
+            self._pf_tag = self._prefetch_tag(nxt)
+            self.backend.prefetch_episode(
+                self._pf_tag,
+                lambda k=nxt: self.backend.new_demixing_episode(k, self.K))
         self.elevation = self.mdl.elevation
         self.rho = self.mdl.rho.astype(np.float32)
         self.maxiter = 10
@@ -204,4 +221,6 @@ class DemixingEnv:
         print("maxiter", self.maxiter, "rho", self.rho)
 
     def close(self):
-        pass
+        if self._pf_tag is not None:
+            self.backend.discard_prefetched(self._pf_tag)
+            self._pf_tag = None

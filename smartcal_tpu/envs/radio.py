@@ -15,8 +15,24 @@ fixed K-direction coherency tensor — unselected directions have their
 coherencies zeroed, so one compiled solver serves every subset, and the
 2^(K-1) exhaustive hint sweep becomes a single vmap over masks rather than
 the reference's 32 sequential MPI launches (demixingenv.py:301-336).
+
+Episode pipeline design (the device-pipelined hot path):
+  * construction is VECTORIZED over the frequency axis — coherency
+    prediction, shapelet addition, Jones corruption, and noise are each
+    ONE device dispatch for all Nf sub-bands (``vectorized=False`` keeps
+    the original per-frequency host loop as the parity oracle);
+  * with more than one device, ``calibrate`` routes to the
+    frequency-sharded consensus solve and ``influence_image`` to the
+    sharded influence kernels (parallel/sharded_cal) — the envs get the
+    mesh for free through the backend (``shard="auto"``);
+  * ``run_pipelined`` overlaps episode t+1's construction with episode
+    t's calibrate/influence work on a worker thread (IMPACT-style
+    actor/learner overlap, arXiv 1912.00167) — deterministic, since
+    every draw is keyed.
 """
 
+import os
+import threading
 from typing import NamedTuple, Optional
 
 import jax
@@ -25,6 +41,14 @@ import numpy as np
 
 from smartcal_tpu.cal import (coherency, imager, influence, observation,
                               simulate, solver)
+
+# calibration-unit thresholds (see RadioBackend._fused_work): one fused
+# XLA program above _WATCHDOG_WORK risks tripping device/tunnel watchdogs
+# (measured on the v5e tunnel, ~35 s of chip time); sharding below
+# _SHARD_MIN_WORK costs more in collective/dispatch overhead than the
+# fan-out returns, so "auto" leaves tiny training configs alone.
+_WATCHDOG_WORK = 1e7
+_SHARD_MIN_WORK = 1e6
 
 
 class Episode(NamedTuple):
@@ -43,11 +67,23 @@ class RadioBackend:
 
     n_times = Ts * tdelta total integration slots; every ``tdelta`` slots
     share one solution interval (sagecal -t).
+
+    vectorized : True (default) builds episodes with the one-dispatch
+        multi-frequency kernels; False keeps the original per-frequency
+        host loop (the parity oracle and the pre-pipeline baseline
+        bench.py compares against).
+    shard : "auto" | True | False — mesh-aware solve/influence routing.
+        "auto" enables the frequency-sharded ADMM + sharded influence
+        when more than one device is visible AND the episode is big
+        enough to amortize the collectives (_SHARD_MIN_WORK); True
+        forces sharding whenever a divisible mesh exists; False never
+        shards.  SMARTCAL_SHARD=0/1 overrides.
     """
 
     def __init__(self, n_stations=14, n_freqs=3, n_times=20, tdelta=10,
                  n_poly=2, admm_iters=10, lbfgs_iters=8, init_iters=30,
-                 polytype=0, npix=128, hint_batch=8):
+                 polytype=0, npix=128, hint_batch=8, vectorized=True,
+                 shard="auto"):
         if n_times <= 0 or n_times % tdelta != 0:
             raise ValueError(
                 f"n_times={n_times} must be a positive multiple of "
@@ -70,12 +106,22 @@ class RadioBackend:
         # count (and cond becomes select), so hint_batch=1 (sequential
         # lax.map, per-lane early exit) is faster on one core
         self.hint_batch = hint_batch
+        self.vectorized = vectorized
+        self.shard = shard
         self._sweep_fns = {}     # (n_dirs, n_masks, batch) -> jitted sweep
+        self._meshes = {}        # axis size -> cached 1D mesh
+        # double-buffer worker (run_pipelined / env prefetch)
+        self._prefetch_lock = threading.Lock()
+        self._prefetch_ex = None
+        self._prefetched = {}
 
     # -- episode construction ------------------------------------------------
 
     def _coherencies(self, obs, sky):
         uvw = np.asarray(obs.uvw).reshape(-1, 3)
+        if self.vectorized:
+            return coherency.predict_coherencies_multi_sr(
+                uvw[:, 0], uvw[:, 1], uvw[:, 2], sky, obs.freqs)
         return jnp.stack([
             coherency.predict_coherencies_sr(uvw[:, 0], uvw[:, 1], uvw[:, 2],
                                              sky, f)
@@ -94,6 +140,13 @@ class RadioBackend:
         Jid = simulate.identity_solutions(J_extra_dirs, self.n_stations,
                                           self.n_chunks, self.n_freqs)
         Jsim = np.concatenate([Jerr, Jid], axis=2)
+        if self.vectorized:
+            # one dispatch for all sub-bands, and the noise scale/add stays
+            # on device — no np.asarray(V) host sync mid-construction
+            V = solver.simulate_vis_multi_sr(jnp.asarray(Jsim), Csim,
+                                             self.n_stations, self.n_chunks)
+            Vn, _ = simulate.add_noise_device(key, V, snr=snr)
+            return Vn
         V = jnp.stack([
             solver.simulate_vis_sr(jnp.asarray(Jsim[f]), Csim[f],
                                    self.n_stations, self.n_chunks)
@@ -108,10 +161,14 @@ class RadioBackend:
         from smartcal_tpu.cal import shapelets
 
         uvw = np.asarray(obs.uvw).reshape(-1, 3)
-        add = jnp.stack([
-            shapelets.shapelet_coherency_sr(coeff, uvw[:, 0], uvw[:, 1],
-                                            float(f), beta, flux=flux)
-            for f in np.asarray(obs.freqs)])
+        if self.vectorized:
+            add = shapelets.shapelet_coherency_multi_sr(
+                coeff, uvw[:, 0], uvw[:, 1], obs.freqs, beta, flux=flux)
+        else:
+            add = jnp.stack([
+                shapelets.shapelet_coherency_sr(coeff, uvw[:, 0], uvw[:, 1],
+                                                float(f), beta, flux=flux)
+                for f in np.asarray(obs.freqs)])
         return C.at[:, 0].add(add)
 
     def new_calib_episode(self, key, K, M, diffuse=False):
@@ -160,6 +217,63 @@ class RadioBackend:
         ep = Episode(obs=obs, V=V, Ccal=Ccal, f0=f0, n_dirs=K, snr=snr)
         return ep, mdl
 
+    # -- episode pipelining --------------------------------------------------
+
+    def _worker(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._prefetch_lock:
+            if self._prefetch_ex is None:
+                self._prefetch_ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="smartcal-episode")
+            return self._prefetch_ex
+
+    def prefetch_episode(self, tag, build):
+        """Schedule ``build()`` (an episode constructor) on the backend's
+        worker thread, keyed by ``tag``.  JAX dispatch is thread-safe and
+        every draw is keyed, so the construction overlaps the caller's
+        device work without changing any result.
+
+        Callers sharing one backend must NAMESPACE their tags (the envs
+        prefix theirs with the env instance identity): a bare PRNG-key
+        tag collides across two envs walking the same seed stream."""
+        self._prefetched[tag] = self._worker().submit(build)
+
+    def take_prefetched(self, tag):
+        """Collect a previously prefetched episode (None if absent)."""
+        fut = self._prefetched.pop(tag, None)
+        return None if fut is None else fut.result()
+
+    def discard_prefetched(self, tag):
+        """Drop a pending prefetch without consuming it (env close):
+        an abandoned future would otherwise pin its episode's device
+        buffers for the backend's lifetime."""
+        fut = self._prefetched.pop(tag, None)
+        if fut is not None:
+            fut.cancel()
+
+    def run_pipelined(self, keys, make_episode, process):
+        """Double-buffered episode pipeline: yields ``process(ep, mdl)``
+        per key while episode t+1's ``make_episode(key)`` (host RNG draws
+        + simulation dispatches) runs on the worker thread alongside
+        episode t's calibrate/influence device work.
+
+        The serial loop pays (host sim setup + device solve) per episode;
+        here the host setup hides behind the previous episode's solve —
+        the IMPACT overlap (arXiv 1912.00167) at episode granularity.
+        Deterministic: outputs are a pure function of the keys.
+        """
+        keys = list(keys)
+        if not keys:
+            return
+        ex = self._worker()
+        fut = ex.submit(make_episode, keys[0])
+        for i in range(len(keys)):
+            ep, mdl = fut.result()
+            if i + 1 < len(keys):
+                fut = ex.submit(make_episode, keys[i + 1])
+            yield process(ep, mdl)
+
     # -- calibration + influence --------------------------------------------
 
     def _solver_cfg(self, K):
@@ -168,52 +282,111 @@ class RadioBackend:
             admm_iters=self.admm_iters, lbfgs_iters=self.lbfgs_iters,
             init_iters=self.init_iters, polytype=self.polytype)
 
+    def _fused_work(self, admm_iters=None):
+        """Calibration units of one fused solve: total L-BFGS iterations x
+        per-iteration work, with the per-call ADMM iteration override (the
+        demixing action's maxiter) counted, not the constructor default."""
+        admm = self.admm_iters if admm_iters is None else int(admm_iters)
+        total_iters = self.init_iters + admm * self.lbfgs_iters
+        return total_iters * (self.n_stations ** 2) * self.n_freqs \
+            * self.n_times
+
+    def _shard_size(self, n_items, work):
+        """Mesh axis size for sharding ``n_items`` (0 = don't shard):
+        the largest divisor of n_items that fits the device count,
+        subject to the shard mode (see class docstring)."""
+        mode = self.shard
+        override = os.environ.get("SMARTCAL_SHARD", "").strip()
+        if override in ("0", "1"):
+            mode = override == "1"
+        if mode is False or mode is None:
+            return 0
+        if mode == "auto" and work < _SHARD_MIN_WORK:
+            return 0
+        try:
+            ndev = jax.device_count()
+        except RuntimeError:
+            return 0
+        if ndev < 2:
+            return 0
+        for size in range(min(ndev, n_items), 1, -1):
+            if n_items % size == 0:
+                return size
+        return 0
+
+    def _mesh(self, size):
+        mesh = self._meshes.get(size)
+        if mesh is None:
+            from smartcal_tpu.parallel import make_mesh
+
+            mesh = make_mesh((size,), ("fp",),
+                             devices=jax.devices()[:size])
+            self._meshes[size] = mesh
+        return mesh
+
     def calibrate(self, ep: Episode, rho, mask=None, admm_iters=None):
         """Solve with per-direction rho; ``mask`` (K,) in {0,1} excludes
         directions by zeroing their model (static shapes, no recompile).
         Cold start: n_chunks (not J0) sets the solution intervals, so the
         solver's chi2-only init phase runs.
 
-        Large problems route to the host-segmented driver automatically
-        (bounded device dispatches; a single fused XLA program running for
-        minutes trips device/tunnel watchdogs — solver.solve_admm_host).
-        Under a jax trace (the vmapped hint sweep) the fused path is the
-        only legal one and is kept.
+        Routing (untraced calls): with a usable mesh the solve runs
+        frequency-sharded (parallel/sharded_cal.solve_admm_sharded — the
+        consensus psum is the MPI allreduce as an ICI collective, and the
+        per-shard program is 1/n-th the fused size, which also keeps it
+        under the device watchdog).  Otherwise large problems route to
+        the host-segmented driver (bounded device dispatches; a single
+        fused XLA program running for minutes trips device/tunnel
+        watchdogs — solver.solve_admm_host).  Under a jax trace (the
+        vmapped hint sweep) the fused path is the only legal one and is
+        kept.
         """
         C = ep.Ccal
         if mask is not None:
             C = C * jnp.asarray(mask)[None, :, None, None, None]
         traced = any(isinstance(x, jax.core.Tracer)
                      for x in (C, ep.V, rho, admm_iters))
-        if not traced and self._use_host_solver(admm_iters):
-            return solver.solve_admm_host(
-                ep.V, C, ep.obs.freqs, ep.f0, jnp.asarray(rho),
-                self._solver_cfg(ep.n_dirs), n_chunks=self.n_chunks,
-                admm_iters=None if admm_iters is None else int(admm_iters))
+        if not traced:
+            work = self._fused_work(admm_iters)
+            # SMARTCAL_HOST_SOLVER=1 is the operational kill-switch for
+            # everything but the bounded host-segmented driver (e.g. to
+            # dodge a sharded/shard_map regression) — it must beat the
+            # mesh route, not just the fused-vs-host heuristic
+            forced_host = (os.environ.get("SMARTCAL_HOST_SOLVER", "")
+                           .strip() == "1")
+            nfp = 0 if forced_host else self._shard_size(self.n_freqs, work)
+            if nfp and work / nfp <= _WATCHDOG_WORK:
+                from smartcal_tpu.parallel import sharded_cal
+
+                return sharded_cal.solve_admm_sharded(
+                    self._mesh(nfp), ep.V, C, ep.obs.freqs, ep.f0,
+                    jnp.asarray(rho), self._solver_cfg(ep.n_dirs),
+                    axis="fp", n_chunks=self.n_chunks,
+                    admm_iters=None if admm_iters is None
+                    else int(admm_iters))
+            if self._use_host_solver(admm_iters):
+                return solver.solve_admm_host(
+                    ep.V, C, ep.obs.freqs, ep.f0, jnp.asarray(rho),
+                    self._solver_cfg(ep.n_dirs), n_chunks=self.n_chunks,
+                    admm_iters=None if admm_iters is None
+                    else int(admm_iters))
         return solver.solve_admm(
             ep.V, C, ep.obs.freqs, ep.f0, jnp.asarray(rho),
             self._solver_cfg(ep.n_dirs), n_chunks=self.n_chunks,
             admm_iters=None if admm_iters is None else jnp.asarray(admm_iters))
 
     def _use_host_solver(self, admm_iters=None) -> bool:
-        """Proxy for 'one fused solve would run too long on a chip': total
-        L-BFGS iterations x per-iteration work, with the per-call ADMM
-        iteration override (the demixing action's maxiter) counted, not the
-        constructor default.  N=14/Nf=3 training configs stay fused (they
+        """Proxy for 'one fused solve would run too long on a chip'
+        (see _fused_work).  N=14/Nf=3 training configs stay fused (they
         live inside vmapped sweeps and finish in seconds); LOFAR-scale
         N=62/Nf=8 segments.  SMARTCAL_HOST_SOLVER=0/1 overrides."""
-        import os
-
         override = os.environ.get("SMARTCAL_HOST_SOLVER", "").strip()
         if override in ("0", "1"):
             return override == "1"
-        admm = self.admm_iters if admm_iters is None else int(admm_iters)
-        total_iters = self.init_iters + admm * self.lbfgs_iters
-        work = (self.n_stations ** 2) * self.n_freqs * self.n_times
         # calibration units: N=62/Nf=8 at few iterations (3.7e6) measured
         # ~10s steady on one v5e chip and runs fine; the watchdog bites
         # near ~60-90s (2-3e7).  1e7 =~ 35s leaves margin both ways.
-        return total_iters * work > 1e7
+        return self._fused_work(admm_iters) > _WATCHDOG_WORK
 
     def hint_sweep(self, ep: Episode, rho, masks, admm_iters=None,
                    batch=None):
@@ -272,12 +445,77 @@ class RadioBackend:
 
     def influence_image(self, ep: Episode, result: solver.SolveResult,
                         rho, rho_spatial, npix=None):
-        """Mean influence dirty image over sub-bands (doinfluence.sh role)."""
+        """Mean influence dirty image over sub-bands (doinfluence.sh role).
+
+        Default path: ONE device dispatch for all sub-bands
+        (cal/influence.influence_images_multi) instead of the original
+        O(Nf) host loop.  With a usable mesh the sub-bands fan out over
+        devices (parallel/sharded_cal.influence_images_sharded); when the
+        frequency axis doesn't divide but the chunk axis does, the
+        per-band chunk-sharded kernel (influence_sharded — the
+        reference's process pool as a mesh axis) is used instead.
+        ``vectorized=False`` keeps the original loop (parity oracle).
+        """
         npix = npix or self.npix
         freqs = np.asarray(ep.obs.freqs)
+        if not self.vectorized:
+            return self._influence_image_loop(ep, result, rho, rho_spatial,
+                                              npix)
+        uvw = jnp.asarray(np.asarray(ep.obs.uvw).reshape(-1, 3))
+        cell = imager.default_cell(ep.obs.uvw, float(freqs[-1]))
         # polytype matches the solve's consensus basis (the reference
         # hard-codes Bernstein here, analysis_torch.py:104 — a solver/
         # influence mismatch we do not reproduce)
+        hadd_all = influence.consensus_hadd_all(
+            rho, rho_spatial, freqs, ep.f0, n_poly=self.n_poly,
+            polytype=self.polytype)                          # (Nf, K)
+        # same size gate as the solve: influence cost tracks the episode
+        # scale, and a backend big enough to shard the ADMM is big enough
+        # to shard the influence fan-out
+        work = self._fused_work()
+        nfp = self._shard_size(self.n_freqs, work)
+        if nfp:
+            from smartcal_tpu.parallel import sharded_cal
+
+            return sharded_cal.influence_images_sharded(
+                self._mesh(nfp), result.residual, ep.Ccal, result.J,
+                hadd_all, ep.obs.freqs, uvw, cell, self.n_stations,
+                self.n_chunks, npix)
+        nsp = self._shard_size(self.n_chunks, work)
+        if nsp:
+            return self._influence_image_chunk_sharded(
+                ep, result, hadd_all, uvw, cell, npix, nsp)
+        imgs = influence.influence_images_multi(
+            result.residual, ep.Ccal, result.J, hadd_all, ep.obs.freqs,
+            uvw, cell, self.n_stations, self.n_chunks, npix)
+        return jnp.mean(imgs, axis=0)
+
+    def _influence_image_chunk_sharded(self, ep, result, hadd_all, uvw,
+                                       cell, npix, nsp):
+        """Per-band influence with the calibration-interval axis sharded
+        (sharded_cal.influence_sharded); used when Nf has no usable
+        divisor but n_chunks does."""
+        from smartcal_tpu.parallel import sharded_cal
+
+        mesh = self._mesh(nsp)
+        freqs = np.asarray(ep.obs.freqs)
+        imgs = []
+        for fi in range(self.n_freqs):
+            Rk = solver.residual_to_kernel(result.residual[fi])
+            inf = sharded_cal.influence_sharded(
+                mesh, Rk, ep.Ccal[fi], result.J[fi], hadd_all[fi],
+                self.n_stations, self.n_chunks, axis="fp")
+            ivis = influence.stokes_i_influence(inf.vis)
+            imgs.append(imager.dirty_image_sr_xla(uvw, ivis,
+                                                  float(freqs[fi]), cell,
+                                                  npix=npix))
+        return jnp.mean(jnp.stack(imgs), axis=0)
+
+    def _influence_image_loop(self, ep, result, rho, rho_spatial, npix):
+        """The original per-frequency host loop (pre-pipeline path): kept
+        as the parity oracle for the vectorized/sharded kernels and the
+        bench.py host-loop baseline."""
+        freqs = np.asarray(ep.obs.freqs)
         hadd_all = [influence.consensus_hadd_scalars(
             rho, rho_spatial, freqs, ep.f0, fi, n_poly=self.n_poly,
             polytype=self.polytype) for fi in range(self.n_freqs)]

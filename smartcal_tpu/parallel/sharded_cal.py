@@ -21,10 +21,25 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:                                  # jax >= 0.6: top-level export
+    from jax import shard_map as _shard_map_impl
+    _SM_CHECK_KW = "check_vma"
+except ImportError:                   # older pins: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _SM_CHECK_KW = "check_rep"
 
 from ..cal import influence as influence_mod
 from ..cal import solver
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+    """Version-tolerant ``shard_map``: newer jax renamed the replication
+    check kwarg (check_rep -> check_vma) and moved the function out of
+    experimental; the solver must run on both pins."""
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs,
+                           **{_SM_CHECK_KW: check_vma})
 
 
 def solve_admm_sharded(mesh: Mesh, V, C, freqs, f0, rho,
@@ -48,18 +63,31 @@ def solve_admm_sharded(mesh: Mesh, V, C, freqs, f0, rho,
         freq_range = (float(fr.min()), float(fr.max()))
 
     fn = partial(solver.solve_admm, cfg=cfg, axis_name=axis,
-                 n_chunks=n_chunks, admm_iters=admm_iters,
-                 freq_range=freq_range)
+                 n_chunks=n_chunks, freq_range=freq_range)
     out_specs = solver.SolveResult(
         J=P(axis), Z=P(), residual=P(axis), sigma_res=P(),
         sigma_data=P(), final_cost=P(axis))
+    if admm_iters is None:
+        sharded = shard_map(
+            lambda v, c, f, r: fn(v, c, f, f0, r),
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P()),
+            out_specs=out_specs,
+            check_vma=False)
+        return sharded(V, C, jnp.asarray(freqs), jnp.asarray(rho))
+    # dynamic iteration count (the demixing action's maxiter) rides as a
+    # replicated OPERAND, not a closure: a closed-over python int would be
+    # baked into the trace (and a closed-over array is not portable across
+    # shard_map versions), while an operand reuses one compiled program
+    # for every maxiter value
     sharded = shard_map(
-        lambda v, c, f, r: fn(v, c, f, f0, r),
+        lambda v, c, f, r, it: fn(v, c, f, f0, r, admm_iters=it),
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P()),
+        in_specs=(P(axis), P(axis), P(axis), P(), P()),
         out_specs=out_specs,
         check_vma=False)
-    return sharded(V, C, jnp.asarray(freqs), jnp.asarray(rho))
+    return sharded(V, C, jnp.asarray(freqs), jnp.asarray(rho),
+                   jnp.asarray(admm_iters))
 
 
 def solve_admm_sharded2d(mesh: Mesh, Vb, Cb, freqs_b, f0_b, rho,
@@ -167,3 +195,35 @@ def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
     # local results concatenate along the chunk-major sample axis, which is
     # exactly the global time-major order
     return res
+
+
+def influence_images_sharded(mesh: Mesh, residual, C, J, hadd_all, freqs,
+                             uvw, cell, n_stations: int, n_chunks: int,
+                             npix: int, axis: str = "fp"):
+    """Mean influence dirty image with the FREQUENCY axis sharded over
+    ``axis``: each shard runs :func:`cal.influence.influence_images_multi`
+    on its local sub-bands and the mean is one psum.
+
+    residual (Nf, T, B, 2, 2, 2); C (Nf, K, T*B, 4, 2);
+    J (Nf, Ts, K, 2N, 2, 2); hadd_all (Nf, K); freqs (Nf,);
+    uvw (T*B, 3).  Nf must divide by the axis size.  Returns the
+    replicated (npix, npix) mean image — the doinfluence.sh average the
+    envs observe, with sub-bands fanned out over devices.
+    """
+    nfp = mesh.shape[axis]
+    Nf = residual.shape[0]
+    if Nf % nfp != 0:
+        raise ValueError(f"Nf={Nf} not divisible by {axis}={nfp}")
+
+    def local(r, c, j, h, f, uvw_):
+        imgs = influence_mod.influence_images_multi(
+            r, c, j, h, f, uvw_, cell, n_stations, n_chunks, npix,
+            use_pallas=False)           # pallas_call has no partitioning rule
+        return jax.lax.psum(jnp.sum(imgs, axis=0), axis)
+
+    sharded = shard_map(local, mesh=mesh,
+                        in_specs=(P(axis), P(axis), P(axis), P(axis),
+                                  P(axis), P()),
+                        out_specs=P(), check_vma=False)
+    return sharded(residual, C, J, hadd_all, jnp.asarray(freqs),
+                   uvw) / Nf

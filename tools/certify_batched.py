@@ -65,9 +65,30 @@ def main():
                               mem_size=1024, lr_a=1e-3, lr_c=1e-3,
                               reward_scale=20.0, alpha=0.03)
 
-    runs = {"config": {"episodes": args.episodes, "n_envs": args.n_envs,
+    # round --episodes UP to a multiple of n_envs: the batched arm runs
+    # episodes // n_envs vector episodes, so a non-multiple silently gave
+    # the two arms different env-step budgets (e.g. 150 sequential vs
+    # 144 batched at n_envs=16) — the curves compared unequal work
+    import math
+
+    episodes = int(math.ceil(args.episodes / args.n_envs) * args.n_envs)
+    n_vec_episodes = episodes // args.n_envs
+    # the batched final window covers the same fraction of env-steps as
+    # the sequential one: ceil, not floor (floor could round a 30-episode
+    # window to 1 vector episode where 2 cover it)
+    bat_window = max(1, math.ceil(args.final_window / args.n_envs))
+
+    runs = {"config": {"episodes": episodes,
+                       "episodes_requested": args.episodes,
+                       "n_envs": args.n_envs,
                        "steps_per_episode": STEPS,
-                       "final_window": args.final_window},
+                       "final_window": args.final_window,
+                       "batched_final_window_vec_episodes": bat_window,
+                       # actual env-step budgets of each arm (equal by
+                       # construction after rounding; recorded so the
+                       # artifact is self-describing)
+                       "seq_env_steps": episodes * STEPS,
+                       "bat_env_steps": n_vec_episodes * args.n_envs * STEPS},
             "seeds": {}}
     for seed in range(args.seeds):
         t0 = time.time()
@@ -80,7 +101,7 @@ def main():
         episode_fn = make_episode_fn(env_cfg, agent_cfg, STEPS,
                                      use_hint=False)
         seq = []
-        for _ in range(args.episodes):
+        for _ in range(episodes):
             key, k = jax.random.split(key)
             agent_state, buf, score = episode_fn(agent_state, buf, k)
             seq.append(float(score))   # already mean step reward
@@ -88,7 +109,6 @@ def main():
         # ---- batched (episode-block; scores are already mean step
         # reward per episode across the env batch)
         mesh = make_mesh((1,), ("dp",), devices=jax.devices()[:1])
-        n_vec_episodes = max(1, args.episodes // args.n_envs)
         init_fn, _, _, run_block = make_parallel_sac(
             env_cfg, agent_cfg, mesh, n_envs=args.n_envs,
             episode_block=(STEPS, n_vec_episodes))
@@ -105,11 +125,9 @@ def main():
             "seq_final_mean": float(np.mean(seq[-w:])),
             "seq_first_mean": float(np.mean(seq[:w])),
             # the batched arm has episodes/n_envs vector episodes; its
-            # final window is scaled to the same env-step fraction
-            "bat_final_mean": float(np.mean(
-                bat[-max(1, w // args.n_envs):])),
-            "bat_first_mean": float(np.mean(
-                bat[:max(1, w // args.n_envs)])),
+            # final window covers the same env-step fraction (ceil)
+            "bat_final_mean": float(np.mean(bat[-bat_window:])),
+            "bat_first_mean": float(np.mean(bat[:bat_window])),
             "wall_s": round(time.time() - t0, 1),
         }
         print(f"seed {seed}: seq final {runs['seeds'][seed]['seq_final_mean']:.3f} "
