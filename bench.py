@@ -215,6 +215,8 @@ def probe_backend():
     comparable: the recorded baseline is the torch reference on this
     same host CPU.
     """
+    from smartcal_tpu import obs
+
     forced = os.environ.get("BENCH_PLATFORM", "").strip().lower()
     if forced in ("cpu", "tpu"):
         return forced, f"forced via BENCH_PLATFORM={forced}"
@@ -236,10 +238,22 @@ def probe_backend():
         except subprocess.TimeoutExpired:
             # only the wedged-tunnel hang retries — a clean non-TPU answer
             # is definitive and must not cost retry sleeps on CPU-only hosts
+            rl = obs.active()
+            if rl is not None:
+                # the structured chip-probe record VERDICT r5 demanded
+                # (87/87 tunnel probes failed with nothing on disk)
+                rl.log("probe", ok=False, attempt=i,
+                       error="backend init timed out (150s)")
             if i < attempts - 1:
                 time.sleep(45 * (i + 1))
             continue
-        if r.returncode == 0 and r.stdout.strip() in ("axon", "tpu"):
+        ok = r.returncode == 0 and r.stdout.strip() in ("axon", "tpu")
+        rl = obs.active()
+        if rl is not None:
+            rl.log("probe", ok=ok, attempt=i,
+                   platform=r.stdout.strip() or None,
+                   returncode=r.returncode)
+        if ok:
             return "tpu", ""
         return "cpu", ("no TPU platform available "
                        f"(probe saw {r.stdout.strip() or r.returncode})")
@@ -644,11 +658,29 @@ def bench_calib_episode(pipeline_episodes: int = 2, small: bool = False):
 
 
 def main():
+    # SMARTCAL_OBS=<path> records the whole bench as an obs run: backend
+    # spans (simulate/solve/influence routes), solver telemetry, compile
+    # events, and structured chip-probe results — aggregate with
+    # tools/obs_report.py.  Unset: every obs hook is a strict no-op, so
+    # timed sections are untouched (the acceptance bar for this layer).
+    from smartcal_tpu import obs
+
+    obs_path = os.environ.get("SMARTCAL_OBS", "").strip()
+    runlog = None
+    if obs_path:
+        runlog = obs.RunLog(obs_path, meta={"entry": "bench"})
+        obs.activate(runlog)
+        obs.install_compile_listener()
     stopped, insurance = _pause_competitors()
     try:
         _measured_main()
     finally:
         _resume_competitors(stopped, insurance)
+        if runlog is not None:
+            obs.log_memory_gauges()
+            obs.flush_counters(reset=True)
+            obs.deactivate(runlog)
+            runlog.close()
 
 
 def _measured_main():

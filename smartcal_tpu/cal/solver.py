@@ -66,6 +66,32 @@ class SolverConfig(NamedTuple):
     polytype: int = 0
 
 
+class SolverStats(NamedTuple):
+    """Telemetry threaded out of the jitted solve (``collect_stats=True``).
+
+    Pure ADDITIONAL outputs computed from intermediates the solve already
+    holds — the solution path is bit-identical with stats on or off
+    (asserted by tests/test_obs.py).  In the fused solve the arrays are
+    sized ``cfg.admm_iters`` (the static bound): entries past the
+    executed count stay 0, and if a caller passes an ``admm_iters``
+    override ABOVE the config (out of that argument's <= contract, but
+    the fuzzy demixing env does it) the scatter drops the excess entries
+    — ``admm_iters`` still reports the true count.  The host-segmented
+    driver sizes them to the actual outer-iteration count.
+    """
+
+    admm_iters: jnp.ndarray    # () int32 outer iterations actually run
+    primal_resid: jnp.ndarray  # (cfg.admm_iters,) consensus RMS ||J-BZ||
+                               # per outer iteration (global over freq)
+    inner_iters: jnp.ndarray   # (cfg.admm_iters,) int32 total L-BFGS
+                               # iterations per outer iteration, all
+                               # (Nf, Ts) lanes
+    init_iters: jnp.ndarray    # () int32 total chi2-only init iterations
+    n_segments: jnp.ndarray    # () int32 device dispatches (1 fused;
+                               # the host-segmented driver counts its
+                               # bounded dispatches)
+
+
 class SolveResult(NamedTuple):
     J: jnp.ndarray          # (Nf, Ts, K, 2N, 2, 2) per-subband solutions
     Z: jnp.ndarray          # (Ts, K, Ne, 2N, 2, 2) global poly solutions
@@ -75,6 +101,7 @@ class SolveResult(NamedTuple):
     final_cost: jnp.ndarray # (Nf, Ts) inner cost at the last ADMM
                             # iteration, in DATA units (rescaled from the
                             # internal normalization)
+    stats: Optional[SolverStats] = None  # telemetry (collect_stats=True)
 
 
 def _blocks(J, n_stations):
@@ -455,11 +482,13 @@ def _finalize(J, V6, C7, data_scale, cost, cfg, T, axis_name=None):
             cost * data_scale * data_scale)
 
 
-@partial(jax.jit, static_argnames=("cfg", "axis_name", "n_chunks"))
+@partial(jax.jit,
+         static_argnames=("cfg", "axis_name", "n_chunks", "collect_stats"))
 def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
                axis_name: Optional[str] = None,
                admm_iters: Optional[jnp.ndarray] = None,
-               freq_range=None, n_chunks: Optional[int] = None) -> SolveResult:
+               freq_range=None, n_chunks: Optional[int] = None,
+               collect_stats: bool = False) -> SolveResult:
     """Consensus-ADMM calibration over (possibly sharded) frequency sub-bands.
 
     V     : (Nf, T, B, 2, 2, 2) observed visibilities (split-real 2x2)
@@ -482,6 +511,10 @@ def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
             None, Ts is derived from J0 (or 1).  Pass n_chunks WITHOUT a J0
             warm start to get per-interval solutions plus the chi2-only
             init phase (a J0 warm start skips init_iters).
+    collect_stats : static; when True the result's ``stats`` field carries
+            per-outer-iteration consensus residuals and L-BFGS iteration
+            counts (SolverStats) — additional outputs only, the solution
+            path is bit-identical either way.
     """
     if axis_name is not None and cfg.polytype == 1 and freq_range is None:
         raise ValueError(
@@ -520,11 +553,15 @@ def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
         pm = _quartic_phi_maker(vp, cp, onehots, prior, half_rho, cfg)
         res = lbfgs.lbfgs_solve(fun, x0, max_iters=cfg.lbfgs_iters,
                                 use_line_search=True, phi_maker=pm)
-        return res.x, res.loss
+        # n_iters rides along for the telemetry path; it is part of the
+        # while_loop carry either way, so the non-collecting program DCEs
+        # it without changing any computed value
+        return res.x, res.loss, res.n_iters
 
     batch_solve = jax.vmap(jax.vmap(inner_solve))        # over (Nf, Ts)
 
     x_shape = (Nf, Ts, K * 2 * N * 2 * 2)
+    init_iters_total = jnp.asarray(0, jnp.int32)
     if not warm and cfg.init_iters > 0:
         # chi2-only initialization at the per-subband data optimum
         def init_solve(x0, vp, cp, prior):
@@ -534,33 +571,68 @@ def solve_admm(V, C, freqs, f0, rho, cfg: SolverConfig, J0=None,
             pm = _quartic_phi_maker(vp, cp, onehots, prior, zero_rho, cfg)
             res = lbfgs.lbfgs_solve(fun, x0, max_iters=cfg.init_iters,
                                     phi_maker=pm)
-            return res.x
+            return res.x, res.n_iters
 
         pr0 = J0.reshape((Nf, Ts, K, 2 * N, 2, 2))
-        x_init = jax.vmap(jax.vmap(init_solve))(
+        x_init, init_nit = jax.vmap(jax.vmap(init_solve))(
             J0.reshape(x_shape), Vp, Cp, pr0)
         J0 = x_init.reshape(J0.shape)
+        if collect_stats:
+            init_iters_total = jnp.sum(init_nit).astype(jnp.int32)
+            if axis_name is not None:
+                init_iters_total = lax.psum(init_iters_total, axis_name)
+
+    rho6 = rho[None, None, :, None, None, None]
 
     def body(i, state):
-        J, Y, Z, cost = state
-        prior = _bz(bfull, Z) - Y / rho[None, None, :, None, None, None]
+        J, Y, Z, cost = state[:4]
+        prior = _bz(bfull, Z) - Y / rho6
         x0 = J.reshape(x_shape)
         pr = prior.reshape((Nf, Ts, K, 2 * N, 2, 2))
-        x, cost = batch_solve(x0, Vp, Cp, pr)
+        x, cost, nit = batch_solve(x0, Vp, Cp, pr)
         J = x.reshape(J.shape)
         Z = _z_update(bfull, Bi, rho, J, Y, axis_name)
-        Y = Y + rho[None, None, :, None, None, None] * (J - _bz(bfull, Z))
-        return J, Y, Z, cost
+        r = J - _bz(bfull, Z)
+        Y = Y + rho6 * r
+        if not collect_stats:
+            return J, Y, Z, cost
+        # telemetry: consensus RMS + inner-iteration total, additional
+        # reductions over intermediates the update already computed
+        rss = jnp.sum(r * r)
+        nel = jnp.asarray(r.size, r.dtype)
+        nit_sum = jnp.sum(nit)
+        if axis_name is not None:
+            rss = lax.psum(rss, axis_name)
+            nel = lax.psum(nel, axis_name)
+            nit_sum = lax.psum(nit_sum, axis_name)
+        # mode="drop": an over-config admm_iters override (fuzzy env)
+        # must drop the excess entries, never clamp onto the last slot
+        pr_hist = state[4].at[i].set(jnp.sqrt(rss / nel), mode="drop")
+        it_hist = state[5].at[i].set(nit_sum.astype(jnp.int32),
+                                     mode="drop")
+        return J, Y, Z, cost, pr_hist, it_hist
 
     Y0 = jnp.zeros_like(J0)
     Z0 = _z_update(bfull, Bi, rho, J0, Y0, axis_name)
     cost0 = jnp.zeros((Nf, Ts), J0.dtype)
-    J, Y, Z, cost = lax.fori_loop(0, niter, body, (J0, Y0, Z0, cost0))
+    stats = None
+    if collect_stats:
+        init = (J0, Y0, Z0, cost0,
+                jnp.zeros((cfg.admm_iters,), J0.dtype),
+                jnp.zeros((cfg.admm_iters,), jnp.int32))
+        J, Y, Z, cost, pr_hist, it_hist = lax.fori_loop(0, niter, body, init)
+        stats = SolverStats(
+            admm_iters=jnp.asarray(niter, jnp.int32),
+            primal_resid=pr_hist, inner_iters=it_hist,
+            init_iters=init_iters_total,
+            n_segments=jnp.asarray(1, jnp.int32))
+    else:
+        J, Y, Z, cost = lax.fori_loop(0, niter, body, (J0, Y0, Z0, cost0))
 
     residual, sigma_res, sigma_data, fcost = _finalize(
         J, V6, C7, data_scale, cost, cfg, T, axis_name)
     return SolveResult(J=J, Z=Z, residual=residual, sigma_res=sigma_res,
-                       sigma_data=sigma_data, final_cost=fcost)
+                       sigma_data=sigma_data, final_cost=fcost, stats=stats)
 
 
 # ---------------------------------------------------------------------------
@@ -638,9 +710,19 @@ def _host_consensus(J, Y, bfull, Bi, rho, cfg):
 _host_finalize = partial(jax.jit, static_argnames=("cfg", "T"))(_finalize)
 
 
+@jax.jit
+def _primal_resid_rms(J, Z, bfull):
+    """Consensus RMS ||J - B Z|| — the host driver's telemetry probe, a
+    SEPARATE tiny dispatch so the production host path stays untouched
+    (bit-identical) when stats are off."""
+    r = J - _bz(bfull, Z)
+    return jnp.sqrt(jnp.mean(r * r))
+
+
 def solve_admm_host(V, C, freqs, f0, rho, cfg: SolverConfig,
                     n_chunks: int = 1, admm_iters: Optional[int] = None,
-                    freq_range=None, seg_iters: int = 8) -> SolveResult:
+                    freq_range=None, seg_iters: int = 8,
+                    collect_stats: bool = False) -> SolveResult:
     """``solve_admm`` as bounded host-driven dispatches (single host/device;
     for the sharded multi-device path use parallel.sharded_cal, whose
     shard_map programs keep per-dispatch work 1/n-th the size anyway).
@@ -650,6 +732,11 @@ def solve_admm_host(V, C, freqs, f0, rho, cfg: SolverConfig,
         cfg.lbfgs_iters > seg_iters.  Cold start only (J0 warm start is a
         solve_admm feature the radio envs don't use with host
         segmentation).
+
+    collect_stats : fill ``result.stats`` with the segment count, the
+        per-outer-iteration consensus residual (via a separate tiny
+        dispatch, :func:`_primal_resid_rms`) and L-BFGS iteration totals.
+        The production dispatch sequence is untouched either way.
     """
     Nf = V.shape[0]
     T = V.shape[1]
@@ -669,43 +756,65 @@ def solve_admm_host(V, C, freqs, f0, rho, cfg: SolverConfig,
         Nf, Ts, K, 2 * N, 2, 2)
     x_shape = (Nf, Ts, K * 2 * N * 2 * 2)
 
+    n_segments = 0
+
     def segmented_solve(x0, prior, total, init_phase):
         """total L-BFGS iterations as ceil(total/seg_iters) dispatches."""
+        nonlocal n_segments
         first = min(seg_iters, total)
         res = _seg_start(x0, V6, C7, prior, rho_n, cfg, first, init_phase)
         jax.block_until_ready(res.x)
+        n_segments += 1
         done = first
         while done < total:
             step = min(seg_iters, total - done)
             res = _seg_resume(res, V6, C7, prior, rho_n, cfg, step,
                               init_phase)
             jax.block_until_ready(res.x)
+            n_segments += 1
             done += step
         return res
 
+    init_iters_done = 0
     # chi2-only init phase (solve_admm's init_iters)
     if cfg.init_iters > 0:
         pr0 = J0.reshape((Nf, Ts, K, 2 * N, 2, 2))
         res = segmented_solve(J0.reshape(x_shape), pr0, cfg.init_iters,
                               init_phase=True)
         J0 = res.x.reshape(J0.shape)
+        if collect_stats:
+            init_iters_done = int(np.sum(np.asarray(res.n_iters)))
 
     Y = jnp.zeros_like(J0)
     Z = _z_update(bfull, Bi, rho_n, J0, Y)
     J = J0
     prior = _bz(bfull, Z) - Y / rho_n[None, None, :, None, None, None]
     cost = jnp.zeros((Nf, Ts), J0.dtype)
-    for _ in range(niter):
+    # sized by the ACTUAL outer iteration count (niter is a host int here,
+    # and callers like the fuzzy demixing env pass admm_iters overrides
+    # above cfg.admm_iters — cfg-sized arrays would index out of bounds)
+    pr_hist = np.zeros(niter, np.float32)
+    it_hist = np.zeros(niter, np.int32)
+    for it in range(niter):
         res = segmented_solve(J.reshape(x_shape),
                               prior.reshape((Nf, Ts, K, 2 * N, 2, 2)),
                               cfg.lbfgs_iters, init_phase=False)
         J, cost = res.x.reshape(J.shape), res.loss
         Z, Y, prior = _host_consensus(J, Y, bfull, Bi, rho_n, cfg)
+        if collect_stats:
+            pr_hist[it] = float(_primal_resid_rms(J, Z, bfull))
+            it_hist[it] = int(np.sum(np.asarray(res.n_iters)))
 
+    stats = None
+    if collect_stats:
+        stats = SolverStats(
+            admm_iters=np.int32(niter), primal_resid=pr_hist,
+            inner_iters=it_hist, init_iters=np.int32(init_iters_done),
+            n_segments=np.int32(n_segments))
     residual, sigma_res, sigma_data, fcost = _host_finalize(
         J, V6, C7, data_scale, cost, cfg, T)
     return SolveResult(J=J, Z=Z, residual=residual, sigma_res=sigma_res,
-                       sigma_data=sigma_data, final_cost=fcost)
+                       sigma_data=sigma_data, final_cost=fcost, stats=stats)
 
 
 def simulate_vis_sr(J, C, n_stations, Ts):

@@ -18,6 +18,7 @@ from typing import Optional
 import jax
 import numpy as np
 
+from smartcal_tpu import obs
 from smartcal_tpu.envs import radio
 
 LOW, HIGH = 0.01, 1000.0        # calibenv.py:21-22
@@ -99,17 +100,19 @@ class CalibEnv:
                     arr[ci] = HIGH
                     penalty += -0.1
 
-        res, img = self._run_calibration()
-        sigma1 = float(np.std(np.asarray(
-            self.backend.residual_image(self.ep, res))))
-        reward = (self._sigma_data_img / max(sigma1, 1e-12)
-                  + 1e-4 / (float(img.std()) + EPS) + penalty)
-        obs = self._observation(img)
+        with obs.span("episode_step", env="calib"):
+            res, img = self._run_calibration()
+            with obs.span("reward"):
+                sigma1 = float(np.std(np.asarray(
+                    self.backend.residual_image(self.ep, res))))
+                reward = (self._sigma_data_img / max(sigma1, 1e-12)
+                          + 1e-4 / (float(img.std()) + EPS) + penalty)
+        observation = self._observation(img)
         done = False
         info = {"sigma_res": float(res.sigma_res)}
         if self.provide_hint:
-            return obs, reward, done, self.hint, info
-        return obs, reward, done, info
+            return observation, reward, done, self.hint, info
+        return observation, reward, done, info
 
     def _build_episode(self, key):
         rng = radio.observation.host_rng(key, salt=21)
@@ -124,6 +127,10 @@ class CalibEnv:
                 + np.asarray(key).tobytes().hex())
 
     def reset(self):
+        with obs.span("episode_reset", env="calib"):
+            return self._reset()
+
+    def _reset(self):
         key = self._next_key()
         got = (self.backend.take_prefetched(self._prefetch_tag(key))
                if self.prefetch else None)
@@ -159,7 +166,7 @@ class CalibEnv:
         return self._observation(img)
 
     def render(self, mode="human"):
-        print(self.rho_spectral, self.rho_spatial)
+        obs.echo(f"{self.rho_spectral} {self.rho_spatial}", event="render")
 
     def close(self):
         if self._pf_tag is not None:

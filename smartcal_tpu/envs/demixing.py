@@ -20,6 +20,7 @@ from typing import Optional
 import jax
 import numpy as np
 
+from smartcal_tpu import obs
 from smartcal_tpu.envs import radio
 
 LOW, HIGH = 0.0, 1.0
@@ -115,21 +116,25 @@ class DemixingEnv:
         mask = self._mask(clus_sel)
         Kselected = int(mask.sum())
 
-        res = self._calibrate(mask)
-        self.std_residual = float(self.backend.noise_std(res.residual))
-        infdata = self._influence_map(res, mask)
+        with obs.span("episode_step", env="demix"):
+            res = self._calibrate(mask)
+            with obs.span("reward"):
+                self.std_residual = float(
+                    self.backend.noise_std(res.residual))
+            infdata = self._influence_map(res, mask)
 
         md = self.metadata.copy()
         md[np.where(mask > 0)[0]] = 0.0     # separations of calibrated dirs
-        obs = {"infmap": infdata * INF_SCALE, "metadata": md * META_SCALE}
+        observation = {"infmap": infdata * INF_SCALE,
+                       "metadata": md * META_SCALE}
         reward = self.calculate_reward_(Kselected) - self.reward0
         done = False
         info = {"sigma_res": self.std_residual}
         if self.provide_hint:
             if self.hint is None:
                 self.hint = self.get_hint()
-            return obs, reward, done, self.hint, info
-        return obs, reward, done, info
+            return observation, reward, done, self.hint, info
+        return observation, reward, done, info
 
     def _prefetch_tag(self, key):
         # namespaced per env INSTANCE (see CalibEnv._prefetch_tag)
@@ -137,6 +142,10 @@ class DemixingEnv:
                 + np.asarray(key).tobytes().hex())
 
     def reset(self):
+        with obs.span("episode_reset", env="demix"):
+            return self._reset()
+
+    def _reset(self):
         key = self._next_key()
         got = (self.backend.take_prefetched(self._prefetch_tag(key))
                if self.prefetch else None)
@@ -218,7 +227,7 @@ class DemixingEnv:
         return out
 
     def render(self, mode="human"):
-        print("maxiter", self.maxiter, "rho", self.rho)
+        obs.echo(f"maxiter {self.maxiter} rho {self.rho}", event="render")
 
     def close(self):
         if self._pf_tag is not None:

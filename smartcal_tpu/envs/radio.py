@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from smartcal_tpu import obs
 from smartcal_tpu.cal import (coherency, imager, influence, observation,
                               simulate, solver)
 
@@ -174,6 +175,10 @@ class RadioBackend:
     def new_calib_episode(self, key, K, M, diffuse=False):
         """CalibEnv episode: K drawn clusters padded to M directions.
         Returns (episode, models) with Ccal zero-padded to M directions."""
+        with obs.span("simulate", kind="calib", K=K):
+            return self._new_calib_episode(key, K, M, diffuse)
+
+    def _new_calib_episode(self, key, K, M, diffuse):
         obs = observation.make_observation(
             key, n_stations=self.n_stations, n_freqs=self.n_freqs,
             n_times=self.n_times)
@@ -198,6 +203,10 @@ class RadioBackend:
 
     def new_demixing_episode(self, key, K):
         """DemixingEnv episode: K-1 A-team outliers + target."""
+        with obs.span("simulate", kind="demix", K=K):
+            return self._new_demixing_episode(key, K)
+
+    def _new_demixing_episode(self, key, K):
         rng = observation.host_rng(key, salt=20)
         strategy = int(rng.integers(0, 3))
         ra0, dec0, t0 = observation.find_valid_target(
@@ -238,11 +247,20 @@ class RadioBackend:
         prefix theirs with the env instance identity): a bare PRNG-key
         tag collides across two envs walking the same seed stream."""
         self._prefetched[tag] = self._worker().submit(build)
+        obs.gauge_set("prefetch_pending", len(self._prefetched))
 
     def take_prefetched(self, tag):
         """Collect a previously prefetched episode (None if absent)."""
         fut = self._prefetched.pop(tag, None)
-        return None if fut is None else fut.result()
+        if fut is None:
+            obs.counter_add("prefetch_miss")
+            return None
+        ready = fut.done()
+        obs.counter_add("prefetch_hit" if ready else "prefetch_stall")
+        # the stall wait is the pipeline's exposed construction time —
+        # the quantity the double-buffering is supposed to hide
+        with obs.span("prefetch_wait", ready=ready):
+            return fut.result()
 
     def discard_prefetched(self, tag):
         """Drop a pending prefetch without consuming it (env close):
@@ -269,7 +287,8 @@ class RadioBackend:
         ex = self._worker()
         fut = ex.submit(make_episode, keys[0])
         for i in range(len(keys)):
-            ep, mdl = fut.result()
+            with obs.span("prefetch_wait", pipelined=True):
+                ep, mdl = fut.result()
             if i + 1 < len(keys):
                 fut = ex.submit(make_episode, keys[i + 1])
             yield process(ep, mdl)
@@ -346,6 +365,11 @@ class RadioBackend:
             C = C * jnp.asarray(mask)[None, :, None, None, None]
         traced = any(isinstance(x, jax.core.Tracer)
                      for x in (C, ep.V, rho, admm_iters))
+        # solver telemetry rides along whenever a RunLog is recording
+        # (untraced calls only: under a trace the output tree must stay
+        # the callers' fused-solve shape).  With no RunLog active this is
+        # collect_stats=False — the exact pre-observability programs.
+        collect = (not traced) and obs.active() is not None
         if not traced:
             work = self._fused_work(admm_iters)
             # SMARTCAL_HOST_SOLVER=1 is the operational kill-switch for
@@ -358,22 +382,41 @@ class RadioBackend:
             if nfp and work / nfp <= _WATCHDOG_WORK:
                 from smartcal_tpu.parallel import sharded_cal
 
-                return sharded_cal.solve_admm_sharded(
-                    self._mesh(nfp), ep.V, C, ep.obs.freqs, ep.f0,
-                    jnp.asarray(rho), self._solver_cfg(ep.n_dirs),
-                    axis="fp", n_chunks=self.n_chunks,
-                    admm_iters=None if admm_iters is None
-                    else int(admm_iters))
+                with obs.span("solve", route="sharded", shards=nfp):
+                    res = sharded_cal.solve_admm_sharded(
+                        self._mesh(nfp), ep.V, C, ep.obs.freqs, ep.f0,
+                        jnp.asarray(rho), self._solver_cfg(ep.n_dirs),
+                        axis="fp", n_chunks=self.n_chunks,
+                        admm_iters=None if admm_iters is None
+                        else int(admm_iters), collect_stats=collect)
+                return self._log_solve(res, "sharded")
             if self._use_host_solver(admm_iters):
-                return solver.solve_admm_host(
+                with obs.span("solve", route="host_segmented"):
+                    res = solver.solve_admm_host(
+                        ep.V, C, ep.obs.freqs, ep.f0, jnp.asarray(rho),
+                        self._solver_cfg(ep.n_dirs), n_chunks=self.n_chunks,
+                        admm_iters=None if admm_iters is None
+                        else int(admm_iters), collect_stats=collect)
+                return self._log_solve(res, "host_segmented")
+            with obs.span("solve", route="fused"):
+                res = solver.solve_admm(
                     ep.V, C, ep.obs.freqs, ep.f0, jnp.asarray(rho),
                     self._solver_cfg(ep.n_dirs), n_chunks=self.n_chunks,
                     admm_iters=None if admm_iters is None
-                    else int(admm_iters))
+                    else jnp.asarray(admm_iters), collect_stats=collect)
+            return self._log_solve(res, "fused")
         return solver.solve_admm(
             ep.V, C, ep.obs.freqs, ep.f0, jnp.asarray(rho),
             self._solver_cfg(ep.n_dirs), n_chunks=self.n_chunks,
             admm_iters=None if admm_iters is None else jnp.asarray(admm_iters))
+
+    def _log_solve(self, res, route):
+        """Record the solver telemetry event (no-op without a RunLog)."""
+        if res.stats is not None and obs.active() is not None:
+            obs.log_solver_stats(res.stats, route=route,
+                                 n_freqs=self.n_freqs,
+                                 n_stations=self.n_stations)
+        return res
 
     def _use_host_solver(self, admm_iters=None) -> bool:
         """Proxy for 'one fused solve would run too long on a chip'
@@ -400,6 +443,10 @@ class RadioBackend:
         reward and std_data use, so the hint's AIC residual term is on the
         same scale as the reward the agent is trained on (a full-pol RMS
         here would rescale it against the ksel*N complexity penalty)."""
+        with obs.span("hint_sweep", n_masks=int(np.asarray(masks).shape[0])):
+            return self._hint_sweep(ep, rho, masks, admm_iters, batch)
+
+    def _hint_sweep(self, ep, rho, masks, admm_iters, batch):
         masks = jnp.asarray(masks, jnp.float32)
         n = int(masks.shape[0])
         batch = min(self.hint_batch if batch is None else batch, n)
@@ -456,9 +503,15 @@ class RadioBackend:
         reference's process pool as a mesh axis) is used instead.
         ``vectorized=False`` keeps the original loop (parity oracle).
         """
+        with obs.span("influence") as sp:
+            return self._influence_image(ep, result, rho, rho_spatial, npix,
+                                         sp)
+
+    def _influence_image(self, ep, result, rho, rho_spatial, npix, sp):
         npix = npix or self.npix
         freqs = np.asarray(ep.obs.freqs)
         if not self.vectorized:
+            sp.tag(route="host_loop")
             return self._influence_image_loop(ep, result, rho, rho_spatial,
                                               npix)
         uvw = jnp.asarray(np.asarray(ep.obs.uvw).reshape(-1, 3))
@@ -477,14 +530,17 @@ class RadioBackend:
         if nfp:
             from smartcal_tpu.parallel import sharded_cal
 
+            sp.tag(route="freq_sharded", shards=nfp)
             return sharded_cal.influence_images_sharded(
                 self._mesh(nfp), result.residual, ep.Ccal, result.J,
                 hadd_all, ep.obs.freqs, uvw, cell, self.n_stations,
                 self.n_chunks, npix)
         nsp = self._shard_size(self.n_chunks, work)
         if nsp:
+            sp.tag(route="chunk_sharded", shards=nsp)
             return self._influence_image_chunk_sharded(
                 ep, result, hadd_all, uvw, cell, npix, nsp)
+        sp.tag(route="vectorized")
         imgs = influence.influence_images_multi(
             result.residual, ep.Ccal, result.J, hadd_all, ep.obs.freqs,
             uvw, cell, self.n_stations, self.n_chunks, npix)

@@ -205,4 +205,5 @@ class DemixController:
             with open(filename, "w+") as fh:
                 json.dump(self.config, fh)
         else:
-            print(self.config)
+            from smartcal_tpu import obs
+            obs.echo(self.config, event="fuzzy_config")

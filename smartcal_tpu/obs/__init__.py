@@ -1,0 +1,54 @@
+"""Run-scoped observability: RunLog (JSONL events), span tracing,
+counters/gauges, jax compile/memory listeners.
+
+Quick use::
+
+    from smartcal_tpu import obs
+
+    with obs.recording("run.jsonl", meta={"entry": "my_tool"}):
+        obs.install_compile_listener()
+        with obs.span("episode", episode=0):
+            ...                       # nested spans record stage timings
+        obs.active().log("episode", episode=0, score=1.2)
+
+Everything is a strict no-op while no RunLog is active; aggregate runs
+with ``tools/obs_report.py``.  The package imports neither jax nor numpy
+— it reads jax lazily from ``sys.modules`` only, so importing obs can
+never initialize (or wedge) an accelerator backend.
+"""
+
+from .console import echo, emit_json                       # noqa: F401
+from .registry import (counter_add, counters_snapshot,     # noqa: F401
+                       flush_counters, gauge_set, install_compile_listener,
+                       log_memory_gauges, reset_counters)
+from .runlog import (SCHEMA_VERSION, RunLog, activate,     # noqa: F401
+                     active, deactivate, recording, sanitize)
+from .spans import span                                    # noqa: F401
+
+
+def log_solver_stats(stats, **tags):
+    """Record a ``solver`` event from a ``cal.solver.SolverStats`` (forces
+    the small stat arrays to host — only called with telemetry on).
+
+    Adds the analytic line-search evaluation model from ``ops.lbfgs``:
+    the L-BFGS iteration counts are the dynamic factor threaded out of
+    the jitted solve; evals-per-iteration is a static property of the
+    compiled line-search loop structure."""
+    rl = active()
+    if rl is None or stats is None:
+        return
+    from smartcal_tpu.ops import lbfgs
+
+    inner = [int(v) for v in list(stats.inner_iters)]
+    total_inner = sum(inner) + int(stats.init_iters)
+    per_ls = lbfgs.linesearch_phi_evals()
+    rl.log("solver",
+           admm_iters=int(stats.admm_iters),
+           primal_resid=[float(v) for v in list(stats.primal_resid)],
+           inner_iters=inner,
+           init_iters=int(stats.init_iters),
+           n_segments=int(stats.n_segments),
+           lbfgs_iters_total=total_inner,
+           phi_evals_per_linesearch=per_ls,
+           phi_evals_est=total_inner * per_ls,
+           **tags)

@@ -1,0 +1,38 @@
+"""The sanctioned console output site for smartcal_tpu.
+
+Human diagnostics route through ``echo`` (stderr + a structured ``log``
+event when a RunLog is active, suppressible with ``quiet``); machine
+payloads route through ``emit_json`` (stdout stays the machine interface
+— bench/capture tooling parses the last stdout JSON line).  This module
+is the ONLY place in the package allowed to call bare ``print`` —
+``tests/test_no_bare_print.py`` enforces it, so diagnostics cannot
+silently regress to unstructured stdout noise.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from .runlog import active, sanitize
+
+
+def echo(msg, quiet: bool = False, event="log", **fields):
+    """Human-facing diagnostic: structured event (when recording) plus a
+    stderr echo (unless ``quiet``).  ``event=None`` skips the structured
+    record — for echoes whose content was already logged under another
+    event (e.g. the per-episode score line)."""
+    rl = active()
+    if rl is not None and event is not None:
+        rl.log(event, msg=str(msg), **fields)
+    if not quiet:
+        print(msg, file=sys.stderr, flush=True)
+
+
+def emit_json(payload: dict, event: str = "result"):
+    """Machine-facing result line: always printed to STDOUT (the contract
+    bench/capture scripts parse), mirrored into the RunLog when active."""
+    rl = active()
+    if rl is not None:
+        rl.log(event, **payload)
+    print(json.dumps(sanitize(payload)), flush=True)

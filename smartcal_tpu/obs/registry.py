@@ -1,0 +1,151 @@
+"""Counters/gauges registry + jax runtime listeners.
+
+Counters accumulate in memory while a RunLog is active and are written as
+one ``counters`` event by ``flush_counters()`` (the drivers call it at
+episode boundaries); gauges log immediately as ``gauge`` events.  Both are
+strict no-ops with no active RunLog.
+
+Two jax hooks feed the registry from the runtime itself:
+
+* ``install_compile_listener()`` registers a ``jax.monitoring`` duration
+  listener and records every compilation-ish event (``.../compile``,
+  backend init) as a ``jax_event`` record — surfacing the
+  minutes-of-compile phases that otherwise hide inside "the first episode
+  was slow".  Listeners cannot be unregistered portably, so the install is
+  idempotent and the callback itself checks ``active()``.
+* ``log_memory_gauges()`` samples per-device ``memory_stats()`` (bytes in
+  use / peak / limit) into ``memory`` events — None-safe on backends that
+  do not report (CPU).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+from .runlog import active
+
+_lock = threading.Lock()
+_counters: dict = {}
+
+
+def counter_add(name: str, value=1.0):
+    """Accumulate ``value`` onto counter ``name`` (no-op when inactive)."""
+    if active() is None:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0.0) + value
+
+
+def gauge_set(name: str, value, **tags):
+    """Log gauge ``name`` as a ``gauge`` event (no-op when inactive)."""
+    rl = active()
+    if rl is None:
+        return
+    rl.log("gauge", name=name, value=value, **tags)
+
+
+def counters_snapshot() -> dict:
+    with _lock:
+        return dict(_counters)
+
+
+def flush_counters(reset: bool = False, **tags):
+    """Write all accumulated counters as one ``counters`` event.
+
+    ``reset=True`` clears them afterwards — run teardown uses it so a
+    later run in the SAME process (e.g. tools/sweep_calib.py invoking a
+    driver main() per seed) starts its counters from zero instead of
+    inheriting the previous run's totals."""
+    rl = active()
+    if rl is None:
+        return
+    with _lock:
+        snap = dict(_counters)
+        if reset:
+            _counters.clear()
+    if snap:
+        rl.log("counters", values=snap, **tags)
+
+
+def reset_counters():
+    with _lock:
+        _counters.clear()
+
+
+# ---------------------------------------------------------------------------
+# jax.monitoring compile listener
+# ---------------------------------------------------------------------------
+
+_listener_installed = False
+
+# jax_event records below this duration stay counter-only: jax fires a
+# jaxpr_trace duration event for EVERY trace (a 2-episode calib run
+# measured ~1.2k sub-millisecond ones), which would drown the stream
+COMPILE_LOG_MIN_S = 0.01
+
+
+def _on_event_duration(event, duration, **kw):
+    rl = active()
+    if rl is None:
+        return
+    # compile/lowering/backend-init phases only: per-dispatch execution
+    # events would flood the stream at span granularity for no signal
+    ev = str(event)
+    if ("compil" in ev or "lower" in ev or "backend_init" in ev
+            or "pjit" in ev):
+        if float(duration) >= COMPILE_LOG_MIN_S:
+            rl.log("jax_event", key=ev, dur_s=round(float(duration), 4))
+        with _lock:
+            _counters["jax_compile_events"] = \
+                _counters.get("jax_compile_events", 0.0) + 1.0
+            _counters["jax_compile_secs"] = \
+                _counters.get("jax_compile_secs", 0.0) + float(duration)
+
+
+def install_compile_listener() -> bool:
+    """Idempotently register the jax.monitoring duration listener.
+    Returns False when jax (or the monitoring API) is unavailable."""
+    global _listener_installed
+    if _listener_installed:
+        return True
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return False
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+    except Exception:
+        return False
+    _listener_installed = True
+    return True
+
+
+def log_memory_gauges() -> int:
+    """Per-device memory_stats() gauges into the active RunLog; returns
+    the number of devices that reported stats (0 when inactive, when jax
+    is not imported, or when the backend exposes none — CPU)."""
+    rl = active()
+    if rl is None:
+        return 0
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return 0
+    try:
+        devs = jax_mod.local_devices()
+    except Exception:
+        return 0
+    n = 0
+    for d in devs:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        rl.log("memory", device=d.id, platform=d.platform,
+               bytes_in_use=ms.get("bytes_in_use"),
+               peak_bytes_in_use=ms.get("peak_bytes_in_use"),
+               bytes_limit=ms.get("bytes_limit"))
+        n += 1
+    return n

@@ -1,0 +1,266 @@
+"""Run-scoped JSONL event log: the durable half of the observability layer.
+
+One training/bench run = one ``RunLog``: a JSONL stream whose FIRST line is
+a header record (run-id, schema version, host + device metadata) and whose
+remaining lines are events (``episode``, ``span``, ``solver``, ``gauge``,
+``probe``, ...).  Design points, each fixing a concrete failure of the old
+``utils.metrics.JsonlLogger``:
+
+* **Non-finite sanitization** — ``json.dumps`` happily writes bare ``NaN``
+  / ``Infinity`` tokens, which are NOT JSON; every downstream reader
+  (``tools/obs_report.py``, ``tools/summarize_demix_curves.py``, jq) then
+  chokes on exactly the interesting lines (a diverged solve is when you
+  need the record).  All floats are checked recursively; non-finite values
+  serialize as ``null``.
+* **Buffered writes with a bounded flush interval** — the old logger
+  flushed per line; at per-span granularity that is a syscall per event on
+  the hot path.  Events buffer up to ``flush_lines`` or ``flush_interval``
+  seconds, whichever trips first, so a crash loses at most a couple of
+  seconds of telemetry.
+* **Size-based rotation** — long sweeps append forever; at ``max_bytes``
+  the stream rotates to ``<path>.<n>`` and a fresh header (same run-id,
+  incremented ``rotated``) opens the new segment, so a reader can always
+  reassemble the run.
+* **Thread safety** — spans are recorded from the episode-prefetch worker
+  thread (envs/radio.run_pipelined) concurrently with the main thread; all
+  writes serialize on one lock.
+
+The module also owns the ACTIVE-run registry: ``activate``/``deactivate``
+push/pop the process-wide current ``RunLog`` and ``active()`` reads it.
+Every other obs primitive (spans, counters, listeners) checks ``active()``
+first and is a strict no-op when no run is recording — instrumented code
+pays one function call and one ``None`` check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Optional
+
+SCHEMA_VERSION = 1
+
+
+def _gen_run_id() -> str:
+    return f"{int(time.time()):x}-{os.urandom(4).hex()}"
+
+
+def sanitize(v):
+    """Recursively convert ``v`` into JSON-safe data: non-finite floats ->
+    None, numpy/jax scalars -> python scalars, arrays -> (sanitized)
+    lists, unknown objects -> ``str``."""
+    if v is None or isinstance(v, (bool, int, str)):
+        return v
+    if isinstance(v, float):                 # covers np.float64 (subclass)
+        return v if math.isfinite(v) else None
+    if isinstance(v, dict):
+        return {str(k): sanitize(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [sanitize(x) for x in v]
+    if getattr(v, "ndim", None) == 0 and hasattr(v, "item"):
+        try:
+            return sanitize(v.item())        # numpy / jax scalar
+        except Exception:
+            return str(v)
+    if hasattr(v, "tolist"):
+        try:
+            return sanitize(v.tolist())      # numpy / jax array
+        except Exception:
+            return str(v)
+    return str(v)
+
+
+def _device_meta() -> dict:
+    """Host/device metadata for the header.  Reads jax ONLY if it is
+    already imported (never triggers the import, and a failure to
+    initialize a backend must never kill the run being observed — the
+    one-client TPU-tunnel rule).  SMARTCAL_OBS_NO_DEVICE_META=1 skips the
+    device probe entirely, e.g. for side processes that must not touch
+    the TPU client."""
+    meta = {"host": socket.gethostname(), "pid": os.getpid(),
+            "python": sys.version.split()[0]}
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return meta
+    try:
+        meta["jax"] = jax_mod.__version__
+    except Exception:
+        pass
+    if os.environ.get("SMARTCAL_OBS_NO_DEVICE_META", "") == "1":
+        return meta
+    try:
+        devs = jax_mod.devices()
+        meta["platform"] = devs[0].platform
+        meta["n_devices"] = len(devs)
+        meta["devices"] = [str(d) for d in devs[:8]]
+    except Exception as e:                   # wedged tunnel, no backend, ...
+        meta["device_probe_error"] = repr(e)
+    return meta
+
+
+class RunLog:
+    """Append-mode, buffered, rotating JSONL event stream (``None`` path
+    disables it — every method is then a no-op)."""
+
+    def __init__(self, path: Optional[str], run_id: Optional[str] = None,
+                 flush_interval: float = 2.0, flush_lines: int = 64,
+                 max_bytes: int = 256 * 1024 * 1024, header: bool = True,
+                 meta: Optional[dict] = None):
+        self.run_id = run_id or _gen_run_id()
+        self._path = path
+        self._lock = threading.RLock()
+        self._buf: list = []
+        self._flush_interval = max(0.0, float(flush_interval))
+        self._flush_lines = max(1, int(flush_lines))
+        self._max_bytes = int(max_bytes)
+        self._header = header
+        self._meta = dict(meta or {})
+        self._rotations = 0
+        self._last_flush = time.monotonic()
+        if path:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._fh = open(path, "a")
+            try:
+                self._bytes = os.path.getsize(path)
+            except OSError:
+                self._bytes = 0
+            if header:
+                self._write_header()
+        else:
+            self._fh = None
+            self._bytes = 0
+
+    @property
+    def path(self):
+        return self._path
+
+    def _write_header(self):
+        rec = {"t": round(time.time(), 3), "event": "run_header",
+               "schema": SCHEMA_VERSION, "run_id": self.run_id,
+               "rotated": self._rotations, "argv": sys.argv}
+        rec.update(_device_meta())
+        if self._meta:
+            rec["meta"] = self._meta
+        self._emit(rec, force_flush=True)
+
+    def log(self, event: str, **fields):
+        """Append one event record (buffered; see class docstring)."""
+        if self._fh is None:
+            return
+        rec = {"t": round(time.time(), 3), "event": event}
+        rec.update(fields)
+        self._emit(rec)
+
+    def _emit(self, rec, force_flush: bool = False):
+        line = json.dumps(sanitize(rec), allow_nan=False) + "\n"
+        with self._lock:
+            if self._fh is None:
+                return
+            self._buf.append(line)
+            self._bytes += len(line)
+            now = time.monotonic()
+            if (force_flush or len(self._buf) >= self._flush_lines
+                    or now - self._last_flush >= self._flush_interval):
+                self._flush_locked()
+            if self._bytes >= self._max_bytes:
+                self._rotate_locked()
+
+    def _flush_locked(self):
+        if self._buf:
+            self._fh.write("".join(self._buf))
+            self._fh.flush()
+            self._buf.clear()
+        self._last_flush = time.monotonic()
+
+    def _rotate_locked(self):
+        """Close the full segment as ``<path>.<n>`` and reopen fresh (same
+        run-id; the new header carries the incremented ``rotated``)."""
+        self._flush_locked()
+        self._fh.close()
+        self._rotations += 1
+        os.replace(self._path, f"{self._path}.{self._rotations}")
+        self._fh = open(self._path, "a")
+        self._bytes = 0
+        if self._header:
+            self._write_header()
+
+    def flush(self):
+        with self._lock:
+            if self._fh is not None:
+                self._flush_locked()
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._flush_locked()
+                self._fh.close()
+                self._fh = None
+
+    @property
+    def closed(self) -> bool:
+        return self._fh is None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# Active-run registry (process-wide; shared across threads on purpose — the
+# prefetch worker must record into the run its parent opened)
+# ---------------------------------------------------------------------------
+
+_active_stack: list = []
+_active_lock = threading.Lock()
+
+
+def activate(runlog: RunLog) -> RunLog:
+    """Make ``runlog`` the process-wide active run (stack discipline)."""
+    with _active_lock:
+        _active_stack.append(runlog)
+    return runlog
+
+
+def deactivate(runlog: Optional[RunLog] = None):
+    """Pop the active run (or remove ``runlog`` specifically)."""
+    with _active_lock:
+        if not _active_stack:
+            return
+        if runlog is None:
+            _active_stack.pop()
+        elif runlog in _active_stack:
+            _active_stack.remove(runlog)
+
+
+def active() -> Optional[RunLog]:
+    """The currently recording RunLog, or None (the no-op fast path)."""
+    try:
+        return _active_stack[-1]
+    except IndexError:
+        return None
+
+
+@contextlib.contextmanager
+def recording(path_or_runlog, **kwargs):
+    """``with recording("run.jsonl") as rl:`` — create (when given a
+    path), activate, and on exit deactivate (and close only if created
+    here)."""
+    created = not isinstance(path_or_runlog, RunLog)
+    rl = RunLog(path_or_runlog, **kwargs) if created else path_or_runlog
+    activate(rl)
+    try:
+        yield rl
+    finally:
+        deactivate(rl)
+        if created:
+            rl.close()

@@ -1,0 +1,130 @@
+"""Nestable, thread-safe stage tracing.
+
+``span("solve")`` times a host-side code region and records one ``span``
+event into the active RunLog on exit: name, nesting path (``/``-joined
+ancestor names, per thread), wall duration, thread name, and any tags.
+The region is additionally tagged with ``jax.profiler.TraceAnnotation``
+when jax is importable, so the same stages show up on the xprof/
+TensorBoard timeline when a profiler trace is running — including spans
+entered from the episode-prefetch worker thread (TraceAnnotation is
+per-thread, and so is the nesting stack here).
+
+STRICT NO-OP CONTRACT: with no active RunLog, ``span()`` returns one
+shared, stateless null context manager — no allocation, no clock read,
+no annotation.  Instrumenting a hot path costs one function call and one
+``None`` check per entry (asserted by tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+from .runlog import active
+
+_tls = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+_TRACE_ANNOTATION = None          # resolved lazily, cached per process
+
+
+def _trace_annotation():
+    """``jax.profiler.TraceAnnotation`` if jax is already imported (never
+    triggers the jax import itself), else None.  Re-checks until jax
+    appears — a span recorded before the first jax import must not latch
+    annotations off for the rest of the process."""
+    global _TRACE_ANNOTATION
+    if _TRACE_ANNOTATION is None:
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is not None:
+            _TRACE_ANNOTATION = getattr(
+                getattr(jax_mod, "profiler", None), "TraceAnnotation", None)
+    return _TRACE_ANNOTATION
+
+
+class _NullSpan:
+    """Shared do-nothing context manager (the inactive fast path)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def tag(self, **tags):          # same surface as Span, still a no-op
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    __slots__ = ("_rl", "name", "tags", "path", "_t0", "_ann")
+
+    def __init__(self, rl, name, tags):
+        self._rl = rl
+        self.name = name
+        self.tags = tags
+        self.path = name
+        self._t0 = 0.0
+        self._ann = None
+
+    def tag(self, **tags):
+        """Attach/override tags after entry (e.g. a routing decision made
+        mid-region)."""
+        self.tags.update(tags)
+        return self
+
+    def __enter__(self):
+        st = _stack()
+        st.append(self.name)
+        self.path = "/".join(st)
+        ta = _trace_annotation()
+        if ta is not None:
+            try:
+                self._ann = ta(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, et, ev, tb):
+        dur = time.perf_counter() - self._t0
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(et, ev, tb)
+            except Exception:
+                pass
+        st = _stack()
+        if st and st[-1] == self.name:
+            st.pop()
+        rec = dict(self.tags)
+        if et is not None:
+            # a failed stage STILL records (the chip-tunnel probes failed
+            # 87/87 with no structured trace of the error — never again)
+            rec["error"] = repr(ev) if ev is not None else et.__name__
+        self._rl.log("span", name=self.name, path=self.path,
+                     dur_s=round(dur, 6),
+                     thread=threading.current_thread().name, **rec)
+        return False
+
+
+def span(name: str, **tags):
+    """Time a stage: ``with span("solve", route="sharded"): ...``.
+
+    Returns the shared null context manager when no RunLog is active."""
+    rl = active()
+    if rl is None:
+        return _NULL_SPAN
+    return Span(rl, name, tags)

@@ -341,6 +341,45 @@ def strong_wolfe_cubic(fun: Callable, x: jnp.ndarray, d: jnp.ndarray,
     return jnp.where(jnp.isnan(alphak), jnp.asarray(lr, dtype), alphak)
 
 
+def linesearch_phi_evals(vmapped: bool = True) -> int:
+    """Static phi-evaluation count of ONE :func:`strong_wolfe_cubic` call,
+    derived from the compiled loop structure (the observability layer's
+    line-search cost model; same spirit as ``cal.solver.cost_eval_flops``:
+    analytic iteration counts x exact per-unit structure).
+
+    The bracket loop is ``fori_loop(0, 3)`` and zoom is ``fori_loop(0,
+    4)`` — fixed trip counts, so phi-eval counts are compile-time
+    constants, not data-dependent.  In the PRODUCTION path the search
+    runs inside a vmapped solve, where ``lax.cond`` lowers to ``select``
+    and BOTH branches execute every trip:
+
+      init: phi(0) + phi(alpha1)                               =  2
+      per bracket trip: zoom branch 4 x (p01 + p02 + interior) = 12
+                        + continuation (lo + hi + interior + mu) =  4
+      total: 2 + 3 x 16                                        = 50
+
+    ``vmapped=False`` returns the un-vmapped lower bound where the zoom
+    cond is a real branch (taken at most once per search).
+    """
+    if vmapped:
+        return 2 + 3 * (4 * 3 + 4)
+    return 2 + 3 * 4 + 4 * 3
+
+
+def solve_eval_counts(n_iters: int, use_line_search: bool = True,
+                      vmapped: bool = True) -> dict:
+    """Evaluation budget of an ``lbfgs_solve`` run that took ``n_iters``
+    iterations (``LBFGSResult.n_iters`` — the dynamic factor the solver
+    telemetry threads out of the jitted paths): one ``value_and_grad``
+    per iteration plus the initial one, and the line-search phi probes."""
+    n = int(n_iters)
+    return {
+        "value_and_grad_evals": n + 1,
+        "phi_evals": (n * linesearch_phi_evals(vmapped)
+                      if use_line_search else 0),
+    }
+
+
 def backtracking_search(fun: Callable, x: jnp.ndarray, d: jnp.ndarray,
                         grad: jnp.ndarray, alphabar,
                         c1: float = 1e-4, max_halvings: int = 35) -> jnp.ndarray:

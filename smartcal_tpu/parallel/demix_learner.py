@@ -278,9 +278,15 @@ def make_distributed_demix_sac(backend: radio.RadioBackend, K: int,
 def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
                             K=4, backend=None, provide_influence=False,
                             agent_kwargs=None, quiet=False,
-                            rollout_epochs=2, rollout_steps=5):
+                            rollout_epochs=2, rollout_steps=5,
+                            metrics=None):
     """Host driver (run_process + Learner.run_episodes parity,
     distributed_per_sac.py:193-229)."""
+    import time
+
+    from smartcal_tpu import obs
+    from smartcal_tpu.train.blocks import train_obs
+
     from . import make_mesh
 
     mesh = mesh or make_mesh()
@@ -299,13 +305,30 @@ def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
     key, k0 = jax.random.split(key)
     st = init_fn(k0)
     scores = []
-    for ep in range(episodes):
-        key, kw, kr = jax.random.split(key, 3)
-        wl = make_wl(kw)
-        st, metrics = run_episode(st, wl, kr)
-        scores.append(float(metrics["mean_reward"]))
-        if not quiet:
-            print(f"episode {ep} mean reward {scores[-1]:.4f}")
+    n_trans = n_actors * rollout_epochs * rollout_steps
+    tob = train_obs("demix_learner", metrics=metrics, quiet=quiet,
+                    seed=seed, n_actors=n_actors, K=K)
+    try:
+        for ep in range(episodes):
+            key, kw, kr = jax.random.split(key, 3)
+            with tob.span("learner_episode", episode=ep):
+                with tob.span("make_workloads"):
+                    wl = make_wl(kw)
+                t0 = time.perf_counter()
+                st, metrics_out = run_episode(st, wl, kr)
+                score = float(metrics_out["mean_reward"])
+                wall = time.perf_counter() - t0
+            scores.append(score)
+            obs.gauge_set("actor_transitions_per_s",
+                          round(n_trans / max(wall, 1e-9), 2))
+            # echo=False: keep the reference driver's own wording below
+            tob.episode(ep, score, scores, echo=False, transitions=n_trans,
+                        weight_staleness_steps=rollout_epochs
+                        * rollout_steps)
+            tob.echo(f"episode {ep} mean reward {scores[-1]:.4f}",
+                     event=None)
+    finally:
+        tob.close()
     return st, scores
 
 
@@ -335,10 +358,15 @@ def main(argv=None):
     p.add_argument("--rollout_epochs", type=int, default=2,
                    help="episodes per actor per learner episode")
     p.add_argument("--rollout_steps", type=int, default=5)
+    from smartcal_tpu import obs
+    from smartcal_tpu.train.blocks import add_obs_args
+
+    add_obs_args(p)
     multihost.add_cli_args(p)
     args = p.parse_args(argv)
     if multihost.initialize_from_args(args):
-        print("multihost:", multihost.runtime_summary())
+        obs.echo(f"multihost: {multihost.runtime_summary()}",
+                 event="multihost")
     if args.small:
         backend = radio.RadioBackend(n_stations=6, n_times=4, tdelta=2,
                                      npix=16, admm_iters=2, lbfgs_iters=3,
@@ -351,7 +379,8 @@ def main(argv=None):
         K=args.K, backend=backend,
         provide_influence=args.provide_influence,
         rollout_epochs=args.rollout_epochs,
-        rollout_steps=args.rollout_steps)
+        rollout_steps=args.rollout_steps,
+        quiet=args.quiet, metrics=args.metrics)
     return scores
 
 

@@ -158,9 +158,23 @@ def make_distributed_per_sac(env_cfg: enet.EnetConfig,
 
 def train_distributed(seed=0, episodes=100, n_actors=None, mesh=None,
                       env_kwargs=None, agent_kwargs=None, use_hint=False,
-                      learn_per_transition=False, quiet=False):
+                      learn_per_transition=False, quiet=False,
+                      rollout_epochs=10, rollout_steps=10, metrics=None):
     """Host driver mirroring ``run_process`` + ``Learner.run_episodes``
-    (distributed_per_sac.py:60-82, :154-174)."""
+    (distributed_per_sac.py:60-82, :154-174).
+
+    ``metrics`` records an obs run: per learner-episode actor throughput
+    (transitions/s through the SPMD rollout+ingest program) and the
+    weight-staleness bound — actor params are episode-frozen, so the last
+    transition of a rollout acts on weights ``rollout_epochs x
+    rollout_steps`` env steps old (the SPMD analogue of the reference's
+    stale CPU weight snapshot; IMPACT-style systems track the same
+    quantity as a distribution)."""
+    import time
+
+    from smartcal_tpu import obs
+    from smartcal_tpu.train.blocks import train_obs
+
     from . import make_mesh
 
     mesh = mesh or make_mesh()
@@ -172,17 +186,34 @@ def train_distributed(seed=0, episodes=100, n_actors=None, mesh=None,
                               use_hint=use_hint, **agent_kwargs)
     init_fn, run_episode = make_distributed_per_sac(
         env_cfg, agent_cfg, mesh, n_actors, use_hint=use_hint,
+        rollout_epochs=rollout_epochs, rollout_steps=rollout_steps,
         learn_per_transition=learn_per_transition)
     key = jax.random.PRNGKey(seed)
     key, k0 = jax.random.split(key)
     st = init_fn(k0)
     scores = []
-    for ep in range(episodes):
-        key, k = jax.random.split(key)
-        st, metrics = run_episode(st, k)
-        scores.append(float(metrics["mean_reward"]))
-        if not quiet:
-            print(f"episode {ep} mean reward {scores[-1]:.4f}")
+    n_trans = n_actors * rollout_epochs * rollout_steps
+    tob = train_obs("parallel_learner", metrics=metrics, quiet=quiet,
+                    seed=seed, n_actors=n_actors)
+    try:
+        for ep in range(episodes):
+            key, k = jax.random.split(key)
+            t0 = time.perf_counter()
+            with tob.span("learner_episode", episode=ep):
+                st, metrics_out = run_episode(st, k)
+                score = float(metrics_out["mean_reward"])
+            wall = time.perf_counter() - t0
+            scores.append(score)
+            obs.gauge_set("actor_transitions_per_s",
+                          round(n_trans / max(wall, 1e-9), 2))
+            # echo=False: keep the reference driver's own wording below
+            tob.episode(ep, score, scores, echo=False, transitions=n_trans,
+                        weight_staleness_steps=rollout_epochs
+                        * rollout_steps)
+            tob.echo(f"episode {ep} mean reward {scores[-1]:.4f}",
+                     event=None)
+    finally:
+        tob.close()
     return st, scores
 
 
@@ -200,20 +231,26 @@ def main(argv=None):
 
     from . import multihost
 
+    from smartcal_tpu import obs
+    from smartcal_tpu.train.blocks import add_obs_args
+
     p = argparse.ArgumentParser(description=main.__doc__)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--episodes", type=int, default=100)
     p.add_argument("--actors", type=int, default=None)
     p.add_argument("--use_hint", action="store_true")
     p.add_argument("--learn_per_transition", action="store_true")
+    add_obs_args(p)
     multihost.add_cli_args(p)
     args = p.parse_args(argv)
     if multihost.initialize_from_args(args):
-        print("multihost:", multihost.runtime_summary())
+        obs.echo(f"multihost: {multihost.runtime_summary()}",
+                 event="multihost")
     _, scores = train_distributed(
         seed=args.seed, episodes=args.episodes, n_actors=args.actors,
         use_hint=args.use_hint,
-        learn_per_transition=args.learn_per_transition)
+        learn_per_transition=args.learn_per_transition,
+        quiet=args.quiet, metrics=args.metrics)
     return scores
 
 

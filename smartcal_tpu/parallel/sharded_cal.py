@@ -45,7 +45,8 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
 def solve_admm_sharded(mesh: Mesh, V, C, freqs, f0, rho,
                        cfg: solver.SolverConfig, axis: str = "fp",
                        n_chunks: Optional[int] = None,
-                       admm_iters=None, freq_range=None):
+                       admm_iters=None, freq_range=None,
+                       collect_stats: bool = False):
     """Consensus-ADMM solve with the frequency axis sharded over ``axis``.
 
     V (Nf, T, B, 2, 2, 2), C (Nf, K, T*B, 4, 2), freqs (Nf,) are global;
@@ -53,6 +54,10 @@ def solve_admm_sharded(mesh: Mesh, V, C, freqs, f0, rho,
     residual / final_cost frequency-sharded and Z / sigmas replicated —
     bitwise the same math as the single-device solve (the psum IS the
     global sum).
+
+    ``collect_stats`` threads the solver telemetry out (SolverStats —
+    consensus residuals are psummed over ``axis`` inside the solve, so
+    the stats come out replicated/global).
     """
     nfp = mesh.shape[axis]
     if V.shape[0] % nfp != 0:
@@ -63,10 +68,15 @@ def solve_admm_sharded(mesh: Mesh, V, C, freqs, f0, rho,
         freq_range = (float(fr.min()), float(fr.max()))
 
     fn = partial(solver.solve_admm, cfg=cfg, axis_name=axis,
-                 n_chunks=n_chunks, freq_range=freq_range)
+                 n_chunks=n_chunks, freq_range=freq_range,
+                 collect_stats=collect_stats)
+    stats_spec = (solver.SolverStats(admm_iters=P(), primal_resid=P(),
+                                     inner_iters=P(), init_iters=P(),
+                                     n_segments=P())
+                  if collect_stats else None)
     out_specs = solver.SolveResult(
         J=P(axis), Z=P(), residual=P(axis), sigma_res=P(),
-        sigma_data=P(), final_cost=P(axis))
+        sigma_data=P(), final_cost=P(axis), stats=stats_spec)
     if admm_iters is None:
         sharded = shard_map(
             lambda v, c, f, r: fn(v, c, f, f0, r),

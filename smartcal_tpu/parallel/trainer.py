@@ -25,15 +25,43 @@ Everything is one jitted function of pure pytrees, so the same code runs on
 
 from __future__ import annotations
 
+import time
 from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from smartcal_tpu import obs
+
 from ..envs import enet
 from ..rl import replay as rp
 from ..rl import sac
+
+
+def _instrument(fn, kind: str, env_steps_per_call: int):
+    """Wrap a jitted train function with dispatch telemetry.
+
+    With no RunLog active the wrapper is one function call + one ``None``
+    check; with one active it records a ``dispatch`` event (submission
+    wall time — NOT compute time: the call is async and deliberately not
+    synchronized, so instrumentation never serializes the pipeline) and
+    accumulates env-step/dispatch counters."""
+    def wrapped(*args, **kwargs):
+        rl = obs.active()
+        if rl is None:
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        rl.log("dispatch", kind=kind,
+               submit_s=round(time.perf_counter() - t0, 6),
+               env_steps=env_steps_per_call)
+        obs.counter_add("train_dispatches")
+        obs.counter_add("env_steps", env_steps_per_call)
+        return out
+
+    wrapped.__wrapped__ = fn
+    return wrapped
 
 
 class ParallelTrainState(NamedTuple):
@@ -149,9 +177,10 @@ def make_parallel_sac(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
 
     dummy = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
     shardings = _state_shardings(dummy)
-    train_step_jit = jax.jit(train_step,
-                             in_shardings=(shardings, repl),
-                             out_shardings=(shardings, repl))
+    train_step_jit = _instrument(
+        jax.jit(train_step, in_shardings=(shardings, repl),
+                out_shardings=(shardings, repl)),
+        "train_step", n_envs)
     reset_envs_jit = jax.jit(reset_envs,
                              in_shardings=(shardings, repl),
                              out_shardings=shardings)
@@ -177,9 +206,10 @@ def make_parallel_sac(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
         keys = jax.random.split(key, eps_pd)
         return jax.lax.scan(one_episode, st, keys)
 
-    run_block_jit = jax.jit(run_block,
-                            in_shardings=(shardings, repl),
-                            out_shardings=(shardings, repl))
+    run_block_jit = _instrument(
+        jax.jit(run_block, in_shardings=(shardings, repl),
+                out_shardings=(shardings, repl)),
+        "episode_block", n_envs * steps_pe * eps_pd)
     return init_fn, train_step_jit, reset_envs_jit, run_block_jit
 
 

@@ -1,15 +1,142 @@
-"""Episode-block dispatch, shared by the enet SAC/TD3/DDPG drivers.
+"""Shared train-driver plumbing: episode-block dispatch + observability.
 
-One jitted program runs ``block`` strictly-sequential episodes (the scan
-carry chains agent + replay state and reproduces the drivers' host key
-chain ``key, k = split(key)`` per episode).  Identical learning dynamics
-to per-episode dispatch — this amortizes the device round trip, which
-dominates the small elastic-net programs on the chip (round-3 capture:
-33 env-steps/s at 1 dispatch/episode over the tunnel); it is NOT a
-batched-env mode (that is ``parallel.make_parallel_sac``).
+Episode blocks: one jitted program runs ``block`` strictly-sequential
+episodes (the scan carry chains agent + replay state and reproduces the
+drivers' host key chain ``key, k = split(key)`` per episode).  Identical
+learning dynamics to per-episode dispatch — this amortizes the device
+round trip, which dominates the small elastic-net programs on the chip
+(round-3 capture: 33 env-steps/s at 1 dispatch/episode over the tunnel);
+it is NOT a batched-env mode (that is ``parallel.make_parallel_sac``).
+
+Observability: ``add_obs_args`` + ``train_obs``/``train_obs_from_args``
+are the ONE wiring shared by all nine train entry points — a ``TrainObs``
+owns the run's RunLog (activated for the process so env/backend spans and
+solver telemetry record into it), the jax compile listener, an optional
+profiler trace, and the per-episode "episode N score ..." echo (stderr,
+``--quiet``-able; the JSONL stream is the machine interface).
 """
 
+import os
+import time
+
 import jax
+
+from smartcal_tpu import obs
+
+
+def add_obs_args(p):
+    """Attach the shared observability flags to an argparse parser."""
+    p.add_argument("--metrics", type=str, default=None,
+                   help="obs run JSONL path (header + episode/span/solver "
+                        "events; aggregate with tools/obs_report.py)")
+    p.add_argument("--run_id", type=str, default=None,
+                   help="run id recorded in the JSONL header "
+                        "(default: generated)")
+    p.add_argument("--trace", type=str, default=None,
+                   help="jax profiler trace dir (view with TensorBoard/"
+                        "xprof; spans appear as TraceAnnotations)")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress the per-episode stderr echo")
+    return p
+
+
+class TrainObs:
+    """Per-run observability handle for a train driver (see module doc).
+
+    With neither ``metrics`` nor ``trace`` set, everything here is a
+    no-op passthrough — the driver's hot loop is unchanged."""
+
+    MEM_EVERY = 10          # episodes between device-memory gauge samples
+
+    def __init__(self, entry, metrics=None, run_id=None, trace=None,
+                 quiet=False, **meta):
+        self.entry = entry
+        self.quiet = quiet
+        self._t0 = time.time()
+        self._episodes = 0
+        self._tracing = False
+        path = metrics
+        if path is None and trace:
+            # a profiler trace without a metrics stream still wants the
+            # span/solver record alongside the xprof dump
+            path = os.path.join(trace, f"{entry}_run.jsonl")
+        self.runlog = None
+        if path:
+            self.runlog = obs.RunLog(path, run_id=run_id,
+                                     meta={"entry": entry, **meta})
+            obs.activate(self.runlog)
+            obs.install_compile_listener()
+        if trace:
+            try:
+                jax.profiler.start_trace(trace)
+                self._tracing = True
+            except Exception as e:
+                self.echo(f"profiler trace unavailable: {e!r}")
+
+    def span(self, name, **tags):
+        return obs.span(name, **tags)
+
+    def episode(self, i, score, scores=None, echo=True, **fields):
+        """Record one ``episode`` event + the classic stderr echo
+        (``echo=False`` for drivers that print their own wording)."""
+        if self.runlog is not None:
+            self.runlog.log("episode", episode=i, score=score, **fields)
+            self._episodes += 1
+            if self._episodes % self.MEM_EVERY == 0:
+                obs.log_memory_gauges()
+        if echo and not self.quiet:
+            if scores:
+                tail = scores[-100:]
+                avg = sum(float(s) for s in tail) / len(tail)
+            else:
+                avg = float(score)
+            # event=None: the structured record is the episode event above
+            obs.echo(f"episode {i} score {float(score):.2f} "
+                     f"average score {avg:.2f}", event=None)
+
+    def echo(self, msg, **fields):
+        obs.echo(msg, quiet=self.quiet, **fields)
+
+    def close(self):
+        if self._tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._tracing = False
+        if self.runlog is not None:
+            # reset: a later run in the same process (sweep drivers call
+            # main() per seed) must not inherit this run's totals
+            obs.flush_counters(reset=True)
+            self.runlog.log("run_end", episodes=self._episodes,
+                            wall_s=round(time.time() - self._t0, 3))
+            obs.deactivate(self.runlog)
+            self.runlog.close()
+            self.runlog = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def train_obs(entry, metrics=None, run_id=None, trace=None, quiet=False,
+              **meta) -> TrainObs:
+    return TrainObs(entry, metrics=metrics, run_id=run_id, trace=trace,
+                    quiet=quiet, **meta)
+
+
+def train_obs_from_args(args, entry, **meta) -> TrainObs:
+    """Build the run handle from the ``add_obs_args`` flags (getattr-safe
+    so programmatic Namespace callers without the new flags keep
+    working)."""
+    return TrainObs(entry,
+                    metrics=getattr(args, "metrics", None),
+                    run_id=getattr(args, "run_id", None),
+                    trace=getattr(args, "trace", None),
+                    quiet=getattr(args, "quiet", False),
+                    seed=getattr(args, "seed", None), **meta)
 
 
 def make_block_fn(episode_body, block: int):

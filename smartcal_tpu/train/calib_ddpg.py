@@ -34,8 +34,9 @@ def main(argv=None):
     agent = ddpg.DDPGAgent(cfg, seed=args.seed, name_prefix=args.prefix)
     if args.load:
         agent.load_models()
+    from .blocks import train_obs_from_args
     return run(env, agent, args.episodes, args.steps, args.use_hint,
-               args.prefix, metrics_path=args.metrics)
+               args.prefix, obs_run=train_obs_from_args(args, "calib_ddpg"))
 
 
 if __name__ == "__main__":

@@ -22,7 +22,7 @@ from ..envs import CalibEnv
 from ..envs.radio import RadioBackend
 from ..rl import sac
 from ..rl.networks import flatten_obs
-from ..utils import JsonlLogger
+from .blocks import add_obs_args, train_obs_from_args
 
 
 def main(argv=None):
@@ -45,8 +45,7 @@ def main(argv=None):
                         "iterations (multi-seed CPU sweeps)")
     p.add_argument("--load", action="store_true")
     p.add_argument("--prefix", type=str, default="calib_sac")
-    p.add_argument("--metrics", type=str, default=None,
-                   help="JSONL metrics stream path")
+    add_obs_args(p)
     args = p.parse_args(argv)
 
     if args.small:
@@ -76,36 +75,38 @@ def main(argv=None):
         agent.load_models()
 
     scores = []
-    mlog = JsonlLogger(args.metrics)
-    for i in range(args.episodes):
-        obs = env.reset()
-        flat = flatten_obs(obs)
-        score, loop, done = 0.0, 0, False
-        while not done and loop < args.steps:
-            action = np.asarray(agent.choose_action(flat)).squeeze()
-            out = env.step(action)
-            if args.use_hint:
-                obs2, reward, done, hint, info = out
-            else:
-                obs2, reward, done, info = out
-                hint = np.zeros(2 * args.M, np.float32)
-            flat2 = flatten_obs(obs2)
-            # rewards > 1 scaled by 10 (main_sac.py:24,49)
-            scaled = reward * 10 if reward > 1 else reward
-            agent.store_transition(flat, action, scaled, flat2, done, hint)
-            agent.learn()
-            score += reward
-            flat = flat2
-            loop += 1
-        scores.append(score / max(loop, 1))
-        mlog.log("episode", episode=i, score=scores[-1], seed=args.seed,
-                 use_hint=args.use_hint)
-        print(f"episode {i} score {scores[-1]:.2f} "
-              f"average score {np.mean(scores[-100:]):.2f}")
-        agent.save_models()
-        with open(f"{args.prefix}_scores.pkl", "wb") as fh:
-            pickle.dump(scores, fh)
-    mlog.close()
+    tob = train_obs_from_args(args, "calib_sac")
+    try:
+        for i in range(args.episodes):
+            with tob.span("episode", episode=i):
+                obs = env.reset()
+                flat = flatten_obs(obs)
+                score, loop, done = 0.0, 0, False
+                while not done and loop < args.steps:
+                    action = np.asarray(agent.choose_action(flat)).squeeze()
+                    out = env.step(action)
+                    if args.use_hint:
+                        obs2, reward, done, hint, info = out
+                    else:
+                        obs2, reward, done, info = out
+                        hint = np.zeros(2 * args.M, np.float32)
+                    flat2 = flatten_obs(obs2)
+                    # rewards > 1 scaled by 10 (main_sac.py:24,49)
+                    scaled = reward * 10 if reward > 1 else reward
+                    agent.store_transition(flat, action, scaled, flat2,
+                                           done, hint)
+                    agent.learn()
+                    score += reward
+                    flat = flat2
+                    loop += 1
+            scores.append(score / max(loop, 1))
+            tob.episode(i, scores[-1], scores, seed=args.seed,
+                        use_hint=args.use_hint)
+            agent.save_models()
+            with open(f"{args.prefix}_scores.pkl", "wb") as fh:
+                pickle.dump(scores, fh)
+    finally:
+        tob.close()
     return scores
 
 

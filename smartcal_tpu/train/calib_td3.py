@@ -24,39 +24,42 @@ from ..rl import td3
 from ..rl.networks import flatten_obs
 
 
-def run(env, agent, episodes, steps, use_hint, prefix, metrics_path=None):
+def run(env, agent, episodes, steps, use_hint, prefix, metrics_path=None,
+        obs_run=None):
     """Shared episode loop of the radio TD3/DDPG drivers
     (main_td3.py:23-48 / main_ddpg.py)."""
-    from ..utils import JsonlLogger
+    from .blocks import train_obs
 
     scores = []
-    mlog = JsonlLogger(metrics_path)
-    for i in range(episodes):
-        obs = env.reset()
-        flat = flatten_obs(obs)
-        score, loop, done = 0.0, 0, False
-        while not done and loop < steps:
-            action = np.asarray(agent.choose_action(flat)).squeeze()
-            out = env.step(action)
-            if use_hint:
-                obs2, reward, done, hint, info = out
-            else:
-                obs2, reward, done, info = out
-                hint = np.zeros_like(action)
-            flat2 = flatten_obs(obs2)
-            agent.store_transition(flat, action, reward, flat2, done, hint)
-            agent.learn()
-            score += reward
-            flat = flat2
-            loop += 1
-        scores.append(score / max(loop, 1))
-        mlog.log("episode", episode=i, score=scores[-1], use_hint=use_hint)
-        print(f"episode {i} score {scores[-1]:.2f} "
-              f"average score {np.mean(scores[-100:]):.2f}")
-        agent.save_models()
-        with open(f"{prefix}_scores.pkl", "wb") as fh:
-            pickle.dump(scores, fh)
-    mlog.close()
+    tob = obs_run or train_obs(prefix, metrics=metrics_path)
+    try:
+        for i in range(episodes):
+            with tob.span("episode", episode=i):
+                obs = env.reset()
+                flat = flatten_obs(obs)
+                score, loop, done = 0.0, 0, False
+                while not done and loop < steps:
+                    action = np.asarray(agent.choose_action(flat)).squeeze()
+                    out = env.step(action)
+                    if use_hint:
+                        obs2, reward, done, hint, info = out
+                    else:
+                        obs2, reward, done, info = out
+                        hint = np.zeros_like(action)
+                    flat2 = flatten_obs(obs2)
+                    agent.store_transition(flat, action, reward, flat2,
+                                           done, hint)
+                    agent.learn()
+                    score += reward
+                    flat = flat2
+                    loop += 1
+            scores.append(score / max(loop, 1))
+            tob.episode(i, scores[-1], scores, use_hint=use_hint)
+            agent.save_models()
+            with open(f"{prefix}_scores.pkl", "wb") as fh:
+                pickle.dump(scores, fh)
+    finally:
+        tob.close()
     return scores
 
 
@@ -69,6 +72,8 @@ def build_backend(args):
 
 
 def add_common_args(p):
+    from .blocks import add_obs_args
+
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--episodes", type=int, default=30)
     p.add_argument("--steps", type=int, default=10)
@@ -78,8 +83,7 @@ def add_common_args(p):
     p.add_argument("--npix", type=int, default=128)
     p.add_argument("--small", action="store_true")
     p.add_argument("--load", action="store_true")
-    p.add_argument("--metrics", type=str, default=None,
-                   help="JSONL metrics stream path")
+    add_obs_args(p)
 
 
 def main(argv=None):
@@ -100,8 +104,9 @@ def main(argv=None):
     agent = td3.TD3Agent(cfg, seed=args.seed, name_prefix=args.prefix)
     if args.load:
         agent.load_models()
+    from .blocks import train_obs_from_args
     return run(env, agent, args.episodes, args.steps, args.use_hint,
-               args.prefix, metrics_path=args.metrics)
+               args.prefix, obs_run=train_obs_from_args(args, "calib_td3"))
 
 
 if __name__ == "__main__":

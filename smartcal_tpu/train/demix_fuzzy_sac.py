@@ -25,6 +25,7 @@ import numpy as np
 from ..envs.demixing_fuzzy import FuzzyDemixingEnv
 from ..rl import sac
 from ..rl.networks import flatten_obs
+from .blocks import add_obs_args
 from .calib_td3 import build_backend
 from .demix_sac import run_warmup_loop
 
@@ -48,8 +49,7 @@ def main(argv=None):
     p.add_argument("--small", action="store_true")
     p.add_argument("--load", action="store_true")
     p.add_argument("--prefix", type=str, default="demix_fuzzy_sac")
-    p.add_argument("--metrics", type=str, default=None,
-                   help="JSONL metrics stream path")
+    add_obs_args(p)
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)

@@ -22,7 +22,7 @@ from ..envs import DemixingEnv
 from ..envs.radio import RadioBackend
 from ..rl import sac
 from ..rl.networks import flatten_obs
-from ..utils import JsonlLogger
+from .blocks import add_obs_args, train_obs_from_args
 
 
 def main(argv=None):
@@ -48,8 +48,7 @@ def main(argv=None):
                         "minimum useful solver iterations")
     p.add_argument("--load", action="store_true")
     p.add_argument("--prefix", type=str, default="demix_sac")
-    p.add_argument("--metrics", type=str, default=None,
-                   help="JSONL metrics stream path")
+    add_obs_args(p)
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
@@ -130,51 +129,57 @@ def run_warmup_loop(env, agent, args, scores, to_flat, n_actions,
     drivers (demixing_rl/main_sac.py:54-98, demixing_fuzzy/main_sac.py:
     70-99 — identical control flow, differing only in the reward-shaping
     rule and the observation flattening)."""
-    mlog = JsonlLogger(args.metrics)
+    tob = train_obs_from_args(args, getattr(args, "prefix", "demix"))
     total_steps = 0
     warmup_steps = args.warmup * args.steps
-    for i in range(args.iteration):
-        obs = env.reset()
-        flat = to_flat(obs)
-        score, loop, done = 0.0, 0, False
-        while not done and loop < args.steps:
-            if total_steps < warmup_steps:
-                action = rng.uniform(-1, 1, n_actions).astype(np.float32)
-            else:
-                action = np.asarray(agent.choose_action(flat)).squeeze()
-            out = env.step(action)
-            if args.use_hint:
-                obs2, reward, done, hint, info = out
-            else:
-                obs2, reward, done, info = out
-                hint = np.zeros(n_actions, np.float32)
-            flat2 = to_flat(obs2)
-            agent.store_transition(flat, action, scale_reward(reward),
-                                   flat2, done, hint)
-            agent.learn()
-            score += reward
-            flat = flat2
-            loop += 1
-            total_steps += 1
-        scores.append(score / max(loop, 1))
-        mlog.log("episode", episode=i, score=scores[-1], seed=args.seed,
-                 use_hint=args.use_hint)
-        print(f"episode {i} score {scores[-1]:.2f} "
-              f"average score {np.mean(scores[-100:]):.2f}")
-        agent.save_models()
-        with open(f"{args.prefix}_scores.pkl", "wb") as fh:
-            pickle.dump(scores, fh)
-        if (i + 1) % _clear_every() == 0:
-            # bound live compiled executables: long hint-mode runs segfault
-            # the XLA CPU client near episode ~43 otherwise (the same
-            # deterministic crash the test suite hit in round 1 —
-            # tests/conftest.py clears per module for the same reason);
-            # costs one recompile pass per clear.  SMARTCAL_CLEAR_EVERY
-            # widens the interval for long sweeps where the recompile tax
-            # dominates (the crash rate scales with live-executable count,
-            # which stays bounded either way).
-            jax.clear_caches()
-    mlog.close()
+    try:
+        for i in range(args.iteration):
+            with tob.span("episode", episode=i):
+                obs = env.reset()
+                flat = to_flat(obs)
+                score, loop, done = 0.0, 0, False
+                while not done and loop < args.steps:
+                    if total_steps < warmup_steps:
+                        action = rng.uniform(-1, 1,
+                                             n_actions).astype(np.float32)
+                    else:
+                        action = np.asarray(
+                            agent.choose_action(flat)).squeeze()
+                    out = env.step(action)
+                    if args.use_hint:
+                        obs2, reward, done, hint, info = out
+                    else:
+                        obs2, reward, done, info = out
+                        hint = np.zeros(n_actions, np.float32)
+                    flat2 = to_flat(obs2)
+                    agent.store_transition(flat, action,
+                                           scale_reward(reward),
+                                           flat2, done, hint)
+                    agent.learn()
+                    score += reward
+                    flat = flat2
+                    loop += 1
+                    total_steps += 1
+            scores.append(score / max(loop, 1))
+            tob.episode(i, scores[-1], scores, seed=args.seed,
+                        use_hint=args.use_hint,
+                        warmup=total_steps <= warmup_steps)
+            agent.save_models()
+            with open(f"{args.prefix}_scores.pkl", "wb") as fh:
+                pickle.dump(scores, fh)
+            if (i + 1) % _clear_every() == 0:
+                # bound live compiled executables: long hint-mode runs
+                # segfault the XLA CPU client near episode ~43 otherwise
+                # (the same deterministic crash the test suite hit in
+                # round 1 — tests/conftest.py clears per module for the
+                # same reason); costs one recompile pass per clear.
+                # SMARTCAL_CLEAR_EVERY widens the interval for long sweeps
+                # where the recompile tax dominates (the crash rate scales
+                # with live-executable count, which stays bounded either
+                # way).
+                jax.clear_caches()
+    finally:
+        tob.close()
     return scores
 
 

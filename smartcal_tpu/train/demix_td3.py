@@ -31,6 +31,7 @@ import numpy as np
 from ..envs import DemixingEnv
 from ..rl import td3
 from ..rl.networks import flatten_obs
+from .blocks import add_obs_args
 from .demix_sac import make_backend, run_warmup_loop
 
 
@@ -55,9 +56,9 @@ def main(argv=None):
                    help="see demix_sac --medium")
     p.add_argument("--load", action="store_true")
     p.add_argument("--prefix", type=str, default="demix_td3")
-    p.add_argument("--metrics", type=str, default=None)
     p.add_argument("--batch_size", type=int, default=64)
     p.add_argument("--memory", type=int, default=4096)
+    add_obs_args(p)
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)

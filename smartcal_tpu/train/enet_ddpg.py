@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import argparse
-import json
 import pickle
 import time
 
@@ -56,7 +55,9 @@ def make_episode_block_fn(env_cfg: enet.EnetConfig, cfg: ddpg.DDPGConfig,
 
 
 def train_fused(seed=0, episodes=1000, steps=5, M=20, N=20, quiet=False,
-                prefix=""):
+                prefix="", metrics_path=None, run_id=None, trace=None):
+    from .blocks import train_obs
+
     env_cfg = enet.EnetConfig(M=M, N=N)
     cfg = ddpg.DDPGConfig(obs_dim=env_cfg.obs_dim, n_actions=2,
                           batch_size=64, mem_size=1024)
@@ -68,32 +69,43 @@ def train_fused(seed=0, episodes=1000, steps=5, M=20, N=20, quiet=False,
 
     scores = []
     t0 = time.time()
-    for i in range(episodes):
-        key, k = jax.random.split(key)
-        agent_state, buf, score = episode_fn(agent_state, buf, k)
-        scores.append(float(score))
-        if not quiet:
-            avg = sum(scores[-100:]) / len(scores[-100:])
-            print(f"episode {i} score {scores[-1]:.2f} average score {avg:.2f}")
-    wall = time.time() - t0
+    tob = train_obs("enet_ddpg", metrics=metrics_path, run_id=run_id,
+                    trace=trace, quiet=quiet, seed=seed)
+    try:
+        for i in range(episodes):
+            key, k = jax.random.split(key)
+            with tob.span("episode", episode=i):
+                agent_state, buf, score = episode_fn(agent_state, buf, k)
+            scores.append(float(score))
+            tob.episode(i, scores[-1], scores, seed=seed)
+        wall = time.time() - t0
+    finally:
+        tob.close()
     with open(f"{prefix}scores_ddpg.pkl", "wb") as f:
         pickle.dump(scores, f)
     return scores, wall, agent_state, buf
 
 
 def main():
+    from smartcal_tpu import obs as smartcal_obs
+
+    from .blocks import add_obs_args
+
     p = argparse.ArgumentParser(description="Elastic net DDPG (TPU)")
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--episodes", default=1000, type=int)
     p.add_argument("--steps", default=5, type=int)
+    add_obs_args(p)
     args = p.parse_args()
     scores, wall, _, _ = train_fused(seed=args.seed, episodes=args.episodes,
-                                     steps=args.steps)
-    print(json.dumps({"episodes": args.episodes, "wall_s": round(wall, 2),
-                      "env_steps_per_sec": round(
-                          args.episodes * args.steps / wall, 2),
-                      "final_avg_score": sum(scores[-100:])
-                      / len(scores[-100:])}))
+                                     steps=args.steps,
+                                     metrics_path=args.metrics,
+                                     run_id=args.run_id, trace=args.trace,
+                                     quiet=args.quiet)
+    smartcal_obs.emit_json(
+        {"episodes": args.episodes, "wall_s": round(wall, 2),
+         "env_steps_per_sec": round(args.episodes * args.steps / wall, 2),
+         "final_avg_score": sum(scores[-100:]) / len(scores[-100:])})
 
 
 if __name__ == "__main__":

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..envs import enet
 from ..ops.lbfgs import lbfgs_solve
 from ..rl import sac
@@ -73,9 +74,11 @@ def evaluate(agent_path: str = "sac_state.pkl", games: int = 2, steps: int = 4,
                "rl_rel_err": float(rel(st.x)),
                "grid_rel_err": float(rel(x_grid))}
         results.append(row)
-        print(f"{i} RL {row['rl_rho'][0]:.4f},{row['rl_rho'][1]:.4f} "
-              f"GR {row['grid_rho'][0]:.4f},{row['grid_rho'][1]:.4f}")
-        print(f"RL {row['rl_rel_err']:.4f} GR {row['grid_rel_err']:.4f}")
+        obs.echo(f"{i} RL {row['rl_rho'][0]:.4f},{row['rl_rho'][1]:.4f} "
+                 f"GR {row['grid_rho'][0]:.4f},{row['grid_rho'][1]:.4f}",
+                 event=None)
+        obs.echo(f"RL {row['rl_rel_err']:.4f} GR {row['grid_rel_err']:.4f}",
+                 event="eval_game", **row)
     return results
 
 

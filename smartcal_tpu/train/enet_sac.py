@@ -18,8 +18,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import functools
-import json
 import pickle
 import time
 
@@ -101,8 +99,9 @@ def make_episode_block_fn(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
 
 def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,
                 M=20, N=20, log_every=1, save_every=500, prefix="",
-                quiet=False, metrics_path=None, block=1):
-    from ..utils import JsonlLogger
+                quiet=False, metrics_path=None, block=1, run_id=None,
+                trace=None):
+    from .blocks import train_obs
 
     env_cfg = enet.EnetConfig(M=M, N=N)
     agent_cfg = sac.SACConfig(
@@ -123,37 +122,41 @@ def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,
 
     scores = []
     t0 = time.time()
-    mlog = JsonlLogger(metrics_path)
+    tob = train_obs("enet_sac", metrics=metrics_path, run_id=run_id,
+                    trace=trace, quiet=quiet, seed=seed, block=block)
 
     def _log_one(i, score):
         scores.append(float(score))
-        mlog.log("episode", episode=i, score=scores[-1], seed=seed,
-                 use_hint=use_hint)
-        if not quiet and i % log_every == 0:
-            avg = sum(scores[-100:]) / len(scores[-100:])
-            print(f"episode {i} score {scores[-1]:.2f} average score {avg:.2f}")
+        # episode echo honors log_every (the block path logs in bursts)
+        tob.episode(i, scores[-1], scores, echo=(i % log_every == 0),
+                    seed=seed, use_hint=use_hint)
 
     i, saved_marker = 0, 0
-    while i < episodes:
-        if block_fn is not None and episodes - i >= block:
-            # same key chain as the per-episode path: the split happens
-            # inside the scan carry, one split per episode
-            agent_state, buf, key, blk = block_fn(agent_state, buf, key)
-            for s in blk:
-                _log_one(i, s)
+    try:
+        while i < episodes:
+            if block_fn is not None and episodes - i >= block:
+                # same key chain as the per-episode path: the split happens
+                # inside the scan carry, one split per episode
+                with tob.span("episode_block", episodes=block):
+                    agent_state, buf, key, blk = block_fn(agent_state, buf,
+                                                          key)
+                for s in blk:
+                    _log_one(i, s)
+                    i += 1
+            else:
+                key, k = jax.random.split(key)
+                with tob.span("episode", episode=i):
+                    agent_state, buf, score = episode_fn(agent_state, buf, k)
+                _log_one(i, score)
                 i += 1
-        else:
-            key, k = jax.random.split(key)
-            agent_state, buf, score = episode_fn(agent_state, buf, k)
-            _log_one(i, score)
-            i += 1
-        # checkpoint cadence: save whenever a save_every multiple was
-        # crossed since the last save (block mode crosses in strides)
-        if save_every and i < episodes and i // save_every > saved_marker:
-            _save(agent_state, buf, scores, prefix)
-            saved_marker = i // save_every
-    wall = time.time() - t0
-    mlog.close()
+            # checkpoint cadence: save whenever a save_every multiple was
+            # crossed since the last save (block mode crosses in strides)
+            if save_every and i < episodes and i // save_every > saved_marker:
+                _save(agent_state, buf, scores, prefix)
+                saved_marker = i // save_every
+        wall = time.time() - t0
+    finally:
+        tob.close()
     _save(agent_state, buf, scores, prefix)
     return scores, wall, agent_state, buf
 
@@ -169,6 +172,8 @@ def _save(agent_state, buf, scores, prefix):
 def train_loop(seed=0, episodes=1000, steps=5, use_hint=False, M=20, N=20):
     """Reference-style host loop (main_sac.py:47-76)."""
     import numpy as np
+
+    from smartcal_tpu import obs as smartcal_obs
 
     env = enet.EnetEnv(M, N, provide_hint=use_hint, seed=seed)
     agent = sac.SACAgent(sac.SACConfig(
@@ -194,11 +199,16 @@ def train_loop(seed=0, episodes=1000, steps=5, use_hint=False, M=20, N=20):
             loop += 1
         scores.append(score / loop)
         avg = sum(scores[-100:]) / len(scores[-100:])
-        print(f"episode {i} score {scores[-1]:.2f} average score {avg:.2f}")
+        smartcal_obs.echo(f"episode {i} score {scores[-1]:.2f} "
+                          f"average score {avg:.2f}", event=None)
     return scores
 
 
 def main():
+    from smartcal_tpu import obs as smartcal_obs
+
+    from .blocks import add_obs_args
+
     p = argparse.ArgumentParser(
         description="Elastic net regression hyperparameter tuning (SAC, TPU)")
     p.add_argument("--seed", default=0, type=int)
@@ -209,22 +219,22 @@ def main():
     p.add_argument("--block", default=1, type=int,
                    help="episodes per device dispatch (lax.scan of whole "
                         "episodes; 1 = reference per-episode cadence)")
-    p.add_argument("--metrics", default=None,
-                   help="JSONL metrics stream path (one line per episode)")
+    add_obs_args(p)
     args = p.parse_args()
 
     if args.mode == "fused":
         scores, wall, _, _ = train_fused(
             seed=args.seed, episodes=args.episodes, steps=args.steps,
             use_hint=args.use_hint, metrics_path=args.metrics,
-            block=args.block)
-        print(json.dumps({"episodes": args.episodes,
-                          "steps_per_episode": args.steps,
-                          "wall_s": round(wall, 2),
-                          "env_steps_per_sec": round(
-                              args.episodes * args.steps / wall, 2),
-                          "final_avg_score": sum(scores[-100:])
-                          / len(scores[-100:])}))
+            block=args.block, run_id=args.run_id, trace=args.trace,
+            quiet=args.quiet)
+        smartcal_obs.emit_json({"episodes": args.episodes,
+                                "steps_per_episode": args.steps,
+                                "wall_s": round(wall, 2),
+                                "env_steps_per_sec": round(
+                                    args.episodes * args.steps / wall, 2),
+                                "final_avg_score": sum(scores[-100:])
+                                / len(scores[-100:])})
     else:
         train_loop(seed=args.seed, episodes=args.episodes, steps=args.steps,
                    use_hint=args.use_hint)

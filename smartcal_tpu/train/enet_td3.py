@@ -5,7 +5,6 @@ prioritized replay + hint-constrained adaptive-ADMM actor updates,
 from __future__ import annotations
 
 import argparse
-import json
 import pickle
 import time
 
@@ -69,7 +68,9 @@ def make_episode_block_fn(env_cfg: enet.EnetConfig, cfg: td3.TD3Config,
 
 def train_fused(seed=0, episodes=1000, steps=4, use_hint=True,
                 prioritized=True, M=20, N=20, quiet=False, save_every=500,
-                prefix=""):
+                prefix="", metrics_path=None, run_id=None, trace=None):
+    from .blocks import train_obs
+
     env_cfg = enet.EnetConfig(M=M, N=N)
     cfg = td3.TD3Config(
         obs_dim=env_cfg.obs_dim, n_actions=2, gamma=0.99, tau=0.005,
@@ -85,16 +86,20 @@ def train_fused(seed=0, episodes=1000, steps=4, use_hint=True,
 
     scores = []
     t0 = time.time()
-    for i in range(episodes):
-        key, k = jax.random.split(key)
-        agent_state, buf, score = episode_fn(agent_state, buf, k)
-        scores.append(float(score))
-        if not quiet:
-            avg = sum(scores[-100:]) / len(scores[-100:])
-            print(f"episode {i} score {scores[-1]:.2f} average score {avg:.2f}")
-        if save_every and i and i % save_every == 0:
-            _save(agent_state, buf, scores, prefix)
-    wall = time.time() - t0
+    tob = train_obs("enet_td3", metrics=metrics_path, run_id=run_id,
+                    trace=trace, quiet=quiet, seed=seed)
+    try:
+        for i in range(episodes):
+            key, k = jax.random.split(key)
+            with tob.span("episode", episode=i):
+                agent_state, buf, score = episode_fn(agent_state, buf, k)
+            scores.append(float(score))
+            tob.episode(i, scores[-1], scores, seed=seed, use_hint=use_hint)
+            if save_every and i and i % save_every == 0:
+                _save(agent_state, buf, scores, prefix)
+        wall = time.time() - t0
+    finally:
+        tob.close()
     _save(agent_state, buf, scores, prefix)
     return scores, wall, agent_state, buf
 
@@ -108,6 +113,10 @@ def _save(agent_state, buf, scores, prefix):
 
 
 def main():
+    from smartcal_tpu import obs as smartcal_obs
+
+    from .blocks import add_obs_args
+
     p = argparse.ArgumentParser(
         description="Elastic net TD3 + PER + hint-ADMM (TPU)")
     p.add_argument("--seed", default=0, type=int)
@@ -115,15 +124,17 @@ def main():
     p.add_argument("--steps", default=4, type=int)
     p.add_argument("--no_hint", action="store_true", default=False)
     p.add_argument("--no_per", action="store_true", default=False)
+    add_obs_args(p)
     args = p.parse_args()
     scores, wall, _, _ = train_fused(
         seed=args.seed, episodes=args.episodes, steps=args.steps,
-        use_hint=not args.no_hint, prioritized=not args.no_per)
-    print(json.dumps({"episodes": args.episodes, "wall_s": round(wall, 2),
-                      "env_steps_per_sec": round(
-                          args.episodes * args.steps / wall, 2),
-                      "final_avg_score": sum(scores[-100:])
-                      / len(scores[-100:])}))
+        use_hint=not args.no_hint, prioritized=not args.no_per,
+        metrics_path=args.metrics, run_id=args.run_id, trace=args.trace,
+        quiet=args.quiet)
+    smartcal_obs.emit_json(
+        {"episodes": args.episodes, "wall_s": round(wall, 2),
+         "env_steps_per_sec": round(args.episodes * args.steps / wall, 2),
+         "final_avg_score": sum(scores[-100:]) / len(scores[-100:])})
 
 
 if __name__ == "__main__":

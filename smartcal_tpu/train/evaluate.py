@@ -28,6 +28,7 @@ import pickle
 import jax.numpy as jnp
 import numpy as np
 
+from smartcal_tpu import obs
 from smartcal_tpu.cal import dataset
 from smartcal_tpu.models.transformer import TransformerEncoder
 
@@ -97,7 +98,8 @@ def _selftest(args):
         probs = recommend(mslist, timesec=args.times * 0.8,
                           model_path=f"{tmp}/net.pkl", tdelta=args.tdelta,
                           workdir=tmp)
-    print("selftest recommendation:", probs)
+    obs.echo(f"selftest recommendation: {probs}",
+             event="recommendation")
     return probs
 
 
@@ -131,10 +133,12 @@ def main(argv=None):
     probs = recommend(mslist, args.timesec, args.model, tdelta=args.tdelta,
                       sky_path=args.sky, cluster_path=args.cluster,
                       seed=args.seed)
-    print("Demixing recommendation (probability per outlier direction):")
+    obs.echo("Demixing recommendation (probability per outlier direction):",
+             event=None)
     for i, v in enumerate(probs):
-        print(f"  direction {i}: {v:.4f}  ->  "
-              f"{'DEMIX' if v > 0.5 else 'skip'}")
+        obs.echo(f"  direction {i}: {v:.4f}  ->  "
+                 f"{'DEMIX' if v > 0.5 else 'skip'}",
+                 event="recommendation", direction=i, prob=float(v))
 
 
 if __name__ == "__main__":

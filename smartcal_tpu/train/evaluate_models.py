@@ -16,6 +16,7 @@ import argparse
 
 import numpy as np
 
+from .. import obs
 from ..envs import DemixingEnv
 from ..envs.radio import RadioBackend
 from ..rl import sac
@@ -40,15 +41,16 @@ def evaluate(env: DemixingEnv, agents: dict, n_steps: int, n_games: int,
                 obs_, reward, done, hint, info = out
                 flats[name] = flatten_obs(obs_)
                 best[name] = max(best[name], reward)
-                if not quiet:
-                    print(f"Iter {cn}:{ci} {name} reward {reward:.3f}")
+                obs.echo(f"Iter {cn}:{ci} {name} reward {reward:.3f}",
+                         quiet=quiet, event="eval_step", game=cn,
+                         step=ci, agent=name, reward=float(reward))
         for name in agents:
             results[name].append(best[name])
         _, reward_hint, *_ = env.step(hint)
         results["hint"].append(reward_hint)
-        if not quiet:
-            print(f"Episode {cn}: rewards "
-                  + " ".join(f"{n}={results[n][-1]:.3f}" for n in results))
+        obs.echo(f"Episode {cn}: rewards "
+                 + " ".join(f"{n}={results[n][-1]:.3f}" for n in results),
+                 quiet=quiet, event="eval_episode", game=cn)
     return results
 
 
@@ -85,7 +87,9 @@ def main(argv=None):
               "untrained": make_agent("", False)}
     results = evaluate(env, agents, n_steps=args.K, n_games=args.games)
     for name, vals in results.items():
-        print(f"{name}: mean best reward {np.mean(vals):.4f}")
+        obs.echo(f"{name}: mean best reward {np.mean(vals):.4f}",
+                 event="eval_summary", agent=name,
+                 mean_best_reward=float(np.mean(vals)))
     return results
 
 

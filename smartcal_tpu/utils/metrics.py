@@ -2,10 +2,12 @@
 
 The reference's observability is ``print()`` + pickled score lists
 (SURVEY §5 metrics/logging); the build plan (SURVEY §7 L6) calls for
-structured metrics.  One line per event, machine-readable, crash-safe
-(append + flush per line):
-
-    {"t": <unix seconds>, "event": "episode", "score": ..., ...}
+structured metrics.  Since the obs layer landed, the real implementation
+is :class:`smartcal_tpu.obs.RunLog` (header line, buffered/rotating
+writes, non-finite sanitization — the old per-line writer emitted bare
+``NaN``/``Infinity`` tokens, which are invalid JSON); ``JsonlLogger``
+stays as a thin compatibility shim with its original surface: headerless
+stream, one flushed line per event, ``None`` path disables.
 
 ``profiler_trace`` wraps a code region in ``jax.profiler.trace`` when a
 directory is given (view with TensorBoard / xprof), else is a no-op —
@@ -16,30 +18,24 @@ weak #1/missing #8 asked for.
 from __future__ import annotations
 
 import contextlib
-import json
-import time
 from typing import Optional
+
+from smartcal_tpu.obs import RunLog
 
 
 class JsonlLogger:
-    """Append-mode JSONL metrics writer; ``None`` path disables it."""
+    """Back-compat shim over :class:`smartcal_tpu.obs.RunLog`: headerless,
+    flush-per-line (the original crash-safety contract), sanitized."""
 
     def __init__(self, path: Optional[str]):
-        self._fh = open(path, "a") if path else None
+        self._run = RunLog(path, header=False, flush_lines=1,
+                           flush_interval=0.0)
 
     def log(self, event: str, **fields):
-        if self._fh is None:
-            return
-        rec = {"t": round(time.time(), 3), "event": event}
-        rec.update({k: (float(v) if hasattr(v, "item") else v)
-                    for k, v in fields.items()})
-        self._fh.write(json.dumps(rec) + "\n")
-        self._fh.flush()
+        self._run.log(event, **fields)
 
     def close(self):
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        self._run.close()
 
     def __enter__(self):
         return self

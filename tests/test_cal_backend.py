@@ -238,6 +238,26 @@ class TestSolver:
                                    float(fused.sigma_res), rtol=1e-3)
         np.testing.assert_allclose(float(host.sigma_data),
                                    float(fused.sigma_data), rtol=1e-5)
+        # obs telemetry rider: collect_stats reuses the SAME segment
+        # programs (host-side counting only), so the stats pass is nearly
+        # free here — and the production outputs must stay bit-identical
+        # to the stats-off host solve just computed
+        assert host.stats is None
+        stats_on = solver.solve_admm_host(
+            Vn, C, obs.freqs, float(obs.freqs[1]), jnp.asarray(mdl.rho),
+            cfg, n_chunks=2, seg_iters=4, collect_stats=True)
+        np.testing.assert_array_equal(np.asarray(stats_on.J),
+                                      np.asarray(host.J))
+        st = stats_on.stats
+        # seg_iters=4: init (11 iters -> 3 dispatches) + 3 outer x
+        # (5 iters -> 2 dispatches) = 9; early-exiting lanes cannot
+        # change the dispatch structure
+        assert int(st.n_segments) == 9
+        assert int(st.admm_iters) == cfg.admm_iters
+        assert st.primal_resid.shape == (cfg.admm_iters,)
+        assert np.all(st.primal_resid > 0)
+        assert np.all(st.inner_iters > 0)
+        assert int(st.init_iters) > 0
 
     def test_dynamic_admm_iters(self, problem):
         obs, mdl, C, Jtrue, V, Vn = problem

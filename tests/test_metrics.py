@@ -43,7 +43,8 @@ def test_profiler_trace_noop():
 
 
 def test_driver_metrics_stream(tmp_path, monkeypatch):
-    """The enet driver emits one JSONL line per episode."""
+    """The enet driver emits one episode event per episode (the stream now
+    also carries a run header and span/run_end events — obs.RunLog)."""
     monkeypatch.chdir(tmp_path)
     from smartcal_tpu.train.enet_sac import train_fused
 
@@ -51,6 +52,7 @@ def test_driver_metrics_stream(tmp_path, monkeypatch):
                 metrics_path=str(tmp_path / "enet.jsonl"))
     lines = [json.loads(ln)
              for ln in (tmp_path / "enet.jsonl").read_text().splitlines()]
-    assert len(lines) == 3
-    assert [ln["episode"] for ln in lines] == [0, 1, 2]
-    assert all(np.isfinite(ln["score"]) for ln in lines)
+    eps = [ln for ln in lines if ln["event"] == "episode"]
+    assert len(eps) == 3
+    assert [ln["episode"] for ln in eps] == [0, 1, 2]
+    assert all(np.isfinite(ln["score"]) for ln in eps)

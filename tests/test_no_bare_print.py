@@ -1,0 +1,69 @@
+"""Static check: no new bare ``print(`` in smartcal_tpu/ (obs satellite).
+
+Diagnostics must flow through the obs layer (``obs.echo`` -> stderr +
+structured event, ``obs.emit_json`` -> the stdout machine interface) so
+logging stays structured and ``--quiet``-able.  ``smartcal_tpu/obs/
+console.py`` is the one sanctioned ``print`` site.  Tokenizer-based so
+strings, comments, and ``.print(`` method calls never false-positive.
+"""
+
+import io
+import os
+import tokenize
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "smartcal_tpu")
+
+# relative paths (to smartcal_tpu/) allowed to call print()
+ALLOWLIST = {
+    os.path.join("obs", "console.py"),
+}
+
+_SKIP_TYPES = (tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+               tokenize.DEDENT, tokenize.COMMENT)
+
+
+def bare_print_lines(path):
+    """Line numbers of bare ``print(`` calls (NAME 'print' followed by
+    '(', not preceded by '.' or 'def')."""
+    with open(path, "rb") as fh:
+        src = fh.read().decode("utf-8")
+    toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    hits = []
+    for i, t in enumerate(toks):
+        if t.type != tokenize.NAME or t.string != "print":
+            continue
+        prev = next((p for p in reversed(toks[:i])
+                     if p.type not in _SKIP_TYPES), None)
+        if prev is not None and prev.string in (".", "def"):
+            continue
+        nxt = next((n for n in toks[i + 1:] if n.type not in _SKIP_TYPES),
+                   None)
+        if nxt is not None and nxt.string == "(":
+            hits.append(t.start[0])
+    return hits
+
+
+def test_no_bare_print_in_package():
+    offenders = []
+    for root, _, files in os.walk(PKG):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, PKG)
+            if rel in ALLOWLIST:
+                continue
+            for line in bare_print_lines(path):
+                offenders.append(f"smartcal_tpu/{rel}:{line}")
+    assert not offenders, (
+        "bare print() found — route human output through smartcal_tpu.obs."
+        "echo (stderr + structured event) or obs.emit_json (stdout machine "
+        "payloads), or extend the allowlist deliberately:\n  "
+        + "\n  ".join(offenders))
+
+
+def test_allowlist_entries_exist():
+    """A deleted/renamed sanctioned file must not linger in the list."""
+    for rel in ALLOWLIST:
+        assert os.path.exists(os.path.join(PKG, rel)), rel

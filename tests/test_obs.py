@@ -1,0 +1,457 @@
+"""Observability layer: RunLog schema/rotation/sanitization, span
+nesting + thread-safety + no-op contract, counters/gauges, solver
+aux-stat plumbing parity (stats on ≙ stats off, bit-identical), and the
+obs_report aggregation/learning-verdict tool."""
+
+import json
+import os
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smartcal_tpu import obs
+from smartcal_tpu.cal import solver
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+import obs_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clean_obs_state():
+    """Every test starts with no active RunLog and empty counters."""
+    while obs.active() is not None:
+        obs.deactivate()
+    obs.reset_counters()
+    yield
+    while obs.active() is not None:
+        obs.deactivate()
+    obs.reset_counters()
+
+
+def read_jsonl(path):
+    return [json.loads(ln) for ln in open(path).read().splitlines()]
+
+
+# ---------------------------------------------------------------------------
+# RunLog
+# ---------------------------------------------------------------------------
+
+def test_runlog_header_schema_and_sanitization(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    with obs.RunLog(path, run_id="r-1", meta={"entry": "test"},
+                    flush_lines=1) as rl:
+        rl.log("episode", episode=0, score=float("nan"),
+               arr=[1.0, float("inf"), -float("inf")],
+               nested={"x": float("nan"), "ok": 2},
+               npval=np.float32(1.5), jval=jnp.asarray(2.5))
+    lines = read_jsonl(path)           # json.loads REJECTS bare NaN tokens
+    hdr = lines[0]
+    assert hdr["event"] == "run_header"
+    assert hdr["schema"] == obs.SCHEMA_VERSION
+    assert hdr["run_id"] == "r-1"
+    assert hdr["host"] and hdr["pid"]
+    assert hdr["meta"]["entry"] == "test"
+    # jax is imported in this process, so device metadata must be present
+    assert hdr["platform"] == "cpu" and hdr["n_devices"] == 8
+    ep = lines[1]
+    assert ep["score"] is None
+    assert ep["arr"] == [1.0, None, None]
+    assert ep["nested"] == {"x": None, "ok": 2}
+    assert ep["npval"] == 1.5 and ep["jval"] == 2.5
+
+
+def test_runlog_buffering_and_flush(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    rl = obs.RunLog(path, flush_lines=1000, flush_interval=1000.0)
+    rl.log("e1")
+    assert len(read_jsonl(path)) == 1      # header force-flushed only
+    rl.flush()
+    assert len(read_jsonl(path)) == 2
+    rl.log("e2")
+    rl.close()                             # close flushes the tail
+    assert [r["event"] for r in read_jsonl(path)] == \
+        ["run_header", "e1", "e2"]
+
+
+def test_runlog_rotation(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    rl = obs.RunLog(path, run_id="rot-1", max_bytes=2000, flush_lines=1)
+    for i in range(40):
+        rl.log("episode", episode=i, payload="x" * 50)
+    rl.close()
+    assert os.path.exists(path + ".1")
+    seg1, cur = read_jsonl(path + ".1"), read_jsonl(path)
+    # both segments parse, share the run id, and re-announce the schema
+    assert seg1[0]["event"] == "run_header" and seg1[0]["rotated"] == 0
+    assert cur[0]["event"] == "run_header" and cur[0]["rotated"] >= 1
+    assert cur[0]["run_id"] == "rot-1" == seg1[0]["run_id"]
+    all_eps = [r["episode"] for r in seg1 + cur if r["event"] == "episode"]
+    missing = set(range(40)) - set(all_eps)
+    # rotation may span >2 segments; everything not in the last two must
+    # live in intermediate segments
+    for n in range(2, 10):
+        p = path + f".{n}"
+        if os.path.exists(p):
+            all_eps += [r["episode"] for r in read_jsonl(p)
+                        if r["event"] == "episode"]
+    assert set(all_eps) == set(range(40)), missing
+
+
+def test_jsonl_shim_headerless(tmp_path):
+    """The back-compat JsonlLogger writes NO header and flushes per line
+    (its original crash-safety contract) — but sanitizes now."""
+    from smartcal_tpu.utils import JsonlLogger
+
+    path = tmp_path / "m.jsonl"
+    with JsonlLogger(str(path)) as log:
+        log.log("episode", score=float("nan"))
+    recs = read_jsonl(str(path))
+    assert len(recs) == 1
+    assert recs[0]["event"] == "episode" and recs[0]["score"] is None
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+def test_span_noop_without_runlog():
+    # the inactive path returns ONE shared null context manager: no
+    # allocation, no clock reads — the strict-no-op contract
+    assert obs.span("a") is obs.span("b", tag=1)
+    with obs.span("a"):
+        with obs.span("b"):
+            pass
+
+
+def test_span_nesting_paths(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    with obs.recording(path, flush_lines=1):
+        with obs.span("episode", episode=3):
+            with obs.span("solve", route="fused"):
+                pass
+            with obs.span("influence"):
+                pass
+    spans = [r for r in read_jsonl(path) if r["event"] == "span"]
+    assert [s["path"] for s in spans] == \
+        ["episode/solve", "episode/influence", "episode"]
+    assert spans[0]["route"] == "fused"
+    assert spans[2]["episode"] == 3
+    assert all(s["dur_s"] >= 0 for s in spans)
+
+
+def test_span_records_errors(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    with obs.recording(path, flush_lines=1):
+        with pytest.raises(ValueError):
+            with obs.span("probe"):
+                raise ValueError("tunnel wedged")
+    spans = [r for r in read_jsonl(path) if r["event"] == "span"]
+    assert "tunnel wedged" in spans[0]["error"]
+
+
+def test_span_thread_safety(tmp_path):
+    """Two threads nest independently: per-thread stacks never interleave
+    (the run_pipelined prefetch-worker requirement)."""
+    path = str(tmp_path / "run.jsonl")
+    errs = []
+
+    def worker(name):
+        try:
+            for _ in range(50):
+                with obs.span(name):
+                    with obs.span(name + "_inner") as sp:
+                        assert sp.path == f"{name}/{name}_inner", sp.path
+        except Exception as e:          # surfaced below; threads swallow
+            errs.append(e)
+
+    with obs.recording(path):
+        ts = [threading.Thread(target=worker, args=(f"t{i}",), name=f"t{i}")
+              for i in range(2)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+    assert not errs
+    spans = [r for r in read_jsonl(path) if r["event"] == "span"]
+    assert len(spans) == 200
+    for s in spans:
+        # a cross-thread interleave would produce paths like t0/t1_inner
+        assert s["path"] in (f"{s['thread']}",
+                             f"{s['thread']}/{s['thread']}_inner")
+
+
+# ---------------------------------------------------------------------------
+# Counters / gauges / listeners
+# ---------------------------------------------------------------------------
+
+def test_counters_and_gauges(tmp_path):
+    obs.counter_add("dead", 5)             # inactive -> strict no-op
+    assert obs.counters_snapshot() == {}
+    path = str(tmp_path / "run.jsonl")
+    with obs.recording(path, flush_lines=1):
+        obs.counter_add("solves")
+        obs.counter_add("solves", 2)
+        obs.gauge_set("queue_depth", 3, where="prefetch")
+        obs.flush_counters()
+    recs = read_jsonl(path)
+    gauge = next(r for r in recs if r["event"] == "gauge")
+    assert gauge["name"] == "queue_depth" and gauge["value"] == 3
+    counters = next(r for r in recs if r["event"] == "counters")
+    assert counters["values"]["solves"] == 3.0
+
+
+def test_memory_gauges_none_safe(tmp_path):
+    """CPU devices report no memory_stats — must be a clean 0, no crash,
+    no malformed events."""
+    path = str(tmp_path / "run.jsonl")
+    with obs.recording(path, flush_lines=1):
+        n = obs.log_memory_gauges()
+    assert n == 0 or all("bytes_in_use" in r for r in read_jsonl(path)
+                         if r["event"] == "memory")
+
+
+def test_compile_listener_records_events(tmp_path, monkeypatch):
+    from smartcal_tpu.obs import registry
+
+    path = str(tmp_path / "run.jsonl")
+    assert obs.install_compile_listener()
+    # tiny programs compile in <10ms; drop the log floor so the stream
+    # check exercises the full path (production keeps the floor so the
+    # ~1k sub-ms jaxpr-trace events stay counter-only)
+    monkeypatch.setattr(registry, "COMPILE_LOG_MIN_S", 0.0)
+
+    @jax.jit
+    def f(x):
+        return x * 2 + 1
+
+    with obs.recording(path, flush_lines=1):
+        f(jnp.arange(7) * np.random.randint(1, 9))   # fresh shape -> compile
+        snap = obs.counters_snapshot()
+    recs = [r for r in read_jsonl(path) if r["event"] == "jax_event"]
+    assert recs, "no compile event captured by the jax.monitoring listener"
+    assert all(r["dur_s"] >= 0 for r in recs)
+    assert snap.get("jax_compile_events", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Solver aux-stat plumbing
+# ---------------------------------------------------------------------------
+
+N, K, NF, T, TS = 4, 2, 2, 4, 2
+CFG = solver.SolverConfig(n_stations=N, n_dirs=K, n_poly=2, admm_iters=3,
+                          lbfgs_iters=2, init_iters=2)
+
+
+@pytest.fixture(scope="module")
+def tiny_problem():
+    rng = np.random.default_rng(7)
+    B = N * (N - 1) // 2
+    V = jnp.asarray(rng.normal(size=(NF, T, B, 2, 2, 2)), jnp.float32)
+    C = jnp.asarray(rng.normal(size=(NF, K, T * B, 4, 2)), jnp.float32)
+    freqs = jnp.asarray([1.0e8, 1.1e8], jnp.float32)
+    rho = jnp.asarray([0.5, 1.0], jnp.float32)
+    return V, C, freqs, rho
+
+
+def test_solver_stats_parity_bit_identical(tiny_problem):
+    """collect_stats=True must be PURELY additive: J/Z/residual/sigmas
+    bit-identical to the stats-off solve."""
+    V, C, freqs, rho = tiny_problem
+    # kwargs spelled exactly like RadioBackend.calibrate's call so the
+    # traced-program cache is shared with the backend test (jax keys
+    # jit traces on kwarg presence, not just bound values)
+    off = solver.solve_admm(V, C, freqs, 1.05e8, rho, CFG, n_chunks=TS,
+                            admm_iters=None, collect_stats=False)
+    on = solver.solve_admm(V, C, freqs, 1.05e8, rho, CFG, n_chunks=TS,
+                           admm_iters=None, collect_stats=True)
+    assert off.stats is None
+    for a, b in zip(off[:6], on[:6]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    st = on.stats
+    assert int(st.admm_iters) == CFG.admm_iters
+    assert st.primal_resid.shape == (CFG.admm_iters,)
+    assert np.all(np.asarray(st.primal_resid) > 0)
+    assert st.inner_iters.shape == (CFG.admm_iters,)
+    # every (Nf, Ts) lane runs at least one inner iteration per outer
+    assert np.all(np.asarray(st.inner_iters) >= NF * TS)
+    assert int(st.init_iters) >= NF * TS
+    assert int(st.n_segments) == 1
+
+
+def test_solver_stats_dynamic_iters(tiny_problem):
+    """Traced admm_iters < cfg.admm_iters: trailing stat entries stay 0."""
+    V, C, freqs, rho = tiny_problem
+    res = solver.solve_admm(V, C, freqs, 1.05e8, rho, CFG, n_chunks=TS,
+                            admm_iters=jnp.asarray(2), collect_stats=True)
+    st = res.stats
+    assert int(st.admm_iters) == 2
+    assert float(st.primal_resid[2]) == 0.0
+    assert int(st.inner_iters[2]) == 0
+    # over-config override (out of the <= contract, but the fuzzy env's
+    # fixed maxiter does it): the scatter DROPS the excess entries — no
+    # clamp onto the last slot, and admm_iters reports the true count.
+    # Same compiled program as above (traced operand), so this is free.
+    over = solver.solve_admm(V, C, freqs, 1.05e8, rho, CFG, n_chunks=TS,
+                             admm_iters=jnp.asarray(CFG.admm_iters + 2),
+                             collect_stats=True)
+    assert int(over.stats.admm_iters) == CFG.admm_iters + 2
+    assert over.stats.primal_resid.shape == (CFG.admm_iters,)
+    assert np.all(np.asarray(over.stats.primal_resid) > 0)
+
+
+# Host-segmented stats ride on tests/test_cal_backend.py::
+# test_host_segmented_matches_fused, which already pays the segment-program
+# traces — collect_stats reuses the same compiled segments there.
+
+
+def test_backend_calibrate_logs_solver_event(tmp_path, tiny_problem):
+    """RadioBackend.calibrate with a RunLog active: solve span + solver
+    telemetry event with the route tag; without one: stats stay None."""
+    from types import SimpleNamespace
+
+    from smartcal_tpu.envs import radio
+
+    V, C, freqs, rho = tiny_problem
+    backend = radio.RadioBackend(n_stations=N, n_freqs=NF, n_times=T,
+                                 tdelta=T // TS, n_poly=2,
+                                 admm_iters=CFG.admm_iters,
+                                 lbfgs_iters=CFG.lbfgs_iters,
+                                 init_iters=CFG.init_iters, shard=False)
+    ep = radio.Episode(obs=SimpleNamespace(freqs=freqs), V=V, Ccal=C,
+                       f0=1.05e8, n_dirs=K, snr=0.05)
+    res_quiet = backend.calibrate(ep, rho)
+    assert res_quiet.stats is None
+
+    path = str(tmp_path / "run.jsonl")
+    with obs.recording(path, flush_lines=1):
+        res = backend.calibrate(ep, rho)
+    assert res.stats is not None
+    np.testing.assert_array_equal(np.asarray(res.J),
+                                  np.asarray(res_quiet.J))
+    recs = read_jsonl(path)
+    ev = next(r for r in recs if r["event"] == "solver")
+    assert ev["route"] == "fused"
+    assert ev["admm_iters"] == CFG.admm_iters
+    assert len(ev["primal_resid"]) == CFG.admm_iters
+    assert ev["lbfgs_iters_total"] > 0
+    assert ev["phi_evals_est"] > ev["lbfgs_iters_total"]
+    span = next(r for r in recs if r["event"] == "span")
+    assert span["name"] == "solve" and span["route"] == "fused"
+
+
+def test_linesearch_eval_counts():
+    from smartcal_tpu.ops import lbfgs
+
+    assert lbfgs.linesearch_phi_evals() == 50
+    assert lbfgs.linesearch_phi_evals(vmapped=False) < 50
+    c = lbfgs.solve_eval_counts(8)
+    assert c["value_and_grad_evals"] == 9
+    assert c["phi_evals"] == 8 * 50
+    assert lbfgs.solve_eval_counts(8, use_line_search=False)["phi_evals"] == 0
+
+
+# ---------------------------------------------------------------------------
+# obs_report
+# ---------------------------------------------------------------------------
+
+def write_run(path, scores, t0=1000.0, dt=2.0, spans=(), probes=()):
+    with open(path, "w") as fh:
+        def w(rec):
+            fh.write(json.dumps(rec) + "\n")
+        w({"t": t0, "event": "run_header", "schema": 1, "run_id": "test",
+           "rotated": 0, "host": "h", "pid": 1, "platform": "cpu",
+           "meta": {"entry": "synthetic"}})
+        for i, s in enumerate(scores):
+            w({"t": t0 + dt * i, "event": "episode", "episode": i,
+               "score": s})
+        for name, p, dur in spans:
+            w({"t": t0, "event": "span", "name": name, "path": p,
+               "dur_s": dur, "thread": "MainThread"})
+        for ok in probes:
+            w({"t": t0, "event": "probe", "ok": ok,
+               **({} if ok else {"error": "UNAVAILABLE: tunnel"})})
+
+
+def test_obs_report_learning_verdict(tmp_path):
+    rng = np.random.default_rng(0)
+    up = str(tmp_path / "up.jsonl")
+    flat = str(tmp_path / "flat.jsonl")
+    n = 60
+    write_run(up, list(0.05 * np.arange(n) + rng.normal(0, 0.3, n)))
+    write_run(flat, list(rng.normal(0, 0.3, n)))
+    rep = obs_report.build_report(
+        [obs_report.load_run(up), obs_report.load_run(flat)],
+        n_boot=300, seed=0)
+    verdicts = {r["path"]: r["learning"]["verdict"] for r in rep["runs"]}
+    assert verdicts[up] == "LEARNING"
+    assert verdicts[flat] == "NO TREND"
+    lo, hi = [r for r in rep["runs"] if r["path"] == up][0][
+        "learning"]["slope_ci95"]
+    assert lo > 0 and lo < 0.05 < hi * 1.5
+    # human rendering carries the verdicts
+    text = obs_report.render(rep)
+    assert "LEARNING" in text and "NO TREND" in text
+
+
+def test_obs_report_stage_breakdown_and_probes(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    spans = []
+    for _ in range(4):
+        spans += [("simulate", "episode/simulate", 1.0),
+                  ("solve", "episode/solve", 6.0),
+                  ("influence", "episode/influence", 2.9),
+                  ("episode", "episode", 10.0)]
+    write_run(path, [0.1, 0.2, 0.3], spans=spans,
+              probes=[False] * 3 + [True])
+    run = obs_report.load_run(path)
+    rep = obs_report.build_report([run], n_boot=50)
+    r = rep["runs"][0]
+    agg = r["spans"]
+    assert agg["episode"]["total_s"] == pytest.approx(40.0)
+    assert agg["episode/solve"]["total_s"] == pytest.approx(24.0)
+    # stage total ≈ episode wall: children cover 99% of the episode span
+    assert r["coverage"]["episode"] == pytest.approx(0.99)
+    assert r["probes"] == {"total": 4, "ok": 1, "failed": 3,
+                           "availability": 0.25,
+                           "errors": ["UNAVAILABLE: tunnel"]}
+    text = obs_report.render(rep)
+    assert "chip-probe availability" in text and "1/4 ok" in text
+
+
+def test_obs_report_folds_rotated_segments(tmp_path):
+    base = str(tmp_path / "run.jsonl")
+    write_run(base + ".1", [0.1, 0.2])
+    write_run(base, [0.3, 0.4])
+    run = obs_report.load_run(base)
+    eps, scores = obs_report.episode_series(run["events"])
+    assert len(scores) == 4
+
+
+# ---------------------------------------------------------------------------
+# Driver integration (cheap enet run)
+# ---------------------------------------------------------------------------
+
+def test_train_obs_enet_driver(tmp_path, monkeypatch):
+    """train_fused records header + per-episode events + episode spans +
+    run_end through the shared TrainObs helper."""
+    monkeypatch.chdir(tmp_path)
+    from smartcal_tpu.train.enet_sac import train_fused
+
+    path = str(tmp_path / "run.jsonl")
+    train_fused(episodes=3, steps=2, M=6, N=6, quiet=True, save_every=0,
+                metrics_path=path)
+    recs = read_jsonl(path)
+    assert recs[0]["event"] == "run_header"
+    assert recs[0]["meta"]["entry"] == "enet_sac"
+    eps = [r for r in recs if r["event"] == "episode"]
+    assert [e["episode"] for e in eps] == [0, 1, 2]
+    spans = [r for r in recs if r["event"] == "span"]
+    assert len(spans) == 3 and all(s["name"] == "episode" for s in spans)
+    end = recs[-1]
+    assert end["event"] == "run_end" and end["episodes"] == 3
+    # the run deactivated cleanly
+    assert obs.active() is None

@@ -1,0 +1,341 @@
+"""Aggregate obs run JSONLs into a human/machine report.
+
+Input: one or more RunLog streams (``smartcal_tpu.obs.RunLog`` — train
+drivers' ``--metrics``, ``SMARTCAL_OBS`` bench runs).  Rotated segments
+(``run.jsonl.1`` ...) are picked up automatically when the base path is
+given.  Output sections:
+
+* **Per-stage time breakdown** — span events grouped by nesting path,
+  rendered as a tree with total/mean/count and percent-of-parent, plus a
+  coverage line (sum of a span's direct children vs the span itself: how
+  much of the episode wall time the instrumentation attributes).
+* **Episode throughput** — per run: episodes, wall span, episodes/min,
+  score stats.
+* **Chip-probe availability** — ``probe`` events (bench.probe_backend):
+  ok/fail counts and the recorded errors, the structured record of "the
+  tunnel failed N/N probes" that VERDICT r5 found missing.
+* **Learning-curve verdict** — per run and pooled: least-squares slope of
+  score vs episode with a bootstrap 95% CI (pairs resampling,
+  deterministic seed), and a verdict: LEARNING (CI > 0), REGRESSING
+  (CI < 0), or NO TREND.  This is the "the sweep cannot detect learning"
+  gap: a flat curve and an improving one get different verdicts with
+  quantified confidence.
+
+Usage:
+    python tools/obs_report.py run1.jsonl [run2.jsonl ...] [--json]
+        [--bootstrap 1000] [--seed 0]
+
+stdlib + numpy only — runs anywhere, never touches jax or a device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def load_run(path):
+    """Read one run (base path + rotated siblings) -> dict of events."""
+    paths = sorted(
+        _glob.glob(path + ".[0-9]*"),
+        key=lambda p: int(p.rsplit(".", 1)[1])) + [path]
+    events, bad = [], 0
+    for p in paths:
+        try:
+            fh = open(p)
+        except OSError:
+            continue
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    bad += 1
+    header = next((e for e in events if e.get("event") == "run_header"), {})
+    return {"path": path, "run_id": header.get("run_id", os.path.basename(path)),
+            "header": header, "events": events, "bad_lines": bad}
+
+
+# ---------------------------------------------------------------------------
+# Span aggregation
+# ---------------------------------------------------------------------------
+
+def span_tree(events):
+    """{path: {n, total_s, mean_s}} over all span events."""
+    agg = {}
+    for e in events:
+        if e.get("event") != "span" or "path" not in e:
+            continue
+        d = agg.setdefault(e["path"], {"n": 0, "total_s": 0.0})
+        d["n"] += 1
+        d["total_s"] += float(e.get("dur_s") or 0.0)
+    for d in agg.values():
+        d["mean_s"] = d["total_s"] / max(d["n"], 1)
+    return agg
+
+def children(agg, path):
+    depth = path.count("/") + 1
+    return {p: d for p, d in agg.items()
+            if p.startswith(path + "/") and p.count("/") == depth}
+
+
+def coverage(agg):
+    """{parent_path: fraction of parent time attributed to child spans}."""
+    out = {}
+    for path, d in agg.items():
+        ch = children(agg, path)
+        if ch and d["total_s"] > 0:
+            out[path] = sum(c["total_s"] for c in ch.values()) / d["total_s"]
+    return out
+
+
+def render_spans(agg, out):
+    if not agg:
+        out.append("  (no span events)")
+        return
+    cov = coverage(agg)
+    roots = sorted(p for p in agg if "/" not in p)
+    out.append(f"  {'stage':40s} {'count':>7s} {'total_s':>10s} "
+               f"{'mean_s':>9s} {'%parent':>8s}")
+
+    def walk(path, parent_total):
+        d = agg[path]
+        pct = (100.0 * d["total_s"] / parent_total
+               if parent_total else 100.0)
+        name = "  " * path.count("/") + path.rsplit("/", 1)[-1]
+        line = (f"  {name:40s} {d['n']:>7d} {d['total_s']:>10.3f} "
+                f"{d['mean_s']:>9.4f} {pct:>7.1f}%")
+        if path in cov:
+            line += f"   (children cover {100 * cov[path]:.1f}%)"
+        out.append(line)
+        for ch in sorted(children(agg, path)):
+            walk(ch, d["total_s"])
+
+    for r in roots:
+        walk(r, None)
+
+
+# ---------------------------------------------------------------------------
+# Episodes + learning verdict
+# ---------------------------------------------------------------------------
+
+def episode_series(events):
+    """(episode_idx[], score[]) from episode events, in record order."""
+    eps, scores = [], []
+    for e in events:
+        if e.get("event") != "episode":
+            continue
+        s = e.get("score")
+        if s is None or not np.isfinite(s):
+            continue
+        eps.append(int(e.get("episode", len(eps))))
+        scores.append(float(s))
+    return np.asarray(eps), np.asarray(scores)
+
+
+def throughput(events):
+    ts = [e["t"] for e in events if e.get("event") == "episode" and "t" in e]
+    _, scores = episode_series(events)
+    out = {"episodes": len(ts)}
+    if len(ts) >= 2:
+        wall = max(ts) - min(ts)
+        out["wall_s"] = round(wall, 3)
+        if wall > 0:
+            out["episodes_per_min"] = round(60.0 * (len(ts) - 1) / wall, 3)
+    if scores.size:
+        out["score_mean"] = round(float(scores.mean()), 4)
+        out["score_last10_mean"] = round(float(scores[-10:].mean()), 4)
+    return out
+
+
+def learning_verdict(eps, scores, n_boot=1000, seed=0, alpha=0.05):
+    """Least-squares slope of score vs episode + bootstrap CI verdict.
+
+    Pairs bootstrap: resample (episode, score) pairs with replacement,
+    refit the slope, take the (alpha/2, 1-alpha/2) percentiles.  Verdict
+    LEARNING only when the whole CI is positive — a flat noisy curve's CI
+    straddles 0 and reads NO TREND, which is exactly the distinction the
+    CalibEnv sweep analysis lacked."""
+    n = len(scores)
+    if n < 3 or np.ptp(eps) == 0:
+        return {"verdict": "INSUFFICIENT DATA", "n": int(n)}
+    slope, intercept = np.polyfit(eps, scores, 1)
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, n, size=(int(n_boot), n))
+    slopes = np.empty(int(n_boot))
+    for b, ix in enumerate(idx):
+        x, y = eps[ix], scores[ix]
+        if np.ptp(x) == 0:
+            slopes[b] = 0.0
+            continue
+        slopes[b] = np.polyfit(x, y, 1)[0]
+    lo, hi = np.percentile(slopes, [100 * alpha / 2, 100 * (1 - alpha / 2)])
+    if lo > 0:
+        verdict = "LEARNING"
+    elif hi < 0:
+        verdict = "REGRESSING"
+    else:
+        verdict = "NO TREND"
+    return {"verdict": verdict, "n": int(n), "slope": float(slope),
+            "intercept": float(intercept),
+            "slope_ci95": [float(lo), float(hi)], "bootstrap": int(n_boot)}
+
+
+# ---------------------------------------------------------------------------
+# Probes / solver
+# ---------------------------------------------------------------------------
+
+def probe_summary(events):
+    probes = [e for e in events if e.get("event") == "probe"]
+    if not probes:
+        return None
+    ok = sum(1 for e in probes if e.get("ok"))
+    errors = sorted({str(e.get("error")) for e in probes
+                     if not e.get("ok") and e.get("error")})
+    return {"total": len(probes), "ok": ok, "failed": len(probes) - ok,
+            "availability": round(ok / len(probes), 4), "errors": errors}
+
+
+def solver_summary(events):
+    recs = [e for e in events if e.get("event") == "solver"]
+    if not recs:
+        return None
+    by_route = {}
+    for e in recs:
+        d = by_route.setdefault(e.get("route", "?"),
+                                {"solves": 0, "admm_iters": 0,
+                                 "lbfgs_iters": 0, "segments": 0,
+                                 "final_resid": []})
+        d["solves"] += 1
+        d["admm_iters"] += int(e.get("admm_iters") or 0)
+        d["lbfgs_iters"] += int(e.get("lbfgs_iters_total") or 0)
+        d["segments"] += int(e.get("n_segments") or 0)
+        pr = [v for v in (e.get("primal_resid") or []) if v]
+        if pr:
+            d["final_resid"].append(pr[-1])
+    for d in by_route.values():
+        fr = d.pop("final_resid")
+        if fr:
+            d["final_consensus_resid_mean"] = round(float(np.mean(fr)), 6)
+    return by_route
+
+
+# ---------------------------------------------------------------------------
+# Report
+# ---------------------------------------------------------------------------
+
+def build_report(runs, n_boot=1000, seed=0):
+    report = {"runs": []}
+    all_pairs = []
+    for run in runs:
+        ev = run["events"]
+        eps, scores = episode_series(ev)
+        all_pairs.append((eps, scores))
+        compiles = [e for e in ev if e.get("event") == "jax_event"]
+        spans = span_tree(ev)
+        r = {"path": run["path"], "run_id": run["run_id"],
+             "entry": (run["header"].get("meta") or {}).get("entry"),
+             "platform": run["header"].get("platform"),
+             "bad_lines": run["bad_lines"],
+             "spans": spans,
+             "coverage": coverage(spans),
+             "throughput": throughput(ev),
+             "learning": learning_verdict(eps, scores, n_boot, seed),
+             "probes": probe_summary(ev),
+             "solver": solver_summary(ev),
+             "compile_events": len(compiles),
+             "compile_secs": round(sum(float(e.get("dur_s") or 0)
+                                       for e in compiles), 3)}
+        report["runs"].append(r)
+    if len(runs) > 1:
+        eps = np.concatenate([p[0] for p in all_pairs])
+        scores = np.concatenate([p[1] for p in all_pairs])
+        report["pooled_learning"] = learning_verdict(eps, scores, n_boot,
+                                                     seed)
+    return report
+
+
+def render(report):
+    out = []
+    for r in report["runs"]:
+        out.append(f"== run {r['run_id']}  ({r['path']})")
+        meta = [f"entry={r['entry']}" if r.get("entry") else None,
+                f"platform={r['platform']}" if r.get("platform") else None,
+                f"bad_lines={r['bad_lines']}" if r["bad_lines"] else None]
+        meta = [m for m in meta if m]
+        if meta:
+            out.append("  " + "  ".join(meta))
+        out.append("-- per-stage time breakdown")
+        render_spans(r["spans"], out)
+        out.append("-- episode throughput")
+        if r["throughput"].get("episodes"):
+            out.append("  " + "  ".join(f"{k}={v}" for k, v
+                                        in r["throughput"].items()))
+        else:
+            out.append("  (no episode events)")
+        if r["probes"]:
+            p = r["probes"]
+            out.append("-- chip-probe availability")
+            out.append(f"  {p['ok']}/{p['total']} ok "
+                       f"(availability {100 * p['availability']:.1f}%)")
+            for err in p["errors"]:
+                out.append(f"  failure: {err}")
+        if r["solver"]:
+            out.append("-- solver telemetry")
+            for route, d in sorted(r["solver"].items()):
+                out.append(f"  route={route}  " + "  ".join(
+                    f"{k}={v}" for k, v in d.items()))
+        if r["compile_events"]:
+            out.append(f"-- jax compile: {r['compile_events']} events, "
+                       f"{r['compile_secs']} s")
+        lv = r["learning"]
+        out.append("-- learning-curve verdict")
+        if "slope" in lv:
+            lo, hi = lv["slope_ci95"]
+            out.append(f"  {lv['verdict']}  slope={lv['slope']:.5g} "
+                       f"per episode, 95% CI [{lo:.5g}, {hi:.5g}] "
+                       f"(n={lv['n']}, bootstrap={lv['bootstrap']})")
+        else:
+            out.append(f"  {lv['verdict']} (n={lv.get('n', 0)})")
+        out.append("")
+    if "pooled_learning" in report:
+        lv = report["pooled_learning"]
+        if "slope" in lv:
+            lo, hi = lv["slope_ci95"]
+            out.append(f"== pooled ({len(report['runs'])} runs): "
+                       f"{lv['verdict']}  slope={lv['slope']:.5g}, "
+                       f"95% CI [{lo:.5g}, {hi:.5g}] (n={lv['n']})")
+        else:
+            out.append(f"== pooled: {lv['verdict']}")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("paths", nargs="+", help="run JSONL path(s); rotated "
+                   "segments <path>.N are folded in automatically")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full report as one JSON document")
+    p.add_argument("--bootstrap", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    runs = [load_run(path) for path in args.paths]
+    report = build_report(runs, n_boot=args.bootstrap, seed=args.seed)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render(report))
+    return report
+
+
+if __name__ == "__main__":
+    main()
