@@ -40,6 +40,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from smartcal_tpu import obs
+# costs imported under its own name: several backend methods take the
+# Observation as a parameter named ``obs``, shadowing the package module
+from smartcal_tpu.obs import costs as obs_costs
 from smartcal_tpu.cal import (coherency, imager, influence, observation,
                               simulate, solver)
 
@@ -146,6 +149,15 @@ class RadioBackend:
             # on device — no np.asarray(V) host sync mid-construction
             V = solver.simulate_vis_multi_sr(jnp.asarray(Jsim), Csim,
                                              self.n_stations, self.n_chunks)
+            # defer=True: this runs inside the simulate/episode spans —
+            # the one-time AOT cost analysis must not inflate the very
+            # span totals the roofline divides by (flushed between
+            # episodes by TrainObs)
+            obs_costs.record_stage_cost(
+                "simulate", solver.simulate_vis_multi_sr,
+                jnp.asarray(Jsim), Csim,
+                static_argnames=("n_stations", "Ts"), defer=True,
+                n_stations=self.n_stations, Ts=self.n_chunks)
             Vn, _ = simulate.add_noise_device(key, V, snr=snr)
             return Vn
         V = jnp.stack([
@@ -404,6 +416,17 @@ class RadioBackend:
                     self._solver_cfg(ep.n_dirs), n_chunks=self.n_chunks,
                     admm_iters=None if admm_iters is None
                     else jnp.asarray(admm_iters), collect_stats=collect)
+            # per-compile FLOPs/bytes accounting (no-op unless --diag
+            # armed it; cached per shape signature).  HLO counts the
+            # while_loop body once, so this is the roofline FLOOR — the
+            # per-iteration truth stays with solver.cost_eval_flops.
+            obs_costs.record_stage_cost(
+                "solve", solver.solve_admm, ep.V, C, ep.obs.freqs, ep.f0,
+                jnp.asarray(rho), self._solver_cfg(ep.n_dirs),
+                defer=True,          # still inside the env step span
+                n_chunks=self.n_chunks,
+                admm_iters=None if admm_iters is None
+                else jnp.asarray(admm_iters), collect_stats=collect)
             return self._log_solve(res, "fused")
         return solver.solve_admm(
             ep.V, C, ep.obs.freqs, ep.f0, jnp.asarray(rho),
@@ -544,6 +567,13 @@ class RadioBackend:
         imgs = influence.influence_images_multi(
             result.residual, ep.Ccal, result.J, hadd_all, ep.obs.freqs,
             uvw, cell, self.n_stations, self.n_chunks, npix)
+        obs_costs.record_stage_cost(
+            "influence", influence.influence_images_multi,
+            result.residual, ep.Ccal, result.J, hadd_all, ep.obs.freqs,
+            uvw, static_argnames=("cell", "n_stations", "n_chunks", "npix"),
+            defer=True,              # inside the influence span
+            cell=cell, n_stations=self.n_stations, n_chunks=self.n_chunks,
+            npix=npix)
         return jnp.mean(imgs, axis=0)
 
     def _influence_image_chunk_sharded(self, ep, result, hadd_all, uvw,

@@ -1,0 +1,199 @@
+"""FLOPs/bytes accounting for the jitted hot paths + roofline peaks.
+
+``record_stage_cost(stage, fn, *args)`` lowers the EXACT jitted callable
+a hot path is about to run (shape-only — ``.lower(...).compile()
+.cost_analysis()``, the same machinery as ``cal.solver.cost_eval_flops``)
+and logs ONE ``cost`` event with the XLA-counted flops and bytes
+accessed.  Results are cached per (stage, abstract-signature), so a
+training run pays the accounting once per compiled program, not per
+step — the dynamic factor (how many times the program runs) comes from
+the span stream, and ``tools/obs_report.py`` joins the two into the
+per-stage achieved-FLOPs/s roofline table.
+
+Known caveat, inherited from HLO cost analysis itself: a ``while_loop``
+body is counted ONCE, so loop-dominated programs (the fused ADMM solve)
+under-report; the numbers are roofline *floors*, and the solver's
+per-iteration truth stays with ``cost_eval_flops``.  The report labels
+them accordingly.
+
+Collection is OFF by default (``set_enabled``) — an AOT lower+compile is
+not free, and must never sneak into a timed region of a run that didn't
+ask for it; the train drivers enable it under ``--diag``.  Call sites
+that sit INSIDE a timed ``obs.span`` region pass ``defer=True``: the
+(deduped) work is queued and executed by ``flush_pending()``, which
+``TrainObs`` calls between episodes and at close — so the compile never
+inflates the very span totals the roofline report divides by.
+
+Reads jax lazily from ``sys.modules`` (the package contract: importing
+``smartcal_tpu.obs`` never initializes an accelerator backend).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+from .runlog import active
+
+_lock = threading.Lock()
+_enabled = False
+_cache: dict = {}      # (stage, signature) -> result dict
+_pending: list = []    # deferred (sig, stage, fn, args, statics, kwargs)
+
+# Peak FLOPs/s by device kind (substring-matched against jax's
+# ``device_kind``/``str(device)``, e.g. "TPU v5 lite") — the chip-probe
+# reference obs_report quotes fraction-of-peak against (v5e numbers,
+# matching bench.py's MFU refs: bf16 systolic peak and the ~4x-lower
+# fp32 estimate the split-real solver actually contends with).  CPU and
+# unrecognized TPU generations have no entry: claiming the wrong chip's
+# peak would silently mis-scale fraction-of-peak, so the report degrades
+# to dashes instead.
+PEAK_FLOPS = {
+    "v5 lite": {"bf16": 197e12, "fp32_est": 49e12, "chip": "v5e"},
+    "v5e": {"bf16": 197e12, "fp32_est": 49e12, "chip": "v5e"},
+}
+
+
+def set_enabled(on: bool) -> None:
+    """Globally arm/disarm cost recording (drivers: ``--diag``)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset_cache() -> None:
+    with _lock:
+        _cache.clear()
+        _pending.clear()
+
+
+def _signature(args, kwargs) -> str:
+    """Hashable abstract signature: leaf shapes/dtypes, statics by repr."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        sig.append(f"{shape}:{dtype}" if shape is not None else repr(leaf))
+    return str(treedef) + "|" + ";".join(sig)
+
+
+def stage_cost(fn, *args, static_argnames=(), **kwargs) -> dict:
+    """XLA cost analysis of ``fn(*args, **kwargs)``: ``{"flops": ...,
+    "bytes_accessed": ...}`` (floats; absent metrics -> 0.0).
+
+    ``fn`` may already be jit-wrapped (used as-is, sharing its trace
+    cache) or a plain traceable callable (wrapped here, with
+    ``static_argnames`` forwarded).
+    """
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        raise RuntimeError("jax not imported")
+    jitted = fn if hasattr(fn, "lower") else \
+        jax_mod.jit(fn, static_argnames=static_argnames)
+    compiled = jitted.lower(*args, **kwargs).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):        # older jax returns [dict]
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    return {"flops": float(ca.get("flops", 0.0) or 0.0),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0) or 0.0)}
+
+
+def _compute_and_log(stage, fn, args, static_argnames, kwargs) -> dict:
+    rl = active()
+    try:
+        cost = stage_cost(fn, *args, static_argnames=static_argnames,
+                          **kwargs)
+    except Exception as e:  # noqa: BLE001 — never kill the observed run
+        cost = {"error": f"{type(e).__name__}: {e}"}
+    if rl is not None:
+        rl.log("cost", stage=stage, **cost)
+    return cost
+
+
+def record_stage_cost(stage: str, fn, *args, static_argnames=(),
+                      defer: bool = False, **kwargs):
+    """Log the ``cost`` event for ``stage`` once per abstract signature.
+
+    Strict no-op unless BOTH a RunLog is active and collection is
+    enabled.  Failures are recorded (``cost`` event with ``error``) and
+    negatively cached — accounting must never kill or repeatedly slow
+    the run being observed.  ``defer=True`` (for call sites inside a
+    timed span) queues the lower+compile for ``flush_pending()`` instead
+    of paying it here.  Returns the cached cost dict or None (always
+    None for a just-deferred signature).
+    """
+    rl = active()
+    if rl is None or not _enabled:
+        return None
+    try:
+        sig = (stage, _signature(args, kwargs))
+    except Exception:
+        sig = (stage, repr((len(args), sorted(kwargs))))
+    with _lock:
+        if sig in _cache:
+            return _cache[sig]
+        _cache[sig] = None               # claim: concurrent callers skip
+        if defer:
+            _pending.append((sig, stage, fn, args, static_argnames,
+                             kwargs))
+            return None
+    cost = _compute_and_log(stage, fn, args, static_argnames, kwargs)
+    with _lock:
+        _cache[sig] = cost
+    return cost
+
+
+def flush_pending() -> int:
+    """Run the deferred cost analyses (call OUTSIDE any timed span —
+    ``TrainObs`` does, between episodes and at close).  Returns how many
+    were processed; cheap no-op when nothing is queued."""
+    n = 0
+    while True:
+        with _lock:
+            if not _pending:
+                return n
+            sig, stage, fn, args, statics, kwargs = _pending.pop(0)
+        cost = _compute_and_log(stage, fn, args, statics, kwargs)
+        with _lock:
+            _cache[sig] = cost
+        n += 1
+
+
+def device_peak() -> dict | None:
+    """Peak-FLOPs reference for the current device, or None (CPU,
+    unrecognized chip generation, jax not imported, probe failure)."""
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return None
+    try:
+        dev = jax_mod.devices()[0]
+        platform = dev.platform
+    except Exception:
+        return None
+    kind = str(getattr(dev, "device_kind", "") or "")
+    probe = f"{kind} {dev}".lower()
+    for sub, peak in PEAK_FLOPS.items():
+        if sub in probe:
+            return {"platform": platform, "device_kind": kind or None,
+                    **peak}
+    return None
+
+
+def log_roofline_peak() -> dict | None:
+    """Record one ``roofline_peak`` event (the report's fraction-of-peak
+    denominator) when the platform has a known peak; None-safe no-op
+    otherwise."""
+    rl = active()
+    if rl is None:
+        return None
+    peak = device_peak()
+    if peak is not None:
+        rl.log("roofline_peak", **peak)
+    return peak

@@ -1,0 +1,131 @@
+"""Jit-safe training-internals diagnostics: the ``UpdateDiag`` pytree.
+
+The RL update steps (``rl/ddpg.py``, ``rl/td3.py``, ``rl/sac.py``,
+``rl/sac_discrete.py``) optionally thread an :class:`UpdateDiag` out of
+the jitted learn step — the same ``collect_stats=`` pattern as
+``cal.solver.solve_admm``: with ``collect_diag=False`` the traced program
+is the EXACT pre-diagnostics computation (bit-identical outputs, asserted
+by tests/test_diagnostics.py); with ``True`` the step additionally
+returns per-update health scalars computed from intermediates the update
+already holds (gradients, Q batches, fresh/target params).  Everything is
+a scalar, so the pytree costs nothing against the update itself and scans
+/ stacks cleanly.
+
+Quantities (all () float32 unless noted):
+
+* ``critic_loss`` / ``actor_loss`` — the step's losses (actor 0 on
+  TD3's delayed-update skip steps);
+* ``critic_grad_norm`` / ``actor_grad_norm`` — global (all-leaf) L2
+  gradient norms, THE divergence leading indicator;
+* ``critic_update_ratio`` / ``actor_update_ratio`` — ||update|| /
+  ||params||: the effective step size Adam actually took (a healthy run
+  sits around 1e-3; a collapse to 0 or jump toward 1 is pathological);
+* ``q_mean`` / ``q_min`` / ``q_max`` — critic value batch statistics
+  (Q blowup shows here before the loss goes non-finite);
+* ``target_drift`` — global L2 norm of (critic - target critic): how far
+  the Polyak target trails, in parameter space;
+* ``alpha`` / ``entropy`` — SAC temperature and policy entropy estimate
+  (-mean log pi); 0 where the agent has neither;
+* ``hint_residual`` — mean squared actor-hint mismatch for the
+  hint-constrained updates (the ADMM constraint residual); 0 otherwise.
+
+The module reads jax lazily (inside functions, from the caller's already-
+imported jax) so that importing ``smartcal_tpu.obs`` keeps its contract
+of never touching an accelerator backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+
+class UpdateDiag(NamedTuple):
+    """Per-update diagnostics pytree (all scalar leaves; see module doc)."""
+
+    critic_loss: Any
+    actor_loss: Any
+    critic_grad_norm: Any
+    actor_grad_norm: Any
+    critic_update_ratio: Any
+    actor_update_ratio: Any
+    q_mean: Any
+    q_min: Any
+    q_max: Any
+    target_drift: Any
+    alpha: Any
+    entropy: Any
+    hint_residual: Any
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def tree_norm(tree):
+    """Global L2 norm over every leaf of ``tree`` (0.0 for empty trees)."""
+    import jax
+    jnp = _jnp()
+    sq = [jnp.sum(jnp.square(leaf)) for leaf in jax.tree_util.tree_leaves(tree)]
+    if not sq:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.sqrt(sum(sq))
+
+
+def update_ratio(update_tree, param_tree, eps: float = 1e-12):
+    """||update|| / ||params|| — the relative step the optimizer took."""
+    return tree_norm(update_tree) / (tree_norm(param_tree) + eps)
+
+
+def target_drift(params, target_params):
+    """Global L2 norm of (params - target_params)."""
+    import jax
+    diff = jax.tree_util.tree_map(lambda a, b: a - b, params, target_params)
+    return tree_norm(diff)
+
+
+def make_diag(**fields) -> UpdateDiag:
+    """Build an :class:`UpdateDiag`, defaulting unset fields to 0.0 —
+    agents fill what they have (DDPG has no alpha, TD3's skip steps have
+    no actor update, ...)."""
+    jnp = _jnp()
+    zero = jnp.asarray(0.0, jnp.float32)
+    vals = {k: zero for k in UpdateDiag._fields}
+    for k, v in fields.items():
+        if k not in vals:
+            raise TypeError(f"unknown UpdateDiag field {k!r}")
+        vals[k] = jnp.asarray(v, jnp.float32)
+    return UpdateDiag(**vals)
+
+
+def zero_diag() -> UpdateDiag:
+    """The no-learn branch's diag (lax.cond needs matching structures)."""
+    return make_diag()
+
+
+def diag_to_host(diag: UpdateDiag) -> dict:
+    """One device->host transfer of a (possibly step-stacked) UpdateDiag
+    into ``{field: float | [float, ...]}`` — the watchdog/RunLog form.
+    Called only when diagnostics are on; NaN/Inf survive as-is here (the
+    RunLog sanitizes to null at serialization, the watchdog checks
+    finiteness BEFORE that happens)."""
+    import jax
+    host = jax.device_get(diag)
+    out = {}
+    for k, v in zip(UpdateDiag._fields, host):
+        arr = getattr(v, "tolist", lambda: v)()
+        out[k] = arr
+    return out
+
+
+def diag_steps(host_diag: dict):
+    """Iterate a ``diag_to_host`` dict as per-step dicts.  Scalar fields
+    (an unstacked single update) yield exactly one step."""
+    first = next(iter(host_diag.values()))
+    if not isinstance(first, list):
+        yield dict(host_diag)
+        return
+    n = len(first)
+    for i in range(n):
+        yield {k: (v[i] if isinstance(v, list) else v)
+               for k, v in host_diag.items()}

@@ -44,7 +44,18 @@ import threading
 import time
 from typing import Optional
 
-SCHEMA_VERSION = 1
+# Schema history (the header's ``schema`` field; readers should accept
+# >= their known version — every bump so far is purely additive):
+#
+# 1 — run_header / episode / span / solver / gauge / counters / memory /
+#     jax_event / probe / log / result / multihost / run_end.
+# 2 — training-internals telemetry: ``diag`` (per-update UpdateDiag
+#     scalars, obs/diagnostics.py), ``replay_health`` (PER distribution
+#     summary, rl.replay.replay_health), ``watchdog_trip`` (divergence
+#     watchdog with ring-buffer context, obs/watchdog.py), ``cost``
+#     (per-stage XLA flops/bytes, obs/costs.py) and ``roofline_peak``
+#     (the fraction-of-peak denominator).
+SCHEMA_VERSION = 2
 
 
 def _gen_run_id() -> str:

@@ -279,7 +279,7 @@ def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
                             K=4, backend=None, provide_influence=False,
                             agent_kwargs=None, quiet=False,
                             rollout_epochs=2, rollout_steps=5,
-                            metrics=None):
+                            metrics=None, diag=False, watchdog=False):
     """Host driver (run_process + Learner.run_episodes parity,
     distributed_per_sac.py:193-229)."""
     import time
@@ -307,7 +307,8 @@ def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
     scores = []
     n_trans = n_actors * rollout_epochs * rollout_steps
     tob = train_obs("demix_learner", metrics=metrics, quiet=quiet,
-                    seed=seed, n_actors=n_actors, K=K)
+                    diag=diag, watchdog=watchdog, seed=seed,
+                    n_actors=n_actors, K=K)
     try:
         for ep in range(episodes):
             key, kw, kr = jax.random.split(key, 3)
@@ -321,12 +322,22 @@ def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
             scores.append(score)
             obs.gauge_set("actor_transitions_per_s",
                           round(n_trans / max(wall, 1e-9), 2))
+            # PER distribution health next to the staleness gauge
+            # (see parallel/learner.py); --diag-gated, feeds the watchdog
+            tripped = False
+            if tob.collect_diag:
+                tripped = tob.record_diag(
+                    {"critic_loss": float(metrics_out["critic_loss"])},
+                    episode=ep)
+            tripped = tob.log_replay_health(st.buf, episode=ep) or tripped
             # echo=False: keep the reference driver's own wording below
             tob.episode(ep, score, scores, echo=False, transitions=n_trans,
                         weight_staleness_steps=rollout_epochs
                         * rollout_steps)
             tob.echo(f"episode {ep} mean reward {scores[-1]:.4f}",
                      event=None)
+            if tripped:
+                break
     finally:
         tob.close()
     return st, scores
@@ -359,7 +370,7 @@ def main(argv=None):
                    help="episodes per actor per learner episode")
     p.add_argument("--rollout_steps", type=int, default=5)
     from smartcal_tpu import obs
-    from smartcal_tpu.train.blocks import add_obs_args
+    from smartcal_tpu.train.blocks import add_obs_args, diag_from_args
 
     add_obs_args(p)
     multihost.add_cli_args(p)
@@ -380,7 +391,9 @@ def main(argv=None):
         provide_influence=args.provide_influence,
         rollout_epochs=args.rollout_epochs,
         rollout_steps=args.rollout_steps,
-        quiet=args.quiet, metrics=args.metrics)
+        quiet=args.quiet, metrics=args.metrics,
+        diag=diag_from_args(args),
+        watchdog=getattr(args, "watchdog", False))
     return scores
 
 

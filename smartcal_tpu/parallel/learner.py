@@ -159,7 +159,8 @@ def make_distributed_per_sac(env_cfg: enet.EnetConfig,
 def train_distributed(seed=0, episodes=100, n_actors=None, mesh=None,
                       env_kwargs=None, agent_kwargs=None, use_hint=False,
                       learn_per_transition=False, quiet=False,
-                      rollout_epochs=10, rollout_steps=10, metrics=None):
+                      rollout_epochs=10, rollout_steps=10, metrics=None,
+                      diag=False, watchdog=False):
     """Host driver mirroring ``run_process`` + ``Learner.run_episodes``
     (distributed_per_sac.py:60-82, :154-174).
 
@@ -194,7 +195,8 @@ def train_distributed(seed=0, episodes=100, n_actors=None, mesh=None,
     scores = []
     n_trans = n_actors * rollout_epochs * rollout_steps
     tob = train_obs("parallel_learner", metrics=metrics, quiet=quiet,
-                    seed=seed, n_actors=n_actors)
+                    diag=diag, watchdog=watchdog, seed=seed,
+                    n_actors=n_actors)
     try:
         for ep in range(episodes):
             key, k = jax.random.split(key)
@@ -206,12 +208,27 @@ def train_distributed(seed=0, episodes=100, n_actors=None, mesh=None,
             scores.append(score)
             obs.gauge_set("actor_transitions_per_s",
                           round(n_trans / max(wall, 1e-9), 2))
+            # PER distribution health next to the staleness gauge — the
+            # Actor-PER signal pair (priority entropy vs weight
+            # staleness) for the learner/actor split; --diag-gated like
+            # every other replay_health producer
+            tripped = False
+            if tob.collect_diag:
+                # the SPMD update surfaces only the episode's last
+                # critic loss on host — enough for the watchdog's
+                # non-finite (diverged-critic) check
+                tripped = tob.record_diag(
+                    {"critic_loss": float(metrics_out["critic_loss"])},
+                    episode=ep)
+            tripped = tob.log_replay_health(st.buf, episode=ep) or tripped
             # echo=False: keep the reference driver's own wording below
             tob.episode(ep, score, scores, echo=False, transitions=n_trans,
                         weight_staleness_steps=rollout_epochs
                         * rollout_steps)
             tob.echo(f"episode {ep} mean reward {scores[-1]:.4f}",
                      event=None)
+            if tripped:
+                break
     finally:
         tob.close()
     return st, scores
@@ -232,7 +249,7 @@ def main(argv=None):
     from . import multihost
 
     from smartcal_tpu import obs
-    from smartcal_tpu.train.blocks import add_obs_args
+    from smartcal_tpu.train.blocks import add_obs_args, diag_from_args
 
     p = argparse.ArgumentParser(description=main.__doc__)
     p.add_argument("--seed", type=int, default=0)
@@ -250,7 +267,9 @@ def main(argv=None):
         seed=args.seed, episodes=args.episodes, n_actors=args.actors,
         use_hint=args.use_hint,
         learn_per_transition=args.learn_per_transition,
-        quiet=args.quiet, metrics=args.metrics)
+        quiet=args.quiet, metrics=args.metrics,
+        diag=diag_from_args(args),
+        watchdog=getattr(args, "watchdog", False))
     return scores
 
 

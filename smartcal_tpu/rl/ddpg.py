@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from ..obs import diagnostics as dg
 from . import replay as rp
 from .networks import (MLPCritic, MLPDeterministicActor,
                        SplitImageMetaCritic,
@@ -107,8 +108,13 @@ def choose_action(cfg: DDPGConfig, st: DDPGState, obs, key
 
 
 def learn(cfg: DDPGConfig, st: DDPGState, buf: rp.ReplayState,
-          key) -> Tuple[DDPGState, rp.ReplayState, dict]:
-    """One DDPG learn step (enet_ddpg.py:251-302)."""
+          key, collect_diag: bool = False
+          ) -> Tuple[DDPGState, rp.ReplayState, dict]:
+    """One DDPG learn step (enet_ddpg.py:251-302).
+
+    ``collect_diag`` (python-static) adds ``metrics['diag']`` — an
+    :class:`~smartcal_tpu.obs.diagnostics.UpdateDiag`; with it False the
+    traced program is the exact pre-diagnostics computation."""
     actor, critic = _nets(cfg)
     opt_a, opt_c = optax.adam(cfg.lr_a), optax.adam(cfg.lr_c)
 
@@ -129,6 +135,11 @@ def learn(cfg: DDPGConfig, st: DDPGState, buf: rp.ReplayState,
             return jnp.sum((q - y) ** 2)  # T.norm(.,2)**2 — summed
 
         closs, gc = jax.value_and_grad(critic_loss)(st.critic_params)
+        # q stats recomputed OUTSIDE the grad: auxing q out of the loss
+        # would change the AD graph (and bit-drift the update); a separate
+        # forward is deterministic and CSE-dedupes under jit
+        q_batch = (critic.apply({"params": st.critic_params}, s, a)
+                   if collect_diag else None)
         uc, critic_opt = opt_c.update(gc, st.critic_opt, st.critic_params)
         critic_params = optax.apply_updates(st.critic_params, uc)
 
@@ -147,12 +158,27 @@ def learn(cfg: DDPGConfig, st: DDPGState, buf: rp.ReplayState,
             t_actor_params=lerp(st.t_actor_params, actor_params),
             t_critic_params=lerp(st.t_critic_params, critic_params),
             actor_opt=actor_opt, critic_opt=critic_opt, noise=st.noise)
-        return st_new, buf, {"critic_loss": closs, "actor_loss": aloss}
+        metrics = {"critic_loss": closs, "actor_loss": aloss}
+        if collect_diag:
+            metrics["diag"] = dg.make_diag(
+                critic_loss=closs, actor_loss=aloss,
+                critic_grad_norm=dg.tree_norm(gc),
+                actor_grad_norm=dg.tree_norm(ga),
+                critic_update_ratio=dg.update_ratio(uc, st.critic_params),
+                actor_update_ratio=dg.update_ratio(ua, st.actor_params),
+                q_mean=jnp.mean(q_batch), q_min=jnp.min(q_batch),
+                q_max=jnp.max(q_batch),
+                target_drift=dg.target_drift(critic_params,
+                                             st_new.t_critic_params))
+        return st_new, buf, metrics
 
     def no_learn(args):
         st, buf, _ = args
-        return st, buf, {"critic_loss": jnp.asarray(0.0),
-                         "actor_loss": jnp.asarray(0.0)}
+        zeros = {"critic_loss": jnp.asarray(0.0),
+                 "actor_loss": jnp.asarray(0.0)}
+        if collect_diag:
+            zeros["diag"] = dg.zero_diag()
+        return st, buf, zeros
 
     return lax.cond(buf.cntr >= cfg.batch_size, do_learn, no_learn,
                     (st, buf, key))
@@ -161,7 +187,8 @@ def learn(cfg: DDPGConfig, st: DDPGState, buf: rp.ReplayState,
 class DDPGAgent:
     """Host-driven wrapper with the reference Agent API."""
 
-    def __init__(self, cfg: DDPGConfig, seed: int = 0, name_prefix: str = ""):
+    def __init__(self, cfg: DDPGConfig, seed: int = 0, name_prefix: str = "",
+                 collect_diag: bool = False):
         self.cfg = cfg
         self.key = jax.random.PRNGKey(seed)
         self.key, k0 = jax.random.split(self.key)
@@ -169,11 +196,15 @@ class DDPGAgent:
         self.buffer = rp.replay_init(
             cfg.mem_size, rp.transition_spec(cfg.obs_dim, cfg.n_actions))
         self.name_prefix = name_prefix
+        self.collect_diag = collect_diag
         self._choose = jax.jit(
             lambda st, obs, key: choose_action(cfg, st, obs, key))
-        self._learn = jax.jit(lambda st, buf, key: learn(cfg, st, buf, key))
+        self._learn = jax.jit(lambda st, buf, key: learn(
+            cfg, st, buf, key, collect_diag=collect_diag))
         self._add = jax.jit(
             lambda buf, tr: rp.replay_add(buf, tr, priority=jnp.asarray(1.0)))
+        self.last_metrics = {}
+        self.last_diag = None
 
     def _next_key(self):
         self.key, sub = jax.random.split(self.key)
@@ -193,8 +224,19 @@ class DDPGAgent:
         self.buffer = self._add(self.buffer, tr)
 
     def learn(self):
-        self.state, self.buffer, _ = self._learn(self.state, self.buffer,
-                                                 self._next_key())
+        from smartcal_tpu.obs import costs
+        from smartcal_tpu.obs.spans import span
+
+        k = self._next_key()
+        # span name == cost stage ('/'-free) -> obs_report roofline join;
+        # cost analysis after the span (see td3.TD3Agent.learn)
+        with span("agent_update_ddpg"):
+            self.state, self.buffer, m = self._learn(self.state,
+                                                     self.buffer, k)
+        costs.record_stage_cost("agent_update_ddpg", self._learn,
+                                self.state, self.buffer, k, defer=True)
+        self.last_metrics = m
+        self.last_diag = m.pop("diag", None)
 
     def save_models(self, prefix: Optional[str] = None):
         prefix = prefix if prefix is not None else self.name_prefix

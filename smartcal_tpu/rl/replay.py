@@ -22,6 +22,7 @@ the same machinery with extra keys.
 
 from __future__ import annotations
 
+import math
 import pickle
 from typing import NamedTuple, Optional
 
@@ -205,6 +206,68 @@ def per_mse(expected, targets, is_weights):
     td = expected - targets
     w = is_weights.reshape(is_weights.shape + (1,) * (td.ndim - 1))
     return jnp.sum(w * td * td) / td.size
+
+
+def _health_from_arrays(p, cntr: int, size: int, beta: float,
+                        n_age_bins: int = 4) -> dict:
+    """Shared replay-health math over a host priority array (the filled
+    prefix); see :func:`replay_health` for the field meanings."""
+    filled = int(min(cntr, size))
+    out = {"filled": filled, "cntr": int(cntr), "size": int(size),
+           "beta": float(beta)}
+    if filled == 0:
+        return out
+    p = np.asarray(p[:filled], np.float64)
+    total = float(p.sum())
+    out["priority_total"] = total
+    out["priority_max"] = float(p.max())
+    if total <= 0.0:
+        # degenerate all-zero distribution (the pmax-fallback edge the
+        # first store repairs); entropy/weights are undefined — report
+        # the collapse explicitly instead of dividing by zero
+        out["priority_entropy"] = 0.0
+        out["max_mean_priority_ratio"] = 0.0
+        return out
+    probs = p / total
+    nz = probs[probs > 0]
+    h = float(-(nz * np.log(nz)).sum())
+    # normalized to [0, 1]: 1 = uniform sampling, ->0 = a handful of
+    # transitions own the whole priority mass (Actor-PER's collapse axis)
+    out["priority_entropy"] = (h / math.log(filled) if filled > 1 else 1.0)
+    out["max_mean_priority_ratio"] = float(p.max() / p.mean())
+    # IS-weight extremes at the CURRENT beta (unnormalized, filled*prob
+    # form): their ratio is the spread the per_mse weighting must absorb
+    w = (filled * np.maximum(probs, 1e-12)) ** (-float(beta))
+    out["is_weight_min"] = float(w.min())
+    out["is_weight_max"] = float(w.max())
+    # sample-age profile: slot i was written at the latest t < cntr with
+    # t % size == i, so age = (cntr - 1 - i) mod size — and the
+    # priority-weighted mean age vs the uniform mean exposes age skew
+    # (stale transitions hoarding priority mass)
+    ages = (int(cntr) - 1 - np.arange(filled)) % max(size, 1)
+    out["age_mean_uniform"] = float(ages.mean())
+    out["age_mean_weighted"] = float((probs * ages).sum())
+    edges = np.linspace(0, max(float(ages.max()), 1.0), n_age_bins + 1)
+    which = np.minimum(np.searchsorted(edges, ages, side="right") - 1,
+                       n_age_bins - 1)
+    out["age_priority_hist"] = [round(float(probs[which == b].sum()), 6)
+                                for b in range(n_age_bins)]
+    return out
+
+
+def replay_health(buf: ReplayState) -> dict:
+    """Host-side PER/replay distribution summary for telemetry.
+
+    One device->host pull of the priority vector (call at train-block
+    cadence, not per step).  Fields: ``priority_entropy`` (normalized,
+    1 = uniform), ``max_mean_priority_ratio``, ``is_weight_min/max`` at
+    the current beta, ``beta``, fill counters, and a sample-age profile —
+    uniform vs priority-weighted mean age plus ``age_priority_hist``
+    (priority mass per age quartile, young to old).  Uniform buffers
+    report trivially healthy numbers (entropy 1, ratio 1)."""
+    return _health_from_arrays(np.asarray(jax.device_get(buf.priority)),
+                               int(jax.device_get(buf.cntr)), buf.size,
+                               float(jax.device_get(buf.beta)))
 
 
 def save_replay(buf: ReplayState, path: str) -> None:

@@ -133,6 +133,14 @@ class NativePER:
         self.tree.update_batch(np.asarray(idx, np.int64),
                                clipped ** PER_ALPHA)
 
+    def health(self) -> dict:
+        """Same replay-health summary as ``replay.replay_health`` (shared
+        math, host tree leaves — no device involved)."""
+        from smartcal_tpu.rl.replay import _health_from_arrays
+
+        return _health_from_arrays(self.tree.leaves(), self.cntr,
+                                   self.size, self.beta)
+
     # -- checkpoint -------------------------------------------------------
     def save(self, path: str) -> None:
         state = {

@@ -34,6 +34,7 @@ import numpy as np
 import optax
 from jax import lax
 
+from ..obs import diagnostics as dg
 from . import replay as rp
 from .networks import (MLPActor, MLPCritic, SplitImageMetaActor,
                        SplitImageMetaCritic, gaussian_sample)
@@ -183,7 +184,8 @@ def _hint_gap(cfg: SACConfig, actions, hints):
 
 
 def learn_from_batch(cfg: SACConfig, st: SACState, batch: dict, is_w,
-                     key) -> Tuple[SACState, dict]:
+                     key, collect_diag: bool = False
+                     ) -> Tuple[SACState, dict]:
     """The SAC learn core on an ALREADY-SAMPLED batch.
 
     The integration point for external replay backends (the host-side
@@ -192,6 +194,13 @@ def learn_from_batch(cfg: SACConfig, st: SACState, batch: dict, is_w,
     priorities live, run this jitted core, then push ``metrics['td']``
     (|Q1 - y| per transition) back into their priority store.
     :func:`learn` wraps it with the fused HBM replay sample/update.
+
+    ``collect_diag`` (python-static, same contract as the solver's
+    ``collect_stats``) additionally returns ``metrics['diag']`` — an
+    :class:`~smartcal_tpu.obs.diagnostics.UpdateDiag` of per-update
+    health scalars computed from intermediates the step already holds.
+    With it False the traced program is the exact pre-diagnostics
+    computation (bit-identical outputs, tested).
     """
     actor, critic = _nets(cfg)
     opt_a = optax.adam(cfg.lr_a)
@@ -245,6 +254,15 @@ def learn_from_batch(cfg: SACConfig, st: SACState, batch: dict, is_w,
         return loss
 
     aloss, ga = jax.value_and_grad(actor_loss)(st.actor_params)
+    if collect_diag:
+        # entropy/constraint stats recomputed OUTSIDE the grad with the
+        # SAME key: auxing them through value_and_grad would change the
+        # AD graph (and bit-drift the update); this forward is the
+        # identical deterministic computation and CSE-dedupes under jit
+        mu_pi, ls_pi = actor.apply({"params": st.actor_params}, s)
+        acts_pi, lp_pi = gaussian_sample(mu_pi, ls_pi, k_pi)
+    else:
+        acts_pi = lp_pi = None
     ua, actor_opt = opt_a.update(ga, st.actor_opt, st.actor_params)
     actor_params = optax.apply_updates(st.actor_params, ua)
 
@@ -304,15 +322,31 @@ def learn_from_batch(cfg: SACConfig, st: SACState, batch: dict, is_w,
     )
     metrics = {"critic_loss": closs, "actor_loss": aloss,
                "alpha": alpha, "rho": rho, "td": td}
+    if collect_diag:
+        metrics["diag"] = dg.make_diag(
+            critic_loss=closs, actor_loss=aloss,
+            critic_grad_norm=dg.tree_norm((g1, g2)),
+            actor_grad_norm=dg.tree_norm(ga),
+            critic_update_ratio=dg.update_ratio(
+                (u1, u2), (st.c1_params, st.c2_params)),
+            actor_update_ratio=dg.update_ratio(ua, st.actor_params),
+            q_mean=jnp.mean(q1), q_min=jnp.min(q1), q_max=jnp.max(q1),
+            target_drift=dg.target_drift(c1_params, st_new.t1_params),
+            alpha=alpha, entropy=-jnp.mean(lp_pi),
+            hint_residual=(jnp.mean((acts_pi - hint) ** 2)
+                           if cfg.use_hint else 0.0))
     return st_new, metrics
 
 
 def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
-          key) -> Tuple[SACState, rp.ReplayState, dict]:
+          key, collect_diag: bool = False
+          ) -> Tuple[SACState, rp.ReplayState, dict]:
     """One SAC learn step, sampling from (and possibly re-prioritising) ``buf``.
 
     No-op (identity state) while the buffer holds fewer than ``batch_size``
     transitions, so it can sit unconditionally inside a scanned train loop.
+    ``collect_diag`` threads ``metrics['diag']`` out (see
+    :func:`learn_from_batch`; the no-learn branch reports a zero diag).
     """
 
     def do_learn(args):
@@ -326,7 +360,8 @@ def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
             batch, idx = rp.replay_sample_uniform(buf, k_samp, cfg.batch_size)
             is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
 
-        st_new, metrics = learn_from_batch(cfg, st, batch, is_w, k_core)
+        st_new, metrics = learn_from_batch(cfg, st, batch, is_w, k_core,
+                                           collect_diag=collect_diag)
         if cfg.prioritized:
             buf2 = rp.replay_update_priorities(buf2, idx, metrics["td"],
                                                cfg.error_clip)
@@ -337,6 +372,8 @@ def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
         zeros = {"critic_loss": jnp.asarray(0.0),
                  "actor_loss": jnp.asarray(0.0),
                  "alpha": st.alpha, "rho": st.rho}
+        if collect_diag:
+            zeros["diag"] = dg.zero_diag()
         return st, buf, zeros
 
     return lax.cond(buf.cntr >= cfg.batch_size, do_learn, no_learn,
@@ -349,12 +386,13 @@ class SACAgent:
     around the pure jitted functions, for host-driven training loops."""
 
     def __init__(self, cfg: SACConfig, seed: int = 0,
-                 name_prefix: str = ""):
+                 name_prefix: str = "", collect_diag: bool = False):
         self.cfg = cfg
         self.key = jax.random.PRNGKey(seed)
         self.key, k0 = jax.random.split(self.key)
         self.state = sac_init(k0, cfg)
         self.native = cfg.prioritized and cfg.replay_backend == "native"
+        self.collect_diag = collect_diag
         spec = rp.transition_spec(cfg.obs_dim, cfg.n_actions)
         if self.native:
             from .replay_native import NativePER
@@ -363,11 +401,13 @@ class SACAgent:
                                     error_clip=cfg.error_clip)
             self._rng = np.random.default_rng(seed + 1)
             self._core = jax.jit(
-                lambda st, b, w, k: learn_from_batch(cfg, st, b, w, k))
+                lambda st, b, w, k: learn_from_batch(
+                    cfg, st, b, w, k, collect_diag=collect_diag))
         else:
             self.buffer = rp.replay_init(cfg.mem_size, spec)
             self._learn = jax.jit(
-                lambda st, buf, key: learn(cfg, st, buf, key))
+                lambda st, buf, key: learn(cfg, st, buf, key,
+                                           collect_diag=collect_diag))
             self._add = jax.jit(
                 lambda buf, tr: rp.replay_add(buf, tr,
                                               priority=None if cfg.prioritized
@@ -376,6 +416,7 @@ class SACAgent:
         self._choose = jax.jit(
             lambda st, obs, key: choose_action(cfg, st, obs, key))
         self.last_metrics = {}
+        self.last_diag = None
 
     def _next_key(self):
         self.key, sub = jax.random.split(self.key)
@@ -394,6 +435,9 @@ class SACAgent:
             self.buffer = self._add(self.buffer, tr)
 
     def learn(self):
+        from smartcal_tpu.obs import costs
+        from smartcal_tpu.obs.spans import span
+
         if self.native:
             if not self.buffer.ready(self.cfg.batch_size):
                 # same metrics contract as the HBM path's no_learn branch
@@ -404,15 +448,25 @@ class SACAgent:
                 return
             batch, idx, is_w = self.buffer.sample(self.cfg.batch_size,
                                                   self._rng)
-            self.state, m = self._core(
-                self.state, {k: jnp.asarray(v) for k, v in batch.items()},
-                jnp.asarray(is_w), self._next_key())
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            is_w, k = jnp.asarray(is_w), self._next_key()
+            # span name == cost stage ('/'-free) -> obs_report roofline
+            # join; cost analysis deferred (see td3.TD3Agent.learn)
+            with span("agent_update_sac"):
+                self.state, m = self._core(self.state, batch, is_w, k)
+            costs.record_stage_cost("agent_update_sac", self._core,
+                                    self.state, batch, is_w, k, defer=True)
             self.buffer.update_priorities(idx, jax.device_get(m["td"]))
-            m = {k: v for k, v in m.items() if k != "td"}
+            m = {k_: v for k_, v in m.items() if k_ != "td"}
         else:
-            self.state, self.buffer, m = self._learn(
-                self.state, self.buffer, self._next_key())
+            k = self._next_key()
+            with span("agent_update_sac"):
+                self.state, self.buffer, m = self._learn(
+                    self.state, self.buffer, k)
+            costs.record_stage_cost("agent_update_sac", self._learn,
+                                    self.state, self.buffer, k, defer=True)
         self.last_metrics = m
+        self.last_diag = m.pop("diag", None)
 
     def save_models(self, prefix: Optional[str] = None):
         prefix = prefix if prefix is not None else self.name_prefix

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from ..obs import diagnostics as dg
 from . import replay as rp
 from .networks import SplitImageMetaCategoricalActor, SplitImageMetaQVector
 
@@ -120,8 +121,13 @@ def choose_action(cfg: DSACConfig, st: DSACState, obs, key,
 
 
 def learn(cfg: DSACConfig, st: DSACState, buf: rp.ReplayState,
-          key) -> Tuple[DSACState, rp.ReplayState, dict]:
-    """One discrete-SAC learn step (no-op below batch_size, scannable)."""
+          key, collect_diag: bool = False
+          ) -> Tuple[DSACState, rp.ReplayState, dict]:
+    """One discrete-SAC learn step (no-op below batch_size, scannable).
+
+    ``collect_diag`` (python-static) adds ``metrics['diag']`` — an
+    :class:`~smartcal_tpu.obs.diagnostics.UpdateDiag`; with it False the
+    traced program is the exact pre-diagnostics computation."""
     actor, critic = _nets(cfg)
     opt_a, opt_c = optax.adam(cfg.lr_a), optax.adam(cfg.lr_c)
 
@@ -180,6 +186,16 @@ def learn(cfg: DSACConfig, st: DSACState, buf: rp.ReplayState,
                 pi * (st.alpha * logpi - lax.stop_gradient(qmin)), axis=-1))
 
         aloss, ga = jax.value_and_grad(actor_loss)(st.actor_params)
+        if collect_diag:
+            # exact categorical entropy, recomputed OUTSIDE the grad so
+            # the AD graph (and the update bits) stay identical to the
+            # diagnostics-off program; CSE dedupes the forward under jit
+            logits_pi = actor.apply({"params": st.actor_params}, s)
+            pi_d = jax.nn.softmax(logits_pi, axis=-1)
+            logpi_d = jax.nn.log_softmax(logits_pi, axis=-1)
+            entropy = -jnp.mean(jnp.sum(pi_d * logpi_d, axis=-1))
+        else:
+            entropy = None
         ua, actor_opt = opt_a.update(ga, st.actor_opt, st.actor_params)
         actor_params = optax.apply_updates(st.actor_params, ua)
 
@@ -196,12 +212,28 @@ def learn(cfg: DSACConfig, st: DSACState, buf: rp.ReplayState,
             t2_params=lerp(st.t2_params, c2_params),
             actor_opt=actor_opt, c1_opt=c1_opt, c2_opt=c2_opt,
             alpha=st.alpha, learn_counter=st.learn_counter + 1)
-        return st_new, buf2, {"critic_loss": closs, "actor_loss": aloss}
+        metrics = {"critic_loss": closs, "actor_loss": aloss}
+        if collect_diag:
+            metrics["diag"] = dg.make_diag(
+                critic_loss=closs, actor_loss=aloss,
+                critic_grad_norm=dg.tree_norm((g1, g2)),
+                actor_grad_norm=dg.tree_norm(ga),
+                critic_update_ratio=dg.update_ratio(
+                    (u1, u2), (st.c1_params, st.c2_params)),
+                actor_update_ratio=dg.update_ratio(ua, st.actor_params),
+                q_mean=jnp.mean(q1v), q_min=jnp.min(q1v),
+                q_max=jnp.max(q1v),
+                target_drift=dg.target_drift(c1_params, st_new.t1_params),
+                alpha=st.alpha, entropy=entropy)
+        return st_new, buf2, metrics
 
     def no_learn(args):
         st, buf, _ = args
-        return st, buf, {"critic_loss": jnp.asarray(0.0),
-                         "actor_loss": jnp.asarray(0.0)}
+        zeros = {"critic_loss": jnp.asarray(0.0),
+                 "actor_loss": jnp.asarray(0.0)}
+        if collect_diag:
+            zeros["diag"] = dg.zero_diag()
+        return st, buf, zeros
 
     return lax.cond(buf.cntr >= cfg.batch_size, do_learn, no_learn,
                     (st, buf, key))

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from ..obs import diagnostics as dg
 from . import replay as rp
 from .networks import (MLPCritic, MLPDeterministicActor,
                        SplitImageMetaCritic,
@@ -135,15 +136,23 @@ def store_priority(cfg: TD3Config, reward):
 
 
 def _actor_admm_update(cfg: TD3Config, st: TD3State, c1_params, s, hint,
-                       is_w):
+                       is_w, collect_diag: bool = False):
     """Hint-constrained actor update: inner ADMM loop with adaptive rho
-    (enet_td3.py:310-361)."""
+    (enet_td3.py:310-361).
+
+    ``collect_diag`` additionally returns the LAST ADMM iteration's
+    (loss, global grad norm, constraint mse) by widening the fori_loop
+    carry — with it False the carry (and the traced program) is exactly
+    the pre-diagnostics one."""
     actor, critic = _nets(cfg)
     opt_a = optax.adam(cfg.lr_a)
     numel = jnp.asarray(s.shape[0] * cfg.n_actions, jnp.float32)
 
     def one_iter(admm, carry):
-        (params, opt_state, y, y0, a0, rho) = carry
+        if collect_diag:
+            (params, opt_state, y, y0, a0, rho, _extras) = carry
+        else:
+            (params, opt_state, y, y0, a0, rho) = carry
 
         def loss_fn(p):
             actions = actor.apply({"params": p}, s)
@@ -167,7 +176,14 @@ def _actor_admm_update(cfg: TD3Config, st: TD3State, c1_params, s, hint,
         diff = (actions - hint).reshape(-1)
         y_new = y + rho * diff
 
+        if collect_diag:
+            # last iteration wins — the converged constraint/gradient state
+            extras = (aloss, dg.tree_norm(g),
+                      jnp.mean((actions - hint) ** 2))
+
         if not cfg.adaptive_admm:
+            if collect_diag:
+                return (params, opt_state, y_new, y0, a0, rho, extras)
             return (params, opt_state, y_new, y0, a0, rho)
 
         # adaptive rho (Barzilai-Borwein spectral / steepest-descent rule
@@ -204,19 +220,34 @@ def _actor_admm_update(cfg: TD3Config, st: TD3State, c1_params, s, hint,
             lambda _: lax.cond(adapt_now, maybe_adapt,
                                lambda __: (y0, a0, rho), operand=None),
             operand=None)
+        if collect_diag:
+            return (params, opt_state, y_new, y0_new, a0_new, rho_new,
+                    extras)
         return (params, opt_state, y_new, y0_new, a0_new, rho_new)
 
     y_init = jnp.zeros((s.shape[0] * cfg.n_actions,), jnp.float32)
     carry = (st.actor_params, st.actor_opt, y_init, y_init,
              jnp.zeros_like(y_init), jnp.asarray(cfg.admm_rho, jnp.float32))
+    if collect_diag:
+        zero = jnp.asarray(0.0, jnp.float32)
+        carry = carry + ((zero, zero, zero),)
+        out = lax.fori_loop(0, cfg.n_admm, one_iter, carry)
+        return out[0], out[1], out[6]
     params, opt_state, _, _, _, _ = lax.fori_loop(0, cfg.n_admm, one_iter,
                                                   carry)
     return params, opt_state
 
 
 def learn(cfg: TD3Config, st: TD3State, buf: rp.ReplayState,
-          key) -> Tuple[TD3State, rp.ReplayState, dict]:
-    """One TD3 learn step (enet_td3.py:222-364)."""
+          key, collect_diag: bool = False
+          ) -> Tuple[TD3State, rp.ReplayState, dict]:
+    """One TD3 learn step (enet_td3.py:222-364).
+
+    ``collect_diag`` (python-static) adds ``metrics['diag']`` — an
+    :class:`~smartcal_tpu.obs.diagnostics.UpdateDiag`; with it False the
+    traced program is the exact pre-diagnostics computation.  Actor
+    fields report 0 on delayed-update skip steps (the watchdog treats
+    exact zeros as skips)."""
     actor, critic = _nets(cfg)
     opt_c = optax.adam(cfg.lr_c)
     opt_a = optax.adam(cfg.lr_a)
@@ -264,6 +295,11 @@ def learn(cfg: TD3Config, st: TD3State, buf: rp.ReplayState,
 
         closs, (g1, g2) = jax.value_and_grad(critic_loss, argnums=(0, 1))(
             st.c1_params, st.c2_params)
+        # q stats recomputed OUTSIDE the grad (auxing q out of the loss
+        # would change the AD graph and bit-drift the update; a separate
+        # forward is deterministic and CSE-dedupes under jit)
+        q_batch = (critic.apply({"params": st.c1_params}, s, a)
+                   if collect_diag else None)
         u1, c1_opt = opt_c.update(g1, st.c1_opt, st.c1_params)
         c1_params = optax.apply_updates(st.c1_params, u1)
         u2, c2_opt = opt_c.update(g2, st.c2_opt, st.c2_params)
@@ -274,8 +310,13 @@ def learn(cfg: TD3Config, st: TD3State, buf: rp.ReplayState,
         # delayed actor + target update (enet_td3.py:298-364)
         def actor_update(_):
             if cfg.use_hint:
-                params, opt_state = _actor_admm_update(
-                    cfg, st, c1_params, s, hint, is_w)
+                if collect_diag:
+                    params, opt_state, (aloss, agn, hres) = \
+                        _actor_admm_update(cfg, st, c1_params, s, hint,
+                                           is_w, collect_diag=True)
+                else:
+                    params, opt_state = _actor_admm_update(
+                        cfg, st, c1_params, s, hint, is_w)
             else:
                 def loss_fn(p):
                     q1 = critic.apply({"params": c1_params}, s,
@@ -285,35 +326,71 @@ def learn(cfg: TD3Config, st: TD3State, buf: rp.ReplayState,
                     return -jnp.mean(q1)
 
                 g = jax.grad(loss_fn)(st.actor_params)
+                if collect_diag:
+                    # recomputed outside the grad — see the q_batch note
+                    aloss = loss_fn(st.actor_params)
+                    agn = dg.tree_norm(g)
+                    hres = jnp.asarray(0.0, jnp.float32)
                 upd, opt_state = opt_a.update(g, st.actor_opt,
                                               st.actor_params)
                 params = optax.apply_updates(st.actor_params, upd)
 
             lerp = lambda t, o: jax.tree_util.tree_map(
                 lambda a_, b_: cfg.tau * a_ + (1.0 - cfg.tau) * b_, o, t)
-            return (params, opt_state,
-                    lerp(st.t_actor_params, params),
-                    lerp(st.t1_params, c1_params),
-                    lerp(st.t2_params, c2_params))
+            out = (params, opt_state,
+                   lerp(st.t_actor_params, params),
+                   lerp(st.t1_params, c1_params),
+                   lerp(st.t2_params, c2_params))
+            if collect_diag:
+                # the ADMM path's net step over the whole inner loop; the
+                # plain path's single Adam step — both ||new - old||/||old||
+                aur = dg.update_ratio(
+                    jax.tree_util.tree_map(lambda n_, o_: n_ - o_, params,
+                                           st.actor_params),
+                    st.actor_params)
+                out = out + ((aloss, agn, aur, hres),)
+            return out
 
         def no_actor_update(_):
-            return (st.actor_params, st.actor_opt, st.t_actor_params,
-                    st.t1_params, st.t2_params)
+            out = (st.actor_params, st.actor_opt, st.t_actor_params,
+                   st.t1_params, st.t2_params)
+            if collect_diag:
+                zero = jnp.asarray(0.0, jnp.float32)
+                out = out + ((zero, zero, zero, zero),)
+            return out
 
-        (actor_params, actor_opt, t_actor, t1, t2) = lax.cond(
+        cond_out = lax.cond(
             counter % cfg.update_actor_interval == 0, actor_update,
             no_actor_update, operand=None)
+        (actor_params, actor_opt, t_actor, t1, t2) = cond_out[:5]
 
         st_new = TD3State(
             actor_params=actor_params, c1_params=c1_params,
             c2_params=c2_params, t_actor_params=t_actor, t1_params=t1,
             t2_params=t2, actor_opt=actor_opt, c1_opt=c1_opt, c2_opt=c2_opt,
             learn_counter=counter, time_step=st.time_step)
-        return st_new, buf2, {"critic_loss": closs}
+        metrics = {"critic_loss": closs}
+        if collect_diag:
+            aloss, agn, aur, hres = cond_out[5]
+            metrics["diag"] = dg.make_diag(
+                critic_loss=closs, actor_loss=aloss,
+                critic_grad_norm=dg.tree_norm((g1, g2)),
+                actor_grad_norm=agn,
+                critic_update_ratio=dg.update_ratio(
+                    (u1, u2), (st.c1_params, st.c2_params)),
+                actor_update_ratio=aur,
+                q_mean=jnp.mean(q_batch), q_min=jnp.min(q_batch),
+                q_max=jnp.max(q_batch),
+                target_drift=dg.target_drift(c1_params, t1),
+                hint_residual=hres)
+        return st_new, buf2, metrics
 
     def no_learn(args):
         st, buf, _ = args
-        return st, buf, {"critic_loss": jnp.asarray(0.0)}
+        zeros = {"critic_loss": jnp.asarray(0.0)}
+        if collect_diag:
+            zeros["diag"] = dg.zero_diag()
+        return st, buf, zeros
 
     return lax.cond(buf.cntr >= cfg.batch_size, do_learn, no_learn,
                     (st, buf, key))
@@ -322,7 +399,8 @@ def learn(cfg: TD3Config, st: TD3State, buf: rp.ReplayState,
 class TD3Agent:
     """Host-driven wrapper with the reference Agent API."""
 
-    def __init__(self, cfg: TD3Config, seed: int = 0, name_prefix: str = ""):
+    def __init__(self, cfg: TD3Config, seed: int = 0, name_prefix: str = "",
+                 collect_diag: bool = False):
         self.cfg = cfg
         self.key = jax.random.PRNGKey(seed)
         self.key, k0 = jax.random.split(self.key)
@@ -330,11 +408,15 @@ class TD3Agent:
         self.buffer = rp.replay_init(
             cfg.mem_size, rp.transition_spec(cfg.obs_dim, cfg.n_actions))
         self.name_prefix = name_prefix
+        self.collect_diag = collect_diag
         self._choose = jax.jit(
             lambda st, obs, key: choose_action(cfg, st, obs, key))
-        self._learn = jax.jit(lambda st, buf, key: learn(cfg, st, buf, key))
+        self._learn = jax.jit(lambda st, buf, key: learn(
+            cfg, st, buf, key, collect_diag=collect_diag))
         self._add = jax.jit(
             lambda buf, tr, pri: rp.replay_add(buf, tr, priority=pri))
+        self.last_metrics = {}
+        self.last_diag = None
 
     def _next_key(self):
         self.key, sub = jax.random.split(self.key)
@@ -354,8 +436,21 @@ class TD3Agent:
         self.buffer = self._add(self.buffer, tr, pri)
 
     def learn(self):
-        self.state, self.buffer, m = self._learn(self.state, self.buffer,
-                                                 self._next_key())
+        from smartcal_tpu.obs import costs
+        from smartcal_tpu.obs.spans import span
+
+        k = self._next_key()
+        # span + cost stage share one '/'-free name so obs_report can
+        # join them into the roofline's achieved-FLOPs/s row; the cost
+        # analysis is deferred (learn() runs inside the drivers' episode
+        # span — TrainObs flushes the AOT compile between episodes)
+        with span("agent_update_td3"):
+            self.state, self.buffer, m = self._learn(self.state,
+                                                     self.buffer, k)
+        costs.record_stage_cost("agent_update_td3", self._learn,
+                                self.state, self.buffer, k, defer=True)
+        self.last_metrics = m
+        self.last_diag = m.pop("diag", None)
 
     def save_models(self, prefix: Optional[str] = None):
         prefix = prefix if prefix is not None else self.name_prefix

@@ -37,7 +37,30 @@ def add_obs_args(p):
                         "xprof; spans appear as TraceAnnotations)")
     p.add_argument("--quiet", action="store_true",
                    help="suppress the per-episode stderr echo")
+    p.add_argument("--diag", action="store_true",
+                   help="collect per-update agent diagnostics (UpdateDiag "
+                        "grad norms/Q stats/entropy), replay health, and "
+                        "per-stage FLOPs costs into the metrics stream")
+    p.add_argument("--watchdog", action="store_true",
+                   help="arm the divergence watchdog on the diagnostics "
+                        "stream (implies --diag): on NaN losses, exploding "
+                        "grad norms or Q blowup, emit watchdog_trip and "
+                        "halt the run gracefully")
     return p
+
+
+def diag_from_args(args) -> bool:
+    """True when the run will actually CONSUME update diagnostics:
+    ``--diag``/``--watchdog`` requested and there is somewhere for them
+    to go (a metrics/trace stream, or the watchdog itself).  Drivers
+    pass this as the agents' ``collect_diag``; it mirrors TrainObs's
+    disarm rule so a ``--diag`` with no sink doesn't leave the agent
+    compiling and computing an UpdateDiag nobody reads."""
+    wd = bool(getattr(args, "watchdog", False))
+    want = bool(getattr(args, "diag", False) or wd)
+    sink = (getattr(args, "metrics", None) is not None
+            or getattr(args, "trace", None) is not None or wd)
+    return want and sink
 
 
 class TrainObs:
@@ -47,14 +70,19 @@ class TrainObs:
     no-op passthrough — the driver's hot loop is unchanged."""
 
     MEM_EVERY = 10          # episodes between device-memory gauge samples
+    DIAG_LOG_EVERY = 1      # update-diag events logged every N updates
 
     def __init__(self, entry, metrics=None, run_id=None, trace=None,
-                 quiet=False, **meta):
+                 quiet=False, diag=False, watchdog=False,
+                 watchdog_cfg=None, **meta):
         self.entry = entry
         self.quiet = quiet
         self._t0 = time.time()
         self._episodes = 0
         self._tracing = False
+        self._updates = 0
+        self.diag = bool(diag or watchdog)
+        self.watchdog = obs.Watchdog(watchdog_cfg) if watchdog else None
         path = metrics
         if path is None and trace:
             # a profiler trace without a metrics stream still wants the
@@ -66,6 +94,19 @@ class TrainObs:
                                      meta={"entry": entry, **meta})
             obs.activate(self.runlog)
             obs.install_compile_listener()
+            if self.diag:
+                # arm per-stage FLOPs accounting (cached once per
+                # compiled signature) + the fraction-of-peak denominator
+                from smartcal_tpu.obs import costs
+                costs.set_enabled(True)
+                costs.log_roofline_peak()
+        if self.diag and self.runlog is None and self.watchdog is None:
+            # --diag with neither a metrics stream nor an armed watchdog
+            # has no consumer: disarm rather than silently paying the
+            # per-update host sync for diagnostics nobody reads
+            self.diag = False
+            self.echo("--diag has no effect without --metrics or "
+                      "--watchdog; diagnostics disabled")
         if trace:
             try:
                 jax.profiler.start_trace(trace)
@@ -73,8 +114,71 @@ class TrainObs:
             except Exception as e:
                 self.echo(f"profiler trace unavailable: {e!r}")
 
+    @property
+    def collect_diag(self) -> bool:
+        """Should the driver's agents thread UpdateDiag out of their
+        jitted updates?  (diag stream or an armed watchdog.)"""
+        return self.diag
+
+    @property
+    def tripped(self) -> bool:
+        return self.watchdog is not None and self.watchdog.tripped
+
     def span(self, name, **tags):
         return obs.span(name, **tags)
+
+    def record_diag(self, diag, **tags) -> bool:
+        """Feed one (possibly step-stacked) UpdateDiag — or an already-
+        host dict — into the diag stream + watchdog; the update index is
+        the handle's running counter.  Returns True when the watchdog has
+        tripped (the driver should exit its loop gracefully).
+        ``diag=None`` (an agent that has not learned yet) just reports
+        the current trip state."""
+        if self.tripped:
+            return True
+        if diag is None or not self.diag:
+            return self.tripped
+        host = diag if isinstance(diag, dict) else obs.diag_to_host(diag)
+        for stepd in obs.diag_steps(host):
+            i = self._updates
+            self._updates += 1
+            if self.runlog is not None \
+                    and i % self.DIAG_LOG_EVERY == 0:
+                self.runlog.log("diag", step=i, **stepd, **tags)
+            if self.watchdog is not None \
+                    and self.watchdog.observe(stepd, step=i, **tags):
+                self.echo(f"watchdog tripped at update {i}: "
+                          f"{self.watchdog.trip_reason} — halting run")
+                return True
+        return False
+
+    def log_replay_health(self, buf, **tags) -> bool:
+        """Log one ``replay_health`` event for ``buf`` (a ReplayState, a
+        NativePER, or anything with ``.health()``); feeds the watchdog.
+        No-op unless diagnostics are on.  Returns the trip state."""
+        if not self.diag:
+            return self.tripped
+        try:
+            health = buf.health() if hasattr(buf, "health") else None
+            if health is None:
+                from smartcal_tpu.rl import replay as rp
+                health = rp.replay_health(buf)
+        except Exception as e:  # telemetry must never kill the run
+            self.echo(f"replay_health unavailable: {e!r}")
+            return self.tripped
+        if self.runlog is not None:
+            self.runlog.log("replay_health", **health, **tags)
+        if self.watchdog is not None \
+                and self.watchdog.observe_replay(health, **tags):
+            self.echo(f"watchdog tripped on replay health: "
+                      f"{self.watchdog.trip_reason} — halting run")
+        return self.tripped
+
+    def record_cost(self, stage, fn, *args, **kwargs):
+        """Per-stage FLOPs/bytes accounting (see obs.costs) — cached per
+        compiled signature, armed only under ``--diag``."""
+        from smartcal_tpu.obs import costs
+        return costs.record_stage_cost(stage, fn, *args, **kwargs)
 
     def episode(self, i, score, scores=None, echo=True, **fields):
         """Record one ``episode`` event + the classic stderr echo
@@ -84,6 +188,11 @@ class TrainObs:
             self._episodes += 1
             if self._episodes % self.MEM_EVERY == 0:
                 obs.log_memory_gauges()
+            if self.diag:
+                # between-episode gap = outside every span: run the cost
+                # analyses the in-span sites deferred
+                from smartcal_tpu.obs import costs
+                costs.flush_pending()
         if echo and not self.quiet:
             if scores:
                 tail = scores[-100:]
@@ -108,7 +217,14 @@ class TrainObs:
             # reset: a later run in the same process (sweep drivers call
             # main() per seed) must not inherit this run's totals
             obs.flush_counters(reset=True)
+            if self.diag:
+                from smartcal_tpu.obs import costs
+                costs.flush_pending()   # drain before the stream closes
+                costs.set_enabled(False)
+                costs.reset_cache()     # next run re-logs into ITS stream
             self.runlog.log("run_end", episodes=self._episodes,
+                            updates=self._updates,
+                            watchdog_tripped=self.tripped,
                             wall_s=round(time.time() - self._t0, 3))
             obs.deactivate(self.runlog)
             self.runlog.close()
@@ -122,9 +238,9 @@ class TrainObs:
 
 
 def train_obs(entry, metrics=None, run_id=None, trace=None, quiet=False,
-              **meta) -> TrainObs:
+              diag=False, watchdog=False, **meta) -> TrainObs:
     return TrainObs(entry, metrics=metrics, run_id=run_id, trace=trace,
-                    quiet=quiet, **meta)
+                    quiet=quiet, diag=diag, watchdog=watchdog, **meta)
 
 
 def train_obs_from_args(args, entry, **meta) -> TrainObs:
@@ -136,6 +252,8 @@ def train_obs_from_args(args, entry, **meta) -> TrainObs:
                     run_id=getattr(args, "run_id", None),
                     trace=getattr(args, "trace", None),
                     quiet=getattr(args, "quiet", False),
+                    diag=getattr(args, "diag", False),
+                    watchdog=getattr(args, "watchdog", False),
                     seed=getattr(args, "seed", None), **meta)
 
 

@@ -31,10 +31,11 @@ def main(argv=None):
         obs_dim=npix * npix + (args.M + 1) * 7, n_actions=2 * args.M,
         gamma=0.99, tau=0.005, batch_size=32, mem_size=1000, lr_a=1e-3,
         lr_c=1e-3, img_shape=(npix, npix))
-    agent = ddpg.DDPGAgent(cfg, seed=args.seed, name_prefix=args.prefix)
+    from .blocks import diag_from_args, train_obs_from_args
+    agent = ddpg.DDPGAgent(cfg, seed=args.seed, name_prefix=args.prefix,
+                           collect_diag=diag_from_args(args))
     if args.load:
         agent.load_models()
-    from .blocks import train_obs_from_args
     return run(env, agent, args.episodes, args.steps, args.use_hint,
                args.prefix, obs_run=train_obs_from_args(args, "calib_ddpg"))
 

@@ -22,7 +22,7 @@ from ..envs import CalibEnv
 from ..envs.radio import RadioBackend
 from ..rl import sac
 from ..rl.networks import flatten_obs
-from .blocks import add_obs_args, train_obs_from_args
+from .blocks import add_obs_args, diag_from_args, train_obs_from_args
 
 
 def main(argv=None):
@@ -70,7 +70,8 @@ def main(argv=None):
         reward_scale=args.M, alpha=0.03, hint_threshold=0.01, admm_rho=1.0,
         use_hint=args.use_hint, hint_distance="kld",
         img_shape=(npix, npix))
-    agent = sac.SACAgent(agent_cfg, seed=args.seed, name_prefix=args.prefix)
+    agent = sac.SACAgent(agent_cfg, seed=args.seed, name_prefix=args.prefix,
+                         collect_diag=diag_from_args(args))
     if args.load:
         agent.load_models()
 
@@ -96,15 +97,20 @@ def main(argv=None):
                     agent.store_transition(flat, action, scaled, flat2,
                                            done, hint)
                     agent.learn()
+                    if tob.record_diag(agent.last_diag, episode=i):
+                        done = True
                     score += reward
                     flat = flat2
                     loop += 1
             scores.append(score / max(loop, 1))
+            tob.log_replay_health(agent.buffer, episode=i)
             tob.episode(i, scores[-1], scores, seed=args.seed,
                         use_hint=args.use_hint)
             agent.save_models()
             with open(f"{args.prefix}_scores.pkl", "wb") as fh:
                 pickle.dump(scores, fh)
+            if tob.tripped:
+                break
     finally:
         tob.close()
     return scores

@@ -50,14 +50,20 @@ def run(env, agent, episodes, steps, use_hint, prefix, metrics_path=None,
                     agent.store_transition(flat, action, reward, flat2,
                                            done, hint)
                     agent.learn()
+                    if tob.record_diag(getattr(agent, "last_diag", None),
+                                       episode=i):
+                        done = True
                     score += reward
                     flat = flat2
                     loop += 1
             scores.append(score / max(loop, 1))
+            tob.log_replay_health(agent.buffer, episode=i)
             tob.episode(i, scores[-1], scores, use_hint=use_hint)
             agent.save_models()
             with open(f"{prefix}_scores.pkl", "wb") as fh:
                 pickle.dump(scores, fh)
+            if tob.tripped:
+                break
     finally:
         tob.close()
     return scores
@@ -101,10 +107,11 @@ def main(argv=None):
         gamma=0.99, tau=0.005, batch_size=32, mem_size=1000, lr_a=1e-3,
         lr_c=1e-3, warmup=100, noise=0.1, update_actor_interval=2,
         use_hint=args.use_hint, img_shape=(npix, npix))
-    agent = td3.TD3Agent(cfg, seed=args.seed, name_prefix=args.prefix)
+    from .blocks import diag_from_args, train_obs_from_args
+    agent = td3.TD3Agent(cfg, seed=args.seed, name_prefix=args.prefix,
+                         collect_diag=diag_from_args(args))
     if args.load:
         agent.load_models()
-    from .blocks import train_obs_from_args
     return run(env, agent, args.episodes, args.steps, args.use_hint,
                args.prefix, obs_run=train_obs_from_args(args, "calib_td3"))
 

@@ -70,7 +70,9 @@ def main(argv=None):
         lr_c=3e-4, alpha=0.03, hint_threshold=0.01, admm_rho=1.0,
         use_hint=args.use_hint, hint_distance="kld", img_shape=img_shape,
         use_image=args.use_influence)
-    agent = sac.SACAgent(agent_cfg, seed=args.seed, name_prefix=args.prefix)
+    from .blocks import diag_from_args
+    agent = sac.SACAgent(agent_cfg, seed=args.seed, name_prefix=args.prefix,
+                         collect_diag=diag_from_args(args))
     scores = []
     if args.load:
         agent.load_models()

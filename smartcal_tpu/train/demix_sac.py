@@ -22,7 +22,7 @@ from ..envs import DemixingEnv
 from ..envs.radio import RadioBackend
 from ..rl import sac
 from ..rl.networks import flatten_obs
-from .blocks import add_obs_args, train_obs_from_args
+from .blocks import add_obs_args, diag_from_args, train_obs_from_args
 
 
 def main(argv=None):
@@ -70,7 +70,8 @@ def main(argv=None):
         batch_size=256, mem_size=16000, lr_a=3e-4, lr_c=1e-3, alpha=0.03,
         hint_threshold=0.01, admm_rho=1.0, use_hint=args.use_hint,
         hint_distance="kld", img_shape=img_shape)
-    agent = sac.SACAgent(agent_cfg, seed=args.seed, name_prefix=args.prefix)
+    agent = sac.SACAgent(agent_cfg, seed=args.seed, name_prefix=args.prefix,
+                         collect_diag=diag_from_args(args))
     scores = []
     if args.load:
         agent.load_models()
@@ -156,17 +157,23 @@ def run_warmup_loop(env, agent, args, scores, to_flat, n_actions,
                                            scale_reward(reward),
                                            flat2, done, hint)
                     agent.learn()
+                    if tob.record_diag(getattr(agent, "last_diag", None),
+                                       episode=i):
+                        done = True
                     score += reward
                     flat = flat2
                     loop += 1
                     total_steps += 1
             scores.append(score / max(loop, 1))
+            tob.log_replay_health(agent.buffer, episode=i)
             tob.episode(i, scores[-1], scores, seed=args.seed,
                         use_hint=args.use_hint,
                         warmup=total_steps <= warmup_steps)
             agent.save_models()
             with open(f"{args.prefix}_scores.pkl", "wb") as fh:
                 pickle.dump(scores, fh)
+            if tob.tripped:
+                break
             if (i + 1) % _clear_every() == 0:
                 # bound live compiled executables: long hint-mode runs
                 # segfault the XLA CPU client near episode ~43 otherwise

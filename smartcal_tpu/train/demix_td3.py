@@ -80,7 +80,9 @@ def main(argv=None):
         update_actor_interval=2, warmup=args.warmup, noise=0.1,
         use_hint=args.use_hint, admm_rho=0.1, prioritized=True,
         error_clip=100.0, img_shape=img_shape)
-    agent = td3.TD3Agent(agent_cfg, seed=args.seed, name_prefix=args.prefix)
+    from .blocks import diag_from_args
+    agent = td3.TD3Agent(agent_cfg, seed=args.seed, name_prefix=args.prefix,
+                         collect_diag=diag_from_args(args))
     scores = []
     if args.load:
         agent.load_models()

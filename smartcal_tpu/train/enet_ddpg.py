@@ -16,7 +16,7 @@ from .blocks import make_block_fn
 
 
 def _make_episode_body(env_cfg: enet.EnetConfig, cfg: ddpg.DDPGConfig,
-                       steps: int):
+                       steps: int, collect_diag: bool = False):
     def run_episode(agent_state, buf, key):
         k_reset, k_scan = jax.random.split(key)
         env_state, obs = enet.reset(env_cfg, k_reset)
@@ -32,20 +32,25 @@ def _make_episode_body(env_cfg: enet.EnetConfig, cfg: ddpg.DDPGConfig,
                   "new_state": obs2, "done": done,
                   "hint": jnp.zeros((cfg.n_actions,), jnp.float32)}
             buf = rp.replay_add(buf, tr, priority=jnp.asarray(1.0))
-            agent_state, buf, _ = ddpg.learn(cfg, agent_state, buf, k_learn)
-            return (agent_state, buf, env_state, obs2), reward
+            agent_state, buf, m = ddpg.learn(cfg, agent_state, buf, k_learn,
+                                             collect_diag=collect_diag)
+            ys = (reward, m["diag"]) if collect_diag else reward
+            return (agent_state, buf, env_state, obs2), ys
 
         keys = jax.random.split(k_scan, steps)
-        (agent_state, buf, _, _), rewards = jax.lax.scan(
+        (agent_state, buf, _, _), ys = jax.lax.scan(
             step_fn, (agent_state, buf, env_state, obs), keys)
-        return agent_state, buf, jnp.mean(rewards)
+        if collect_diag:
+            rewards, diag = ys
+            return agent_state, buf, jnp.mean(rewards), diag
+        return agent_state, buf, jnp.mean(ys)
 
     return run_episode
 
 
 def make_episode_fn(env_cfg: enet.EnetConfig, cfg: ddpg.DDPGConfig,
-                    steps: int):
-    return jax.jit(_make_episode_body(env_cfg, cfg, steps))
+                    steps: int, collect_diag: bool = False):
+    return jax.jit(_make_episode_body(env_cfg, cfg, steps, collect_diag))
 
 
 def make_episode_block_fn(env_cfg: enet.EnetConfig, cfg: ddpg.DDPGConfig,
@@ -55,7 +60,8 @@ def make_episode_block_fn(env_cfg: enet.EnetConfig, cfg: ddpg.DDPGConfig,
 
 
 def train_fused(seed=0, episodes=1000, steps=5, M=20, N=20, quiet=False,
-                prefix="", metrics_path=None, run_id=None, trace=None):
+                prefix="", metrics_path=None, run_id=None, trace=None,
+                diag=False, watchdog=False):
     from .blocks import train_obs
 
     env_cfg = enet.EnetConfig(M=M, N=N)
@@ -65,19 +71,32 @@ def train_fused(seed=0, episodes=1000, steps=5, M=20, N=20, quiet=False,
     key, k0 = jax.random.split(key)
     agent_state = ddpg.ddpg_init(k0, cfg)
     buf = rp.replay_init(cfg.mem_size, rp.transition_spec(env_cfg.obs_dim, 2))
-    episode_fn = make_episode_fn(env_cfg, cfg, steps)
 
     scores = []
     t0 = time.time()
     tob = train_obs("enet_ddpg", metrics=metrics_path, run_id=run_id,
-                    trace=trace, quiet=quiet, seed=seed)
+                    trace=trace, quiet=quiet, diag=diag, watchdog=watchdog,
+                    seed=seed)
+    collect = tob.collect_diag
+    episode_fn = make_episode_fn(env_cfg, cfg, steps, collect_diag=collect)
     try:
         for i in range(episodes):
             key, k = jax.random.split(key)
             with tob.span("episode", episode=i):
-                agent_state, buf, score = episode_fn(agent_state, buf, k)
+                out = episode_fn(agent_state, buf, k)
+            if collect:
+                agent_state, buf, score, ep_diag = out
+                tob.record_cost("episode_update", episode_fn,
+                                agent_state, buf, k)
+                halted = tob.record_diag(ep_diag, episode=i)
+                tob.log_replay_health(buf, episode=i)
+            else:
+                agent_state, buf, score = out
+                halted = False
             scores.append(float(score))
             tob.episode(i, scores[-1], scores, seed=seed)
+            if halted or tob.tripped:
+                break
         wall = time.time() - t0
     finally:
         tob.close()
@@ -101,7 +120,8 @@ def main():
                                      steps=args.steps,
                                      metrics_path=args.metrics,
                                      run_id=args.run_id, trace=args.trace,
-                                     quiet=args.quiet)
+                                     quiet=args.quiet, diag=args.diag,
+                                     watchdog=args.watchdog)
     smartcal_obs.emit_json(
         {"episodes": args.episodes, "wall_s": round(wall, 2),
          "env_steps_per_sec": round(args.episodes * args.steps / wall, 2),

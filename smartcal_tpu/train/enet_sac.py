@@ -31,9 +31,14 @@ from .blocks import make_block_fn
 
 
 def _make_episode_body(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
-                       steps: int, use_hint: bool):
+                       steps: int, use_hint: bool,
+                       collect_diag: bool = False):
     """The traceable one-episode computation (reset + scan over steps),
-    shared by the per-episode jit and the episode-block scan."""
+    shared by the per-episode jit and the episode-block scan.
+
+    ``collect_diag`` (python-static, the agents' UpdateDiag plumbing)
+    makes the episode ADDITIONALLY return the step-stacked diagnostics;
+    with it False the traced program is the exact pre-diagnostics one."""
 
     def run_episode(agent_state, buf, key):
         k_reset, k_noise, k_scan = jax.random.split(key, 3)
@@ -59,22 +64,28 @@ def _make_episode_body(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
                                 priority=None if agent_cfg.prioritized
                                 else jnp.asarray(1.0))
             agent_state, buf, metrics = sac.learn(agent_cfg, agent_state,
-                                                  buf, k_learn)
-            return (agent_state, buf, env_state, obs2), reward
+                                                  buf, k_learn,
+                                                  collect_diag=collect_diag)
+            ys = ((reward, metrics["diag"]) if collect_diag else reward)
+            return (agent_state, buf, env_state, obs2), ys
 
         keys = jax.random.split(k_scan, steps)
         first = jnp.arange(steps) == 0
-        (agent_state, buf, env_state, _), rewards = jax.lax.scan(
+        (agent_state, buf, env_state, _), ys = jax.lax.scan(
             step_fn, (agent_state, buf, env_state, obs), (keys, first))
-        return agent_state, buf, jnp.mean(rewards)
+        if collect_diag:
+            rewards, diag = ys
+            return agent_state, buf, jnp.mean(rewards), diag
+        return agent_state, buf, jnp.mean(ys)
 
     return run_episode
 
 
 def make_episode_fn(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
-                    steps: int, use_hint: bool):
+                    steps: int, use_hint: bool, collect_diag: bool = False):
     """Build the jitted one-episode function (reset + scan over steps)."""
-    return jax.jit(_make_episode_body(env_cfg, agent_cfg, steps, use_hint))
+    return jax.jit(_make_episode_body(env_cfg, agent_cfg, steps, use_hint,
+                                      collect_diag))
 
 
 def make_episode_block_fn(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
@@ -100,7 +111,7 @@ def make_episode_block_fn(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
 def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,
                 M=20, N=20, log_every=1, save_every=500, prefix="",
                 quiet=False, metrics_path=None, block=1, run_id=None,
-                trace=None):
+                trace=None, diag=False, watchdog=False):
     from .blocks import train_obs
 
     env_cfg = enet.EnetConfig(M=M, N=N)
@@ -115,15 +126,23 @@ def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,
     buf = rp.replay_init(agent_cfg.mem_size,
                          rp.transition_spec(env_cfg.obs_dim, 2))
     block = max(1, min(int(block), episodes))
-    block_fn = (make_episode_block_fn(env_cfg, agent_cfg, steps, use_hint,
-                                      block) if block > 1 else None)
-    episode_fn = (make_episode_fn(env_cfg, agent_cfg, steps, use_hint)
-                  if block == 1 or episodes % block else None)
 
     scores = []
     t0 = time.time()
     tob = train_obs("enet_sac", metrics=metrics_path, run_id=run_id,
-                    trace=trace, quiet=quiet, seed=seed, block=block)
+                    trace=trace, quiet=quiet, diag=diag, watchdog=watchdog,
+                    seed=seed, block=block)
+    collect = tob.collect_diag
+    if collect and block > 1:
+        # diagnostics stream at per-episode cadence: the watchdog must
+        # see updates before committing to a whole block's compute
+        tob.echo("diag/watchdog: forcing block=1")
+        block = 1
+    block_fn = (make_episode_block_fn(env_cfg, agent_cfg, steps, use_hint,
+                                      block) if block > 1 else None)
+    episode_fn = (make_episode_fn(env_cfg, agent_cfg, steps, use_hint,
+                                  collect_diag=collect)
+                  if block == 1 or episodes % block else None)
 
     def _log_one(i, score):
         scores.append(float(score))
@@ -146,7 +165,19 @@ def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,
             else:
                 key, k = jax.random.split(key)
                 with tob.span("episode", episode=i):
-                    agent_state, buf, score = episode_fn(agent_state, buf, k)
+                    out = episode_fn(agent_state, buf, k)
+                if collect:
+                    agent_state, buf, score, ep_diag = out
+                    tob.record_cost("episode_update", episode_fn,
+                                    agent_state, buf, k)
+                    halted = tob.record_diag(ep_diag, episode=i)
+                    tob.log_replay_health(buf, episode=i)
+                    if halted or tob.tripped:
+                        _log_one(i, score)
+                        i += 1
+                        break
+                else:
+                    agent_state, buf, score = out
                 _log_one(i, score)
                 i += 1
             # checkpoint cadence: save whenever a save_every multiple was
@@ -227,7 +258,7 @@ def main():
             seed=args.seed, episodes=args.episodes, steps=args.steps,
             use_hint=args.use_hint, metrics_path=args.metrics,
             block=args.block, run_id=args.run_id, trace=args.trace,
-            quiet=args.quiet)
+            quiet=args.quiet, diag=args.diag, watchdog=args.watchdog)
         smartcal_obs.emit_json({"episodes": args.episodes,
                                 "steps_per_episode": args.steps,
                                 "wall_s": round(wall, 2),

@@ -18,7 +18,8 @@ from .blocks import make_block_fn
 
 
 def _make_episode_body(env_cfg: enet.EnetConfig, cfg: td3.TD3Config,
-                       steps: int, use_hint: bool):
+                       steps: int, use_hint: bool,
+                       collect_diag: bool = False):
     def run_episode(agent_state, buf, key):
         k_reset, k_noise, k_scan = jax.random.split(key, 3)
         env_state, obs = enet.reset(env_cfg, k_reset)
@@ -42,21 +43,27 @@ def _make_episode_body(env_cfg: enet.EnetConfig, cfg: td3.TD3Config,
             buf = rp.replay_add(buf, tr,
                                 priority=jnp.asarray(1.0) if pri is None
                                 else pri)
-            agent_state, buf, _ = td3.learn(cfg, agent_state, buf, k_learn)
-            return (agent_state, buf, env_state, obs2), reward
+            agent_state, buf, m = td3.learn(cfg, agent_state, buf, k_learn,
+                                            collect_diag=collect_diag)
+            ys = (reward, m["diag"]) if collect_diag else reward
+            return (agent_state, buf, env_state, obs2), ys
 
         keys = jax.random.split(k_scan, steps)
         first = jnp.arange(steps) == 0
-        (agent_state, buf, _, _), rewards = jax.lax.scan(
+        (agent_state, buf, _, _), ys = jax.lax.scan(
             step_fn, (agent_state, buf, env_state, obs), (keys, first))
-        return agent_state, buf, jnp.mean(rewards)
+        if collect_diag:
+            rewards, diag = ys
+            return agent_state, buf, jnp.mean(rewards), diag
+        return agent_state, buf, jnp.mean(ys)
 
     return run_episode
 
 
 def make_episode_fn(env_cfg: enet.EnetConfig, cfg: td3.TD3Config,
-                    steps: int, use_hint: bool):
-    return jax.jit(_make_episode_body(env_cfg, cfg, steps, use_hint))
+                    steps: int, use_hint: bool, collect_diag: bool = False):
+    return jax.jit(_make_episode_body(env_cfg, cfg, steps, use_hint,
+                                      collect_diag))
 
 
 def make_episode_block_fn(env_cfg: enet.EnetConfig, cfg: td3.TD3Config,
@@ -68,7 +75,8 @@ def make_episode_block_fn(env_cfg: enet.EnetConfig, cfg: td3.TD3Config,
 
 def train_fused(seed=0, episodes=1000, steps=4, use_hint=True,
                 prioritized=True, M=20, N=20, quiet=False, save_every=500,
-                prefix="", metrics_path=None, run_id=None, trace=None):
+                prefix="", metrics_path=None, run_id=None, trace=None,
+                diag=False, watchdog=False):
     from .blocks import train_obs
 
     env_cfg = enet.EnetConfig(M=M, N=N)
@@ -82,19 +90,33 @@ def train_fused(seed=0, episodes=1000, steps=4, use_hint=True,
     key, k0 = jax.random.split(key)
     agent_state = td3.td3_init(k0, cfg)
     buf = rp.replay_init(cfg.mem_size, rp.transition_spec(env_cfg.obs_dim, 2))
-    episode_fn = make_episode_fn(env_cfg, cfg, steps, use_hint)
 
     scores = []
     t0 = time.time()
     tob = train_obs("enet_td3", metrics=metrics_path, run_id=run_id,
-                    trace=trace, quiet=quiet, seed=seed)
+                    trace=trace, quiet=quiet, diag=diag, watchdog=watchdog,
+                    seed=seed)
+    collect = tob.collect_diag
+    episode_fn = make_episode_fn(env_cfg, cfg, steps, use_hint,
+                                 collect_diag=collect)
     try:
         for i in range(episodes):
             key, k = jax.random.split(key)
             with tob.span("episode", episode=i):
-                agent_state, buf, score = episode_fn(agent_state, buf, k)
+                out = episode_fn(agent_state, buf, k)
+            if collect:
+                agent_state, buf, score, ep_diag = out
+                tob.record_cost("episode_update", episode_fn,
+                                agent_state, buf, k)
+                halted = tob.record_diag(ep_diag, episode=i)
+                tob.log_replay_health(buf, episode=i)
+            else:
+                agent_state, buf, score = out
+                halted = False
             scores.append(float(score))
             tob.episode(i, scores[-1], scores, seed=seed, use_hint=use_hint)
+            if halted or tob.tripped:
+                break
             if save_every and i and i % save_every == 0:
                 _save(agent_state, buf, scores, prefix)
         wall = time.time() - t0
@@ -130,7 +152,7 @@ def main():
         seed=args.seed, episodes=args.episodes, steps=args.steps,
         use_hint=not args.no_hint, prioritized=not args.no_per,
         metrics_path=args.metrics, run_id=args.run_id, trace=args.trace,
-        quiet=args.quiet)
+        quiet=args.quiet, diag=args.diag, watchdog=args.watchdog)
     smartcal_obs.emit_json(
         {"episodes": args.episodes, "wall_s": round(wall, 2),
          "env_steps_per_sec": round(args.episodes * args.steps / wall, 2),

@@ -1,0 +1,649 @@
+"""Training-internals telemetry: UpdateDiag bit-identity + single-trace
+contract for all four agents, replay health summaries, the divergence
+watchdog (unit + end-to-end driver halt), FLOPs/roofline cost
+accounting, and the obs_report training-health/roofline sections."""
+
+import io
+import json
+import math
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smartcal_tpu import obs
+from smartcal_tpu.obs import costs
+from smartcal_tpu.rl import ddpg, sac, td3
+from smartcal_tpu.rl import replay as rp
+from smartcal_tpu.rl import sac_discrete as dsac
+from smartcal_tpu.train import blocks
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+import obs_report  # noqa: E402
+import obs_tail    # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clean_obs_state():
+    """No active RunLog, no armed cost collection, empty caches."""
+    while obs.active() is not None:
+        obs.deactivate()
+    obs.reset_counters()
+    costs.set_enabled(False)
+    costs.reset_cache()
+    yield
+    while obs.active() is not None:
+        obs.deactivate()
+    obs.reset_counters()
+    costs.set_enabled(False)
+    costs.reset_cache()
+
+
+def read_jsonl(path):
+    return [json.loads(ln) for ln in open(path).read().splitlines()]
+
+
+OBS_DIM, N_ACT = 5, 2
+
+
+def _tr(rng, obs_dim=OBS_DIM, n_actions=N_ACT):
+    return {"state": rng.standard_normal(obs_dim).astype(np.float32),
+            "new_state": rng.standard_normal(obs_dim).astype(np.float32),
+            "action": rng.standard_normal(n_actions).astype(np.float32),
+            "reward": np.float32(rng.standard_normal()),
+            "done": False,
+            "hint": rng.standard_normal(n_actions).astype(np.float32)}
+
+
+def _filled_buf(n=8, mem=16, prioritized=False):
+    buf = rp.replay_init(mem, rp.transition_spec(OBS_DIM, N_ACT))
+    rng = np.random.default_rng(0)
+    for _ in range(n):
+        buf = rp.replay_add(buf, _tr(rng),
+                            priority=None if prioritized
+                            else jnp.asarray(1.0),
+                            error=jnp.asarray(abs(rng.standard_normal()))
+                            if prioritized else None)
+    return buf
+
+
+def _assert_trees_bit_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _check_on_off(learn, cfg, st, buf, n_steps=2):
+    """Run ``n_steps`` chained updates with collect_diag on and off and
+    assert the primary outputs are bit-identical; returns the last diag."""
+    f_off = jax.jit(lambda s, b, k: learn(cfg, s, b, k, collect_diag=False))
+    f_on = jax.jit(lambda s, b, k: learn(cfg, s, b, k, collect_diag=True))
+    st_off = st_on = st
+    buf_off = buf_on = buf
+    diag = None
+    for i in range(n_steps):
+        k = jax.random.PRNGKey(100 + i)
+        st_off, buf_off, m_off = f_off(st_off, buf_off, k)
+        st_on, buf_on, m_on = f_on(st_on, buf_on, k)
+        diag = m_on.pop("diag")
+        _assert_trees_bit_equal(st_off, st_on)
+        _assert_trees_bit_equal(buf_off, buf_on)
+        assert set(m_off) == set(m_on)
+        _assert_trees_bit_equal(m_off, m_on)
+    host = obs.diag_to_host(diag)
+    assert set(host) == set(obs.UpdateDiag._fields)
+    return host
+
+
+# ---------------------------------------------------------------------------
+# Per-agent bit-identity (collect_diag off ≙ on for the primary outputs)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_ddpg_diag_bit_identity():
+    cfg = ddpg.DDPGConfig(obs_dim=OBS_DIM, n_actions=N_ACT, batch_size=4,
+                          mem_size=16, img_shape=None)
+    st = ddpg.ddpg_init(jax.random.PRNGKey(0), cfg)
+    host = _check_on_off(ddpg.learn, cfg, st, _filled_buf())
+    assert host["critic_grad_norm"] > 0
+    assert host["q_max"] >= host["q_mean"] >= host["q_min"]
+    assert host["alpha"] == 0.0          # DDPG has no temperature
+
+
+@pytest.mark.slow
+def test_td3_hint_admm_diag_bit_identity():
+    """TD3 with the hint-ADMM actor: the fori_loop carry widening must
+    not perturb the update, across both a delayed-skip and an actor
+    step (update_actor_interval=2)."""
+    cfg = td3.TD3Config(obs_dim=OBS_DIM, n_actions=N_ACT, batch_size=4,
+                        mem_size=16, img_shape=None, use_hint=True,
+                        n_admm=2, update_actor_interval=2,
+                        prioritized=True)
+    st = td3.td3_init(jax.random.PRNGKey(0), cfg)
+    host = _check_on_off(td3.learn, cfg, st,
+                         _filled_buf(prioritized=True), n_steps=2)
+    assert host["critic_grad_norm"] > 0
+    # step 2 is the actor step: the ADMM constraint residual is real
+    assert host["actor_grad_norm"] > 0
+    assert host["hint_residual"] > 0
+
+
+@pytest.mark.slow
+def test_sac_hint_diag_bit_identity():
+    cfg = sac.SACConfig(obs_dim=OBS_DIM, n_actions=N_ACT, batch_size=4,
+                        mem_size=16, img_shape=None, use_hint=True,
+                        reward_scale=1.0, prioritized=True)
+    st = sac.sac_init(jax.random.PRNGKey(0), cfg)
+    host = _check_on_off(sac.learn, cfg, st, _filled_buf(prioritized=True))
+    assert host["critic_grad_norm"] > 0
+    assert host["actor_grad_norm"] > 0
+    assert host["alpha"] > 0
+    assert host["hint_residual"] > 0
+    assert math.isfinite(host["entropy"])
+
+
+@pytest.mark.slow
+def test_dsac_diag_bit_identity():
+    npix, K = 4, 3
+    cfg = dsac.DSACConfig(obs_dim=npix * npix + 3 * K + 2,
+                          n_actions=2 ** (K - 1), img_shape=(npix, npix),
+                          use_image=True, batch_size=4, mem_size=16)
+    st = dsac.dsac_init(jax.random.PRNGKey(0), cfg)
+    buf = rp.replay_init(cfg.mem_size, dsac.transition_spec(cfg.obs_dim))
+    rng = np.random.default_rng(1)
+    for _ in range(8):
+        buf = rp.replay_add(
+            buf, {"state": rng.standard_normal(cfg.obs_dim)
+                  .astype(np.float32),
+                  "action": np.int32(rng.integers(cfg.n_actions)),
+                  "reward": np.float32(rng.standard_normal()),
+                  "new_state": rng.standard_normal(cfg.obs_dim)
+                  .astype(np.float32),
+                  "done": False},
+            error=jnp.asarray(abs(rng.standard_normal())))
+    host = _check_on_off(dsac.learn, cfg, st, buf, n_steps=1)
+    assert host["critic_grad_norm"] > 0
+    assert host["entropy"] > 0           # categorical entropy is exact
+
+
+@pytest.mark.slow
+def test_no_learn_branch_zero_diag():
+    """Below batch_size the no-learn branch reports the all-zero diag and
+    still bit-matches the diagnostics-off no-op."""
+    cfg = ddpg.DDPGConfig(obs_dim=OBS_DIM, n_actions=N_ACT, batch_size=4,
+                          mem_size=16, img_shape=None)
+    st = ddpg.ddpg_init(jax.random.PRNGKey(0), cfg)
+    buf = _filled_buf(n=2)               # 2 < batch_size
+    host = _check_on_off(ddpg.learn, cfg, st, buf, n_steps=1)
+    assert all(v == 0.0 for v in host.values())
+
+
+@pytest.mark.slow
+def test_agent_wrapper_single_trace_with_diag():
+    """collect_diag=True costs at most ONE compiled program per agent:
+    repeated ``learn()`` calls hit the same jit cache entry (the call
+    site is spelled identically every step)."""
+    cfg = td3.TD3Config(obs_dim=OBS_DIM, n_actions=N_ACT, batch_size=4,
+                        mem_size=16, img_shape=None, warmup=0)
+    agent = td3.TD3Agent(cfg, seed=0, collect_diag=True)
+    rng = np.random.default_rng(2)
+    for _ in range(6):
+        t = _tr(rng)
+        agent.store_transition(t["state"], t["action"], float(t["reward"]),
+                               t["new_state"], t["done"], t["hint"])
+        agent.learn()
+    assert agent._learn._cache_size() == 1
+    assert agent.last_diag is not None
+    assert "diag" not in agent.last_metrics
+
+
+# ---------------------------------------------------------------------------
+# Replay health
+# ---------------------------------------------------------------------------
+
+def test_replay_health_uniform_vs_collapsed():
+    buf = _filled_buf(n=8)
+    h = rp.replay_health(buf)
+    assert h["filled"] == 8
+    np.testing.assert_allclose(h["priority_entropy"], 1.0, atol=1e-6)
+    np.testing.assert_allclose(h["max_mean_priority_ratio"], 1.0,
+                               atol=1e-6)
+    np.testing.assert_allclose(sum(h["age_priority_hist"]), 1.0, atol=1e-4)
+    assert h["is_weight_max"] >= h["is_weight_min"] > 0
+
+    # one transition hoards the priority mass -> entropy collapses
+    collapsed = buf._replace(
+        priority=buf.priority.at[0].set(1e6))
+    hc = rp.replay_health(collapsed)
+    assert hc["priority_entropy"] < 0.1
+    assert hc["max_mean_priority_ratio"] > 5.0
+
+
+def test_replay_health_zero_total_degenerate():
+    """The all-zero distribution (pre-first-store) reports the collapse
+    explicitly instead of dividing by zero."""
+    buf = rp.replay_init(16, rp.transition_spec(OBS_DIM, N_ACT))
+    rng = np.random.default_rng(3)
+    for _ in range(3):
+        buf = rp.replay_add(buf, _tr(rng), priority=jnp.asarray(0.0))
+    h = rp.replay_health(buf)
+    assert h["filled"] == 3
+    assert h["priority_total"] == 0.0
+    assert h["priority_entropy"] == 0.0
+    assert "is_weight_max" not in h      # undefined at zero mass
+
+
+def test_native_per_health_matches_shared_math():
+    from smartcal_tpu.rl.replay_native import NativePER
+
+    spec = rp.transition_spec(OBS_DIM, N_ACT)
+    buf = NativePER(16, spec, error_clip=100.0)
+    rng = np.random.default_rng(4)
+    for _ in range(6):
+        buf.store(_tr(rng), error=abs(rng.standard_normal()))
+    h = buf.health()
+    assert h["filled"] == 6
+    assert 0 < h["priority_entropy"] <= 1.0
+    assert h["beta"] == buf.beta
+
+
+# ---------------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------------
+
+def _diag(closs=0.1, aloss=0.1, cgrad=1.0, agrad=1.0, q=0.5):
+    return {"critic_loss": closs, "actor_loss": aloss,
+            "critic_grad_norm": cgrad, "actor_grad_norm": agrad,
+            "q_mean": q, "q_min": q - 1, "q_max": q + 1}
+
+
+def test_watchdog_nan_trip_with_ring(tmp_path):
+    path = str(tmp_path / "w.jsonl")
+    with obs.recording(path):
+        wd = obs.Watchdog(obs.WatchdogConfig(ring=4))
+        for i in range(6):
+            assert not wd.observe(_diag(), step=i)
+        assert wd.observe(_diag(closs=float("nan")), step=6)
+    assert wd.tripped and wd.trip_reason == "non_finite:critic_loss"
+    trips = [e for e in read_jsonl(path) if e["event"] == "watchdog_trip"]
+    assert len(trips) == 1
+    t = trips[0]
+    assert t["reason"] == "non_finite:critic_loss" and t["step"] == 6
+    # ring holds the LAST cfg.ring diagnostics incl. the offender
+    assert len(t["ring"]) == 4
+    assert t["ring"][-1]["step"] == 6
+    assert t["ring"][-1]["critic_loss"] is None    # sanitized NaN
+    # latched: later observations keep reporting tripped, no second event
+    assert wd.observe(_diag(), step=7)
+
+
+def test_watchdog_sanitized_null_counts_as_non_finite():
+    wd = obs.Watchdog()
+    d = _diag()
+    d["critic_grad_norm"] = None         # runlog sanitize()d upstream
+    assert wd.observe(d, step=0)
+    assert wd.trip_reason == "non_finite:critic_grad_norm"
+
+
+def test_watchdog_exploding_grad_within_k_steps():
+    cfg = obs.WatchdogConfig(grad_mult=10.0, warmup=5, ewma_alpha=0.1)
+    wd = obs.Watchdog(cfg)
+    rng = np.random.default_rng(5)
+    for i in range(20):                  # healthy stream around 1.0
+        assert not wd.observe(_diag(cgrad=1.0 + 0.1
+                                    * rng.standard_normal()), step=i)
+    assert wd.observe(_diag(cgrad=1e4), step=20)   # trips IMMEDIATELY
+    assert wd.trip_reason.startswith("exploding_grad:critic_grad_norm")
+
+
+def test_watchdog_skips_zero_grads_and_warmup():
+    """Pre-fill/delayed-update zero grads must not poison the EWMA: the
+    first real gradient after a run of zeros is NOT explosive, and no
+    check arms before ``warmup`` real observations."""
+    wd = obs.Watchdog(obs.WatchdogConfig(grad_mult=5.0, warmup=3))
+    for i in range(50):
+        assert not wd.observe(_diag(cgrad=0.0, agrad=0.0), step=i)
+    assert not wd.observe(_diag(cgrad=2.0), step=50)
+    for i in range(10):
+        assert not wd.observe(_diag(cgrad=2.0), step=51 + i)
+    assert not wd.tripped
+
+
+def test_watchdog_q_blowup():
+    wd = obs.Watchdog(obs.WatchdogConfig(q_limit=100.0))
+    assert not wd.observe(_diag(q=50.0), step=0)
+    assert wd.observe(_diag(q=500.0), step=1)
+    assert wd.trip_reason.startswith("q_blowup:")
+
+
+def test_watchdog_replay_non_finite():
+    wd = obs.Watchdog()
+    assert not wd.observe_replay({"priority_entropy": 0.9,
+                                  "priority_total": 10.0})
+    assert wd.observe_replay({"priority_entropy": float("nan"),
+                              "priority_total": 10.0})
+    assert wd.trip_reason == "replay_non_finite:priority_entropy"
+
+
+# ---------------------------------------------------------------------------
+# TrainObs integration (record_diag / log_replay_health / halt contract)
+# ---------------------------------------------------------------------------
+
+def test_train_obs_record_diag_stream_and_halt(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tob = blocks.train_obs("unit", metrics=path, quiet=True, diag=True,
+                           watchdog=True)
+    try:
+        # a step-stacked host diag (what an episode scan produces)
+        clean = {k: [0.1] * 3 for k in obs.UpdateDiag._fields}
+        assert tob.record_diag(clean, episode=0) is False
+        bad = {k: [0.1, float("nan"), 0.1]
+               for k in obs.UpdateDiag._fields}
+        assert tob.record_diag(bad, episode=1) is True
+        assert tob.tripped
+        # after the trip the stream stops cleanly
+        assert tob.record_diag(clean, episode=2) is True
+        tob.log_replay_health(_filled_buf(), episode=2)
+    finally:
+        tob.close()
+    recs = read_jsonl(path)
+    diags = [e for e in recs if e["event"] == "diag"]
+    assert [d["step"] for d in diags[:3]] == [0, 1, 2]
+    assert any(e["event"] == "watchdog_trip" for e in recs)
+    assert recs[-1]["event"] == "run_end"
+    assert recs[-1]["watchdog_tripped"] is True
+
+
+def test_train_obs_record_diag_noop_without_diag(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tob = blocks.train_obs("unit", metrics=path, quiet=True)
+    try:
+        assert tob.record_diag(None) is False
+        assert tob.record_diag({"critic_loss": float("nan")}) is False
+        assert tob.log_replay_health(_filled_buf()) is False
+    finally:
+        tob.close()
+    recs = read_jsonl(path)
+    assert not [e for e in recs if e["event"] in ("diag", "replay_health",
+                                                  "watchdog_trip")]
+
+
+@pytest.mark.slow
+def test_enet_driver_watchdog_halts_on_injected_nan(tmp_path, monkeypatch):
+    """End-to-end: a NaN critic loss injected at the device->host diag
+    boundary trips the watchdog, the enet driver logs watchdog_trip with
+    ring context, stops early, and exits cleanly."""
+    monkeypatch.chdir(tmp_path)
+    from smartcal_tpu.train.enet_sac import train_fused
+
+    real = obs.diag_to_host
+    state = {"calls": 0}
+
+    def inject(diag):
+        host = real(diag)
+        state["calls"] += 1
+        if state["calls"] >= 2:          # poison from the second episode
+            v = host["critic_loss"]
+            host["critic_loss"] = ([float("nan")] * len(v)
+                                   if isinstance(v, list) else float("nan"))
+        return host
+
+    monkeypatch.setattr(obs, "diag_to_host", inject)
+    path = str(tmp_path / "run.jsonl")
+    scores = train_fused(episodes=6, steps=2, M=6, N=6, quiet=True,
+                         save_every=0, metrics_path=path,
+                         watchdog=True)[0]
+    assert len(scores) < 6               # halted early, returned cleanly
+    recs = read_jsonl(path)
+    trips = [e for e in recs if e["event"] == "watchdog_trip"]
+    assert len(trips) == 1
+    assert trips[0]["reason"] == "non_finite:critic_loss"
+    assert len(trips[0]["ring"]) >= 1
+    end = recs[-1]
+    assert end["event"] == "run_end" and end["watchdog_tripped"] is True
+    assert obs.active() is None
+
+
+# ---------------------------------------------------------------------------
+# FLOPs / roofline accounting
+# ---------------------------------------------------------------------------
+
+def test_stage_cost_counts_flops():
+    f = jax.jit(lambda a, b: a @ b)
+    x = jnp.ones((8, 8), jnp.float32)
+    c = costs.stage_cost(f, x, x)
+    assert c["flops"] > 0
+    assert c["bytes_accessed"] > 0
+
+
+def test_record_stage_cost_gating_and_cache(tmp_path):
+    path = str(tmp_path / "c.jsonl")
+    f = jax.jit(lambda a: a * 2.0)
+    x = jnp.ones((4,), jnp.float32)
+    # disarmed / no runlog -> strict no-op
+    assert costs.record_stage_cost("s", f, x) is None
+    with obs.recording(path):
+        assert costs.record_stage_cost("s", f, x) is None  # not enabled
+        costs.set_enabled(True)
+        c1 = costs.record_stage_cost("s", f, x)
+        assert c1["flops"] >= 0
+        # same signature -> cached, no second event
+        assert costs.record_stage_cost("s", f, x) == c1
+        # new signature -> new event
+        costs.record_stage_cost("s", f, jnp.ones((8,), jnp.float32))
+    evs = [e for e in read_jsonl(path) if e["event"] == "cost"]
+    assert len(evs) == 2
+    assert all(e["stage"] == "s" for e in evs)
+
+
+def test_record_stage_cost_failure_is_recorded_not_raised(tmp_path):
+    path = str(tmp_path / "c.jsonl")
+
+    def boom(a):
+        raise ValueError("no lowering for you")
+
+    with obs.recording(path):
+        costs.set_enabled(True)
+        out = costs.record_stage_cost("bad", boom,
+                                      jnp.ones((2,), jnp.float32))
+        assert "error" in out
+        # negatively cached: the failure is paid once
+        assert costs.record_stage_cost(
+            "bad", boom, jnp.ones((2,), jnp.float32)) == out
+    evs = [e for e in read_jsonl(path) if e["event"] == "cost"]
+    assert len(evs) == 1 and "error" in evs[0]
+
+
+def test_record_stage_cost_defer_flush(tmp_path):
+    """In-span call sites defer the lower+compile; flush_pending (the
+    between-episodes hook) pays it outside any timed region, once."""
+    path = str(tmp_path / "c.jsonl")
+    f = jax.jit(lambda a: a + 1.0)
+    x = jnp.ones((4,), jnp.float32)
+    with obs.recording(path):
+        costs.set_enabled(True)
+        assert costs.record_stage_cost("d", f, x, defer=True) is None
+        # deduped while pending: the repeat does not queue again
+        assert costs.record_stage_cost("d", f, x, defer=True) is None
+        assert not [e for e in read_jsonl(path) if e["event"] == "cost"]
+        assert costs.flush_pending() == 1
+        assert costs.flush_pending() == 0
+        # flushed result is cached for later immediate callers
+        assert costs.record_stage_cost("d", f, x)["flops"] >= 0
+    evs = [e for e in read_jsonl(path) if e["event"] == "cost"]
+    assert len(evs) == 1 and evs[0]["stage"] == "d"
+
+
+def test_roofline_peak_cpu_graceful(tmp_path):
+    assert costs.device_peak() is None   # CPU: no known peak
+    path = str(tmp_path / "c.jsonl")
+    with obs.recording(path):
+        assert costs.log_roofline_peak() is None
+    assert not [e for e in read_jsonl(path)
+                if e["event"] == "roofline_peak"]
+
+
+# ---------------------------------------------------------------------------
+# obs_report: training health + roofline sections
+# ---------------------------------------------------------------------------
+
+def _write_run(path, events):
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"t": 0.0, "event": "run_header", "schema": 2,
+                             "run_id": "r", "meta": {"entry": "x"}}) + "\n")
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+
+
+def _synthetic_training_run(with_peak):
+    evs = []
+    # grad norms ramp 1 -> 4 over 20 learning updates + 4 skip zeros
+    for i in range(24):
+        g = 0.0 if i < 4 else 1.0 + 3.0 * (i - 4) / 19.0
+        evs.append({"t": float(i), "event": "diag", "step": i,
+                    "critic_loss": 0.1, "actor_loss": 0.1,
+                    "critic_grad_norm": g, "actor_grad_norm": g / 2,
+                    "q_mean": 0.5, "q_min": 0.0, "q_max": 1.0,
+                    "critic_update_ratio": 1e-3, "entropy": 0.9})
+    evs.append({"t": 24.0, "event": "replay_health", "priority_entropy":
+                0.99, "max_mean_priority_ratio": 1.2, "beta": 0.4,
+                "is_weight_max": 1.0, "filled": 24, "size": 64})
+    evs.append({"t": 25.0, "event": "replay_health", "priority_entropy":
+                0.8, "max_mean_priority_ratio": 3.0, "beta": 0.5,
+                "is_weight_max": 2.0, "filled": 48, "size": 64})
+    evs.append({"t": 26.0, "event": "watchdog_trip", "reason":
+                "q_blowup:q_max (|2e+06| > 1e+06)", "step": 23,
+                "observations": 24, "ring": [{"step": 23}]})
+    evs.append({"t": 27.0, "event": "cost", "stage": "episode_update",
+                "flops": 1e9, "bytes_accessed": 1e8})
+    for i in range(4):
+        evs.append({"t": 28.0 + i, "event": "span", "path": "episode",
+                    "name": "episode", "dur_s": 0.5})
+    if with_peak:
+        evs.append({"t": 40.0, "event": "roofline_peak", "platform": "tpu",
+                    "chip": "v5e", "bf16": 197e12, "fp32_est": 49e12})
+    return evs
+
+
+def test_obs_report_training_health_and_roofline(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    _write_run(path, _synthetic_training_run(with_peak=True))
+    rep = obs_report.build_report([obs_report.load_run(path)], n_boot=10)
+    r = rep["runs"][0]
+
+    th = r["training_health"]
+    assert th["updates"] == 24
+    assert th["learning_updates"] == 20   # zeros are skip steps
+    qm = th["trajectory"]["critic_grad_norm"]["quarter_means"]
+    assert len(qm) == 4 and qm[-1] > qm[0]          # the ramp is visible
+    assert th["replay"]["priority_entropy_last"] == 0.8
+    assert th["watchdog_trips"][0]["reason"].startswith("q_blowup")
+
+    rl = r["roofline"]
+    assert rl["peak"]["chip"] == "v5e"
+    st = rl["stages"]["episode_update"]
+    assert st["calls"] == 4
+    # 1e9 flops x 4 calls / 2.0 s = 2e9 FLOPs/s
+    np.testing.assert_allclose(st["achieved_flops_per_s"], 2e9)
+    np.testing.assert_allclose(st["fraction_of_peak_fp32"], 2e9 / 49e12,
+                               rtol=1e-2)  # report rounds to 6 decimals
+
+    text = obs_report.render(rep)
+    assert "WATCHDOG TRIP" in text
+    assert "roofline" in text
+    assert "%peak" in text
+    json.dumps(rep)                       # fully machine-serializable
+
+
+def test_obs_report_roofline_degrades_without_peak(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    _write_run(path, _synthetic_training_run(with_peak=False))
+    rep = obs_report.build_report([obs_report.load_run(path)], n_boot=10)
+    st = rep["runs"][0]["roofline"]["stages"]["episode_update"]
+    assert "achieved_flops_per_s" in st
+    assert "fraction_of_peak_fp32" not in st
+    text = obs_report.render(rep)
+    assert "fraction-of-peak unavailable" in text
+
+
+def test_obs_report_no_diag_run_has_no_health_sections(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    _write_run(path, [{"t": 1.0, "event": "episode", "episode": 0,
+                       "score": 1.0}])
+    rep = obs_report.build_report([obs_report.load_run(path)], n_boot=10)
+    assert rep["runs"][0]["training_health"] is None
+    assert rep["runs"][0]["roofline"] is None
+    text = obs_report.render(rep)
+    assert "training health" not in text
+
+
+# ---------------------------------------------------------------------------
+# obs_tail
+# ---------------------------------------------------------------------------
+
+def test_obs_tail_renders_all_new_event_kinds(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    _write_run(path, _synthetic_training_run(with_peak=True)
+               + [{"t": 50.0, "event": "episode", "episode": 0,
+                   "score": -0.5},
+                  {"t": 51.0, "event": "run_end", "episodes": 1,
+                   "updates": 24, "watchdog_tripped": True,
+                   "wall_s": 9.0}])
+    out = io.StringIO()
+    obs_tail.tail(path, follow=False, out=out)
+    text = out.getvalue()
+    assert "WATCHDOG" in text and "q_blowup" in text
+    assert "diag" in text and "replay" in text
+    assert "cost" in text and "peak" in text
+    assert "episode    #0" in text
+    assert "tripped=True" in text
+    # filtering
+    out2 = io.StringIO()
+    obs_tail.tail(path, wanted={"watchdog_trip"}, follow=False, out=out2)
+    lines = [ln for ln in out2.getvalue().splitlines() if ln]
+    assert len(lines) == 1 and "WATCHDOG" in lines[0]
+
+
+def test_obs_tail_rotation_drains_old_segment(tmp_path, monkeypatch):
+    """The writer's final flush to a segment can land between the
+    tailer's last read and the rotation rename; the tailer must drain
+    the old inode before following the fresh file (the burst can hold
+    the watchdog_trip)."""
+    base = str(tmp_path / "run.jsonl")
+    with open(base, "w") as f:
+        f.write(json.dumps({"t": 1.0, "event": "episode", "episode": 0,
+                            "score": 1.0}) + "\n")
+    state = {"rotated": False}
+    real_stat = os.stat
+
+    def stat_and_rotate(p, *a, **kw):
+        # fires on the tailer's idle poll: emulate the writer flushing a
+        # last burst to the old inode and rotating, exactly between the
+        # tailer's read()=="" and its os.stat
+        if p == base and not state["rotated"]:
+            state["rotated"] = True
+            with open(base, "a") as f:
+                f.write(json.dumps(
+                    {"t": 2.0, "event": "watchdog_trip",
+                     "reason": "non_finite:critic_loss", "step": 7,
+                     "observations": 8, "ring": [{}]}) + "\n")
+            os.replace(base, base + ".1")
+            with open(base, "w") as f:
+                f.write(json.dumps({"t": 3.0, "event": "episode",
+                                    "episode": 1, "score": 2.0}) + "\n")
+        return real_stat(p, *a, **kw)
+
+    monkeypatch.setattr(obs_tail.os, "stat", stat_and_rotate)
+    out = io.StringIO()
+    obs_tail.tail(base, follow=True, interval=0.01, out=out, max_iters=2)
+    text = out.getvalue()
+    assert "episode    #0" in text
+    assert "WATCHDOG" in text            # drained from the rotated inode
+    assert "episode    #1" in text       # and followed into the new file

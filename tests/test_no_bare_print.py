@@ -1,22 +1,51 @@
-"""Static check: no new bare ``print(`` in smartcal_tpu/ (obs satellite).
+"""Static check: no new bare ``print(`` in smartcal_tpu/ or tools/.
 
 Diagnostics must flow through the obs layer (``obs.echo`` -> stderr +
 structured event, ``obs.emit_json`` -> the stdout machine interface) so
 logging stays structured and ``--quiet``-able.  ``smartcal_tpu/obs/
-console.py`` is the one sanctioned ``print`` site.  Tokenizer-based so
-strings, comments, and ``.print(`` method calls never false-positive.
+console.py`` is the one sanctioned ``print`` site in the package; in
+``tools/`` an explicit stdout allowlist names the CLIs whose stdout IS
+their product (report/sweep/bench output that scripts parse or humans
+pipe) — a new tool must either route through ``smartcal_tpu.obs.console``
+or be added there deliberately.  Tokenizer-based so strings, comments,
+and ``.print(`` method calls never false-positive.
 """
 
 import io
 import os
 import tokenize
 
-PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "smartcal_tpu")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(_ROOT, "smartcal_tpu")
+TOOLS = os.path.join(_ROOT, "tools")
 
 # relative paths (to smartcal_tpu/) allowed to call print()
 ALLOWLIST = {
     os.path.join("obs", "console.py"),
+}
+
+# tools/ files sanctioned to print to stdout directly: their stdout is
+# the tool's interface (obs_report/obs_tail render reports and must run
+# standalone without the package importable; the sweeps/benches emit the
+# JSON lines capture scripts parse).  Anything NOT listed here must
+# route output through smartcal_tpu.obs.console.
+TOOLS_STDOUT_ALLOWLIST = {
+    "bench_host_seg.py",
+    "bench_per.py",
+    "bench_solve_eval.py",
+    "capture_calib_episode.py",
+    "certify_batched.py",
+    "chip_checks.py",
+    "convert_ateam.py",
+    "eig_mode_parity.py",
+    "enet_hint_stats.py",
+    "measure_reference.py",
+    "obs_report.py",
+    "obs_tail.py",
+    "summarize_demix_curves.py",
+    "sweep_calib.py",
+    "sweep_demix.py",
+    "sweep_enet.py",
 }
 
 _SKIP_TYPES = (tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
@@ -63,7 +92,22 @@ def test_no_bare_print_in_package():
         + "\n  ".join(offenders))
 
 
+def test_no_bare_print_in_tools():
+    offenders = []
+    for fn in sorted(os.listdir(TOOLS)):
+        if not fn.endswith(".py") or fn in TOOLS_STDOUT_ALLOWLIST:
+            continue
+        for line in bare_print_lines(os.path.join(TOOLS, fn)):
+            offenders.append(f"tools/{fn}:{line}")
+    assert not offenders, (
+        "bare print() in an unlisted tool — route output through "
+        "smartcal_tpu.obs.console (echo/emit_json) or add the file to "
+        "TOOLS_STDOUT_ALLOWLIST deliberately:\n  " + "\n  ".join(offenders))
+
+
 def test_allowlist_entries_exist():
-    """A deleted/renamed sanctioned file must not linger in the list."""
+    """A deleted/renamed sanctioned file must not linger in the lists."""
     for rel in ALLOWLIST:
         assert os.path.exists(os.path.join(PKG, rel)), rel
+    for fn in TOOLS_STDOUT_ALLOWLIST:
+        assert os.path.exists(os.path.join(TOOLS, fn)), fn

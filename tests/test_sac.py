@@ -77,6 +77,73 @@ def test_per_distribution_matches_priorities():
     assert counts[4:].sum() == 0
 
 
+def test_per_pmax_fallback_zero_and_nonzero():
+    """replay.py:103-105: priority-less store falls back to error_clip on
+    an all-zero priority vector (untouched buffer) and to the running max
+    afterwards — the repair that keeps the first stores sampleable."""
+    buf = rp.replay_init(8, _spec())
+    assert float(jnp.max(buf.priority)) == 0.0
+    buf = rp.replay_add(buf, _tr(0), error_clip=7.0)
+    assert float(buf.priority[0]) == 7.0          # pmax==0 -> clip
+    buf = rp.replay_update_priorities(buf, jnp.asarray([0]),
+                                      jnp.asarray([0.5]))
+    pmax = float(jnp.max(buf.priority))
+    buf = rp.replay_add(buf, _tr(1), error_clip=7.0)
+    np.testing.assert_allclose(float(buf.priority[1]), pmax, rtol=1e-6)
+
+    # batch variant, same two branches
+    batch = {k: np.stack([v, v]) for k, v in _tr(2).items()}
+    b2 = rp.replay_add_batch(rp.replay_init(8, _spec()), batch,
+                             error_clip=5.0)
+    np.testing.assert_allclose(np.asarray(b2.priority[:2]), 5.0)
+    b3 = rp.replay_add_batch(b2, batch)
+    np.testing.assert_allclose(np.asarray(b3.priority[2:4]),
+                               float(jnp.max(b2.priority)), rtol=1e-6)
+
+
+def test_per_error_clip_saturation():
+    """The deliberate store/update clip asymmetry at saturation: store
+    clips the POWER min((|e|+eps)^a, clip); batch_update clips the ERROR
+    then exponentiates, min(|e|+eps, clip)^a (enet_sac.py:237/314)."""
+    huge = jnp.asarray(1e12)
+    stored = float(rp.priority_from_errors(huge, error_clip=100.0))
+    assert stored == 100.0
+    buf = rp.replay_init(4, _spec())
+    buf = rp.replay_add(buf, _tr(0), error=huge, error_clip=100.0)
+    assert float(buf.priority[0]) == 100.0
+    buf = rp.replay_update_priorities(buf, jnp.asarray([0]), huge[None],
+                                      error_clip=100.0)
+    np.testing.assert_allclose(float(buf.priority[0]),
+                               100.0 ** rp.PER_ALPHA, rtol=1e-5)
+    # below the clip both rules agree (eps + exponent, no saturation)
+    buf = rp.replay_add(buf, _tr(1), error=jnp.asarray(0.25))
+    np.testing.assert_allclose(float(buf.priority[1]),
+                               (0.25 + rp.PER_EPSILON) ** rp.PER_ALPHA,
+                               rtol=1e-5)
+
+
+def test_per_beta_annealing_monotone_and_capped():
+    """Beta anneals by PER_BETA_INCREMENT per PER sample, never
+    decreases, and saturates at exactly 1.0."""
+    buf = rp.replay_init(8, _spec())
+    for i in range(4):
+        buf = rp.replay_add(buf, _tr(i), error=jnp.asarray(float(i)))
+    betas = [float(buf.beta)]
+    for s in range(5):
+        _, _, _, buf = rp.replay_sample_per(buf, jax.random.PRNGKey(s), 2)
+        betas.append(float(buf.beta))
+    diffs = np.diff(betas)
+    assert np.all(diffs > 0)
+    np.testing.assert_allclose(diffs, rp.PER_BETA_INCREMENT, rtol=1e-3)
+    # force the cap: one increment away from 1 -> exactly 1, then stays
+    buf = buf._replace(beta=jnp.asarray(1.0 - rp.PER_BETA_INCREMENT / 2,
+                                        jnp.float32))
+    _, _, _, buf = rp.replay_sample_per(buf, jax.random.PRNGKey(99), 2)
+    assert float(buf.beta) == 1.0
+    _, _, _, buf = rp.replay_sample_per(buf, jax.random.PRNGKey(100), 2)
+    assert float(buf.beta) == 1.0
+
+
 def test_gaussian_sample_logprob():
     mu = jnp.zeros((1, 2))
     logsigma = jnp.zeros((1, 2))

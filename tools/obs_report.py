@@ -20,6 +20,16 @@ given.  Output sections:
   (CI < 0), or NO TREND.  This is the "the sweep cannot detect learning"
   gap: a flat curve and an improving one get different verdicts with
   quantified confidence.
+* **Training health** (``--diag`` runs) — grad-norm trajectory over the
+  learning updates (quarter means, so a ramp or a blowup is visible at a
+  glance), non-finite counts, watchdog trips with their reasons, and the
+  replay-health trend (priority entropy, max/mean ratio, IS-weight
+  spread, beta).
+* **Roofline** (``--diag`` runs) — per-stage XLA flops/bytes from the
+  ``cost`` events joined with the span stream's call counts/wall time
+  into achieved FLOPs/s, plus fraction-of-peak when the run recorded a
+  ``roofline_peak`` (chip) reference; dashes, never a crash, when a
+  stage has no span match or the run has no peak reference.
 
 Usage:
     python tools/obs_report.py run1.jsonl [run2.jsonl ...] [--json]
@@ -229,6 +239,214 @@ def solver_summary(events):
 
 
 # ---------------------------------------------------------------------------
+# Training health (diag / replay_health / watchdog_trip events)
+# ---------------------------------------------------------------------------
+
+# diag fields summarized in the health section (trajectory-worthy ones)
+_DIAG_TRAJ = ("critic_grad_norm", "actor_grad_norm", "critic_loss",
+              "q_mean", "q_max", "critic_update_ratio", "entropy")
+
+
+def _quarter_means(vals):
+    """Mean of each quarter of the series — the cheapest trajectory that
+    still shows a ramp, a plateau, or a blowup."""
+    v = np.asarray(vals, np.float64)
+    qs = np.array_split(v, min(4, len(v)))
+    return [round(float(q.mean()), 6) for q in qs if q.size]
+
+
+def training_health(events):
+    """Aggregate the diag/replay_health/watchdog_trip streams, or None
+    for a run recorded without ``--diag``."""
+    diags = [e for e in events if e.get("event") == "diag"]
+    replay = [e for e in events if e.get("event") == "replay_health"]
+    trips = [e for e in events if e.get("event") == "watchdog_trip"]
+    if not (diags or replay or trips):
+        return None
+    out = {"updates": len(diags)}
+    if diags:
+        # learning updates: the ones where the critic actually stepped
+        # (exact zeros are the pre-buffer-fill / delayed-update skips,
+        # same convention as the watchdog); None = sanitized non-finite
+        nonfinite = sum(1 for e in diags
+                        for k in ("critic_loss", "critic_grad_norm",
+                                  "q_mean")
+                        if k in e and e[k] is None)
+        def _learned(e):
+            g = e.get("critic_grad_norm")
+            if isinstance(g, (int, float)):
+                return g != 0.0
+            # partial streams (the parallel learners log only the
+            # episode's last critic loss): any real loss value means
+            # the SPMD update program learned
+            return ("critic_grad_norm" not in e
+                    and isinstance(e.get("critic_loss"), (int, float)))
+
+        learn = [e for e in diags if _learned(e)]
+        out["learning_updates"] = len(learn)
+        out["nonfinite_values"] = nonfinite
+        traj = {}
+        for k in _DIAG_TRAJ:
+            vals = [e[k] for e in learn
+                    if isinstance(e.get(k), (int, float))
+                    and np.isfinite(e[k])]
+            if vals:
+                traj[k] = {"quarter_means": _quarter_means(vals),
+                           "last": round(float(vals[-1]), 6),
+                           "max": round(float(max(vals)), 6)}
+        out["trajectory"] = traj
+    if replay:
+        first, last = replay[0], replay[-1]
+        rh = {"samples": len(replay)}
+        for k in ("priority_entropy", "max_mean_priority_ratio", "beta",
+                  "is_weight_max", "age_mean_weighted"):
+            if isinstance(last.get(k), (int, float)):
+                rh[k + "_last"] = round(float(last[k]), 6)
+            if isinstance(first.get(k), (int, float)):
+                rh[k + "_first"] = round(float(first[k]), 6)
+        for k in ("filled", "size"):
+            if last.get(k) is not None:
+                rh[k] = last[k]
+        out["replay"] = rh
+    out["watchdog_trips"] = [
+        {"reason": e.get("reason"), "step": e.get("step"),
+         "observations": e.get("observations"),
+         "ring_len": len(e.get("ring") or [])} for e in trips]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Roofline (cost / roofline_peak events joined with the span stream)
+# ---------------------------------------------------------------------------
+
+# cost stage -> span leaf name, where they are not spelled identically
+# (the enet drivers' whole-episode jitted update is spanned "episode");
+# every other costed stage — simulate/solve/influence and the agent
+# wrappers' agent_update_<algo> — spans under its own cost-stage name
+_STAGE_SPAN_ALIASES = {"episode_update": "episode"}
+
+
+def roofline(events, spans):
+    """Per-stage flops/bytes/achieved-FLOPs/s table, or None without
+    ``cost`` events.  Achieved rate = flops-per-call x span count / span
+    wall; absent span match or peak reference leaves those fields unset
+    (the renderer prints dashes)."""
+    costs = [e for e in events if e.get("event") == "cost"]
+    if not costs:
+        return None
+    peak = next((e for e in events if e.get("event") == "roofline_peak"),
+                None)
+    by_stage = {}
+    for e in costs:
+        d = by_stage.setdefault(e.get("stage", "?"),
+                                {"flops": [], "bytes": [], "errors": 0})
+        if e.get("error"):
+            d["errors"] += 1
+        else:
+            d["flops"].append(float(e.get("flops") or 0.0))
+            d["bytes"].append(float(e.get("bytes_accessed") or 0.0))
+    stages = {}
+    for stage, d in sorted(by_stage.items()):
+        row = {"signatures": len(d["flops"]) + d["errors"],
+               "errors": d["errors"]}
+        if d["flops"]:
+            row["flops_per_call"] = float(np.mean(d["flops"]))
+            row["bytes_per_call"] = float(np.mean(d["bytes"]))
+            if row["bytes_per_call"] > 0:
+                row["arith_intensity"] = round(
+                    row["flops_per_call"] / row["bytes_per_call"], 3)
+        leaf = _STAGE_SPAN_ALIASES.get(stage, stage)
+        matches = [p for p in spans if p.rsplit("/", 1)[-1] == leaf]
+        if matches and "flops_per_call" in row:
+            n = sum(spans[p]["n"] for p in matches)
+            tot = sum(spans[p]["total_s"] for p in matches)
+            row["calls"] = n
+            row["span_total_s"] = round(tot, 3)
+            if tot > 0 and n > 0:
+                row["achieved_flops_per_s"] = \
+                    row["flops_per_call"] * n / tot
+                if peak and peak.get("fp32_est"):
+                    row["fraction_of_peak_fp32"] = round(
+                        row["achieved_flops_per_s"]
+                        / float(peak["fp32_est"]), 6)
+        stages[stage] = row
+    peak_out = None
+    if peak is not None:
+        peak_out = {k: peak[k] for k in ("platform", "chip", "bf16",
+                                         "fp32_est") if k in peak}
+    return {"peak": peak_out, "stages": stages}
+
+
+def _fmt_si(v, unit=""):
+    if v is None:
+        return "-"
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f}{suffix}{unit}"
+    return f"{v:.2f}{unit}"
+
+
+def render_roofline(rl, out):
+    peak = rl.get("peak")
+    if peak:
+        out.append(f"  peak: {peak.get('chip', peak.get('platform'))} "
+                   f"bf16={_fmt_si(peak.get('bf16'))}F/s "
+                   f"fp32_est={_fmt_si(peak.get('fp32_est'))}F/s")
+    else:
+        out.append("  (no roofline_peak reference — fraction-of-peak "
+                   "unavailable on this platform)")
+    out.append(f"  {'stage':24s} {'flops/call':>11s} {'bytes/call':>11s} "
+               f"{'AI':>7s} {'calls':>6s} {'span_s':>8s} "
+               f"{'FLOP/s':>9s} {'%peak':>7s}")
+    for stage, row in rl["stages"].items():
+        ai = row.get("arith_intensity")
+        span_s = row.get("span_total_s")
+        frac = row.get("fraction_of_peak_fp32")
+        out.append(
+            f"  {stage:24s} {_fmt_si(row.get('flops_per_call')):>11s} "
+            f"{_fmt_si(row.get('bytes_per_call')):>11s} "
+            f"{(f'{ai:.2f}' if ai is not None else '-'):>7s} "
+            f"{(str(row['calls']) if 'calls' in row else '-'):>6s} "
+            f"{(f'{span_s:.2f}' if span_s is not None else '-'):>8s} "
+            f"{_fmt_si(row.get('achieved_flops_per_s')):>9s} "
+            f"{(f'{100 * frac:.2f}%' if frac is not None else '-'):>7s}")
+        if row.get("errors"):
+            out.append(f"    ({row['errors']} cost-analysis failure(s) "
+                       f"recorded for {stage})")
+
+
+def render_training_health(th, out):
+    out.append(f"  updates={th.get('updates', 0)} "
+               f"learning={th.get('learning_updates', 0)} "
+               f"nonfinite={th.get('nonfinite_values', 0)}")
+    traj = th.get("trajectory") or {}
+    for k, d in traj.items():
+        qm = " -> ".join(f"{v:g}" for v in d["quarter_means"])
+        out.append(f"  {k:22s} quarters [{qm}]  last={d['last']:g} "
+                   f"max={d['max']:g}")
+    if not traj and th.get("updates"):
+        out.append("  (no learning updates in the diag stream — e.g. the "
+                   "buffer stayed below batch size for the whole run)")
+    rh = th.get("replay")
+    if rh:
+        ent = (f"{rh.get('priority_entropy_first', float('nan')):.3f}"
+               f" -> {rh.get('priority_entropy_last', float('nan')):.3f}"
+               if "priority_entropy_last" in rh else "-")
+        out.append(f"  replay: entropy {ent}  "
+                   f"max/mean={rh.get('max_mean_priority_ratio_last', '-')}  "
+                   f"beta={rh.get('beta_last', '-')}  "
+                   f"filled={rh.get('filled', '-')}/{rh.get('size', '-')}")
+    trips = th.get("watchdog_trips") or []
+    if trips:
+        for t in trips:
+            out.append(f"  WATCHDOG TRIP at update {t.get('step')}: "
+                       f"{t.get('reason')} (after {t.get('observations')} "
+                       f"observations, ring={t.get('ring_len')})")
+    else:
+        out.append("  watchdog: no trips")
+
+
+# ---------------------------------------------------------------------------
 # Report
 # ---------------------------------------------------------------------------
 
@@ -251,6 +469,8 @@ def build_report(runs, n_boot=1000, seed=0):
              "learning": learning_verdict(eps, scores, n_boot, seed),
              "probes": probe_summary(ev),
              "solver": solver_summary(ev),
+             "training_health": training_health(ev),
+             "roofline": roofline(ev, spans),
              "compile_events": len(compiles),
              "compile_secs": round(sum(float(e.get("dur_s") or 0)
                                        for e in compiles), 3)}
@@ -296,6 +516,12 @@ def render(report):
         if r["compile_events"]:
             out.append(f"-- jax compile: {r['compile_events']} events, "
                        f"{r['compile_secs']} s")
+        if r.get("training_health"):
+            out.append("-- training health")
+            render_training_health(r["training_health"], out)
+        if r.get("roofline"):
+            out.append("-- roofline")
+            render_roofline(r["roofline"], out)
         lv = r["learning"]
         out.append("-- learning-curve verdict")
         if "slope" in lv:

@@ -1,0 +1,195 @@
+"""Live-tail a running RunLog JSONL as compact human lines.
+
+``tail -f`` for the obs stream: follows the file as the run writes it
+(RunLog buffers ~2 s of events, so lines arrive in small bursts),
+survives size-based rotation (the writer ``os.replace``s the base path
+and reopens it — the tailer re-stats the inode and follows the fresh
+segment), and renders each event kind on one line:
+
+    12:03:41 episode    #14 score=-0.0312 (mean10 -0.0298)
+    12:03:41 diag       step=112 closs=0.031 cgrad=1.2e+00 q[-0.4,0.1,0.6]
+    12:03:41 replay     entropy=0.98 max/mean=3.1 beta=0.43 filled=4096
+    12:03:42 WATCHDOG   non_finite:critic_loss at update 113 (ring=32)
+
+Usage:
+    python tools/obs_tail.py run.jsonl [--events diag,episode,...]
+        [--no-follow] [--interval 0.5]
+
+``--no-follow`` renders what is on disk and exits (scripting / tests).
+stdlib only — runs anywhere, never touches jax or a device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _ts(e):
+    t = e.get("t")
+    return (time.strftime("%H:%M:%S", time.localtime(t))
+            if isinstance(t, (int, float)) else "--:--:--")
+
+
+def _g(v, default="?"):
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v) if v is not None else default
+
+
+def render_event(e):
+    """One compact line for an event record, or None to skip it."""
+    ev = e.get("event")
+    ts = _ts(e)
+    if ev == "run_header":
+        meta = e.get("meta") or {}
+        return (f"{ts} run        {e.get('run_id')} schema={e.get('schema')}"
+                f" entry={meta.get('entry', '?')}"
+                f" platform={e.get('platform', '?')}"
+                + (f" (rotated {e['rotated']})" if e.get("rotated") else ""))
+    if ev == "episode":
+        extra = ""
+        if isinstance(e.get("mean10"), (int, float)):
+            extra = f" (mean10 {_g(e['mean10'])})"
+        return (f"{ts} episode    #{e.get('episode', '?')} "
+                f"score={_g(e.get('score'))}{extra}")
+    if ev == "diag":
+        q = [e.get("q_min"), e.get("q_mean"), e.get("q_max")]
+        qs = ",".join("null" if v is None else f"{v:.3g}" for v in q)
+        return (f"{ts} diag       step={e.get('step', '?')} "
+                f"closs={_g(e.get('critic_loss'), 'null')} "
+                f"cgrad={_g(e.get('critic_grad_norm'), 'null')} "
+                f"agrad={_g(e.get('actor_grad_norm'), 'null')} "
+                f"q[{qs}]")
+    if ev == "replay_health":
+        return (f"{ts} replay     "
+                f"entropy={_g(e.get('priority_entropy'))} "
+                f"max/mean={_g(e.get('max_mean_priority_ratio'))} "
+                f"beta={_g(e.get('beta'))} filled={e.get('filled', '?')}")
+    if ev == "watchdog_trip":
+        return (f"{ts} WATCHDOG   {e.get('reason')} at update "
+                f"{e.get('step')} (ring={len(e.get('ring') or [])})")
+    if ev == "cost":
+        if e.get("error"):
+            return (f"{ts} cost       {e.get('stage')} FAILED: "
+                    f"{e['error']}")
+        return (f"{ts} cost       {e.get('stage')} "
+                f"flops={_g(e.get('flops'))} "
+                f"bytes={_g(e.get('bytes_accessed'))}")
+    if ev == "roofline_peak":
+        return (f"{ts} peak       {e.get('chip', e.get('platform'))} "
+                f"fp32_est={_g(e.get('fp32_est'))}")
+    if ev == "solver":
+        return (f"{ts} solver     route={e.get('route', '?')} "
+                f"admm={e.get('admm_iters', '?')} "
+                f"lbfgs={e.get('lbfgs_iters_total', '?')}")
+    if ev == "span":
+        return (f"{ts} span       {e.get('path', e.get('name', '?'))} "
+                f"{_g(e.get('dur_s'))}s")
+    if ev == "run_end":
+        return (f"{ts} run_end    episodes={e.get('episodes', '?')} "
+                f"updates={e.get('updates', '?')} "
+                f"tripped={e.get('watchdog_tripped', False)} "
+                f"wall={_g(e.get('wall_s'))}s")
+    if ev == "log":
+        return f"{ts} log        {e.get('msg', '')}"
+    # gauge / counters / jax_event / probe / anything future: terse
+    return f"{ts} {str(ev):10s} " + json.dumps(
+        {k: v for k, v in e.items() if k not in ("t", "event")})[:120]
+
+
+def _emit_line(line, wanted, out):
+    line = line.strip()
+    if not line:
+        return
+    try:
+        e = json.loads(line)
+    except ValueError:
+        return                          # mid-write partial line
+    if wanted and e.get("event") not in wanted:
+        return
+    txt = render_event(e)
+    if txt:
+        out.write(txt + "\n")
+        out.flush()
+
+
+def tail(path, wanted=None, follow=True, interval=0.5, out=sys.stdout,
+         max_iters=None):
+    """Render ``path``'s events; with ``follow`` keep polling for growth
+    and reopen when the writer rotates the file under us (inode change
+    or truncation).  ``max_iters`` bounds the follow loop for tests."""
+    fh, ino = None, None
+    partial = ""
+    iters = 0
+    while True:
+        if fh is None:
+            try:
+                fh = open(path)
+                ino = os.fstat(fh.fileno()).st_ino
+            except OSError:
+                if not follow:
+                    raise
+                time.sleep(interval)
+                continue
+        chunk = fh.read()
+        if chunk:
+            buf = partial + chunk
+            lines = buf.split("\n")
+            partial = lines.pop()       # may be a half-written line
+            for line in lines:
+                _emit_line(line, wanted, out)
+        else:
+            if not follow:
+                _emit_line(partial, wanted, out)
+                return
+            try:
+                st = os.stat(path)
+                if st.st_ino != ino or st.st_size < fh.tell():
+                    # rotated (or truncated): drain anything the writer
+                    # flushed to the old segment between our last read
+                    # and the rename (the final burst can hold the
+                    # watchdog_trip), then reopen the fresh file
+                    last = fh.read()
+                    if last:
+                        for line in (partial + last).split("\n"):
+                            _emit_line(line, wanted, out)
+                    fh.close()
+                    fh = None
+                    partial = ""
+                    continue
+            except OSError:
+                pass                    # transiently missing mid-rotate
+            iters += 1
+            if max_iters is not None and iters >= max_iters:
+                return
+            time.sleep(interval)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("path", help="RunLog JSONL path (the --metrics file "
+                   "of a running driver)")
+    p.add_argument("--events", default=None,
+                   help="comma-separated event kinds to show "
+                        "(default: all)")
+    p.add_argument("--no-follow", action="store_true",
+                   help="render the current file content and exit")
+    p.add_argument("--interval", type=float, default=0.5,
+                   help="poll interval in seconds (default 0.5)")
+    args = p.parse_args(argv)
+    wanted = (set(args.events.split(",")) if args.events else None)
+    try:
+        tail(args.path, wanted=wanted, follow=not args.no_follow,
+             interval=args.interval)
+    except KeyboardInterrupt:
+        pass
+    except BrokenPipeError:             # | head — exit quietly
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+#!/bin/bash
+# Observability smoke: record a tiny enet driver run with the full
+# telemetry surface armed (--metrics --diag --watchdog), then aggregate
+# it with obs_report --json and assert the machine document is non-empty
+# and carries the training-health section.  Exercises the whole chain a
+# CI box can run in ~1 min on CPU: RunLog schema-2 events (diag /
+# replay_health / cost), the watchdog arming path, and the report's JSON
+# contract — without asserting anything about learning itself.
+#
+#   bash tools/smoke_obs.sh [workdir]
+#
+# Exits non-zero on any broken link in the chain.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+REPO="$PWD"
+WORK="${1:-$(mktemp -d /tmp/smoke_obs.XXXXXX)}"
+RUN="$WORK/smoke_run.jsonl"
+mkdir -p "$WORK"
+
+echo "[smoke_obs] recording 2-episode enet_td3 run -> $RUN" >&2
+# run from $WORK so the driver's checkpoint side-files land there
+(cd "$WORK" && PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m smartcal_tpu.train.enet_td3 \
+    --episodes 2 --steps 4 --metrics "$RUN" --diag --watchdog --quiet \
+    > "$WORK/driver_stdout.json")
+
+echo "[smoke_obs] aggregating with obs_report --json" >&2
+python tools/obs_report.py "$RUN" --json --bootstrap 50 \
+    > "$WORK/report.json"
+
+python - "$RUN" "$WORK/report.json" <<'EOF'
+import json
+import sys
+
+run_path, report_path = sys.argv[1], sys.argv[2]
+
+events = [json.loads(ln) for ln in open(run_path) if ln.strip()]
+kinds = {e["event"] for e in events}
+for want in ("run_header", "episode", "diag", "replay_health", "cost",
+             "run_end"):
+    assert want in kinds, f"run JSONL missing {want!r} events: {kinds}"
+header = events[0]
+assert header["event"] == "run_header" and header["schema"] >= 2, header
+
+report = json.load(open(report_path))
+assert report.get("runs"), "obs_report --json produced no runs"
+run = report["runs"][0]
+th = run.get("training_health")
+assert th and th.get("updates", 0) > 0, f"empty training_health: {th}"
+assert run.get("roofline"), "missing roofline section"
+assert "verdict" in (run.get("learning") or {}), "missing learning verdict"
+end = [e for e in events if e["event"] == "run_end"][-1]
+assert end["watchdog_tripped"] is False, "smoke run must not trip"
+print("[smoke_obs] OK:", len(events), "events,",
+      th["updates"], "updates,",
+      len(run["roofline"]["stages"]), "costed stage(s)")
+EOF
