@@ -189,15 +189,27 @@ def _settle_load(threshold=1.2, max_wait_s=240.0):
     return load
 
 
-def load_baseline():
-    """The torch-reference steps/s measured on this host class
-    (tools/measure_reference.py), shared by every 1:1-protocol metric."""
+def load_baseline_info():
+    """(value, platform) of the reference baseline every ``vs_baseline``
+    multiple divides by: the torch reference implementation measured on
+    THIS HOST's CPU (tools/measure_reference.py — upstream publishes no
+    numbers, so there is no A100 figure to compare against; see
+    BASELINE.md).  The platform string is emitted in the bench payload
+    (``baseline_platform``) so a reader can never mistake the multiple
+    for a GPU comparison."""
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "tools", "reference_baseline.json")
     if os.path.exists(baseline_path):
         with open(baseline_path) as f:
-            return json.load(f)["value"]
-    return FALLBACK_BASELINE
+            ref = json.load(f)
+        return ref["value"], ref.get("hardware", "torch CPU (this host)")
+    return FALLBACK_BASELINE, "torch CPU (this host; fallback constant)"
+
+
+def load_baseline():
+    """The torch-reference steps/s measured on this host class
+    (tools/measure_reference.py), shared by every 1:1-protocol metric."""
+    return load_baseline_info()[0]
 
 
 def probe_backend():
@@ -705,7 +717,7 @@ def _measured_main():
     # section (view with tensorboard --logdir <dir>).
     value = measure_epblock(PRIMARY_BLOCK, PRIMARY_TIMED_BLOCKS,
                             os.environ.get("BENCH_TRACE_DIR"))
-    baseline = load_baseline()
+    baseline, baseline_platform = load_baseline_info()
     dispatch = f"episode_block({PRIMARY_BLOCK})"
 
     out = {
@@ -713,6 +725,10 @@ def _measured_main():
         "value": round(value, 2),
         "unit": "env-steps/sec/chip",
         "vs_baseline": round(value / baseline, 2),
+        # what vs_baseline divides by: the torch reference on THIS host's
+        # CPU (tools/reference_baseline.json), NOT an A100 — upstream
+        # publishes no numbers (BASELINE.md)
+        "baseline_platform": baseline_platform,
         "dispatch": dispatch,
         # gate value = the WORSE of (settled pre-measurement load, load
         # right after the timed section): sweeps are SIGSTOPped and the

@@ -100,9 +100,13 @@ class InfluenceResult(NamedTuple):
 
 
 def _chunk_influence(R, C, J, hadd, n_stations, fullpol, perdir):
-    """One calibration interval.  R (2*B*Td, 2, 2); C (K, B*Td, 4, 2);
-    J (K, 2N, 2, 2); hadd (K,).  Returns (vis_b, llr) where vis_b is
-    (B, 4, 2) [or (K, B, 4, 2) per-direction]."""
+    """One calibration interval, ORACLE formulation.  R (2*B*Td, 2, 2);
+    C (K, B*Td, 4, 2); J (K, 2N, 2, 2); hadd (K,).  Returns (vis_b, llr)
+    where vis_b is (B, 4, 2) [or (K, B, 4, 2) per-direction].
+
+    Retained as the parity oracle for the optimized chunk path below
+    (``optimized=False`` routes here): per-kernel split-real rebuilds,
+    scatter-based Hessian, and the 8B-column Dsolutions solve."""
     H = kernels.hessian_res_sr(R, C, J, n_stations)
     N4 = H.shape[1]
     H = H.at[:, jnp.arange(N4), jnp.arange(N4), 0].add(hadd[:, None])
@@ -112,18 +116,43 @@ def _chunk_influence(R, C, J, hadd, n_stations, fullpol, perdir):
     # LOFAR-scale regime (N=62, B=1891) fit in HBM without r-chunking
     pol_means = kernels.dresiduals_colmeans_sr(C, J, n_stations, dJ,
                                                addself=False, perdir=perdir)
+    return _chunk_post(pol_means, fullpol), \
+        kernels.log_likelihood_ratio_sr(R, C, J, n_stations)
+
+
+def _chunk_post(pol_means, fullpol):
     vis = jnp.sum(pol_means, axis=0)          # (K, 4, B, 2) or (4, B, 2)
     vis = jnp.swapaxes(vis, -3, -2)           # (K, B, 4, 2) or (B, 4, 2)
     if not fullpol:
         vis = vis.at[..., 1, :].set(0.0).at[..., 2, :].set(0.0)
-    llr = kernels.log_likelihood_ratio_sr(R, C, J, n_stations)
-    return vis, llr
+    return vis
+
+
+def _chunk_influence_opt(R3, C5, Jp, Jq, lhs, hadd, n_stations, fullpol,
+                         perdir):
+    """One calibration interval, OPTIMIZED formulation, on hoisted
+    operands: the split-real block forms (R3, C5), the station-gathered
+    Jones blocks (Jp, Jq) and the shared Dsolutions/Dresiduals lhs are
+    built ONCE for all chunks by the caller (the oracle chain rebuilds
+    each of them per chunk per kernel).  Hessian is the scatter-free
+    formulation; the Dsolutions -> Dresiduals chain is the adjoint
+    4-RHS transpose solve (kernels._colmeans_adjoint_core_sr)."""
+    Td = C5.shape[1]
+    p_idx, _ = kernels.baseline_indices(n_stations)
+    H = kernels._hessian_res_core_sr(R3, C5, Jp, Jq, n_stations)
+    N4 = H.shape[1]
+    H = H.at[:, jnp.arange(N4), jnp.arange(N4), 0].add(hadd[:, None])
+    pol_means = kernels._colmeans_adjoint_core_sr(
+        lhs, H, p_idx, n_stations, Td, addself=False, perdir=perdir)
+    return _chunk_post(pol_means, fullpol), \
+        kernels._llr_core_sr(R3, C5, Jp, Jq)
 
 
 @partial(jax.jit, static_argnames=("n_stations", "n_chunks", "fullpol",
-                                   "perdir"))
+                                   "perdir", "optimized"))
 def influence_visibilities(R, C, J, hadd, n_stations, n_chunks,
-                           fullpol=False, perdir=False) -> InfluenceResult:
+                           fullpol=False, perdir=False,
+                           optimized=True) -> InfluenceResult:
     """Influence visibilities over all calibration intervals.
 
     R : (2*B*T, 2, 2) kernel-convention residuals for one sub-band
@@ -134,20 +163,48 @@ def influence_visibilities(R, C, J, hadd, n_stations, n_chunks,
     Returns vis (T*B, 4, 2) — or (K, T*B, 4, 2) when ``perdir`` — scaled by
     8*B*Tdelta like the reference (analysis_torch.py:173-179), and llr
     (Ts, K).  Chunks run under ``lax.map``; jit once per shape.
+
+    ``optimized`` (static, default) selects the formulation-optimized
+    chunk path: scatter-free Hessian, the adjoint 4-RHS Dsolutions ->
+    Dresiduals chain, and chunk-loop-invariant operands (split-real
+    block forms, Jones gathers, the shared lhs and its per-chunk time
+    sum) hoisted out of the ``lax.map`` into one fused pass each.
+    ``optimized=False`` is the retained oracle chain — same results to
+    float round-off (tested), O(10x) slower at the N=62 episode scale.
     """
     B = n_stations * (n_stations - 1) // 2
     T = C.shape[1] // B
     Td = T // n_chunks
     K = C.shape[0]
 
-    R4 = R.reshape(n_chunks, 2 * B * Td, 2, 2)
-    C4 = jnp.moveaxis(C.reshape(K, n_chunks, B * Td, 4, 2), 1, 0)
+    if optimized:
+        from smartcal_tpu.cal import creal  # local: kernels owns the math
 
-    def one(args):
-        r, c, j = args
-        return _chunk_influence(r, c, j, hadd, n_stations, fullpol, perdir)
+        R3 = R.reshape(n_chunks, Td, B, 2, 2, 2)
+        C5 = jnp.moveaxis(jnp.swapaxes(
+            C.reshape(K, n_chunks, Td, B, 2, 2, 2), -3, -2), 1, 0)
+        p_idx, q_idx = kernels.baseline_indices(n_stations)
+        J4 = J.reshape(n_chunks, K, n_stations, 2, 2, 2)
+        Jp, Jq = J4[:, :, p_idx], J4[:, :, q_idx]   # (Ts, K, B, 2, 2, 2)
+        Csum = jnp.sum(C5, axis=2)                  # (Ts, K, B, 2, 2, 2)
+        lhs = creal.einsum("skbuv,skbwv->skbuw", Jq, creal.conj(Csum))
 
-    vis_b, llr = lax.map(one, (R4, C4, J))
+        def one(args):
+            r3, c5, jp, jq, lh = args
+            return _chunk_influence_opt(r3, c5, jp, jq, lh, hadd,
+                                        n_stations, fullpol, perdir)
+
+        vis_b, llr = lax.map(one, (R3, C5, Jp, Jq, lhs))
+    else:
+        R4 = R.reshape(n_chunks, 2 * B * Td, 2, 2)
+        C4 = jnp.moveaxis(C.reshape(K, n_chunks, B * Td, 4, 2), 1, 0)
+
+        def one(args):
+            r, c, j = args
+            return _chunk_influence(r, c, j, hadd, n_stations, fullpol,
+                                    perdir)
+
+        vis_b, llr = lax.map(one, (R4, C4, J))
     scale = 8.0 * B * Td
     if perdir:
         # (Ts, K, B, 4, 2) -> (K, Ts*Td*B, 4, 2) replicating over Td slots
@@ -160,9 +217,10 @@ def influence_visibilities(R, C, J, hadd, n_stations, n_chunks,
 
 
 @partial(jax.jit, static_argnames=("n_stations", "n_chunks", "npix",
-                                   "use_pallas"))
+                                   "use_pallas", "optimized"))
 def influence_images_multi(residual, C, J, hadd_all, freqs, uvw, cell,
-                           n_stations, n_chunks, npix, use_pallas=True):
+                           n_stations, n_chunks, npix, use_pallas=True,
+                           optimized=True):
     """Per-sub-band Stokes-I influence dirty images in ONE device dispatch.
 
     The envs' host loop over sub-bands (residual_to_kernel ->
@@ -175,21 +233,65 @@ def influence_images_multi(residual, C, J, hadd_all, freqs, uvw, cell,
     residual (Nf, T, B, 2, 2, 2) solver residuals; C (Nf, K, T*B, 4, 2);
     J (Nf, Ts, K, 2N, 2, 2); hadd_all (Nf, K) per-band consensus scalars
     (:func:`consensus_hadd_all`); freqs (Nf,); uvw (T*B, 3) meters; cell
-    static pixel size.  Returns (Nf, npix, npix).  ``use_pallas=False``
+    static pixel size.  Returns (Nf, npix, npix).
+
+    ``optimized`` (static, default) runs the formulation-optimized chain:
+    the optimized :func:`influence_visibilities` kernels, the kernel-
+    convention residual reshape hoisted out of the frequency loop, and
+    the rank-factored DFT imager (``imager.dirty_image_factored_sr`` —
+    matmul-only, so it is also the path used inside sharded programs).
+    ``optimized=False`` keeps the oracle chain, where ``use_pallas=False``
     forces the XLA imager (required inside GSPMD/shard_map programs).
     """
     from smartcal_tpu.cal import imager, solver  # lazy: solver is a consumer
 
+    if optimized:
+        # frequency-loop hoist: ONE reshape to kernel-convention rows for
+        # all sub-bands (the oracle path re-runs residual_to_kernel per
+        # lane inside the map)
+        Nf, T, B = residual.shape[0], residual.shape[1], residual.shape[2]
+        Rk_all = residual.reshape(Nf, 2 * T * B, 2, 2)
+
+        def one(args):
+            rk, c, j, hadd, f = args
+            inf = influence_visibilities(rk, c, j, hadd, n_stations,
+                                         n_chunks, optimized=True)
+            ivis = stokes_i_influence(inf.vis)
+            return imager.dirty_image_factored_sr(uvw, ivis, f, cell,
+                                                  npix=npix)
+
+        return lax.map(one, (Rk_all, C, J, hadd_all, jnp.asarray(freqs)))
+
     def one(args):
         resid, c, j, hadd, f = args
         Rk = solver.residual_to_kernel(resid)
-        inf = influence_visibilities(Rk, c, j, hadd, n_stations, n_chunks)
+        inf = influence_visibilities(Rk, c, j, hadd, n_stations, n_chunks,
+                                     optimized=False)
         ivis = stokes_i_influence(inf.vis)
         if use_pallas:
             return imager.dirty_image_sr(uvw, ivis, f, cell, npix=npix)
         return imager.dirty_image_sr_xla(uvw, ivis, f, cell, npix=npix)
 
     return lax.map(one, (residual, C, J, hadd_all, jnp.asarray(freqs)))
+
+
+@partial(jax.jit, static_argnames=("n_stations", "n_chunks", "npix"))
+def influence_image_single_sr(residual_f, C_f, J_f, hadd_f, freq, uvw,
+                              cell, n_stations, n_chunks, npix):
+    """ONE sub-band's influence dirty image with the optimized kernels —
+    the bounded per-dispatch unit of the host-segmented influence route
+    (envs/radio.RadioBackend): at the N=62 episode scale the fused
+    all-band program runs minutes on a chip (device-watchdog territory,
+    same story as the segmented ADMM driver), while this program is
+    1/Nf-th the size and the host loop double-buffers it — band f+1's
+    dispatch is enqueued while band f executes."""
+    from smartcal_tpu.cal import imager, solver
+
+    Rk = solver.residual_to_kernel(residual_f)
+    inf = influence_visibilities(Rk, C_f, J_f, hadd_f, n_stations,
+                                 n_chunks, optimized=True)
+    ivis = stokes_i_influence(inf.vis)
+    return imager.dirty_image_factored_sr(uvw, ivis, freq, cell, npix=npix)
 
 
 class PerdirSummary(NamedTuple):

@@ -53,6 +53,39 @@ def baseline_indices(n_stations):
     return jnp.asarray(p), jnp.asarray(q)
 
 
+def baseline_onehots(n_stations, dtype=jnp.float32):
+    """One-hot (N, B) selection matrices for the p and q station of each
+    baseline — the scatter-free station<->baseline expansion shared by the
+    solver's inner evaluation (cal/solver._cost_fn_onehot) and the
+    optimized influence kernels below.  A gather ``J4[:, p_idx]`` becomes
+    a matmul whose autodiff transpose is another matmul, and the forward
+    segment-sum onto stations becomes ``onehot @ X`` with full lanes
+    instead of a scatter-add.
+
+    Built with NUMPY on host (constants under jit either way): shape-only
+    helpers (solver.cost_eval_flops) call this outside any jit, and an
+    eager ``jnp.eye`` there would execute on the default backend — which
+    can be a wedged TPU tunnel when the helper is meant to stay
+    CPU-side."""
+    p_idx, q_idx = np.triu_indices(n_stations, 1)
+    eye = np.eye(n_stations, dtype=np.dtype(dtype))
+    return eye[:, p_idx], eye[:, q_idx]          # each (N, B)
+
+
+def offdiag_index_map(n_stations):
+    """(N, N) int32 map [p, q] -> baseline index b for p < q, else B (a
+    zero-pad sentinel slot).  Each off-diagonal station block of the
+    residual Hessian receives exactly ONE baseline's contribution, so the
+    oracle's scatter-add placement is a pure permutation — reproduced
+    bit-exactly by a static gather of the zero-padded block table, with
+    no scatter lowering.  Host-side numpy: a compile-time constant."""
+    p_idx, q_idx = np.triu_indices(n_stations, 1)
+    B = p_idx.size
+    m = np.full((n_stations, n_stations), B, np.int32)
+    m[p_idx, q_idx] = np.arange(B)
+    return m
+
+
 def _split_samples_sr(Rs, Cs, n_stations):
     """Split-real (2BT, 2, 2) / (K, BT, 4, 2) -> time/baseline block form."""
     B = n_stations * (n_stations - 1) // 2
@@ -128,6 +161,69 @@ def hessian_res(R, C, J, n_stations):
     H = hessian_res_sr(creal.split(R), creal.split(C), creal.split(J),
                        n_stations)
     return creal.fuse(np.asarray(H))
+
+
+def _hessian_res_core_sr(R3, C5, Jp, Jq, n_stations):
+    """Scatter-free residual-Hessian core on PRE-SPLIT operands.
+
+    Same math as :func:`hessian_res_sr` (the retained oracle) with the
+    two scatter lowerings replaced by the solver's formulation moves:
+
+      * the station segment-sums of the diagonal blocks become one-hot
+        matmuls (``baseline_onehots`` — full lanes, and the transpose is
+        a matmul rather than the scatter a ``segment_sum`` lowers to);
+      * the off-diagonal block placement — a pure permutation, one
+        baseline per (p, q) slot — becomes a static GATHER of the
+        zero-padded block table (``offdiag_index_map``), bit-identical to
+        the oracle's scatter-add.
+
+    Taking ``R3/C5/Jp/Jq`` directly lets the influence engine hoist the
+    split-real rebuilds out of its chunk loop (they are recomputed per
+    chunk per kernel in the oracle chain).
+    """
+    K, T, B = C5.shape[0], C5.shape[1], C5.shape[2]
+
+    off = -creal.einsum("ktbij,tbuv->kbiujv", creal.conj(C5), R3)
+    off = off.reshape(K, B, 4, 4, 2)
+
+    A1 = creal.einsum("ktbuv,kbwv->ktbuw", C5, creal.conj(Jq))
+    Sp = creal.einsum("ktbuw,ktbvw->kbuv", A1, creal.conj(A1))
+    A2 = creal.einsum("kbuv,ktbvw->ktbuw", Jp, C5)
+    Sq = creal.einsum("ktbuv,ktbuw->kbvw", creal.conj(A2), A2)
+
+    onehot_p, onehot_q = baseline_onehots(n_stations, R3.dtype)
+    Dsum = (jnp.einsum("nb,kbuvz->knuvz", jnp.asarray(onehot_p), Sp)
+            + jnp.einsum("nb,kbuvz->knuvz", jnp.asarray(onehot_q), Sq))
+    eye2 = jnp.eye(2, dtype=R3.dtype)
+    diag_blocks = jnp.einsum("knjiz,uv->kniujvz", Dsum, eye2).reshape(
+        K, n_stations, 4, 4, 2)
+
+    idx = jnp.asarray(offdiag_index_map(n_stations))
+    off_pad = jnp.concatenate(
+        [off, jnp.zeros((K, 1, 4, 4, 2), off.dtype)], axis=1)
+    herm_pad = creal.conj(jnp.swapaxes(off_pad, -3, -2))
+    Hup = off_pad[:, idx]                 # (K, p, q, 4, 4, 2)
+    Hlow = herm_pad[:, idx.T]             # (K, q, p, 4, 4, 2)
+    eyeN = jnp.eye(n_stations, dtype=R3.dtype)
+    Hd = jnp.einsum("nm,knijz->knmijz", eyeN, diag_blocks)
+    # the three terms live on disjoint (n, m) slots (p < q strictly), so
+    # the sum is placement, not accumulation
+    H = jnp.swapaxes(Hup + Hlow + Hd, 2, 3)     # (K, N, 4, N, 4, 2)
+    N4 = 4 * n_stations
+    return H.reshape(K, N4, N4, 2) / (B * T)
+
+
+@partial(jax.jit, static_argnames=("n_stations",))
+def hessian_res_opt_sr(Rs, Cs, Js, n_stations):
+    """Scatter-free :func:`hessian_res_sr` (the production influence-path
+    kernel; the scatter-based original is retained as the parity oracle).
+    Same signature, equal to float round-off (the one-hot matmul reorders
+    the diagonal segment reductions)."""
+    R3, C5, B, T, K = _split_samples_sr(Rs, Cs, n_stations)
+    J4 = _jones_blocks_sr(Js, n_stations)
+    p_idx, q_idx = baseline_indices(n_stations)
+    return _hessian_res_core_sr(R3, C5, J4[:, p_idx], J4[:, q_idx],
+                                n_stations)
 
 
 # ---------------------------------------------------------------------------
@@ -315,6 +411,98 @@ def dresiduals_colmeans_sr(Cs, Js, n_stations, dJs, addself=True,
     return out
 
 
+def _colmeans_adjoint_core_sr(lhs, Dgs, p_idx, n_stations, T,
+                              addself, perdir):
+    """Adjoint-form Dsolutions -> Dresiduals column means on the PRE-BUILT
+    shared lhs blocks (``lhs = Jq Csum^H``, (K, B, 2, 2, 2)).
+
+    The influence engine consumes ONLY the column means of dR, which are
+    linear functionals of dJ = A^{-1} AdV:
+      colmeans = G^T dJ / (B^2 T)          (G = per-station sums of the
+                                            Dresiduals lhs blocks)
+    so instead of the oracle's solve against the 8B-column RHS AdV
+    (15128 columns at N=62 — the dominant cost of the whole influence
+    chain, measured 2.3 s per chunk on the host core) this solves the
+    TRANSPOSE system
+      A^T y_k = w_k                        (4 RHS per direction, one
+                                            factorization shared by all
+                                            8 perturbation directions)
+    and contracts y against AdV's closed form.  AdV is never built
+    (~180 MB at N=62): its only nonzero rows per baseline column b sit at
+    station p(b) with values ``lhs[k, b, J_OF_R[r], :] * phase_r`` on the
+    V_OF_R[r] polarization row, so y^T AdV collapses to a gather of y at
+    p(b) plus one small einsum.  Equal to the oracle chain
+    (dsolutions_all_sr -> dresiduals_colmeans_sr) to float round-off.
+
+    The Dresiduals lhs shares the Dsolutions lhs: ``-(Csum Jq^H)^T =
+    -conj(Jq Csum^H)`` — one einsum where the oracle chain computes two.
+    """
+    N = n_stations
+    B = lhs.shape[1]
+    K = lhs.shape[0]
+    dtype = lhs.dtype
+    onehot_p = jnp.asarray(baseline_onehots(N, dtype)[0])
+
+    # G[k, n, i, j] = sum over baselines b with p(b) = n of the
+    # Dresiduals lhs -conj(lhs)[k, b, i, j]  (one-hot matmul, no scatter)
+    G = jnp.einsum("nb,kbijz->knijz", onehot_p, -creal.conj(lhs))
+    # W[k, row(j, n, u'), (i, u)] = G[k, n, i, j] delta_{u, u'}
+    eye2 = jnp.eye(2, dtype=dtype)
+    W = jnp.einsum("knijz,vu->kjnviuz", G, eye2)
+    W = W.reshape(K, 4 * N, 4, 2)
+
+    eps_eye = EPS_SINGULAR * jnp.eye(4 * N, dtype=dtype)
+
+    def solve_k(Dg_k, w_k):
+        A = Dg_k.at[..., 0].add(eps_eye)
+        return creal.solve(jnp.swapaxes(A, 0, 1), w_k)   # A^T y = w
+
+    Y = jax.vmap(solve_k)(Dgs, W)                        # (K, 4N, 4, 2)
+    Y6 = Y.reshape(K, 2, N, 2, 4, 2)                     # (k,j,n,u',c,2)
+    Yr = Y6[:, :, p_idx][:, :, :, _V_OF_R]               # (k,j,B,r,c,2)
+    Lr = lhs[:, :, _J_OF_R]                              # (k,B,r,j,2)
+    if perdir:
+        out = creal.einsum("kjbrc,kbrj->krcb", Yr, Lr)
+        out = jnp.moveaxis(out, 0, 1)                    # (8, K, 4, B, 2)
+        out = jnp.where(_ODD_R[:, None, None, None, None],
+                        creal.mul_i(out), out) / (B * B * T)
+        if addself:
+            sel = _selfterm() / (B * B)
+            out = out + sel[:, None, :, None, :]
+    else:
+        out = creal.einsum("kjbrc,kbrj->rcb", Yr, Lr)    # (8, 4, B, 2)
+        out = jnp.where(_ODD_R[:, None, None, None],
+                        creal.mul_i(out), out) / (B * B * T)
+        if addself:
+            sel = _selfterm() * K / (B * B)
+            out = out + sel[:, :, None, :]
+    return out
+
+
+@partial(jax.jit, static_argnames=("n_stations", "addself", "perdir"))
+def influence_colmeans_opt_sr(Cs, Js, n_stations, Dgs, addself=False,
+                              perdir=False):
+    """Fused Dsolutions -> Dresiduals column means (8, 4, B, 2) — or
+    (8, K, 4, B, 2) when ``perdir`` — straight from the coherencies,
+    Jones solutions, and the (consensus-augmented) Hessian ``Dgs``.
+
+    The production influence-path kernel: the adjoint formulation (see
+    :func:`_colmeans_adjoint_core_sr`) replaces the oracle chain's
+    8B-column solve with a 4-column transpose solve and drops both the
+    AdV RHS and the dJ tensor.  ``dsolutions_all_sr`` +
+    ``dresiduals_colmeans_sr`` are retained as the parity oracles."""
+    B = n_stations * (n_stations - 1) // 2
+    K = Cs.shape[0]
+    T = Cs.shape[1] // B
+    C5 = jnp.swapaxes(Cs.reshape(K, -1, B, 2, 2, 2), -3, -2)
+    Csum = jnp.sum(C5, axis=1)
+    J4 = _jones_blocks_sr(Js, n_stations)
+    p_idx, q_idx = baseline_indices(n_stations)
+    lhs = creal.einsum("kbuv,kbwv->kbuw", J4[:, q_idx], creal.conj(Csum))
+    return _colmeans_adjoint_core_sr(lhs, Dgs, p_idx, n_stations, T,
+                                     addself, perdir)
+
+
 @partial(jax.jit, static_argnames=("n_stations", "addself"))
 def dresiduals_all_perdir_sr(Cs, Js, n_stations, dJs, addself=True):
     """dR (8, K, 4B, B, 2): per-direction variant.
@@ -360,17 +548,9 @@ def dresiduals(C, J, n_stations, dJ_r, addself, r):
 # Log-likelihood-ratio detector
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("n_stations",))
-def log_likelihood_ratio_sr(Rs, Cs, Js, n_stations):
-    """Per-direction LLR (K,): (||r+mu||^2 - ||r||^2) / sigma^2 with
-    mu = Jp C Jq^H per sample and sigma^2 estimated from Stokes V of the
-    residual.  Reference: calibration_tools.py:1181-1223."""
-    R3, C5, B, T, K = _split_samples_sr(Rs, Cs, n_stations)
-    J4 = _jones_blocks_sr(Js, n_stations)
-    p_idx, q_idx = baseline_indices(n_stations)
-    Jp = J4[:, p_idx]
-    Jq = J4[:, q_idx]
-
+def _llr_core_sr(R3, C5, Jp, Jq):
+    """LLR body on pre-split operands (shared by the jitted wrapper and
+    the influence engine's hoisted chunk path — bit-identical math)."""
     tmp = creal.einsum("kbuv,ktbvw->ktbuw", Jp, C5)
     mu = creal.einsum("ktbuw,kbxw->ktbux", tmp, creal.conj(Jq))
 
@@ -379,6 +559,17 @@ def log_likelihood_ratio_sr(Rs, Cs, Js, n_stations):
     rn2 = jnp.sum(creal.abs2(R3))
     rpmu2 = jnp.sum(creal.abs2(R3[None] + mu), axis=(1, 2, 3, 4))
     return (rpmu2 - rn2) / (sigma2 + EPS_DIV)
+
+
+@partial(jax.jit, static_argnames=("n_stations",))
+def log_likelihood_ratio_sr(Rs, Cs, Js, n_stations):
+    """Per-direction LLR (K,): (||r+mu||^2 - ||r||^2) / sigma^2 with
+    mu = Jp C Jq^H per sample and sigma^2 estimated from Stokes V of the
+    residual.  Reference: calibration_tools.py:1181-1223."""
+    R3, C5, B, T, K = _split_samples_sr(Rs, Cs, n_stations)
+    J4 = _jones_blocks_sr(Js, n_stations)
+    p_idx, q_idx = baseline_indices(n_stations)
+    return _llr_core_sr(R3, C5, J4[:, p_idx], J4[:, q_idx])
 
 
 def log_likelihood_ratio(R, C, J, n_stations):

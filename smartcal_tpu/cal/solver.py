@@ -38,7 +38,7 @@ import numpy as np
 from jax import lax
 
 from smartcal_tpu.cal import consensus, creal
-from smartcal_tpu.cal.kernels import baseline_indices
+from smartcal_tpu.cal.kernels import baseline_indices, baseline_onehots
 from smartcal_tpu.ops import lbfgs
 
 
@@ -228,21 +228,15 @@ def _cost_fn_pretrans(x, Vp, Cp, prior, half_rho, cfg: SolverConfig):
     return chi2 + jnp.sum(half_rho * pr)
 
 
-def _baseline_onehots(n_stations, dtype=jnp.float32):
-    """One-hot (N, B) selection matrices for the p and q station of each
-    baseline.  Multiplying J planes by these reproduces the
-    ``J4[:, p_idx]`` gather as a matmul — whose autodiff TRANSPOSE is
-    another matmul (MXU) instead of the scatter-add a gather transposes
-    to, the dominant non-elementwise op in the eval's backward pass.
-
-    Built with NUMPY on host (constants under jit either way): the
-    shape-only `cost_eval_flops` helper calls this outside any jit, and
-    an eager ``jnp.eye`` there would execute on the default backend —
-    which can be a wedged TPU tunnel when the helper is meant to stay
-    CPU-side."""
-    p_idx, q_idx = np.triu_indices(n_stations, 1)  # kernels.baseline_indices
-    eye = np.eye(n_stations, dtype=np.dtype(dtype))  # order, host-side
-    return eye[:, p_idx], eye[:, q_idx]          # each (N, B)
+# One-hot (N, B) station-selection matrices: the scatter-free station<->
+# baseline expansion.  Multiplying J planes by these reproduces the
+# ``J4[:, p_idx]`` gather as a matmul — whose autodiff TRANSPOSE is
+# another matmul (MXU) instead of the scatter-add a gather transposes to,
+# the dominant non-elementwise op in the eval's backward pass.  The ONE
+# implementation now lives in cal/kernels.baseline_onehots (shared with
+# the formulation-optimized influence chain); this alias keeps the
+# solver-local name its call sites and tests use.
+_baseline_onehots = baseline_onehots
 
 
 def _model_bilinear(Ja, Jb, Cp, onehot_p, onehot_q, cfg: SolverConfig):

@@ -54,6 +54,12 @@ from smartcal_tpu.cal import (coherency, imager, influence, observation,
 _WATCHDOG_WORK = 1e7
 _SHARD_MIN_WORK = 1e6
 
+# donated-carry image accumulator for the host-segmented influence route:
+# band f's running sum is donated into band f+1's add, so the per-band
+# loop holds ONE image buffer on the device (no-op on CPU, where buffer
+# donation is unsupported)
+_img_acc = jax.jit(lambda acc, img: acc + img, donate_argnums=(0,))
+
 
 class Episode(NamedTuple):
     """Device-resident state of one simulated observation."""
@@ -517,14 +523,21 @@ class RadioBackend:
                         rho, rho_spatial, npix=None):
         """Mean influence dirty image over sub-bands (doinfluence.sh role).
 
-        Default path: ONE device dispatch for all sub-bands
-        (cal/influence.influence_images_multi) instead of the original
-        O(Nf) host loop.  With a usable mesh the sub-bands fan out over
-        devices (parallel/sharded_cal.influence_images_sharded); when the
+        All production routes run the formulation-optimized chain
+        (scatter-free Hessian, adjoint 4-RHS Dsolutions -> Dresiduals
+        transpose solve, hoisted chunk/frequency invariants, rank-
+        factored DFT imager — cal/influence, cal/kernels).  Routing:
+        with a usable mesh the sub-bands fan out over devices
+        (parallel/sharded_cal.influence_images_sharded); when the
         frequency axis doesn't divide but the chunk axis does, the
         per-band chunk-sharded kernel (influence_sharded — the
-        reference's process pool as a mesh axis) is used instead.
-        ``vectorized=False`` keeps the original loop (parity oracle).
+        reference's process pool as a mesh axis) is used instead; a
+        single device above the watchdog work threshold segments per
+        sub-band with double-buffered dispatches and a donated image
+        carry; small problems run ONE fused dispatch for all sub-bands
+        (cal/influence.influence_images_multi).  ``vectorized=False``
+        keeps the original host loop on the ORACLE kernels (the parity
+        oracle and the bench.py pre-optimization baseline).
         """
         with obs.span("influence") as sp:
             return self._influence_image(ep, result, rho, rho_spatial, npix,
@@ -554,19 +567,43 @@ class RadioBackend:
             from smartcal_tpu.parallel import sharded_cal
 
             sp.tag(route="freq_sharded", shards=nfp)
-            return sharded_cal.influence_images_sharded(
+            out = sharded_cal.influence_images_sharded(
                 self._mesh(nfp), result.residual, ep.Ccal, result.J,
                 hadd_all, ep.obs.freqs, uvw, cell, self.n_stations,
                 self.n_chunks, npix)
+            self._record_influence_cost(result, ep, hadd_all, uvw, cell,
+                                        npix)
+            return out
         nsp = self._shard_size(self.n_chunks, work)
         if nsp:
             sp.tag(route="chunk_sharded", shards=nsp)
-            return self._influence_image_chunk_sharded(
+            out = self._influence_image_chunk_sharded(
                 ep, result, hadd_all, uvw, cell, npix, nsp)
+            self._record_influence_cost(result, ep, hadd_all, uvw, cell,
+                                        npix)
+            return out
+        if self._use_host_solver():
+            # single device at watchdog scale: same proxy as the solve —
+            # one fused all-band influence program runs minutes on a
+            # chip, so segment per sub-band (bounded dispatches,
+            # host-loop double-buffered)
+            sp.tag(route="host_segmented", bands=self.n_freqs)
+            return self._influence_image_host_segmented(
+                ep, result, hadd_all, uvw, cell, npix)
         sp.tag(route="vectorized")
         imgs = influence.influence_images_multi(
             result.residual, ep.Ccal, result.J, hadd_all, ep.obs.freqs,
             uvw, cell, self.n_stations, self.n_chunks, npix)
+        self._record_influence_cost(result, ep, hadd_all, uvw, cell, npix)
+        return jnp.mean(imgs, axis=0)
+
+    def _record_influence_cost(self, result, ep, hadd_all, uvw, cell, npix):
+        """Deferred cost-analysis event for the influence stage, shared by
+        the vectorized and BOTH sharded routes: shard_map programs don't
+        AOT-lower through record_stage_cost's plain-args contract, so the
+        sharded routes account the fused single-device equivalent — the
+        same math (the shard only adds the mean's psum), hence the right
+        TOTAL stage flops for the roofline table."""
         obs_costs.record_stage_cost(
             "influence", influence.influence_images_multi,
             result.residual, ep.Ccal, result.J, hadd_all, ep.obs.freqs,
@@ -574,7 +611,31 @@ class RadioBackend:
             defer=True,              # inside the influence span
             cell=cell, n_stations=self.n_stations, n_chunks=self.n_chunks,
             npix=npix)
-        return jnp.mean(imgs, axis=0)
+
+    def _influence_image_host_segmented(self, ep, result, hadd_all, uvw,
+                                        cell, npix):
+        """Per-sub-band influence images as bounded device dispatches
+        (cal/influence.influence_image_single_sr), double-buffered by
+        JAX's async dispatch: band f+1's program is enqueued while band
+        f executes, with no host sync until the final mean.  The running
+        image sum is a DONATED carry (``_img_acc``), so on accelerators
+        each band's accumulation reuses the previous buffer instead of
+        allocating Nf images."""
+        freqs_arr = jnp.asarray(np.asarray(ep.obs.freqs), jnp.float32)
+        acc = None
+        for fi in range(self.n_freqs):
+            img = influence.influence_image_single_sr(
+                result.residual[fi], ep.Ccal[fi], result.J[fi],
+                hadd_all[fi], freqs_arr[fi], uvw, cell,
+                n_stations=self.n_stations, n_chunks=self.n_chunks,
+                npix=npix)
+            acc = img if acc is None else _img_acc(acc, img)
+        obs_costs.record_stage_cost(
+            "influence", influence.influence_image_single_sr,
+            result.residual[0], ep.Ccal[0], result.J[0], hadd_all[0],
+            freqs_arr[0], uvw, cell, defer=True,  # inside the span
+            n_stations=self.n_stations, n_chunks=self.n_chunks, npix=npix)
+        return acc / self.n_freqs
 
     def _influence_image_chunk_sharded(self, ep, result, hadd_all, uvw,
                                        cell, npix, nsp):
@@ -592,15 +653,17 @@ class RadioBackend:
                 mesh, Rk, ep.Ccal[fi], result.J[fi], hadd_all[fi],
                 self.n_stations, self.n_chunks, axis="fp")
             ivis = influence.stokes_i_influence(inf.vis)
-            imgs.append(imager.dirty_image_sr_xla(uvw, ivis,
-                                                  float(freqs[fi]), cell,
-                                                  npix=npix))
+            imgs.append(imager.dirty_image_factored_sr(uvw, ivis,
+                                                       float(freqs[fi]),
+                                                       cell, npix=npix))
         return jnp.mean(jnp.stack(imgs), axis=0)
 
     def _influence_image_loop(self, ep, result, rho, rho_spatial, npix):
         """The original per-frequency host loop (pre-pipeline path): kept
         as the parity oracle for the vectorized/sharded kernels and the
-        bench.py host-loop baseline."""
+        bench.py host-loop baseline — ``optimized=False`` pins it to the
+        oracle influence kernels and the direct-DFT imager, so the
+        host-loop arm keeps measuring the PRE-optimization formulation."""
         freqs = np.asarray(ep.obs.freqs)
         hadd_all = [influence.consensus_hadd_scalars(
             rho, rho_spatial, freqs, ep.f0, fi, n_poly=self.n_poly,
@@ -612,7 +675,7 @@ class RadioBackend:
             Rk = solver.residual_to_kernel(result.residual[fi])
             inf = influence.influence_visibilities(
                 Rk, ep.Ccal[fi], result.J[fi], hadd_all[fi],
-                self.n_stations, self.n_chunks)
+                self.n_stations, self.n_chunks, optimized=False)
             ivis = influence.stokes_i_influence(inf.vis)
             imgs.append(imager.dirty_image_sr(uvw, ivis, float(freqs[fi]),
                                               cell, npix=npix))

@@ -168,11 +168,13 @@ def solve_admm_sharded2d(mesh: Mesh, Vb, Cb, freqs_b, f0_b, rho,
 
 def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
                       n_chunks: int, axis: str = "sp", fullpol=False,
-                      perdir=False):
+                      perdir=False, optimized=True):
     """Influence visibilities with the calibration-interval (chunk) axis
     sharded over ``axis`` (the reference's process pool as a mesh axis).
 
-    Same signature/semantics as cal/influence.influence_visibilities;
+    Same signature/semantics as cal/influence.influence_visibilities,
+    including the ``optimized`` formulation switch (default: the
+    scatter-free/adjoint chain; False = the retained oracle kernels);
     ``n_chunks`` must divide by the axis size.
     """
     nsp = mesh.shape[axis]
@@ -194,7 +196,7 @@ def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
         c = jnp.moveaxis(c4, 0, 1).reshape(K, local_chunks * B * Td, 4, 2)
         return influence_mod.influence_visibilities(
             r, c, j, hadd, n_stations, local_chunks, fullpol=fullpol,
-            perdir=perdir)
+            perdir=perdir, optimized=optimized)
 
     out_specs = influence_mod.InfluenceResult(
         vis=P(None, axis) if perdir else P(axis), llr=P(axis))
@@ -209,7 +211,7 @@ def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
 
 def influence_images_sharded(mesh: Mesh, residual, C, J, hadd_all, freqs,
                              uvw, cell, n_stations: int, n_chunks: int,
-                             npix: int, axis: str = "fp"):
+                             npix: int, axis: str = "fp", optimized=True):
     """Mean influence dirty image with the FREQUENCY axis sharded over
     ``axis``: each shard runs :func:`cal.influence.influence_images_multi`
     on its local sub-bands and the mean is one psum.
@@ -218,7 +220,10 @@ def influence_images_sharded(mesh: Mesh, residual, C, J, hadd_all, freqs,
     J (Nf, Ts, K, 2N, 2, 2); hadd_all (Nf, K); freqs (Nf,);
     uvw (T*B, 3).  Nf must divide by the axis size.  Returns the
     replicated (npix, npix) mean image — the doinfluence.sh average the
-    envs observe, with sub-bands fanned out over devices.
+    envs observe, with sub-bands fanned out over devices.  The default
+    ``optimized`` chain is matmul-only end to end (scatter-free Hessian,
+    adjoint transpose solve, rank-factored DFT imager), so every stage
+    partitions cleanly under GSPMD.
     """
     nfp = mesh.shape[axis]
     Nf = residual.shape[0]
@@ -228,7 +233,8 @@ def influence_images_sharded(mesh: Mesh, residual, C, J, hadd_all, freqs,
     def local(r, c, j, h, f, uvw_):
         imgs = influence_mod.influence_images_multi(
             r, c, j, h, f, uvw_, cell, n_stations, n_chunks, npix,
-            use_pallas=False)           # pallas_call has no partitioning rule
+            use_pallas=False,           # pallas_call has no partitioning rule
+            optimized=optimized)
         return jax.lax.psum(jnp.sum(imgs, axis=0), axis)
 
     sharded = shard_map(local, mesh=mesh,

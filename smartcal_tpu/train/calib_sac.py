@@ -45,6 +45,14 @@ def main(argv=None):
                         "iterations (multi-seed CPU sweeps)")
     p.add_argument("--load", action="store_true")
     p.add_argument("--prefix", type=str, default="calib_sac")
+    p.add_argument("--fixed_K", type=int, default=None,
+                   help="pin the per-episode direction count (sweep "
+                        "variance reduction; default: reference draw "
+                        "in [2, M])")
+    p.add_argument("--baseline_reward", action="store_true",
+                   help="subtract each episode's own reset-calibration "
+                        "reward from step rewards (demixing reward0 "
+                        "pattern; sweep variance reduction)")
     add_obs_args(p)
     args = p.parse_args(argv)
 
@@ -61,7 +69,8 @@ def main(argv=None):
     else:
         backend = RadioBackend(n_stations=args.stations, npix=args.npix)
     env = CalibEnv(M=args.M, provide_hint=args.use_hint, backend=backend,
-                   seed=args.seed)
+                   seed=args.seed, fixed_K=args.fixed_K,
+                   baseline_reward=args.baseline_reward)
     npix = backend.npix
     obs_dim = npix * npix + (args.M + 1) * 7
     agent_cfg = sac.SACConfig(

@@ -1,0 +1,167 @@
+"""Formulation-optimized influence chain vs its retained oracles.
+
+Each rewritten kernel (scatter-free Hessian, adjoint 4-RHS Dsolutions ->
+Dresiduals column means, hoisted-operand chunk path, rank-factored DFT
+imager, per-band segmented image) is a REFORMULATION of a kernel that
+stays in the tree as the parity oracle — same math, different lowering —
+so everything here asserts equality to float round-off at toy scale
+(N<=6, K<=3: the whole file is cheap enough for the tier-1 dots budget).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smartcal_tpu.cal import creal, imager, influence, kernels, solver
+from smartcal_tpu.parallel import make_mesh
+from smartcal_tpu.parallel.sharded_cal import influence_sharded
+
+
+@pytest.fixture(scope="module")
+def problem():
+    """Split-real toy problem shared by the chain tests."""
+    rng = np.random.default_rng(11)
+    N, K, Ts, Td = 5, 3, 2, 3
+    B = N * (N - 1) // 2
+    T = Ts * Td
+    R = (rng.standard_normal((2 * B * T, 2))
+         + 1j * rng.standard_normal((2 * B * T, 2))).astype(np.complex64)
+    C = (rng.standard_normal((K, T * B, 4))
+         + 1j * rng.standard_normal((K, T * B, 4))).astype(np.complex64)
+    J = (rng.standard_normal((Ts, K, 2 * N, 2))
+         + 1j * rng.standard_normal((Ts, K, 2 * N, 2))).astype(np.complex64)
+    hadd = jnp.asarray([0.5, 1.0, 0.25])
+    Rs = jnp.asarray(creal.split(R)).reshape(-1, 2, 2)
+    return N, K, Ts, Td, Rs, jnp.asarray(creal.split(C)), \
+        jnp.asarray(creal.split(J)), hadd
+
+
+def _one_interval(problem):
+    """First calibration interval's (Rs, Cs, Js) in kernel convention."""
+    N, K, Ts, Td, Rs, Cs, Js, hadd = problem
+    B = N * (N - 1) // 2
+    R1 = Rs.reshape(Ts, 2 * B * Td, 2, 2)[0]
+    C1 = Cs.reshape(K, Ts, B * Td, 4, 2)[:, 0]
+    J1 = Js[0]
+    return N, K, Td, R1, C1, J1, hadd
+
+
+def test_hessian_opt_matches_oracle(problem):
+    N, K, Td, R1, C1, J1, _ = _one_interval(problem)
+    want = np.asarray(kernels.hessian_res_sr(R1, C1, J1, N))
+    got = np.asarray(kernels.hessian_res_opt_sr(R1, C1, J1, N))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("addself", [False, True])
+@pytest.mark.parametrize("perdir", [False, True])
+def test_colmeans_adjoint_matches_oracle_chain(problem, addself, perdir):
+    """The fused adjoint transpose-solve must equal the oracle chain
+    dsolutions_all_sr -> dresiduals_colmeans_sr (8B-column solve)."""
+    N, K, Td, R1, C1, J1, hadd = _one_interval(problem)
+    H = kernels.hessian_res_sr(R1, C1, J1, N)
+    N4 = H.shape[1]
+    Dgs = H.at[:, jnp.arange(N4), jnp.arange(N4), 0].add(hadd[:, None])
+    dJ = kernels.dsolutions_all_sr(C1, J1, N, Dgs)
+    want = np.asarray(kernels.dresiduals_colmeans_sr(
+        C1, J1, N, dJ, addself=addself, perdir=perdir))
+    got = np.asarray(kernels.influence_colmeans_opt_sr(
+        C1, J1, N, Dgs, addself=addself, perdir=perdir))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("perdir", [False, True])
+@pytest.mark.parametrize("fullpol", [False, True])
+def test_influence_visibilities_opt_matches_oracle(problem, perdir,
+                                                   fullpol):
+    N, K, Ts, Td, Rs, Cs, Js, hadd = problem
+    want = influence.influence_visibilities(
+        Rs, Cs, Js, hadd, N, Ts, fullpol=fullpol, perdir=perdir,
+        optimized=False)
+    got = influence.influence_visibilities(
+        Rs, Cs, Js, hadd, N, Ts, fullpol=fullpol, perdir=perdir,
+        optimized=True)
+    np.testing.assert_allclose(np.asarray(got.vis), np.asarray(want.vis),
+                               rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.llr), np.asarray(want.llr),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_factored_imager_matches_xla():
+    rng = np.random.default_rng(5)
+    R = 40
+    uvw = jnp.asarray(rng.standard_normal((R, 3)) * 200.0, jnp.float32)
+    vis = jnp.asarray(rng.standard_normal((R, 2)), jnp.float32)
+    freq = 140e6
+    cell = 1e-4
+    want = np.asarray(imager.dirty_image_sr_xla(uvw, vis, freq, cell,
+                                                npix=32))
+    got = np.asarray(imager.dirty_image_factored_sr(uvw, vis, freq, cell,
+                                                    npix=32))
+    # the angle-addition identity reassociates the phase evaluation, so
+    # agreement is float round-off, not bitwise
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def multi_band(problem):
+    """(Nf-band solver-convention residual, C, J, hadd, freqs, uvw)."""
+    rng = np.random.default_rng(7)
+    N, K, Ts, Td, Rs, Cs, Js, hadd = problem
+    B = N * (N - 1) // 2
+    T = Ts * Td
+    Nf = 2
+    resid = jnp.asarray(rng.standard_normal((Nf, T, B, 2, 2, 2)),
+                        jnp.float32)
+    C = jnp.asarray(rng.standard_normal((Nf,) + tuple(Cs.shape)),
+                    jnp.float32)
+    J = jnp.asarray(rng.standard_normal((Nf,) + tuple(Js.shape)),
+                    jnp.float32) * 0.3
+    hadd_all = jnp.asarray(rng.uniform(0.1, 1.0, (Nf, K)), jnp.float32)
+    freqs = np.linspace(120e6, 160e6, Nf)
+    uvw = jnp.asarray(rng.standard_normal((T * B, 3)) * 300.0, jnp.float32)
+    return N, Ts, resid, C, J, hadd_all, freqs, uvw
+
+
+def test_images_multi_opt_matches_oracle(multi_band):
+    N, Ts, resid, C, J, hadd_all, freqs, uvw = multi_band
+    cell = 1e-4
+    want = np.asarray(influence.influence_images_multi(
+        resid, C, J, hadd_all, freqs, uvw, cell, N, Ts, npix=16,
+        use_pallas=False, optimized=False))
+    got = np.asarray(influence.influence_images_multi(
+        resid, C, J, hadd_all, freqs, uvw, cell, N, Ts, npix=16,
+        optimized=True))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=1e-5)
+
+
+def test_single_band_segmented_matches_multi(multi_band):
+    """The host-segmented per-band unit (influence_image_single_sr) must
+    reproduce the fused all-band program band by band."""
+    N, Ts, resid, C, J, hadd_all, freqs, uvw = multi_band
+    cell = 1e-4
+    fused = np.asarray(influence.influence_images_multi(
+        resid, C, J, hadd_all, freqs, uvw, cell, N, Ts, npix=16,
+        optimized=True))
+    for fi in range(resid.shape[0]):
+        one = np.asarray(influence.influence_image_single_sr(
+            resid[fi], C[fi], J[fi], hadd_all[fi],
+            jnp.float32(freqs[fi]), uvw, cell, N, Ts, npix=16))
+        np.testing.assert_allclose(one, fused[fi], rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("perdir", [False, True])
+def test_influence_sharded_opt_matches_single_device(problem, perdir):
+    """The chunk-sharded route on the OPTIMIZED kernels vs the
+    single-device ORACLE chain on the virtual mesh: the two formulation
+    switches and the shard_map partitioning must all agree."""
+    N, K, Ts, Td, Rs, Cs, Js, hadd = problem
+    ref = influence.influence_visibilities(Rs, Cs, Js, hadd, N, Ts,
+                                           perdir=perdir, optimized=False)
+    mesh = make_mesh((4, 2), ("fp", "sp"))   # sp=2 divides n_chunks=Ts=2
+    out = influence_sharded(mesh, Rs, Cs, Js, hadd, N, Ts, axis="sp",
+                            perdir=perdir, optimized=True)
+    np.testing.assert_allclose(np.asarray(out.vis), np.asarray(ref.vis),
+                               rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out.llr), np.asarray(ref.llr),
+                               rtol=1e-5, atol=1e-5)

@@ -1,0 +1,262 @@
+"""Same-protocol, same-seed DDPG vs TD3 vs SAC elasticnet comparison.
+
+VERDICT weak #5: the reference's headline figure
+(``figures/comparison.png``) is a THREE-algorithm learning-curve
+comparison on the elasticnet task, but ``results/`` only ever recorded
+SAC sweeps.  This tool reproduces that figure's experiment on the
+in-framework drivers under one protocol:
+
+* identical ``EnetConfig(M, N)`` env, identical ``--steps``, identical
+  replay capacity/batch (64/1024 — each driver's own main() protocol);
+* identical SEED CHAIN: every algorithm consumes the same
+  ``PRNGKey(seed)`` split sequence, so episode k of seed s draws the
+  same problem instance for all three — the deltas are paired by
+  construction;
+* the jitted episode function is built ONCE per algorithm and reused
+  across seeds (three compiles total, the sweep_enet.py pattern);
+* no hint for any arm (the reference's comparison figure is the
+  plain-task one; hint ablations live in sweep_enet.py).
+
+Artifacts in ``--outdir`` (default ``results/enet_compare/``):
+
+* ``scores.jsonl``     — {"algo", "seed", "episode", "score"} per line
+* ``summary.json``     — per-run first/final 100-episode averages + wall
+* ``paired_stats.json``— same-seed robust-tail deltas for each algorithm
+  pair with the exact sign test + Wilcoxon signed-rank from
+  tools/enet_hint_stats.py (spike-robust: median of the tail window)
+* ``learning_curves.png`` — mean +/- std and cross-seed-median panels
+
+Tool-only (not in tier-1).  Usage:
+    python tools/enet_compare.py [--seeds 10] [--episodes 1000]
+        [--steps 5] [--platform cpu]
+"""
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(TOOLS))
+
+import jax
+
+from smartcal_tpu.utils import enable_compilation_cache
+
+enable_compilation_cache()
+
+from enet_hint_stats import robust_tail, sign_test_p, wilcoxon_exact_p
+from smartcal_tpu import obs as smartcal_obs
+from smartcal_tpu.envs import enet
+from smartcal_tpu.rl import ddpg, replay as rp, sac, td3
+
+ALGOS = ("ddpg", "td3", "sac")
+
+
+def build_algo(name, env_cfg, steps):
+    """(init_fn, episode_fn) for one algorithm under the shared protocol
+    — each config mirrors its own train/enet_<algo>.py main()."""
+    if name == "ddpg":
+        from smartcal_tpu.train.enet_ddpg import make_episode_fn
+        cfg = ddpg.DDPGConfig(obs_dim=env_cfg.obs_dim, n_actions=2,
+                              batch_size=64, mem_size=1024)
+        return (lambda k: ddpg.ddpg_init(k, cfg),
+                make_episode_fn(env_cfg, cfg, steps))
+    if name == "td3":
+        from smartcal_tpu.train.enet_td3 import make_episode_fn
+        cfg = td3.TD3Config(
+            obs_dim=env_cfg.obs_dim, n_actions=2, gamma=0.99, tau=0.005,
+            batch_size=64, mem_size=1024, lr_a=1e-3, lr_c=1e-3,
+            update_actor_interval=2, warmup=100, noise=0.1,
+            prioritized=True, use_hint=False, admm_rho=1.0)
+        return (lambda k: td3.td3_init(k, cfg),
+                make_episode_fn(env_cfg, cfg, steps, use_hint=False))
+    if name == "sac":
+        from smartcal_tpu.train.enet_sac import make_episode_fn
+        cfg = sac.SACConfig(
+            obs_dim=env_cfg.obs_dim, n_actions=2, gamma=0.99, tau=0.005,
+            batch_size=64, mem_size=1024, lr_a=1e-3, lr_c=1e-3,
+            reward_scale=20.0, alpha=0.03, use_hint=False)
+        return (lambda k: sac.sac_init(k, cfg),
+                make_episode_fn(env_cfg, cfg, steps, use_hint=False))
+    raise ValueError(name)
+
+
+def run_one(init_fn, episode_fn, env_cfg, mem_size, seed, episodes, log):
+    """One (algo, seed) run on the SHARED key chain: the per-episode key
+    depends only on (seed, episode), never the algorithm."""
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    agent_state = init_fn(k0)
+    buf = rp.replay_init(mem_size, rp.transition_spec(env_cfg.obs_dim, 2))
+    scores = []
+    for i in range(episodes):
+        key, k = jax.random.split(key)
+        agent_state, buf, score = episode_fn(agent_state, buf, k)
+        scores.append(float(score))
+        log(i, scores[-1])
+    return scores
+
+
+def paired_stats(tails, seeds, window):
+    """Pairwise same-seed deltas (a - b) for every algorithm pair."""
+    out = {"window": window, "pairs": {}}
+    for a, b in itertools.combinations(ALGOS, 2):
+        rows = [{"seed": s, a: tails[(a, s)], b: tails[(b, s)],
+                 "delta": tails[(a, s)] - tails[(b, s)]}
+                for s in seeds if (a, s) in tails and (b, s) in tails]
+        deltas = [r["delta"] for r in rows]
+        if not deltas:
+            continue
+        import numpy as np
+        out["pairs"][f"{a}_minus_{b}"] = {
+            "n_pairs": len(rows),
+            "rows": rows,
+            "median_delta": float(np.median(deltas)),
+            "mean_delta": float(np.mean(deltas)),
+            "n_positive": int(sum(1 for d in deltas if d > 0)),
+            "sign_test_p_two_sided": sign_test_p(deltas),
+            "wilcoxon_exact_p_two_sided": wilcoxon_exact_p(deltas),
+        }
+    return out
+
+
+def moving_avg(xs, w=100):
+    out = []
+    for i in range(len(xs)):
+        lo = max(0, i - w + 1)
+        out.append(sum(xs[lo:i + 1]) / (i + 1 - lo))
+    return out
+
+
+def make_plots(jsonl_path, outdir, n_seeds):
+    import numpy as np
+
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    per_run = {}
+    with open(jsonl_path) as f:
+        for line in f:
+            r = json.loads(line)
+            per_run.setdefault((r["algo"], r["seed"]), []).append(r["score"])
+    fig, (ax, ax2) = plt.subplots(1, 2, figsize=(13, 5))
+    colors = {"ddpg": "tab:green", "td3": "tab:blue", "sac": "tab:orange"}
+    for algo in ALGOS:
+        runs = [sc for (a, _), sc in sorted(per_run.items()) if a == algo]
+        if not runs:
+            continue
+        # an interrupted sweep leaves one run shorter than the rest;
+        # truncate to the common prefix so --plot-only still recovers
+        # curves from the usable data instead of crashing on the ragged
+        # array
+        n_min = min(len(sc) for sc in runs)
+        if n_min < max(len(sc) for sc in runs):
+            smartcal_obs.echo(f"{algo}: ragged runs, truncating curves "
+                              f"to {n_min} episodes")
+            runs = [sc[:n_min] for sc in runs]
+        arr = np.asarray([moving_avg(sc) for sc in runs])
+        mu, sd = arr.mean(axis=0), arr.std(axis=0)
+        x = np.arange(arr.shape[1])
+        ax.plot(x, mu, color=colors[algo],
+                label=f"{algo.upper()} (n={arr.shape[0]})")
+        ax.fill_between(x, mu - sd, mu + sd, color=colors[algo], alpha=0.15)
+        med_ma = moving_avg(list(np.median(np.asarray(runs), axis=0)))
+        ax2.plot(x, med_ma, color=colors[algo], label=f"{algo.upper()} median")
+    for a, title in ((ax, "mean +/- std of per-seed moving averages"),
+                     (ax2, "cross-seed median (spike-robust)")):
+        a.set_xlabel("episode")
+        a.set_ylabel("score (100-episode moving average)")
+        a.set_title(title)
+        a.legend()
+    fig.suptitle(f"Elastic-net DDPG vs TD3 vs SAC ({n_seeds} seeds, "
+                 "same-seed protocol)")
+    fig.tight_layout()
+    fig.savefig(os.path.join(outdir, "learning_curves.png"), dpi=120)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seeds", default=10, type=int)
+    p.add_argument("--episodes", default=1000, type=int)
+    p.add_argument("--steps", default=5, type=int)
+    p.add_argument("--M", default=20, type=int)
+    p.add_argument("--N", default=20, type=int)
+    p.add_argument("--window", default=100, type=int,
+                   help="tail window of the robust (median) statistic")
+    p.add_argument("--outdir", default="results/enet_compare")
+    p.add_argument("--platform", default=None, choices=["cpu", "axon"])
+    p.add_argument("--plot-only", action="store_true",
+                   help="regenerate plots/stats from existing scores.jsonl")
+    args = p.parse_args()
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    os.makedirs(args.outdir, exist_ok=True)
+    jsonl_path = os.path.join(args.outdir, "scores.jsonl")
+    env_cfg = enet.EnetConfig(M=args.M, N=args.N)
+    t_start = time.time()
+
+    if not args.plot_only:
+        summary, tails = [], {}
+        with open(jsonl_path, "w") as jf:
+            for algo in ALGOS:
+                init_fn, episode_fn = build_algo(algo, env_cfg, args.steps)
+                for seed in range(args.seeds):
+                    t0 = time.time()
+
+                    def log(i, s, algo=algo, seed=seed):
+                        jf.write(json.dumps(
+                            {"algo": algo, "seed": seed, "episode": i,
+                             "score": round(s, 4)}) + "\n")
+                        if i % 200 == 0:
+                            jf.flush()
+                            smartcal_obs.echo(
+                                f"[{time.time() - t_start:7.0f}s] {algo} "
+                                f"seed {seed} episode {i} score {s:.2f}")
+
+                    scores = run_one(init_fn, episode_fn, env_cfg, 1024,
+                                     seed, args.episodes, log)
+                    tails[(algo, seed)] = robust_tail(scores, args.window)
+                    summary.append({
+                        "algo": algo, "seed": seed,
+                        "final_avg_100": round(
+                            sum(scores[-100:]) / len(scores[-100:]), 3),
+                        "first_avg_100": round(
+                            sum(scores[:100]) / min(100, len(scores)), 3),
+                        "robust_tail": round(tails[(algo, seed)], 3),
+                        "wall_s": round(time.time() - t0, 1)})
+                    smartcal_obs.echo(
+                        f"DONE {algo} seed {seed}: "
+                        f"final_avg {summary[-1]['final_avg_100']} "
+                        f"({summary[-1]['wall_s']}s)")
+        with open(os.path.join(args.outdir, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=1)
+    else:
+        tails = {}
+        per_run = {}
+        with open(jsonl_path) as f:
+            for line in f:
+                r = json.loads(line)
+                per_run.setdefault((r["algo"], r["seed"]), []).append(
+                    (r["episode"], r["score"]))
+        for (algo, seed), rows in per_run.items():
+            rows.sort()
+            tails[(algo, seed)] = robust_tail([s for _, s in rows],
+                                              args.window)
+
+    stats = paired_stats(tails, sorted({s for _, s in tails}), args.window)
+    with open(os.path.join(args.outdir, "paired_stats.json"), "w") as f:
+        json.dump(stats, f, indent=1)
+    make_plots(jsonl_path, args.outdir, args.seeds)
+    smartcal_obs.emit_json({"outdir": args.outdir, "paired": {
+        k: {kk: v[kk] for kk in ("median_delta", "n_pairs",
+                                 "wilcoxon_exact_p_two_sided")}
+        for k, v in stats["pairs"].items()}})
+
+
+if __name__ == "__main__":
+    main()

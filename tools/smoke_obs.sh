@@ -29,6 +29,38 @@ echo "[smoke_obs] aggregating with obs_report --json" >&2
 python tools/obs_report.py "$RUN" --json --bootstrap 50 \
     > "$WORK/report.json"
 
+echo "[smoke_obs] recording 1-episode calib_sac run (influence stage) -> " \
+     "$WORK/smoke_calib.jsonl" >&2
+CALIB="$WORK/smoke_calib.jsonl"
+# the radio-backend driver: its episode loop is the one place the
+# influence stage runs, so this is where the span + cost-analysis
+# contract for the rewritten influence kernels is enforced
+(cd "$WORK" && PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m smartcal_tpu.train.calib_sac \
+    --small --episodes 1 --steps 1 --metrics "$CALIB" --diag --quiet)
+
+python - "$CALIB" <<'EOF'
+import json
+import sys
+
+events = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+spans = [e for e in events if e["event"] == "span"]
+inf_spans = [e for e in spans if e.get("name") == "influence"]
+assert inf_spans, ("calib run emitted no 'influence' spans: "
+                   f"{sorted({e.get('name') for e in spans})}")
+assert all(e.get("route") for e in inf_spans), \
+    f"influence spans missing route tag: {inf_spans[:2]}"
+costs = [e for e in events if e["event"] == "cost"]
+inf_costs = [e for e in costs if e.get("stage") == "influence"
+             and not e.get("error")]
+assert inf_costs, ("no successful influence cost-analysis event under "
+                   f"--diag: {sorted({e.get('stage') for e in costs})} "
+                   "— the roofline table would silently lose the "
+                   "influence kernels")
+print("[smoke_obs] influence OK:", len(inf_spans), "span(s), route",
+      inf_spans[0].get("route") + ",", len(inf_costs), "cost event(s)")
+EOF
+
 python - "$RUN" "$WORK/report.json" <<'EOF'
 import json
 import sys

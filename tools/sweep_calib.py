@@ -47,6 +47,18 @@ def main():
     p.add_argument("--light", action="store_true")
     p.add_argument("--seed0", default=0, type=int,
                    help="first seed (parallel shards of the sweep)")
+    p.add_argument("--fixed_K", default=None, type=int,
+                   help="pin the per-episode direction count in every "
+                        "run of the sweep (variance reduction: the K "
+                        "draw in [2, M] is a dominant reward-variance "
+                        "source; the episode RNG stream is unchanged, "
+                        "so skies stay same-seed comparable)")
+    p.add_argument("--baseline_reward", action="store_true",
+                   help="difference each step reward against the "
+                        "episode's own reset-calibration reward "
+                        "(demixing reward0 pattern) — removes the "
+                        "episode-to-episode sky-draw variance component "
+                        "from the hint/no-hint contrast")
     args = p.parse_args()
 
     import jax
@@ -102,6 +114,10 @@ def main():
                 argv.append("--medium")
             if args.light:
                 argv.append("--light")
+            if args.fixed_K is not None:
+                argv += ["--fixed_K", str(args.fixed_K)]
+            if args.baseline_reward:
+                argv.append("--baseline_reward")
             calib_sac.main(argv)
             os.rename(part, dst)
             print(f"[{time.time() - t_start:7.0f}s] DONE {tag} "
