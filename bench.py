@@ -232,16 +232,30 @@ def probe_backend():
     forced = os.environ.get("BENCH_PLATFORM", "").strip().lower()
     if forced in ("cpu", "tpu"):
         return forced, f"forced via BENCH_PLATFORM={forced}"
-    # Bounded retries with backoff (VERDICT r2 item 1): a wedged tunnel
-    # sometimes recovers within minutes, and round 2 lost its on-chip
-    # numbers to a single-shot probe.  3 attempts x 150 s + (45, 90) s
-    # backoff ≈ 9.5 min worst case, still bounded so bench.py always
-    # prints its JSON line.  BENCH_PROBE_ATTEMPTS overrides.
-    try:
-        attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
-    except ValueError:
-        attempts = 3
-    for i in range(max(1, attempts)):
+    # Bounded retries with exponential backoff + jitter (VERDICT r2 item
+    # 1; blind fixed-sleep loop replaced in the runtime PR): a wedged
+    # tunnel sometimes recovers within minutes, and round 2 lost its
+    # on-chip numbers to a single-shot probe — but round 5 also burned 87
+    # fixed-cadence probes against a dead tunnel.  The walk is 45 s
+    # doubling to 300 s (+/-25% jitter) under BOTH an attempt cap
+    # (BENCH_PROBE_ATTEMPTS, default 3) and a total-sleep budget
+    # (BENCH_PROBE_BUDGET_S, default 900 s), so bench.py always prints
+    # its JSON line.
+    from smartcal_tpu.runtime import Backoff, BackoffPolicy
+
+    def _env_num(name, default, cast):
+        try:
+            return cast(os.environ.get(name, str(default)))
+        except ValueError:
+            return default
+
+    attempts = max(1, _env_num("BENCH_PROBE_ATTEMPTS", 3, int))
+    budget_s = _env_num("BENCH_PROBE_BUDGET_S", 900.0, float)
+    bo = Backoff(BackoffPolicy(base_s=45.0, factor=2.0, max_s=300.0,
+                               jitter=0.25, max_attempts=attempts - 1,
+                               budget_s=budget_s),
+                 seed=os.getpid())
+    for i in range(attempts):
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
@@ -250,14 +264,19 @@ def probe_backend():
         except subprocess.TimeoutExpired:
             # only the wedged-tunnel hang retries — a clean non-TPU answer
             # is definitive and must not cost retry sleeps on CPU-only hosts
+            delay = None if i >= attempts - 1 else bo.next_delay()
             rl = obs.active()
             if rl is not None:
                 # the structured chip-probe record VERDICT r5 demanded
                 # (87/87 tunnel probes failed with nothing on disk)
                 rl.log("probe", ok=False, attempt=i,
-                       error="backend init timed out (150s)")
-            if i < attempts - 1:
-                time.sleep(45 * (i + 1))
+                       error="backend init timed out (150s)",
+                       next_retry_s=None if delay is None
+                       else round(delay, 1),
+                       backoff_spent_s=round(bo.spent_s, 1))
+            if delay is None:
+                break
+            time.sleep(delay)
             continue
         ok = r.returncode == 0 and r.stdout.strip() in ("axon", "tpu")
         rl = obs.active()
@@ -270,7 +289,8 @@ def probe_backend():
         return "cpu", ("no TPU platform available "
                        f"(probe saw {r.stdout.strip() or r.returncode})")
     return "cpu", ("TPU backend init timed out (tunnel wedged?), "
-                   f"{max(1, attempts)} attempts")
+                   f"{attempts} attempts, "
+                   f"{round(bo.spent_s)}s backoff spent")
 
 
 def bench_configs():

@@ -811,6 +811,73 @@ def solve_admm_host(V, C, freqs, f0, rho, cfg: SolverConfig,
                        sigma_data=sigma_data, final_cost=fcost, stats=stats)
 
 
+class SolverDegradedError(RuntimeError):
+    """Every degradation rung (rho-boosted retries, host-segmented
+    fallback) still produced non-finite solutions — the one error the
+    graceful-degradation ladder surfaces."""
+
+
+def result_finite(res: SolveResult) -> bool:
+    """Host check: are the solve's consensus iterates and residuals all
+    finite?  One tiny reduction + device->host sync."""
+    ok = (jnp.all(jnp.isfinite(res.J))
+          & jnp.all(jnp.isfinite(res.residual))
+          & jnp.all(jnp.isfinite(res.final_cost)))
+    return bool(jax.device_get(ok))
+
+
+def solve_admm_safe(solve_fn, rho, *, initial_result=None,
+                    host_fallback=None, max_retries: int = 2,
+                    rho_boost: float = 10.0, on_event=None):
+    """Graceful degradation around ANY solve route: detect non-finite
+    consensus iterates and walk the recovery ladder instead of handing a
+    poisoned result downstream.
+
+    1. ``solve_fn(rho)`` (or the caller's already-computed
+       ``initial_result``) — the production route, untouched when the
+       solve is healthy;
+    2. up to ``max_retries`` re-solves at ``rho * rho_boost**attempt``
+       (a diverging consensus usually means the regularization was too
+       weak for the drawn scene; boosting rho contracts the inner
+       problem);
+    3. ``host_fallback(rho)`` — the host-segmented route, whose bounded
+       dispatches sidestep fused-program pathologies;
+    4. :class:`SolverDegradedError`.
+
+    Returns ``(result, info)`` where ``info`` records what happened
+    ({"degraded", "attempts", "route", "rho_scale"}); ``on_event`` (if
+    given) is called with the same fields per degradation step — the
+    caller's RunLog hook, so this module stays obs-free.
+    """
+    rho = jnp.asarray(rho)
+    info = {"degraded": False, "attempts": 0, "route": "primary",
+            "rho_scale": 1.0}
+    res = initial_result if initial_result is not None else solve_fn(rho)
+    if result_finite(res):
+        return res, info
+    info["degraded"] = True
+    for attempt in range(1, max_retries + 1):
+        scale = float(rho_boost) ** attempt
+        info.update(attempts=attempt, route="retry_rho", rho_scale=scale)
+        if on_event is not None:
+            on_event(**info)
+        res = solve_fn(rho * scale)
+        if result_finite(res):
+            return res, info
+    if host_fallback is not None:
+        info.update(route="host_segmented", rho_scale=1.0)
+        if on_event is not None:
+            on_event(**info)
+        res = host_fallback(rho)
+        if result_finite(res):
+            return res, info
+    tail = (" and the host-segmented fallback"
+            if host_fallback is not None else "")
+    raise SolverDegradedError(
+        f"non-finite ADMM iterates survived {info['attempts']} rho-boosted "
+        f"retries (x{rho_boost}){tail}")
+
+
 def simulate_vis_sr(J, C, n_stations, Ts):
     """Corrupt model coherencies with per-interval Jones: the in-framework
     stand-in for ``sagecal_gpu -O DATA -p ...`` simulation

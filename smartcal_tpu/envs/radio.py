@@ -93,7 +93,8 @@ class RadioBackend:
     def __init__(self, n_stations=14, n_freqs=3, n_times=20, tdelta=10,
                  n_poly=2, admm_iters=10, lbfgs_iters=8, init_iters=30,
                  polytype=0, npix=128, hint_batch=8, vectorized=True,
-                 shard="auto"):
+                 shard="auto", robust_solver=True, solver_max_retries=2,
+                 solver_rho_boost=10.0):
         if n_times <= 0 or n_times % tdelta != 0:
             raise ValueError(
                 f"n_times={n_times} must be a positive multiple of "
@@ -118,6 +119,14 @@ class RadioBackend:
         self.hint_batch = hint_batch
         self.vectorized = vectorized
         self.shard = shard
+        # graceful degradation (runtime PR): non-finite consensus iterates
+        # re-solve at boosted rho, then fall back to the host-segmented
+        # route, before surfacing SolverDegradedError — one bad episode
+        # degrades instead of crashing a batch.  SMARTCAL_ROBUST_SOLVER=0/1
+        # overrides the constructor flag.
+        self.robust_solver = robust_solver
+        self.solver_max_retries = solver_max_retries
+        self.solver_rho_boost = solver_rho_boost
         self._sweep_fns = {}     # (n_dirs, n_masks, batch) -> jitted sweep
         self._meshes = {}        # axis size -> cached 1D mesh
         # double-buffer worker (run_pipelined / env prefetch)
@@ -397,47 +406,96 @@ class RadioBackend:
             forced_host = (os.environ.get("SMARTCAL_HOST_SOLVER", "")
                            .strip() == "1")
             nfp = 0 if forced_host else self._shard_size(self.n_freqs, work)
-            if nfp and work / nfp <= _WATCHDOG_WORK:
-                from smartcal_tpu.parallel import sharded_cal
 
-                with obs.span("solve", route="sharded", shards=nfp):
-                    res = sharded_cal.solve_admm_sharded(
-                        self._mesh(nfp), ep.V, C, ep.obs.freqs, ep.f0,
-                        jnp.asarray(rho), self._solver_cfg(ep.n_dirs),
-                        axis="fp", n_chunks=self.n_chunks,
-                        admm_iters=None if admm_iters is None
-                        else int(admm_iters), collect_stats=collect)
-                return self._log_solve(res, "sharded")
-            if self._use_host_solver(admm_iters):
+            def host_route(rho_arr):
                 with obs.span("solve", route="host_segmented"):
-                    res = solver.solve_admm_host(
-                        ep.V, C, ep.obs.freqs, ep.f0, jnp.asarray(rho),
+                    return solver.solve_admm_host(
+                        ep.V, C, ep.obs.freqs, ep.f0, jnp.asarray(rho_arr),
                         self._solver_cfg(ep.n_dirs), n_chunks=self.n_chunks,
                         admm_iters=None if admm_iters is None
                         else int(admm_iters), collect_stats=collect)
-                return self._log_solve(res, "host_segmented")
-            with obs.span("solve", route="fused"):
-                res = solver.solve_admm(
-                    ep.V, C, ep.obs.freqs, ep.f0, jnp.asarray(rho),
-                    self._solver_cfg(ep.n_dirs), n_chunks=self.n_chunks,
-                    admm_iters=None if admm_iters is None
-                    else jnp.asarray(admm_iters), collect_stats=collect)
-            # per-compile FLOPs/bytes accounting (no-op unless --diag
-            # armed it; cached per shape signature).  HLO counts the
-            # while_loop body once, so this is the roofline FLOOR — the
-            # per-iteration truth stays with solver.cost_eval_flops.
-            obs_costs.record_stage_cost(
-                "solve", solver.solve_admm, ep.V, C, ep.obs.freqs, ep.f0,
-                jnp.asarray(rho), self._solver_cfg(ep.n_dirs),
-                defer=True,          # still inside the env step span
-                n_chunks=self.n_chunks,
-                admm_iters=None if admm_iters is None
-                else jnp.asarray(admm_iters), collect_stats=collect)
-            return self._log_solve(res, "fused")
+
+            if nfp and work / nfp <= _WATCHDOG_WORK:
+                from smartcal_tpu.parallel import sharded_cal
+
+                route = "sharded"
+
+                def route_fn(rho_arr):
+                    with obs.span("solve", route="sharded", shards=nfp):
+                        return sharded_cal.solve_admm_sharded(
+                            self._mesh(nfp), ep.V, C, ep.obs.freqs, ep.f0,
+                            jnp.asarray(rho_arr),
+                            self._solver_cfg(ep.n_dirs),
+                            axis="fp", n_chunks=self.n_chunks,
+                            admm_iters=None if admm_iters is None
+                            else int(admm_iters), collect_stats=collect)
+            elif self._use_host_solver(admm_iters):
+                route, route_fn = "host_segmented", host_route
+            else:
+                route = "fused"
+
+                def route_fn(rho_arr):
+                    with obs.span("solve", route="fused"):
+                        res = solver.solve_admm(
+                            ep.V, C, ep.obs.freqs, ep.f0,
+                            jnp.asarray(rho_arr),
+                            self._solver_cfg(ep.n_dirs),
+                            n_chunks=self.n_chunks,
+                            admm_iters=None if admm_iters is None
+                            else jnp.asarray(admm_iters),
+                            collect_stats=collect)
+                    # per-compile FLOPs/bytes accounting (no-op unless
+                    # --diag armed it; cached per shape signature).  HLO
+                    # counts the while_loop body once, so this is the
+                    # roofline FLOOR — the per-iteration truth stays with
+                    # solver.cost_eval_flops.
+                    obs_costs.record_stage_cost(
+                        "solve", solver.solve_admm, ep.V, C, ep.obs.freqs,
+                        ep.f0, jnp.asarray(rho_arr),
+                        self._solver_cfg(ep.n_dirs),
+                        defer=True,      # still inside the env step span
+                        n_chunks=self.n_chunks,
+                        admm_iters=None if admm_iters is None
+                        else jnp.asarray(admm_iters), collect_stats=collect)
+                    return res
+
+            res = route_fn(rho)
+            res, route = self._robustify(
+                res, route_fn, None if route == "host_segmented"
+                else host_route, rho, route)
+            return self._log_solve(res, route)
         return solver.solve_admm(
             ep.V, C, ep.obs.freqs, ep.f0, jnp.asarray(rho),
             self._solver_cfg(ep.n_dirs), n_chunks=self.n_chunks,
             admm_iters=None if admm_iters is None else jnp.asarray(admm_iters))
+
+    def _robustify(self, res, route_fn, host_fn, rho, route):
+        """Solver graceful degradation on the untraced routes: non-finite
+        consensus iterates re-solve at boosted rho (bounded retries), then
+        fall back to the host-segmented route, then surface
+        SolverDegradedError — one bad episode degrades, never crashes, a
+        batch.  Healthy solves pay one finiteness reduction.  Every
+        degradation step emits a structured ``solver_degraded`` event."""
+        override = os.environ.get("SMARTCAL_ROBUST_SOLVER", "").strip()
+        enabled = (override == "1" if override in ("0", "1")
+                   else self.robust_solver)
+        if not enabled:
+            return res, route
+        final_route = [route]
+
+        def on_event(**info):
+            if info.get("route") == "host_segmented":
+                final_route[0] = "host_segmented"
+            rl = obs.active()
+            if rl is not None:
+                rl.log("solver_degraded", primary_route=route, **info)
+            obs.echo(f"solver degraded ({route}): {info}", event=None)
+
+        res, _ = solver.solve_admm_safe(
+            route_fn, rho, initial_result=res, host_fallback=host_fn,
+            max_retries=self.solver_max_retries,
+            rho_boost=self.solver_rho_boost, on_event=on_event)
+        return res, final_route[0]
 
     def _log_solve(self, res, route):
         """Record the solver telemetry event (no-op without a RunLog)."""

@@ -61,10 +61,21 @@ class Watchdog:
         self.cfg = cfg or WatchdogConfig()
         self.tripped = False
         self.trip_reason: Optional[str] = None
+        self.trips = 0                  # lifetime count (survives reset())
         self._ring = deque(maxlen=self.cfg.ring)
         self._ewma = {k: None for k in _GRAD_FIELDS}
         self._n = {k: 0 for k in _GRAD_FIELDS}
         self._seen = 0
+
+    def reset(self) -> None:
+        """Un-latch after a recovery rollback (runtime.recovery): the trip
+        state clears so the retried trajectory is monitored afresh, while
+        the gradient EWMAs and the lifetime ``trips`` count survive — the
+        healthy pre-trip baseline is exactly what the retry should be
+        judged against."""
+        self.tripped = False
+        self.trip_reason = None
+        self._ring.clear()
 
     # -- detectors --------------------------------------------------------
     def _check_finite(self, diag: dict) -> Optional[str]:
@@ -142,6 +153,7 @@ class Watchdog:
     def _trip(self, reason: str, step, tags: dict):
         self.tripped = True
         self.trip_reason = reason
+        self.trips += 1
         rl = active()
         if rl is not None:
             rl.log("watchdog_trip", reason=reason, step=step,

@@ -109,32 +109,25 @@ class DistDemixState(NamedTuple):
     episode: jnp.ndarray
 
 
-def make_distributed_demix_sac(backend: radio.RadioBackend, K: int,
-                               agent_cfg: dsac.DSACConfig, mesh: Mesh,
-                               n_actors: int, rollout_epochs: int = 2,
-                               rollout_steps: int = 5,
-                               provide_influence: bool = False,
-                               maxiter: int = 10,
-                               learn_per_transition: bool = False):
-    """Build (init_fn, make_workloads_fn, run_episode_fn) on ``mesh``.
-
-    ``provide_influence`` populates the infmap block of the observation
-    (the reference variant's [1, Ninf, Ninf] input) — with False the block
-    is zeros and ``agent_cfg.use_image`` should be False too."""
-    if n_actors % mesh.shape["dp"] != 0:
-        raise ValueError(f"n_actors={n_actors} not divisible by dp axis "
-                         f"{mesh.shape['dp']}")
+def make_demix_actor_rollout(backend: radio.RadioBackend, K: int,
+                             agent_cfg: dsac.DSACConfig,
+                             rollout_epochs: int, rollout_steps: int,
+                             provide_influence: bool = False,
+                             maxiter: int = 10):
+    """One demixing actor's rollout as a pure function ``(agent_state,
+    wl, key) -> transitions`` — ``wl`` a :class:`DemixWorkload` slice
+    with leading axis ``rollout_epochs``, output leading axis
+    ``rollout_epochs * rollout_steps``.  Shared by the SPMD learner
+    (vmapped over the actor axis) and the supervised actor-thread
+    fleet (jitted per thread)."""
     n_actions = 2 ** (K - 1)
     if agent_cfg.n_actions != n_actions:
         raise ValueError(f"agent n_actions={agent_cfg.n_actions} != "
                          f"2^(K-1)={n_actions}")
-    repl = NamedSharding(mesh, P())
-    shard = NamedSharding(mesh, P("dp"))
     npix = backend.npix
     N = backend.n_stations
     tbl = jnp.asarray(mask_table(K))
     n_trans = rollout_epochs * rollout_steps
-    spec = dsac.transition_spec(agent_cfg.obs_dim)
 
     def _calibrate(wl_ep, mask):
         C = wl_ep.Ccal * mask[None, :, None, None, None]
@@ -187,8 +180,8 @@ def make_distributed_demix_sac(backend: radio.RadioBackend, K: int,
         return jnp.concatenate([img.reshape(-1), md * META_SCALE])
 
     def _actor_rollout(agent_state, wl, key):
-        """One actor: rollout_epochs episodes x rollout_steps transitions
-        with frozen params (Actor.run_observations, :123-146)."""
+        """rollout_epochs episodes x rollout_steps transitions with
+        frozen params (Actor.run_observations, :123-146)."""
 
         def epoch_body(carry, inp):
             wl_ep, k_epoch = inp
@@ -222,6 +215,32 @@ def make_distributed_demix_sac(backend: radio.RadioBackend, K: int,
             (wl, jax.random.split(key, rollout_epochs)))
         return jax.tree_util.tree_map(
             lambda x: x.reshape((n_trans,) + x.shape[2:]), trs)
+
+    return _actor_rollout
+
+
+def make_distributed_demix_sac(backend: radio.RadioBackend, K: int,
+                               agent_cfg: dsac.DSACConfig, mesh: Mesh,
+                               n_actors: int, rollout_epochs: int = 2,
+                               rollout_steps: int = 5,
+                               provide_influence: bool = False,
+                               maxiter: int = 10,
+                               learn_per_transition: bool = False):
+    """Build (init_fn, make_workloads_fn, run_episode_fn) on ``mesh``.
+
+    ``provide_influence`` populates the infmap block of the observation
+    (the reference variant's [1, Ninf, Ninf] input) — with False the block
+    is zeros and ``agent_cfg.use_image`` should be False too."""
+    if n_actors % mesh.shape["dp"] != 0:
+        raise ValueError(f"n_actors={n_actors} not divisible by dp axis "
+                         f"{mesh.shape['dp']}")
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P("dp"))
+    n_trans = rollout_epochs * rollout_steps
+    spec = dsac.transition_spec(agent_cfg.obs_dim)
+    _actor_rollout = make_demix_actor_rollout(
+        backend, K, agent_cfg, rollout_epochs, rollout_steps,
+        provide_influence=provide_influence, maxiter=maxiter)
 
     def init_fn(key) -> DistDemixState:
         agent = dsac.dsac_init(key, agent_cfg)
@@ -279,13 +298,15 @@ def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
                             K=4, backend=None, provide_influence=False,
                             agent_kwargs=None, quiet=False,
                             rollout_epochs=2, rollout_steps=5,
-                            metrics=None, diag=False, watchdog=False):
+                            metrics=None, diag=False, watchdog=False,
+                            ckpt_dir=None, ckpt_every=0, resume=False):
     """Host driver (run_process + Learner.run_episodes parity,
     distributed_per_sac.py:193-229)."""
     import time
 
     from smartcal_tpu import obs
-    from smartcal_tpu.train.blocks import train_obs
+    from smartcal_tpu.runtime import pack_replay, unpack_replay
+    from smartcal_tpu.train.blocks import TrainRuntime, train_obs
 
     from . import make_mesh
 
@@ -309,8 +330,29 @@ def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
     tob = train_obs("demix_learner", metrics=metrics, quiet=quiet,
                     diag=diag, watchdog=watchdog, seed=seed,
                     n_actors=n_actors, K=K)
+    rt = TrainRuntime("demix_learner", ckpt_dir=ckpt_dir,
+                      ckpt_every=ckpt_every, resume=resume, tob=tob)
+    ep0 = 0
+    restored = rt.restore()
+    if restored is not None:
+        st = DistDemixState(
+            agent=jax.tree_util.tree_map(jnp.asarray,
+                                         restored["agent_state"]),
+            buf=unpack_replay(restored["replay"]),
+            episode=jnp.asarray(restored["episode"], jnp.int32))
+        key = jnp.asarray(restored["key"])
+        scores = list(restored["scores"])
+        ep0 = int(restored["episode"])
+
+    def ckpt_payload(ep, key):
+        return {"kind": "dist_demix", "episode": ep + 1,
+                "scores": list(scores),
+                "agent_state": jax.device_get(st.agent),
+                "replay": pack_replay(st.buf),
+                "key": jax.device_get(key)}
+
     try:
-        for ep in range(episodes):
+        for ep in range(ep0, episodes):
             key, kw, kr = jax.random.split(key, 3)
             with tob.span("learner_episode", episode=ep):
                 with tob.span("make_workloads"):
@@ -337,10 +379,93 @@ def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
             tob.echo(f"episode {ep} mean reward {scores[-1]:.4f}",
                      event=None)
             if tripped:
+                # never checkpoint the tripped episode's state (see
+                # parallel.learner.train_distributed)
                 break
+            rt.maybe_checkpoint(ep + 1, lambda: ckpt_payload(ep, key))
     finally:
         tob.close()
     return st, scores
+
+
+def train_supervised_demix(seed=0, episodes=5, n_actors=2, K=4,
+                           backend=None, provide_influence=False,
+                           agent_kwargs=None, quiet=False,
+                           rollout_epochs=1, rollout_steps=3, metrics=None,
+                           diag=False, watchdog=False,
+                           heartbeat_timeout=300.0, max_restarts=3,
+                           queue_timeout=300.0, max_empty_rounds=10,
+                           restart_backoff=None):
+    """Supervised actor-thread fleet for the demixing workload (the
+    fault-tolerant sibling of :func:`train_distributed_demix`; see
+    parallel.learner.train_supervised for the architecture).
+
+    Each actor thread simulates ITS OWN workload slice on the host
+    (``make_workloads`` with one actor) and runs the jitted per-actor
+    rollout against the latest weights snapshot; the supervisor restarts
+    dead/hung actors with backoff and a watchdog trip joins the fleet
+    cleanly.  Returns ``((agent_state, buf), scores, fleet_summary)``.
+    """
+    from smartcal_tpu.runtime import Fleet
+    from smartcal_tpu.runtime import faults as rt_faults
+    from smartcal_tpu.train.blocks import train_obs
+
+    from .learner import run_supervised_loop
+
+    backend = backend or radio.RadioBackend()
+    md_dim = 3 * K + 2
+    agent_cfg = dsac.DSACConfig(
+        obs_dim=backend.npix * backend.npix + md_dim,
+        n_actions=2 ** (K - 1), img_shape=(backend.npix, backend.npix),
+        use_image=provide_influence, **(agent_kwargs or {}))
+    n_trans = rollout_epochs * rollout_steps
+    rollout = jax.jit(make_demix_actor_rollout(
+        backend, K, agent_cfg, rollout_epochs, rollout_steps,
+        provide_influence=provide_influence))
+
+    def _ingest(agent, buf, flat, key):
+        buf = rp.replay_add_batch(buf, flat)
+        return dsac.learn(agent_cfg, agent, buf, key)
+
+    ingest = jax.jit(_ingest)
+
+    def ingest_batch(agent, buf, host_trs, kl):
+        flat = {k2: jnp.asarray(v) for k2, v in host_trs.items()}
+        return ingest(agent, buf, flat, kl)
+
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    agent = dsac.dsac_init(k0, agent_cfg)
+    buf = rp.replay_init(agent_cfg.mem_size,
+                         dsac.transition_spec(agent_cfg.obs_dim))
+
+    base_key = jax.random.PRNGKey(seed ^ 0x0AC7D32)
+
+    def work_fn(actor_id, iteration, weights):
+        rt_faults.maybe_delay("actor_rollout", iteration)
+        if rt_faults.should_kill_actor(actor_id, iteration):
+            raise rt_faults.FaultInjected(
+                f"actor {actor_id} killed at iteration {iteration}")
+        k = jax.random.fold_in(jax.random.fold_in(base_key, actor_id),
+                               iteration)
+        k_wl, k_roll = jax.random.split(k)
+        # the actor simulates its own episodes (the host-side half the
+        # SPMD mode batches up front)
+        wl = make_workloads(backend, K, 1, rollout_epochs, k_wl)
+        wl_one = jax.tree_util.tree_map(lambda x: x[0], wl)
+        return jax.device_get(rollout(weights, wl_one, k_roll))
+
+    tob = train_obs("demix_learner_supervised", metrics=metrics,
+                    quiet=quiet, diag=diag, watchdog=watchdog, seed=seed,
+                    n_actors=n_actors, K=K)
+    fleet = Fleet(n_actors, work_fn, name="demix-actor",
+                  heartbeat_timeout=heartbeat_timeout,
+                  max_restarts=max_restarts, backoff=restart_backoff,
+                  seed=seed)
+    return run_supervised_loop(fleet, ingest_batch, agent, buf, key,
+                               episodes, n_trans, tob,
+                               queue_timeout=queue_timeout,
+                               max_empty_rounds=max_empty_rounds)
 
 
 def main(argv=None):
@@ -369,10 +494,18 @@ def main(argv=None):
     p.add_argument("--rollout_epochs", type=int, default=2,
                    help="episodes per actor per learner episode")
     p.add_argument("--rollout_steps", type=int, default=5)
+    p.add_argument("--supervised", action="store_true",
+                   help="actor-THREAD fleet with heartbeat supervision + "
+                        "restart backoff (train_supervised_demix) instead "
+                        "of the fused SPMD program")
+    p.add_argument("--heartbeat_timeout", type=float, default=300.0)
+    p.add_argument("--max_restarts", type=int, default=3)
     from smartcal_tpu import obs
-    from smartcal_tpu.train.blocks import add_obs_args, diag_from_args
+    from smartcal_tpu.train.blocks import (add_obs_args, add_runtime_args,
+                                           diag_from_args)
 
     add_obs_args(p)
+    add_runtime_args(p)
     multihost.add_cli_args(p)
     args = p.parse_args(argv)
     if multihost.initialize_from_args(args):
@@ -385,6 +518,22 @@ def main(argv=None):
     else:
         backend = radio.RadioBackend(n_stations=args.stations,
                                      npix=args.npix)
+    if args.supervised:
+        if args.ckpt_every or args.resume:
+            obs.echo("checkpoint/resume is not yet supported in "
+                     "--supervised mode; flags ignored")
+        _, scores, _ = train_supervised_demix(
+            seed=args.seed, episodes=args.episodes,
+            n_actors=args.actors or 2, K=args.K, backend=backend,
+            provide_influence=args.provide_influence,
+            rollout_epochs=args.rollout_epochs,
+            rollout_steps=args.rollout_steps,
+            quiet=args.quiet, metrics=args.metrics,
+            diag=diag_from_args(args),
+            watchdog=getattr(args, "watchdog", False),
+            heartbeat_timeout=args.heartbeat_timeout,
+            max_restarts=args.max_restarts)
+        return scores
     _, scores = train_distributed_demix(
         seed=args.seed, episodes=args.episodes, n_actors=args.actors,
         K=args.K, backend=backend,
@@ -393,7 +542,9 @@ def main(argv=None):
         rollout_steps=args.rollout_steps,
         quiet=args.quiet, metrics=args.metrics,
         diag=diag_from_args(args),
-        watchdog=getattr(args, "watchdog", False))
+        watchdog=getattr(args, "watchdog", False),
+        ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
+        resume=args.resume)
     return scores
 
 

@@ -49,6 +49,50 @@ class DistPERState(NamedTuple):
     episode: jnp.ndarray    # () int32
 
 
+def make_actor_rollout(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
+                       rollout_epochs: int, rollout_steps: int,
+                       use_hint: bool = False):
+    """One actor's rollout as a pure function ``(agent_state, key) ->
+    transitions`` with leading axis ``rollout_epochs * rollout_steps``
+    (reference Actor.run_observations, :123-146).  Shared by the SPMD
+    learner (vmapped over the actor axis) and the supervised
+    actor-thread fleet (jitted per thread)."""
+    n_trans = rollout_epochs * rollout_steps
+
+    def _actor_rollout(agent_state, key):
+        def epoch_body(carry, k_epoch):
+            k_reset, k_noise, k_scan = jax.random.split(k_epoch, 3)
+            env_state, obs = enet.reset(env_cfg, k_reset)
+            env_state = enet.draw_noise(env_cfg, env_state, k_noise)
+            hint = (enet.get_hint(env_cfg, env_state) if use_hint
+                    else jnp.zeros((agent_cfg.n_actions,), jnp.float32))
+
+            def step_body(scarry, inp):
+                k, first = inp
+                env_state, obs = scarry
+                k_act, k_env = jax.random.split(k)
+                a = sac.choose_action(agent_cfg, agent_state, obs[None],
+                                      k_act)[0]
+                env_state, obs2, r, done = enet.step(env_cfg, env_state, a,
+                                                     k_env, keepnoise=first)
+                tr = {"state": obs, "action": a, "reward": r,
+                      "new_state": obs2, "done": done, "hint": hint}
+                return (env_state, obs2), tr
+
+            keys = jax.random.split(k_scan, rollout_steps)
+            first = jnp.arange(rollout_steps) == 0
+            _, trs = jax.lax.scan(step_body, (env_state, obs), (keys, first))
+            return carry, trs
+
+        _, trs = jax.lax.scan(epoch_body, 0,
+                              jax.random.split(key, rollout_epochs))
+        # (epochs, steps, ...) -> (epochs*steps, ...)
+        return jax.tree_util.tree_map(
+            lambda x: x.reshape((n_trans,) + x.shape[2:]), trs)
+
+    return _actor_rollout
+
+
 def make_distributed_per_sac(env_cfg: enet.EnetConfig,
                              agent_cfg: sac.SACConfig, mesh: Mesh,
                              n_actors: int, rollout_epochs: int = 10,
@@ -85,39 +129,8 @@ def make_distributed_per_sac(env_cfg: enet.EnetConfig,
             buf=jax.tree_util.tree_map(lambda _: repl, st.buf),
             episode=repl)
 
-    def _actor_rollout(agent_state, key):
-        """One actor: epochs x steps transitions with frozen params
-        (reference Actor.run_observations, :123-146)."""
-
-        def epoch_body(carry, k_epoch):
-            k_reset, k_noise, k_scan = jax.random.split(k_epoch, 3)
-            env_state, obs = enet.reset(env_cfg, k_reset)
-            env_state = enet.draw_noise(env_cfg, env_state, k_noise)
-            hint = (enet.get_hint(env_cfg, env_state) if use_hint
-                    else jnp.zeros((agent_cfg.n_actions,), jnp.float32))
-
-            def step_body(scarry, inp):
-                k, first = inp
-                env_state, obs = scarry
-                k_act, k_env = jax.random.split(k)
-                a = sac.choose_action(agent_cfg, agent_state, obs[None],
-                                      k_act)[0]
-                env_state, obs2, r, done = enet.step(env_cfg, env_state, a,
-                                                     k_env, keepnoise=first)
-                tr = {"state": obs, "action": a, "reward": r,
-                      "new_state": obs2, "done": done, "hint": hint}
-                return (env_state, obs2), tr
-
-            keys = jax.random.split(k_scan, rollout_steps)
-            first = jnp.arange(rollout_steps) == 0
-            _, trs = jax.lax.scan(step_body, (env_state, obs), (keys, first))
-            return carry, trs
-
-        _, trs = jax.lax.scan(epoch_body, 0,
-                              jax.random.split(key, rollout_epochs))
-        # (epochs, steps, ...) -> (epochs*steps, ...)
-        return jax.tree_util.tree_map(
-            lambda x: x.reshape((n_trans,) + x.shape[2:]), trs)
+    _actor_rollout = make_actor_rollout(env_cfg, agent_cfg, rollout_epochs,
+                                        rollout_steps, use_hint=use_hint)
 
     def run_episode(st: DistPERState, key):
         k_roll, k_learn = jax.random.split(key)
@@ -160,7 +173,8 @@ def train_distributed(seed=0, episodes=100, n_actors=None, mesh=None,
                       env_kwargs=None, agent_kwargs=None, use_hint=False,
                       learn_per_transition=False, quiet=False,
                       rollout_epochs=10, rollout_steps=10, metrics=None,
-                      diag=False, watchdog=False):
+                      diag=False, watchdog=False, ckpt_dir=None,
+                      ckpt_every=0, resume=False):
     """Host driver mirroring ``run_process`` + ``Learner.run_episodes``
     (distributed_per_sac.py:60-82, :154-174).
 
@@ -189,6 +203,10 @@ def train_distributed(seed=0, episodes=100, n_actors=None, mesh=None,
         env_cfg, agent_cfg, mesh, n_actors, use_hint=use_hint,
         rollout_epochs=rollout_epochs, rollout_steps=rollout_steps,
         learn_per_transition=learn_per_transition)
+    from smartcal_tpu.train.blocks import TrainRuntime
+
+    from smartcal_tpu.runtime import pack_replay, unpack_replay
+
     key = jax.random.PRNGKey(seed)
     key, k0 = jax.random.split(key)
     st = init_fn(k0)
@@ -197,8 +215,29 @@ def train_distributed(seed=0, episodes=100, n_actors=None, mesh=None,
     tob = train_obs("parallel_learner", metrics=metrics, quiet=quiet,
                     diag=diag, watchdog=watchdog, seed=seed,
                     n_actors=n_actors)
+    rt = TrainRuntime("parallel_learner", ckpt_dir=ckpt_dir,
+                      ckpt_every=ckpt_every, resume=resume, tob=tob)
+    ep0 = 0
+    restored = rt.restore()
+    if restored is not None:
+        st = DistPERState(
+            agent=jax.tree_util.tree_map(jnp.asarray,
+                                         restored["agent_state"]),
+            buf=unpack_replay(restored["replay"]),
+            episode=jnp.asarray(restored["episode"], jnp.int32))
+        key = jnp.asarray(restored["key"])
+        scores = list(restored["scores"])
+        ep0 = int(restored["episode"])
+
+    def ckpt_payload(ep, key):
+        return {"kind": "dist_per", "episode": ep + 1,
+                "scores": list(scores),
+                "agent_state": jax.device_get(st.agent),
+                "replay": pack_replay(st.buf),
+                "key": jax.device_get(key)}
+
     try:
-        for ep in range(episodes):
+        for ep in range(ep0, episodes):
             key, k = jax.random.split(key)
             t0 = time.perf_counter()
             with tob.span("learner_episode", episode=ep):
@@ -228,10 +267,184 @@ def train_distributed(seed=0, episodes=100, n_actors=None, mesh=None,
             tob.echo(f"episode {ep} mean reward {scores[-1]:.4f}",
                      event=None)
             if tripped:
+                # never checkpoint the tripped episode's (possibly
+                # poisoned) state — a --resume must restart from the
+                # last GOOD checkpoint
                 break
+            rt.maybe_checkpoint(ep + 1, lambda: ckpt_payload(ep, key))
     finally:
         tob.close()
     return st, scores
+
+
+def train_supervised(seed=0, episodes=50, n_actors=2, env_kwargs=None,
+                     agent_kwargs=None, use_hint=False, rollout_epochs=2,
+                     rollout_steps=5, metrics=None, quiet=False, diag=False,
+                     watchdog=False, heartbeat_timeout=60.0, max_restarts=3,
+                     queue_timeout=30.0, max_empty_rounds=20,
+                     restart_backoff=None):
+    """Supervised actor-thread fleet: the fault-tolerant sibling of
+    :func:`train_distributed`.
+
+    Where the SPMD learner fuses all actors into one jitted program
+    (nothing can die independently), here each actor is a host THREAD
+    running the same jitted per-actor rollout against the latest weights
+    snapshot and queueing host transition batches; the learner ingests
+    whatever arrived (IMPACT-style: stale snapshots are expected — the
+    staleness-in-versions gauge records how stale), and a
+    :class:`~smartcal_tpu.runtime.supervisor.Fleet` restarts dead/hung
+    actors with exponential backoff + jitter.  Learning continues from
+    the surviving fleet; a watchdog trip stops AND joins every actor
+    thread before the driver exits (no actor left running against a
+    dead learner).  Deterministic faults (kill actor i at iteration n,
+    delay a rollout) come from :mod:`smartcal_tpu.runtime.faults`.
+
+    Returns ``((agent_state, buf), scores, fleet_summary)``.
+    """
+    from smartcal_tpu.runtime import Fleet
+    from smartcal_tpu.runtime import faults as rt_faults
+    from smartcal_tpu.train.blocks import train_obs
+
+    env_cfg = enet.EnetConfig(**(env_kwargs or {}))
+    agent_kwargs = dict(agent_kwargs or {})
+    agent_kwargs.setdefault("prioritized", True)
+    agent_cfg = sac.SACConfig(obs_dim=env_cfg.obs_dim, n_actions=2,
+                              use_hint=use_hint, **agent_kwargs)
+    n_trans = rollout_epochs * rollout_steps
+
+    rollout = jax.jit(make_actor_rollout(env_cfg, agent_cfg, rollout_epochs,
+                                         rollout_steps, use_hint=use_hint))
+
+    def _ingest(agent, buf, flat, key):
+        buf = rp.replay_add_batch(buf, flat)
+        return sac.learn(agent_cfg, agent, buf, key)
+
+    ingest = jax.jit(_ingest)
+
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    agent = sac.sac_init(k0, agent_cfg)
+    buf = rp.replay_init(
+        agent_cfg.mem_size,
+        rp.transition_spec(env_cfg.obs_dim, agent_cfg.n_actions))
+
+    # per-(actor, iteration) rollout keys: a restarted actor continues
+    # its predecessor's deterministic stream from the next iteration
+    base_key = jax.random.PRNGKey(seed ^ 0x0AC7035)
+
+    def work_fn(actor_id, iteration, weights):
+        rt_faults.maybe_delay("actor_rollout", iteration)
+        if rt_faults.should_kill_actor(actor_id, iteration):
+            raise rt_faults.FaultInjected(
+                f"actor {actor_id} killed at iteration {iteration}")
+        k = jax.random.fold_in(jax.random.fold_in(base_key, actor_id),
+                               iteration)
+        return jax.device_get(rollout(weights, k))
+
+    def ingest_batch(agent, buf, host_trs, kl):
+        flat = {k2: jnp.asarray(v) for k2, v in host_trs.items()}
+        return ingest(agent, buf, flat, kl)
+
+    tob = train_obs("parallel_learner_supervised", metrics=metrics,
+                    quiet=quiet, diag=diag, watchdog=watchdog, seed=seed,
+                    n_actors=n_actors)
+    fleet = Fleet(n_actors, work_fn, name="enet-actor",
+                  heartbeat_timeout=heartbeat_timeout,
+                  max_restarts=max_restarts, backoff=restart_backoff,
+                  seed=seed)
+    return run_supervised_loop(fleet, ingest_batch, agent, buf, key,
+                               episodes, n_trans, tob,
+                               queue_timeout=queue_timeout,
+                               max_empty_rounds=max_empty_rounds)
+
+
+def run_supervised_loop(fleet, ingest_batch, agent, buf, key, episodes,
+                        n_trans, tob, queue_timeout=30.0,
+                        max_empty_rounds=20):
+    """The supervised learners' shared ingest loop (enet + demix fleets).
+
+    Per learner episode: collect whatever actor batches arrived (at most
+    one per actor slot), ingest + learn each, publish fresh weights, run
+    one supervision pass (restarts), and feed the watchdog.  A trip
+    stops AND joins the actor fleet before the loop exits.  Owns the
+    fleet and the TrainObs handle (always stopped/closed on the way
+    out)."""
+    import time
+
+    import numpy as np
+
+    from smartcal_tpu import obs
+
+    scores = []
+    try:
+        fleet.start(agent)
+        learner_version = fleet.get_weights()[1]
+        ep, empty_rounds = 0, 0
+        while ep < episodes:
+            t0 = time.perf_counter()
+            batches = fleet.collect(max_items=fleet.n_actors,
+                                    timeout=queue_timeout)
+            fleet.poll()
+            if not batches:
+                empty_rounds += 1
+                if len(fleet.failed_slots) == fleet.n_actors:
+                    tob.echo("all actor slots permanently failed "
+                             f"(after {fleet.restarts_total()} restarts); "
+                             "stopping")
+                    break
+                if empty_rounds >= max_empty_rounds:
+                    tob.echo(f"no actor output for {empty_rounds} rounds; "
+                             "stopping")
+                    break
+                continue
+            empty_rounds = 0
+            staleness = 0
+            with tob.span("learner_episode", episode=ep,
+                          batches=len(batches)):
+                for actor_id, iteration, wv, host_trs in batches:
+                    key, kl = jax.random.split(key)
+                    agent, buf, metrics_out = ingest_batch(agent, buf,
+                                                           host_trs, kl)
+                    staleness = max(staleness, learner_version - wv)
+            learner_version = fleet.set_weights(agent)
+            wall = time.perf_counter() - t0
+            score = float(np.mean([np.mean(b[3]["reward"])
+                                   for b in batches]))
+            scores.append(score)
+            obs.gauge_set("actor_transitions_per_s",
+                          round(len(batches) * n_trans / max(wall, 1e-9),
+                                2))
+            obs.gauge_set("weight_staleness_versions", staleness)
+            tripped = False
+            if tob.collect_diag:
+                tripped = tob.record_diag(
+                    {"critic_loss": float(metrics_out["critic_loss"])},
+                    episode=ep)
+            tripped = tob.log_replay_health(buf, episode=ep) or tripped
+            tob.episode(ep, score, scores, echo=False,
+                        transitions=len(batches) * n_trans,
+                        actors_alive=fleet.alive_count,
+                        restarts=fleet.restarts_total(),
+                        staleness_versions=staleness)
+            tob.echo(f"episode {ep} mean reward {score:.4f} "
+                     f"(batches {len(batches)}, alive {fleet.alive_count})",
+                     event=None)
+            ep += 1
+            if tripped:
+                # watchdog trip: stop AND join the actor threads before
+                # leaving the loop — no actor may keep rolling out
+                # against a dead learner
+                joined = fleet.stop(join=True)
+                tob.echo(f"watchdog trip: stopped fleet "
+                         f"({joined} actor thread(s) joined)")
+                break
+    finally:
+        fleet.stop(join=True)
+        tob.close()
+    summary = {"restarts": fleet.restarts_total(),
+               "failed_slots": sorted(fleet.failed_slots),
+               "alive_at_exit": fleet.alive_count}
+    return (agent, buf), scores, summary
 
 
 def main(argv=None):
@@ -249,7 +462,8 @@ def main(argv=None):
     from . import multihost
 
     from smartcal_tpu import obs
-    from smartcal_tpu.train.blocks import add_obs_args, diag_from_args
+    from smartcal_tpu.train.blocks import (add_obs_args, add_runtime_args,
+                                           diag_from_args)
 
     p = argparse.ArgumentParser(description=main.__doc__)
     p.add_argument("--seed", type=int, default=0)
@@ -257,19 +471,46 @@ def main(argv=None):
     p.add_argument("--actors", type=int, default=None)
     p.add_argument("--use_hint", action="store_true")
     p.add_argument("--learn_per_transition", action="store_true")
+    p.add_argument("--supervised", action="store_true",
+                   help="actor-THREAD fleet with heartbeat supervision, "
+                        "restart backoff and clean shutdown on watchdog "
+                        "trip (see train_supervised) instead of the fused "
+                        "SPMD program")
+    p.add_argument("--heartbeat_timeout", type=float, default=60.0,
+                   help="supervised mode: seconds without an actor "
+                        "heartbeat before it counts as hung")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="supervised mode: restarts per actor slot before "
+                        "it is abandoned")
     add_obs_args(p)
+    add_runtime_args(p)
     multihost.add_cli_args(p)
     args = p.parse_args(argv)
     if multihost.initialize_from_args(args):
         obs.echo(f"multihost: {multihost.runtime_summary()}",
                  event="multihost")
+    if args.supervised:
+        if args.ckpt_every or args.resume:
+            obs.echo("checkpoint/resume is not yet supported in "
+                     "--supervised mode; flags ignored")
+        _, scores, _ = train_supervised(
+            seed=args.seed, episodes=args.episodes,
+            n_actors=args.actors or 2, use_hint=args.use_hint,
+            quiet=args.quiet, metrics=args.metrics,
+            diag=diag_from_args(args),
+            watchdog=getattr(args, "watchdog", False),
+            heartbeat_timeout=args.heartbeat_timeout,
+            max_restarts=args.max_restarts)
+        return scores
     _, scores = train_distributed(
         seed=args.seed, episodes=args.episodes, n_actors=args.actors,
         use_hint=args.use_hint,
         learn_per_transition=args.learn_per_transition,
         quiet=args.quiet, metrics=args.metrics,
         diag=diag_from_args(args),
-        watchdog=getattr(args, "watchdog", False))
+        watchdog=getattr(args, "watchdog", False),
+        ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
+        resume=args.resume)
     return scores
 
 

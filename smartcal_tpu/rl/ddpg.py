@@ -11,7 +11,6 @@ reference's ``T.norm(...)**2``, ``:281-284``), actor loss
 from __future__ import annotations
 
 import dataclasses
-import pickle
 from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
@@ -239,13 +238,23 @@ class DDPGAgent:
         self.last_diag = m.pop("diag", None)
 
     def save_models(self, prefix: Optional[str] = None):
+        from smartcal_tpu.runtime.atomic import atomic_pickle
+
         prefix = prefix if prefix is not None else self.name_prefix
-        with open(f"{prefix}ddpg_state.pkl", "wb") as f:
-            pickle.dump(jax.device_get(self.state), f)
+        atomic_pickle(jax.device_get(self.state), f"{prefix}ddpg_state.pkl")
         rp.save_replay(self.buffer, f"{prefix}replaymem_ddpg.pkl")
 
     def load_models(self, prefix: Optional[str] = None):
+        """Corruption-tolerant resume: warn + keep the fresh init when a
+        checkpoint file is missing/truncated (see SACAgent.load_models)."""
+        from smartcal_tpu.runtime.atomic import safe_pickle_load
+
         prefix = prefix if prefix is not None else self.name_prefix
-        with open(f"{prefix}ddpg_state.pkl", "rb") as f:
-            self.state = jax.tree_util.tree_map(jnp.asarray, pickle.load(f))
-        self.buffer = rp.load_replay(f"{prefix}replaymem_ddpg.pkl")
+        host = safe_pickle_load(f"{prefix}ddpg_state.pkl")
+        if host is None:
+            return False
+        self.state = jax.tree_util.tree_map(jnp.asarray, host)
+        mem = safe_pickle_load(f"{prefix}replaymem_ddpg.pkl")
+        if mem is not None:
+            self.buffer = jax.tree_util.tree_map(jnp.asarray, mem)
+        return True

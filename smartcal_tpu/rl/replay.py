@@ -271,9 +271,11 @@ def replay_health(buf: ReplayState) -> dict:
 
 
 def save_replay(buf: ReplayState, path: str) -> None:
-    """Whole-buffer checkpoint (reference pickles the object, :59-73)."""
-    with open(path, "wb") as f:
-        pickle.dump(jax.device_get(buf), f)
+    """Whole-buffer checkpoint (reference pickles the object, :59-73);
+    atomic (tmp + os.replace) so a mid-write kill cannot truncate it."""
+    from smartcal_tpu.runtime.atomic import atomic_pickle
+
+    atomic_pickle(jax.device_get(buf), path)
 
 
 def load_replay(path: str) -> ReplayState:

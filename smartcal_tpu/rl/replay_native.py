@@ -142,20 +142,19 @@ class NativePER:
                                    self.size, self.beta)
 
     # -- checkpoint -------------------------------------------------------
-    def save(self, path: str) -> None:
-        state = {
+    def state_dict(self) -> dict:
+        """The complete host state — ring arrays, sum-tree leaves/cursor
+        (the priorities), beta — as one picklable dict; the in-payload
+        form runtime.checkpoint.pack_replay uses."""
+        return {
             "data": self.data, "cntr": self.cntr, "beta": self.beta,
             "leaves": self.tree.leaves(), "cursor": self.tree.cursor,
             "filled": self.tree.filled, "size": self.size,
             "error_clip": self.error_clip, "spec": self.spec,
         }
-        with open(path, "wb") as f:
-            pickle.dump(state, f)
 
     @classmethod
-    def load(cls, path: str) -> "NativePER":
-        with open(path, "rb") as f:
-            state = pickle.load(f)
+    def from_state_dict(cls, state: dict) -> "NativePER":
         buf = cls(state["size"], state["spec"],
                   error_clip=state["error_clip"])
         buf.data = state["data"]
@@ -163,3 +162,14 @@ class NativePER:
         buf.beta = state["beta"]
         buf.tree.set_state(state["leaves"], state["cursor"], state["filled"])
         return buf
+
+    def save(self, path: str) -> None:
+        from smartcal_tpu.runtime.atomic import atomic_pickle
+
+        atomic_pickle(self.state_dict(), path)
+
+    @classmethod
+    def load(cls, path: str) -> "NativePER":
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        return cls.from_state_dict(state)

@@ -25,7 +25,6 @@ actor-hint mismatch with a KL divergence on softmaxed vectors
 from __future__ import annotations
 
 import dataclasses
-import pickle
 from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
@@ -469,18 +468,26 @@ class SACAgent:
         self.last_diag = m.pop("diag", None)
 
     def save_models(self, prefix: Optional[str] = None):
+        from smartcal_tpu.runtime.atomic import atomic_pickle
+
         prefix = prefix if prefix is not None else self.name_prefix
-        with open(f"{prefix}sac_state.pkl", "wb") as f:
-            pickle.dump(jax.device_get(self.state), f)
+        atomic_pickle(jax.device_get(self.state), f"{prefix}sac_state.pkl")
         if self.native:
             self.buffer.save(f"{prefix}replaymem_sac.pkl")
         else:
             rp.save_replay(self.buffer, f"{prefix}replaymem_sac.pkl")
 
     def load_models(self, prefix: Optional[str] = None):
+        """Resume from ``save_models`` files; a missing/truncated/corrupt
+        pair warns and keeps the fresh init instead of crashing (the
+        mid-write-kill case the atomic saves make rare but old files can
+        still exhibit)."""
+        from smartcal_tpu.runtime.atomic import safe_pickle_load
+
         prefix = prefix if prefix is not None else self.name_prefix
-        with open(f"{prefix}sac_state.pkl", "rb") as f:
-            host = pickle.load(f)
+        host = safe_pickle_load(f"{prefix}sac_state.pkl")
+        if host is None:
+            return False
         st = jax.tree_util.tree_map(jnp.asarray, host)
         if st.log_alpha is None:
             # checkpoint predates the optimizer-on-log-alpha state: resume
@@ -490,9 +497,13 @@ class SACAgent:
                 log_alpha=log_alpha,
                 alpha_opt=optax.adam(self.cfg.alpha_lr).init(log_alpha))
         self.state = st
-        if self.native:
-            from .replay_native import NativePER
+        from smartcal_tpu.runtime.atomic import safe_pickle_load
+        mem = safe_pickle_load(f"{prefix}replaymem_sac.pkl")
+        if mem is not None:
+            if self.native:
+                from .replay_native import NativePER
 
-            self.buffer = NativePER.load(f"{prefix}replaymem_sac.pkl")
-        else:
-            self.buffer = rp.load_replay(f"{prefix}replaymem_sac.pkl")
+                self.buffer = NativePER.from_state_dict(mem)
+            else:
+                self.buffer = jax.tree_util.tree_map(jnp.asarray, mem)
+        return True

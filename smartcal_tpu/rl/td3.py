@@ -28,7 +28,6 @@ standard TD3 formulation, one fused XLA step.
 from __future__ import annotations
 
 import dataclasses
-import pickle
 from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
@@ -453,13 +452,23 @@ class TD3Agent:
         self.last_diag = m.pop("diag", None)
 
     def save_models(self, prefix: Optional[str] = None):
+        from smartcal_tpu.runtime.atomic import atomic_pickle
+
         prefix = prefix if prefix is not None else self.name_prefix
-        with open(f"{prefix}td3_state.pkl", "wb") as f:
-            pickle.dump(jax.device_get(self.state), f)
+        atomic_pickle(jax.device_get(self.state), f"{prefix}td3_state.pkl")
         rp.save_replay(self.buffer, f"{prefix}replaymem_td3.pkl")
 
     def load_models(self, prefix: Optional[str] = None):
+        """Corruption-tolerant resume: warn + keep the fresh init when a
+        checkpoint file is missing/truncated (see SACAgent.load_models)."""
+        from smartcal_tpu.runtime.atomic import safe_pickle_load
+
         prefix = prefix if prefix is not None else self.name_prefix
-        with open(f"{prefix}td3_state.pkl", "rb") as f:
-            self.state = jax.tree_util.tree_map(jnp.asarray, pickle.load(f))
-        self.buffer = rp.load_replay(f"{prefix}replaymem_td3.pkl")
+        host = safe_pickle_load(f"{prefix}td3_state.pkl")
+        if host is None:
+            return False
+        self.state = jax.tree_util.tree_map(jnp.asarray, host)
+        mem = safe_pickle_load(f"{prefix}replaymem_td3.pkl")
+        if mem is not None:
+            self.buffer = jax.tree_util.tree_map(jnp.asarray, mem)
+        return True

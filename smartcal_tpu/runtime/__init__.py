@@ -1,0 +1,43 @@
+"""Fault-tolerant training runtime shared by the train drivers and the
+parallel learners.
+
+Five small, composable pieces:
+
+* :mod:`~smartcal_tpu.runtime.atomic` — crash-safe file writes
+  (tmp + ``os.replace``) and corruption-tolerant pickle loads.  Every
+  score/model/replay ``pickle.dump`` in the repo routes through these so
+  a mid-write SIGKILL can no longer leave a truncated checkpoint behind.
+* :mod:`~smartcal_tpu.runtime.checkpoint` — the versioned run
+  checkpoint store: ``ckpt_<step>/`` dirs holding ONE pickled payload
+  (agent params + optimizer state + targets + replay contents incl. PER
+  priorities + RNG key streams + episode counters), sha256-validated,
+  with a ``LATEST`` pointer and a retain-last-K policy.
+* :mod:`~smartcal_tpu.runtime.backoff` — deterministic exponential
+  backoff with jitter and a bounded budget, shared by actor restarts
+  and the chip-probe retry loops.
+* :mod:`~smartcal_tpu.runtime.faults` — the deterministic
+  fault-injection harness (NaN into a named update field at step s,
+  kill actor i at iteration n, delay a named dispatch) that makes the
+  recovery paths testable on CPU.
+* :mod:`~smartcal_tpu.runtime.recovery` — the watchdog escalation
+  policy: roll back to the last good checkpoint, apply a mitigation
+  (LR shrink / exploration reseed), retry within a bounded budget.
+* :mod:`~smartcal_tpu.runtime.supervisor` — heartbeat-monitored actor
+  threads with restart-on-death (exponential backoff + jitter) for the
+  parallel learners.
+
+Import cost: stdlib only at package import; jax is read lazily inside
+the functions that move device arrays.
+"""
+
+from .atomic import (atomic_pickle, atomic_write_bytes,      # noqa: F401
+                     atomic_write_text, safe_pickle_load)
+from .backoff import Backoff, BackoffPolicy                  # noqa: F401
+from .checkpoint import (Checkpointer, load_latest,          # noqa: F401
+                         pack_replay, save_checkpoint, unpack_replay)
+from .faults import (FaultInjected, FaultPlan,               # noqa: F401
+                     clear as clear_faults, install as install_faults,
+                     plan_from_env)
+from .recovery import (RecoveryAction, RecoveryManager,      # noqa: F401
+                       RecoveryPolicy)
+from .supervisor import Fleet                                # noqa: F401

@@ -1,0 +1,117 @@
+"""Crash-safe file writes and corruption-tolerant loads.
+
+The repo's original persistence was ``open(path, "wb"); pickle.dump`` —
+a SIGTERM/preemption mid-write leaves a truncated file at the final
+path, and the next ``--load`` run dies inside ``pickle.load`` with an
+opaque ``EOFError``.  Two rules fix both halves:
+
+* **writes** go to a same-directory temp file, ``fsync``, then one
+  ``os.replace`` — readers see either the old bytes or the new bytes,
+  never a prefix;
+* **loads** of resumable state go through :func:`safe_pickle_load`,
+  which turns a missing/truncated/corrupt file into a warning plus a
+  caller-supplied default (start fresh) instead of a crash.
+
+Stdlib only — no jax, no numpy.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+from typing import Any, Callable, Optional
+
+
+def _fsync_dir(path: str) -> None:
+    """Best-effort directory fsync so the rename itself is durable."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes, fsync: bool = True) -> None:
+    """Write ``data`` to ``path`` atomically (tmp file + ``os.replace``).
+
+    The temp file lives in the SAME directory so the final rename never
+    crosses a filesystem boundary (cross-device rename is a copy, which
+    reintroduces the torn-write window).
+    """
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=f".{os.path.basename(path)}.",
+                               suffix=".tmp", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+        if fsync:
+            _fsync_dir(path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_text(path: str, text: str, fsync: bool = True) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"), fsync=fsync)
+
+
+def atomic_pickle(obj: Any, path: str, fsync: bool = True) -> int:
+    """Atomically pickle ``obj`` at ``path``; returns the byte count."""
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    atomic_write_bytes(path, data, fsync=fsync)
+    return len(data)
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def safe_pickle_load(path: str, default: Any = None,
+                     warn: Optional[Callable[[str], None]] = None) -> Any:
+    """Load a pickle, degrading to ``default`` on ANY corruption.
+
+    Missing file, truncated stream (the mid-write kill signature),
+    or an unpicklable payload all warn (via ``warn``, default: the
+    obs echo so the message reaches stderr + the RunLog) and return
+    ``default`` — resume paths start fresh instead of crashing.
+    """
+    if warn is None:
+        def warn(msg):
+            try:
+                from smartcal_tpu import obs
+                obs.echo(msg, event="log")
+            except Exception:
+                import sys
+                sys.stderr.write(msg + "\n")
+    if not os.path.exists(path):
+        warn(f"resume file {path!r} missing; starting fresh")
+        return default
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except Exception as e:
+        warn(f"resume file {path!r} unreadable ({e!r}); starting fresh")
+        return default

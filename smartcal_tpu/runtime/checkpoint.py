@@ -1,0 +1,228 @@
+"""Versioned, checksummed run checkpoints with atomic publication.
+
+Layout under a run's checkpoint root::
+
+    <root>/
+      ckpt_000040/
+        payload.pkl      # ONE pickle: the whole host-side run state
+        meta.json        # {"step", "sha256", "payload_bytes", ...}
+      ckpt_000080/
+      LATEST             # json {"step", "dir", "sha256"}
+
+Publication protocol (all failure windows leave a loadable store):
+
+1. the payload pickles into a hidden temp dir next to the target;
+2. ``meta.json`` (with the payload's sha256) lands inside it;
+3. ONE ``os.replace`` renames the temp dir to ``ckpt_<step>`` — a
+   checkpoint either exists completely or not at all;
+4. ``LATEST`` updates via the atomic text write;
+5. retention prunes to the newest K (never the one just written).
+
+``load_latest`` validates the sha256 before unpickling and falls back —
+corrupt/missing LATEST degrades to a directory scan, a corrupt newest
+checkpoint degrades to the next older one — so a mid-write kill costs
+at most one checkpoint interval, never the run.
+
+The payload is an ordinary host dict; :func:`pack_replay` /
+:func:`unpack_replay` give replay buffers (both the HBM pytree and the
+native C++ sum-tree buffer) a uniform in-payload form that round-trips
+PER priorities exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import tempfile
+import time
+from typing import Any, Optional, Tuple
+
+from .atomic import atomic_pickle, atomic_write_text, sha256_file
+
+CKPT_PREFIX = "ckpt_"
+LATEST = "LATEST"
+PAYLOAD = "payload.pkl"
+META = "meta.json"
+_DIR_RE = re.compile(r"^ckpt_(\d+)$")
+
+
+def _ckpt_dirname(step: int) -> str:
+    return f"{CKPT_PREFIX}{int(step):06d}"
+
+
+def list_checkpoints(root: str):
+    """[(step, absolute dir)] sorted ascending by step."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        m = _DIR_RE.match(name)
+        if m and os.path.isdir(os.path.join(root, name)):
+            out.append((int(m.group(1)), os.path.join(root, name)))
+    return sorted(out)
+
+
+def save_checkpoint(root: str, step: int, payload: dict,
+                    keep: int = 3, fsync: bool = True) -> str:
+    """Write ``payload`` as ``ckpt_<step>`` (see module doc); returns the
+    published directory path.  ``payload`` must already be host data
+    (callers ``jax.device_get`` before handing it over)."""
+    os.makedirs(root, exist_ok=True)
+    final = os.path.join(root, _ckpt_dirname(step))
+    tmp = tempfile.mkdtemp(prefix=f".{_ckpt_dirname(step)}.", dir=root)
+    try:
+        nbytes = atomic_pickle(payload, os.path.join(tmp, PAYLOAD),
+                               fsync=fsync)
+        sha = sha256_file(os.path.join(tmp, PAYLOAD))
+        meta = {"step": int(step), "sha256": sha, "payload_bytes": nbytes,
+                "wrote_unix": round(time.time(), 3),
+                "fields": sorted(payload) if isinstance(payload, dict)
+                else None}
+        atomic_write_text(os.path.join(tmp, META), json.dumps(meta),
+                          fsync=fsync)
+        if os.path.isdir(final):
+            # re-checkpointing the same step (a rolled-back run walking
+            # past it again): retire the old dir first so the rename
+            # can't collide.  LATEST still points at a valid older
+            # checkpoint throughout.
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    atomic_write_text(os.path.join(root, LATEST),
+                      json.dumps({"step": int(step),
+                                  "dir": _ckpt_dirname(step),
+                                  "sha256": sha}), fsync=fsync)
+    _prune(root, keep, protect=final)
+    _log_event("checkpoint", root=root, step=int(step), bytes=nbytes,
+               kept=keep)
+    return final
+
+
+def _prune(root: str, keep: int, protect: str) -> None:
+    if keep <= 0:
+        return
+    entries = list_checkpoints(root)
+    for step, path in entries[:-keep]:
+        if os.path.abspath(path) != os.path.abspath(protect):
+            shutil.rmtree(path, ignore_errors=True)
+    # stale hidden temp dirs from killed writers
+    for name in os.listdir(root):
+        if name.startswith(f".{CKPT_PREFIX}"):
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+
+
+def _validate(path: str) -> bool:
+    """True when ``path`` holds a complete, checksum-clean checkpoint."""
+    payload, meta = os.path.join(path, PAYLOAD), os.path.join(path, META)
+    try:
+        with open(meta) as f:
+            m = json.load(f)
+        return sha256_file(payload) == m.get("sha256")
+    except (OSError, ValueError):
+        return False
+
+
+def load_latest(root: str) -> Optional[Tuple[dict, int]]:
+    """(payload, step) of the newest VALID checkpoint, or None.
+
+    The LATEST pointer is the fast path; a corrupt pointer or a failed
+    checksum falls back to scanning ``ckpt_*`` newest-first.
+    """
+    import pickle
+
+    candidates = []
+    latest = os.path.join(root, LATEST)
+    if os.path.exists(latest):
+        try:
+            with open(latest) as f:
+                rec = json.load(f)
+            candidates.append((int(rec["step"]),
+                               os.path.join(root, rec["dir"])))
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+    for step, path in reversed(list_checkpoints(root)):
+        if (step, path) not in candidates:
+            candidates.append((step, path))
+    for step, path in candidates:
+        if not _validate(path):
+            continue
+        try:
+            with open(os.path.join(path, PAYLOAD), "rb") as f:
+                return pickle.load(f), step
+        except Exception:
+            continue
+    return None
+
+
+def _log_event(event: str, **fields) -> None:
+    try:
+        from smartcal_tpu import obs
+        rl = obs.active()
+        if rl is not None:
+            rl.log(event, **fields)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Replay-buffer payload forms (HBM pytree + native sum tree)
+# ---------------------------------------------------------------------------
+
+def pack_replay(buf) -> dict:
+    """Uniform host form of a replay buffer for the checkpoint payload.
+
+    HBM :class:`~smartcal_tpu.rl.replay.ReplayState` pytrees pull to
+    host; the native buffer contributes its ``state_dict`` (ring arrays
+    + sum-tree leaves/cursor + beta + the sampling RNG state), so PER
+    priorities round-trip bit-exactly for BOTH backends.
+    """
+    import jax
+
+    from smartcal_tpu.rl import replay as rp
+
+    if isinstance(buf, rp.ReplayState):
+        return {"kind": "hbm", "state": jax.device_get(buf)}
+    if hasattr(buf, "state_dict"):                 # NativePER
+        return {"kind": "native", "state": buf.state_dict()}
+    raise TypeError(f"unsupported replay buffer {type(buf)!r}")
+
+
+def unpack_replay(obj: dict):
+    import jax
+    import jax.numpy as jnp
+
+    kind = obj.get("kind")
+    if kind == "hbm":
+        return jax.tree_util.tree_map(jnp.asarray, obj["state"])
+    if kind == "native":
+        from smartcal_tpu.rl.replay_native import NativePER
+
+        return NativePER.from_state_dict(obj["state"])
+    raise ValueError(f"unknown replay payload kind {kind!r}")
+
+
+class Checkpointer:
+    """Bound (root, keep) pair with cadence bookkeeping for a run."""
+
+    def __init__(self, root: str, keep: int = 3, every: int = 0):
+        self.root = root
+        self.keep = max(1, int(keep))
+        self.every = max(0, int(every))
+        self.last_step: Optional[int] = None
+
+    def due(self, step: int) -> bool:
+        # a rolled-back run re-crossing an already-saved step SHOULD
+        # re-save: post-mitigation state differs from the poisoned walk
+        return self.every > 0 and step > 0 and step % self.every == 0
+
+    def save(self, step: int, payload: dict) -> str:
+        path = save_checkpoint(self.root, step, payload, keep=self.keep)
+        self.last_step = int(step)
+        return path
+
+    def load_latest(self) -> Optional[Tuple[dict, int]]:
+        return load_latest(self.root)

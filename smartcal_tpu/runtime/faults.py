@@ -1,0 +1,143 @@
+"""Deterministic fault injection: the testing harness for the recovery
+paths.
+
+A single process-wide :class:`FaultPlan` (installed programmatically or
+via the ``SMARTCAL_FAULTS`` env var, a JSON object) arms up to three
+fault sites, each keyed on an exact deterministic index so injected
+runs are reproducible and a post-recovery retry does NOT re-fire:
+
+* ``nan_field``/``nan_step`` — overwrite the named field of the
+  per-update diagnostics dict with NaN at global update ``nan_step``
+  (the watchdog's input; this is how the rollback-and-retry path is
+  exercised end-to-end on CPU without poisoning real device state).
+* ``kill_actor``/``kill_at`` — raise :class:`FaultInjected` inside
+  actor ``kill_actor``'s work function at rollout iteration
+  ``kill_at`` (the supervisor must detect the death and restart; the
+  replacement resumes AFTER the poisoned iteration, so a deterministic
+  kill cannot crash-loop the fleet).
+* ``delay_stage``/``delay_at``/``delay_s`` — sleep ``delay_s`` seconds
+  inside the named stage at index ``delay_at`` (hung-actor / slow-
+  dispatch detection).
+
+Each firing is recorded once as a ``fault_injected`` RunLog event (when
+a run is recording).  With no plan installed every hook is one ``None``
+check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an injected actor kill (see module doc)."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    nan_field: Optional[str] = None
+    nan_step: Optional[int] = None
+    kill_actor: Optional[int] = None
+    kill_at: Optional[int] = None
+    delay_stage: Optional[str] = None
+    delay_at: Optional[int] = None
+    delay_s: float = 0.0
+
+
+_plan: Optional[FaultPlan] = None
+_lock = threading.Lock()
+_fired: set = set()
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    """Install ``plan`` process-wide (None clears)."""
+    global _plan
+    with _lock:
+        _plan = plan
+        _fired.clear()
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> Optional[FaultPlan]:
+    return _plan
+
+
+def plan_from_env(env=None) -> Optional[FaultPlan]:
+    """Parse ``SMARTCAL_FAULTS`` (JSON with FaultPlan field names) —
+    lets the smoke scripts inject faults into unmodified driver CLIs."""
+    env = os.environ if env is None else env
+    raw = env.get("SMARTCAL_FAULTS", "").strip()
+    if not raw:
+        return None
+    try:
+        d = json.loads(raw)
+        fields = {f.name for f in dataclasses.fields(FaultPlan)}
+        return FaultPlan(**{k: v for k, v in d.items() if k in fields})
+    except (ValueError, TypeError) as e:
+        import sys
+        sys.stderr.write(f"SMARTCAL_FAULTS unparseable ({e!r}); "
+                         "ignoring\n")
+        return None
+
+
+def install_from_env() -> Optional[FaultPlan]:
+    plan = plan_from_env()
+    if plan is not None:
+        install(plan)
+    return plan
+
+
+def _record(site: str, **fields) -> None:
+    key = (site, tuple(sorted(fields.items())))
+    with _lock:
+        if key in _fired:
+            return
+        _fired.add(key)
+    try:
+        from smartcal_tpu import obs
+        rl = obs.active()
+        if rl is not None:
+            rl.log("fault_injected", site=site, **fields)
+    except Exception:
+        pass
+
+
+def mutate_diag(step_diag: dict, step: int) -> dict:
+    """Apply the NaN fault to one per-update diagnostics dict (a copy);
+    identity when the plan doesn't target this step."""
+    p = _plan
+    if p is None or p.nan_field is None or p.nan_step != step:
+        return step_diag
+    out = dict(step_diag)
+    out[p.nan_field] = float("nan")
+    _record("diag_nan", field=p.nan_field, step=step)
+    return out
+
+
+def should_kill_actor(actor_id: int, iteration: int) -> bool:
+    p = _plan
+    if p is None or p.kill_actor is None:
+        return False
+    if p.kill_actor == actor_id and p.kill_at == iteration:
+        _record("actor_kill", actor=actor_id, iteration=iteration)
+        return True
+    return False
+
+
+def maybe_delay(stage: str, index: int) -> float:
+    """Sleep the planned delay at (stage, index); returns seconds slept."""
+    p = _plan
+    if (p is None or p.delay_stage != stage or p.delay_at != index
+            or p.delay_s <= 0.0):
+        return 0.0
+    _record("delay", stage=stage, index=index, delay_s=p.delay_s)
+    time.sleep(p.delay_s)
+    return p.delay_s

@@ -1,0 +1,91 @@
+"""Watchdog escalation: rollback-and-retry before the graceful halt.
+
+PR 4's divergence watchdog could only trip and kill the driver.  The
+:class:`RecoveryManager` turns a trip into a bounded retry loop:
+
+1. load the last GOOD checkpoint (sha-validated; the poisoned episodes
+   since it are discarded);
+2. hand the driver a :class:`RecoveryAction` carrying the payload plus
+   the mitigation the policy prescribes — a learning-rate shrink
+   (``lr_scale = lr_shrink ** attempt``, applied by rebuilding the
+   jitted update at the scaled config) and/or an exploration reseed
+   (fold a fresh constant into the run's key stream so the retry
+   explores a different trajectory out of the divergence basin);
+3. emit ONE structured ``recovery`` RunLog event per rollback;
+4. after ``max_recoveries`` attempts (or with no checkpoint to roll
+   back to) return ``None`` — the driver falls through to the existing
+   graceful halt.
+
+The manager owns policy + counting only; restoring state and applying
+the mitigation stay in the driver, which knows its own pytrees.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .checkpoint import Checkpointer
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryPolicy:
+    max_recoveries: int = 0      # 0 = recovery disabled (halt on trip)
+    lr_shrink: float = 0.5       # per-attempt LR multiplier (1.0 = off)
+    reseed: bool = True          # fold a fresh offset into the key stream
+
+
+@dataclasses.dataclass
+class RecoveryAction:
+    payload: dict                # the checkpoint to restore
+    step: int                    # its step (episodes completed)
+    attempt: int                 # 1-based recovery attempt
+    lr_scale: float              # cumulative LR multiplier to apply
+    reseed: bool
+
+
+class RecoveryManager:
+    def __init__(self, policy: RecoveryPolicy,
+                 ckpt: Optional[Checkpointer]):
+        self.policy = policy
+        self.ckpt = ckpt
+        self.attempts = 0
+
+    @property
+    def armed(self) -> bool:
+        return self.policy.max_recoveries > 0 and self.ckpt is not None
+
+    def on_trip(self, reason: Optional[str] = None,
+                episode: Optional[int] = None) -> Optional[RecoveryAction]:
+        """Trip handler; None means halt (budget spent / nothing saved)."""
+        if not self.armed or self.attempts >= self.policy.max_recoveries:
+            self._log(action="halt", reason=reason, episode=episode,
+                      attempt=self.attempts,
+                      budget=self.policy.max_recoveries)
+            return None
+        loaded = self.ckpt.load_latest()
+        if loaded is None:
+            self._log(action="halt_no_checkpoint", reason=reason,
+                      episode=episode, attempt=self.attempts)
+            return None
+        payload, step = loaded
+        self.attempts += 1
+        act = RecoveryAction(
+            payload=payload, step=step, attempt=self.attempts,
+            lr_scale=self.policy.lr_shrink ** self.attempts,
+            reseed=self.policy.reseed)
+        self._log(action="rollback", reason=reason, episode=episode,
+                  rollback_step=step, attempt=self.attempts,
+                  budget=self.policy.max_recoveries,
+                  lr_scale=act.lr_scale, reseed=act.reseed)
+        return act
+
+    def _log(self, **fields) -> None:
+        try:
+            from smartcal_tpu import obs
+            rl = obs.active()
+            if rl is not None:
+                rl.log("recovery", **fields)
+                rl.flush()
+        except Exception:
+            pass

@@ -1,0 +1,257 @@
+"""Heartbeat-supervised actor-thread fleet for the parallel learners.
+
+The SPMD learners (:mod:`smartcal_tpu.parallel.learner`,
+``demix_learner``) fuse actors into one jitted program — nothing there
+can die independently.  The supervised mode instead runs each actor as
+a host thread (the IMPACT-shaped split: actors roll out against a
+possibly-stale weights snapshot, the learner consumes whatever arrives)
+and THIS module is the part that survives faults:
+
+* each actor thread beats a heartbeat before every rollout and pushes
+  its result onto the shared queue;
+* :meth:`Fleet.poll` (called from the learner loop) detects dead
+  threads (work_fn raised — e.g. an injected
+  :class:`~smartcal_tpu.runtime.faults.FaultInjected`) and HUNG threads
+  (heartbeat older than ``heartbeat_timeout``; the thread is abandoned
+  as a daemon and a replacement spawned);
+* restarts happen after an exponential backoff with jitter
+  (:class:`~smartcal_tpu.runtime.backoff.BackoffPolicy`), at most
+  ``max_restarts`` times per actor slot; a replacement resumes at the
+  iteration AFTER the one that killed its predecessor, so a
+  deterministic poison-pill iteration cannot crash-loop the slot;
+* the learner keeps training from whatever subset of the fleet is
+  alive; ``Fleet.stop(join=True)`` is the one call a tripping watchdog
+  needs to leave no actor running against a dead learner.
+
+Telemetry: ``actor_down`` / ``actor_restart`` / ``actor_failed`` RunLog
+events, an ``actors_alive`` gauge and an ``actor_restarts`` counter via
+the existing obs registry.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from .backoff import BackoffPolicy
+from .faults import FaultInjected  # noqa: F401  (re-export for callers)
+
+# work_fn(actor_id, iteration, weights) -> host result pushed to the queue
+WorkFn = Callable[[int, int, Any], Any]
+
+
+class _Actor(threading.Thread):
+    def __init__(self, fleet: "Fleet", actor_id: int, start_iteration: int):
+        super().__init__(name=f"{fleet.name}-{actor_id}", daemon=True)
+        self.fleet = fleet
+        self.actor_id = actor_id
+        self.iteration = start_iteration
+        self.last_beat = time.monotonic()
+        self.stop_event = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def run(self):
+        f = self.fleet
+        while not self.stop_event.is_set():
+            self.last_beat = time.monotonic()
+            weights, version = f.get_weights()
+            try:
+                out = f.work_fn(self.actor_id, self.iteration, weights)
+            except BaseException as e:   # noqa: BLE001 — death IS the signal
+                self.error = e
+                return
+            f._q.put((self.actor_id, self.iteration, version, out))
+            self.iteration += 1
+
+
+class Fleet:
+    """A supervised set of ``n_actors`` worker threads (see module doc)."""
+
+    def __init__(self, n_actors: int, work_fn: WorkFn, *,
+                 name: str = "actor", heartbeat_timeout: float = 60.0,
+                 max_restarts: int = 3,
+                 backoff: Optional[BackoffPolicy] = None, seed: int = 0):
+        self.n_actors = int(n_actors)
+        self.work_fn = work_fn
+        self.name = name
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.max_restarts = int(max_restarts)
+        self.backoff = backoff or BackoffPolicy(base_s=0.25, factor=2.0,
+                                                max_s=30.0, jitter=0.25)
+        self._seed = seed
+        self._q: "queue.Queue" = queue.Queue()
+        self._weights: Any = None
+        self._version = 0
+        self._wlock = threading.Lock()
+        self._actors: dict = {}              # slot -> _Actor (current)
+        self._restarts = {i: 0 for i in range(self.n_actors)}
+        self._pending: dict = {}             # slot -> (due_monotonic, iter)
+        self._failed: set = set()            # slots past max_restarts
+        self._stopped = False
+        import random
+        self._rng = random.Random(seed)
+
+    # -- weights snapshot --------------------------------------------------
+    def set_weights(self, weights: Any) -> int:
+        with self._wlock:
+            self._weights = weights
+            self._version += 1
+            return self._version
+
+    def get_weights(self):
+        with self._wlock:
+            return self._weights, self._version
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, weights: Any) -> None:
+        self.set_weights(weights)
+        for i in range(self.n_actors):
+            self._spawn(i, start_iteration=0)
+        self._gauge()
+
+    def _spawn(self, slot: int, start_iteration: int) -> None:
+        a = _Actor(self, slot, start_iteration)
+        self._actors[slot] = a
+        a.start()
+
+    def stop(self, join: bool = True, timeout: float = 10.0) -> int:
+        """Signal every actor to stop; with ``join`` wait for each thread
+        (hung threads are daemons and are abandoned after ``timeout``).
+        Returns the number of threads that actually joined.  Idempotent —
+        a second call (trip path, then the driver's finally) is a no-op."""
+        if self._stopped:
+            return 0
+        self._stopped = True
+        for a in self._actors.values():
+            a.stop_event.set()
+        joined = 0
+        if join:
+            deadline = time.monotonic() + timeout
+            for a in self._actors.values():
+                a.join(timeout=max(0.0, deadline - time.monotonic()))
+                joined += 0 if a.is_alive() else 1
+        self._log("actors_stopped", joined=joined,
+                  total=len(self._actors))
+        self._gauge()
+        return joined
+
+    # -- collection --------------------------------------------------------
+    def collect(self, max_items: int, timeout: float) -> list:
+        """Up to ``max_items`` queued results, waiting at most ``timeout``
+        seconds TOTAL for the first one (later ones are taken only if
+        already queued).  Returns [(actor_id, iteration, weights_version,
+        result), ...] — possibly empty when the whole fleet is down."""
+        out = []
+        deadline = time.monotonic() + timeout
+        while len(out) < max_items:
+            remaining = deadline - time.monotonic()
+            try:
+                if not out and remaining > 0:
+                    out.append(self._q.get(timeout=remaining))
+                else:
+                    out.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+    # -- supervision -------------------------------------------------------
+    @property
+    def alive_count(self) -> int:
+        return sum(1 for a in self._actors.values() if a.is_alive())
+
+    @property
+    def failed_slots(self) -> set:
+        return set(self._failed)
+
+    def restarts_total(self) -> int:
+        return sum(self._restarts.values())
+
+    def poll(self) -> list:
+        """One supervision pass: detect dead/hung actors, schedule and
+        perform backoff-delayed restarts.  Returns the list of event
+        dicts emitted this pass (also logged to the RunLog)."""
+        if self._stopped:
+            return []
+        now = time.monotonic()
+        events = []
+        for slot in range(self.n_actors):
+            if slot in self._failed or slot in self._pending:
+                continue
+            a = self._actors.get(slot)
+            if a is None:
+                continue
+            dead = not a.is_alive()
+            hung = (not dead and not a.stop_event.is_set()
+                    and now - a.last_beat > self.heartbeat_timeout)
+            if not dead and not hung:
+                continue
+            if hung:
+                # can't kill a python thread: abandon it (daemon) and
+                # make sure it exits if it ever wakes up
+                a.stop_event.set()
+            reason = (f"error:{a.error!r}" if dead and a.error is not None
+                      else ("exited" if dead else "hung"))
+            n = self._restarts[slot]
+            if n >= self.max_restarts:
+                self._failed.add(slot)
+                ev = {"event": "actor_failed", "actor": slot,
+                      "reason": reason, "restarts": n}
+                events.append(ev)
+                self._log(**ev)
+                continue
+            delay = self.backoff.delay(n, self._rng)
+            # the replacement skips the iteration that killed its
+            # predecessor (poison-pill protection)
+            self._pending[slot] = (now + delay, a.iteration + 1)
+            ev = {"event": "actor_down", "actor": slot, "reason": reason,
+                  "iteration": a.iteration, "restart_in_s": round(delay, 3),
+                  "attempt": n + 1}
+            events.append(ev)
+            self._log(**ev)
+        for slot in list(self._pending):
+            due, it = self._pending[slot]
+            if now >= due:
+                del self._pending[slot]
+                self._restarts[slot] += 1
+                self._spawn(slot, start_iteration=it)
+                ev = {"event": "actor_restart", "actor": slot,
+                      "iteration": it, "attempt": self._restarts[slot]}
+                events.append(ev)
+                self._log(**ev)
+                self._counter("actor_restarts")
+        if events:
+            self._gauge()
+        return events
+
+    def wait_pending(self, timeout: float = 30.0) -> None:
+        """Block until no restart is pending (tests; bounded)."""
+        deadline = time.monotonic() + timeout
+        while self._pending and time.monotonic() < deadline:
+            time.sleep(0.01)
+            self.poll()
+
+    # -- telemetry ---------------------------------------------------------
+    def _log(self, event: str = "actor_event", **fields) -> None:
+        try:
+            from smartcal_tpu import obs
+            rl = obs.active()
+            if rl is not None:
+                rl.log(fields.pop("event", event), **fields)
+        except Exception:
+            pass
+
+    def _gauge(self) -> None:
+        try:
+            from smartcal_tpu import obs
+            obs.gauge_set("actors_alive", self.alive_count)
+        except Exception:
+            pass
+
+    def _counter(self, name: str) -> None:
+        try:
+            from smartcal_tpu import obs
+            obs.counter_add(name)
+        except Exception:
+            pass

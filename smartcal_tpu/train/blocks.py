@@ -22,6 +22,40 @@ import time
 import jax
 
 from smartcal_tpu import obs
+from smartcal_tpu.runtime import faults as rt_faults
+
+
+def add_runtime_args(p):
+    """Attach the shared fault-tolerance flags (checkpoint / resume /
+    watchdog recovery) to an argparse parser — the companion of
+    ``add_obs_args``, wired through every train entry point."""
+    p.add_argument("--resume", action="store_true",
+                   help="restore the run from the newest valid checkpoint "
+                        "in --ckpt-dir and continue bit-continuably")
+    p.add_argument("--ckpt-dir", dest="ckpt_dir", type=str, default=None,
+                   help="checkpoint root (versioned ckpt_<episode>/ dirs + "
+                        "LATEST pointer; default <entry>_ckpt)")
+    p.add_argument("--ckpt-every", dest="ckpt_every", type=int, default=0,
+                   help="checkpoint every N episodes (0 = none, except "
+                        "--max-recoveries arms a default cadence of "
+                        "10 so recovery has something to roll back to)")
+    p.add_argument("--keep-ckpts", dest="keep_ckpts", type=int, default=3,
+                   help="retained checkpoints (older ones are pruned)")
+    p.add_argument("--max-recoveries", dest="max_recoveries", type=int,
+                   default=0,
+                   help="on a watchdog trip, roll back to the last good "
+                        "checkpoint and retry up to N times before the "
+                        "graceful halt (implies --watchdog; needs "
+                        "--ckpt-every)")
+    p.add_argument("--recovery-lr-shrink", dest="recovery_lr_shrink",
+                   type=float, default=0.5,
+                   help="learning-rate multiplier applied per recovery "
+                        "attempt (1.0 disables the LR mitigation)")
+    p.add_argument("--no-recovery-reseed", dest="recovery_reseed",
+                   action="store_false", default=True,
+                   help="do NOT fold a fresh offset into the exploration "
+                        "key stream on recovery")
+    return p
 
 
 def add_obs_args(p):
@@ -56,7 +90,8 @@ def diag_from_args(args) -> bool:
     pass this as the agents' ``collect_diag``; it mirrors TrainObs's
     disarm rule so a ``--diag`` with no sink doesn't leave the agent
     compiling and computing an UpdateDiag nobody reads."""
-    wd = bool(getattr(args, "watchdog", False))
+    wd = bool(getattr(args, "watchdog", False)
+              or getattr(args, "max_recoveries", 0))
     want = bool(getattr(args, "diag", False) or wd)
     sink = (getattr(args, "metrics", None) is not None
             or getattr(args, "trace", None) is not None or wd)
@@ -83,6 +118,9 @@ class TrainObs:
         self._updates = 0
         self.diag = bool(diag or watchdog)
         self.watchdog = obs.Watchdog(watchdog_cfg) if watchdog else None
+        # arm any SMARTCAL_FAULTS plan (deterministic injection for the
+        # recovery smoke paths; no-op without the env var)
+        rt_faults.install_from_env()
         path = metrics
         if path is None and trace:
             # a profiler trace without a metrics stream still wants the
@@ -142,6 +180,10 @@ class TrainObs:
         for stepd in obs.diag_steps(host):
             i = self._updates
             self._updates += 1
+            # deterministic fault injection (runtime.faults): identity
+            # unless a plan targets exactly this update index — the
+            # CPU-testable path into the watchdog/rollback machinery
+            stepd = rt_faults.mutate_diag(stepd, i)
             if self.runlog is not None \
                     and i % self.DIAG_LOG_EVERY == 0:
                 self.runlog.log("diag", step=i, **stepd, **tags)
@@ -253,8 +295,219 @@ def train_obs_from_args(args, entry, **meta) -> TrainObs:
                     trace=getattr(args, "trace", None),
                     quiet=getattr(args, "quiet", False),
                     diag=getattr(args, "diag", False),
-                    watchdog=getattr(args, "watchdog", False),
+                    # --max-recoveries implies the watchdog: recovery
+                    # without the detector would never fire
+                    watchdog=(getattr(args, "watchdog", False)
+                              or getattr(args, "max_recoveries", 0) > 0),
                     seed=getattr(args, "seed", None), **meta)
+
+
+# salt folded into the exploration key stream by the recovery reseed
+# mitigation (offset by the attempt so successive recoveries diverge)
+RESEED_SALT = 0x5EED0
+
+
+class TrainRuntime:
+    """Per-run fault-tolerance handle: the checkpoint cadence, the
+    ``--resume`` restore, and the watchdog rollback-and-retry policy —
+    the ONE wiring shared by the train drivers (the companion of
+    :class:`TrainObs`, built from ``add_runtime_args`` flags).
+
+    With none of the flags set every method is a no-op/None, so a
+    driver's hot loop is unchanged.
+    """
+
+    DEFAULT_RECOVERY_CKPT_EVERY = 10
+
+    def __init__(self, entry, ckpt_dir=None, ckpt_every=0, keep=3,
+                 resume=False, max_recoveries=0, lr_shrink=0.5,
+                 reseed=True, tob=None):
+        from smartcal_tpu.runtime import (Checkpointer, RecoveryManager,
+                                          RecoveryPolicy)
+
+        self.entry = entry
+        self.tob = tob
+        self.resume = bool(resume)
+        if max_recoveries > 0 and ckpt_every <= 0:
+            # recovery without a cadence would have nothing to roll back
+            # to (the '0 = only what --max-recoveries needs' contract of
+            # the --ckpt-every help)
+            ckpt_every = self.DEFAULT_RECOVERY_CKPT_EVERY
+            self._echo(f"--max-recoveries without --ckpt-every: "
+                       f"checkpointing every {ckpt_every} episodes")
+        enabled = bool(resume or ckpt_every or max_recoveries)
+        self.ckpt = None
+        if enabled:
+            self.ckpt = Checkpointer(ckpt_dir or f"{entry}_ckpt",
+                                     keep=keep, every=ckpt_every)
+        self.recovery = RecoveryManager(
+            RecoveryPolicy(max_recoveries=max_recoveries,
+                           lr_shrink=lr_shrink, reseed=reseed), self.ckpt)
+
+    @classmethod
+    def from_args(cls, args, entry, tob=None) -> "TrainRuntime":
+        """getattr-safe construction (programmatic Namespace callers
+        without the runtime flags keep working)."""
+        return cls(entry,
+                   ckpt_dir=getattr(args, "ckpt_dir", None),
+                   ckpt_every=getattr(args, "ckpt_every", 0),
+                   keep=getattr(args, "keep_ckpts", 3),
+                   resume=getattr(args, "resume", False),
+                   max_recoveries=getattr(args, "max_recoveries", 0),
+                   lr_shrink=getattr(args, "recovery_lr_shrink", 0.5),
+                   reseed=getattr(args, "recovery_reseed", True), tob=tob)
+
+    @property
+    def enabled(self) -> bool:
+        return self.ckpt is not None
+
+    def _echo(self, msg):
+        if self.tob is not None:
+            self.tob.echo(msg)
+        else:
+            obs.echo(msg)
+
+    def restore(self):
+        """The ``--resume`` payload (newest valid checkpoint), or None."""
+        if self.ckpt is None or not self.resume:
+            return None
+        loaded = self.ckpt.load_latest()
+        if loaded is None:
+            self._echo(f"--resume: no valid checkpoint under "
+                       f"{self.ckpt.root!r}; starting fresh")
+            return None
+        payload, step = loaded
+        rl = obs.active()
+        if rl is not None:
+            rl.log("resume", step=step, root=self.ckpt.root)
+        self._echo(f"resumed from checkpoint step {step} "
+                   f"({self.ckpt.root})")
+        return payload
+
+    def maybe_checkpoint(self, step, build_payload) -> bool:
+        """Save when the cadence says so; ``build_payload`` (a zero-arg
+        callable returning the host payload dict) runs only then."""
+        if self.ckpt is None or not self.ckpt.due(step):
+            return False
+        self.ckpt.save(step, build_payload())
+        return True
+
+    def on_trip(self):
+        """Watchdog-trip escalation: a RecoveryAction to apply (the
+        caller restores the payload, applies the mitigation, and
+        continues), or None → graceful halt.  Un-latches the watchdog
+        when a rollback is granted."""
+        reason = None
+        if self.tob is not None and self.tob.watchdog is not None:
+            reason = self.tob.watchdog.trip_reason
+        act = self.recovery.on_trip(reason=reason)
+        if act is None:
+            return None
+        if self.tob is not None and self.tob.watchdog is not None:
+            self.tob.watchdog.reset()
+        self._echo(f"watchdog recovery {act.attempt}/"
+                   f"{self.recovery.policy.max_recoveries}: rolled back to "
+                   f"episode {act.step} (lr x{act.lr_scale:g}, "
+                   f"reseed={act.reseed})")
+        return act
+
+
+def rollback_fused(act, rebuild=None):
+    """Restore an enet fused-driver checkpoint payload and apply the
+    recovery mitigation — the ONE rollback implementation shared by the
+    enet SAC/TD3/DDPG drivers.  ``rebuild(lr_scale)`` (optional) re-jits
+    the driver's episode program(s) at the scaled config when the LR
+    mitigation applies.  Returns ``(agent_state, buf, key, scores,
+    episode)``; driver-specific payload extras (e.g. enet_sac's
+    ``saved_marker``) stay with the caller."""
+    import jax.numpy as jnp
+
+    from smartcal_tpu.runtime import unpack_replay
+
+    p = act.payload
+    agent_state = jax.tree_util.tree_map(jnp.asarray, p["agent_state"])
+    buf = unpack_replay(p["replay"])
+    key = jnp.asarray(p["key"])
+    if act.reseed:
+        key = jax.random.fold_in(key, RESEED_SALT + act.attempt)
+    if act.lr_scale != 1.0 and rebuild is not None:
+        rebuild(act.lr_scale)
+    return agent_state, buf, key, list(p["scores"]), int(p["episode"])
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint payload helpers for the host-driven agent loops (SACAgent /
+# TD3Agent / DDPGAgent drivers: calib_*, demix_*)
+# ---------------------------------------------------------------------------
+
+def pack_agent_loop(agent, env, scores, episode, extra=None) -> dict:
+    """Host payload capturing EVERYTHING a host-driven agent loop needs
+    to restart bit-continuably: agent pytree (params + opt + targets +
+    alpha/rho counters), the agent's jax key stream, the replay buffer
+    (incl. PER priorities, both backends), the env's episode key stream,
+    the native sampler's numpy RNG, scores, and the episode counter."""
+    from smartcal_tpu.runtime import pack_replay
+
+    payload = {
+        "kind": "agent_loop",
+        "episode": int(episode),
+        "scores": list(scores),
+        "agent_state": jax.device_get(agent.state),
+        "agent_key": jax.device_get(agent.key),
+        "replay": pack_replay(agent.buffer),
+    }
+    if getattr(agent, "_rng", None) is not None:
+        payload["agent_sample_rng"] = agent._rng.bit_generator.state
+    if env is not None and hasattr(env, "_key"):
+        payload["env_key"] = jax.device_get(env._key)
+    if extra:
+        payload["extra"] = dict(extra)
+    return payload
+
+
+def restore_agent_loop(agent, env, payload):
+    """Inverse of :func:`pack_agent_loop`: load the payload into
+    ``agent``/``env`` in place; returns (scores, episode, extra)."""
+    import jax.numpy as jnp
+
+    from smartcal_tpu.runtime import unpack_replay
+
+    agent.state = jax.tree_util.tree_map(jnp.asarray,
+                                         payload["agent_state"])
+    agent.key = jnp.asarray(payload["agent_key"])
+    agent.buffer = unpack_replay(payload["replay"])
+    if "agent_sample_rng" in payload and getattr(agent, "_rng", None) \
+            is not None:
+        agent._rng.bit_generator.state = payload["agent_sample_rng"]
+    if env is not None and "env_key" in payload and hasattr(env, "_key"):
+        env._key = jnp.asarray(payload["env_key"])
+    return list(payload["scores"]), int(payload["episode"]), \
+        payload.get("extra") or {}
+
+
+def apply_agent_recovery(agent, base_cfg, act):
+    """Apply a RecoveryAction's mitigation to a host agent wrapper:
+    exploration reseed folds into the agent's key stream; an LR shrink
+    rebuilds the agent's jitted updates at ``base_cfg`` with the
+    CUMULATIVE scale (base_cfg is the driver's original config, so
+    repeated recoveries don't compound twice).  Returns the (possibly
+    new) agent — state/key/buffer carry over untouched."""
+    import dataclasses
+
+    if act.reseed:
+        agent.key = jax.random.fold_in(agent.key, RESEED_SALT + act.attempt)
+    if act.lr_scale != 1.0:
+        cfg = dataclasses.replace(base_cfg,
+                                  lr_a=base_cfg.lr_a * act.lr_scale,
+                                  lr_c=base_cfg.lr_c * act.lr_scale)
+        new = type(agent)(cfg, seed=0, name_prefix=agent.name_prefix,
+                          collect_diag=agent.collect_diag)
+        new.state, new.key, new.buffer = agent.state, agent.key, agent.buffer
+        if getattr(agent, "_rng", None) is not None \
+                and getattr(new, "_rng", None) is not None:
+            new._rng = agent._rng
+        agent = new
+    return agent
 
 
 def make_block_fn(episode_body, block: int):

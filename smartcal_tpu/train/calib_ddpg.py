@@ -37,7 +37,8 @@ def main(argv=None):
     if args.load:
         agent.load_models()
     return run(env, agent, args.episodes, args.steps, args.use_hint,
-               args.prefix, obs_run=train_obs_from_args(args, "calib_ddpg"))
+               args.prefix, obs_run=train_obs_from_args(args, "calib_ddpg"),
+               args=args)
 
 
 if __name__ == "__main__":

@@ -14,7 +14,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import pickle
 
 import numpy as np
 
@@ -22,7 +21,8 @@ from ..envs import CalibEnv
 from ..envs.radio import RadioBackend
 from ..rl import sac
 from ..rl.networks import flatten_obs
-from .blocks import add_obs_args, diag_from_args, train_obs_from_args
+from .blocks import (add_obs_args, add_runtime_args, diag_from_args,
+                     train_obs_from_args)
 
 
 def main(argv=None):
@@ -54,6 +54,7 @@ def main(argv=None):
                         "reward from step rewards (demixing reward0 "
                         "pattern; sweep variance reduction)")
     add_obs_args(p)
+    add_runtime_args(p)
     args = p.parse_args(argv)
 
     if args.small:
@@ -84,10 +85,24 @@ def main(argv=None):
     if args.load:
         agent.load_models()
 
+    from smartcal_tpu.runtime import atomic_pickle
+
+    from .blocks import (TrainRuntime, apply_agent_recovery,
+                         pack_agent_loop, restore_agent_loop)
+
     scores = []
     tob = train_obs_from_args(args, "calib_sac")
+    rt = TrainRuntime.from_args(args, args.prefix, tob=tob)
+    i = 0
+    restored = rt.restore()
+    if restored is not None:
+        scores, i, _ = restore_agent_loop(agent, env, restored)
+
+    def ckpt_payload():
+        return pack_agent_loop(agent, env, scores, i)
+
     try:
-        for i in range(args.episodes):
+        while i < args.episodes:
             with tob.span("episode", episode=i):
                 obs = env.reset()
                 flat = flatten_obs(obs)
@@ -111,15 +126,23 @@ def main(argv=None):
                     score += reward
                     flat = flat2
                     loop += 1
+            if tob.tripped:
+                act = rt.on_trip()
+                if act is not None:
+                    scores, i, _ = restore_agent_loop(agent, env,
+                                                      act.payload)
+                    agent = apply_agent_recovery(agent, agent_cfg, act)
+                    continue
             scores.append(score / max(loop, 1))
             tob.log_replay_health(agent.buffer, episode=i)
             tob.episode(i, scores[-1], scores, seed=args.seed,
                         use_hint=args.use_hint)
             agent.save_models()
-            with open(f"{args.prefix}_scores.pkl", "wb") as fh:
-                pickle.dump(scores, fh)
+            atomic_pickle(scores, f"{args.prefix}_scores.pkl")
             if tob.tripped:
                 break
+            i += 1
+            rt.maybe_checkpoint(i, ckpt_payload)
     finally:
         tob.close()
     return scores

@@ -14,7 +14,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import pickle
 
 import numpy as np
 
@@ -25,15 +24,33 @@ from ..rl.networks import flatten_obs
 
 
 def run(env, agent, episodes, steps, use_hint, prefix, metrics_path=None,
-        obs_run=None):
+        obs_run=None, args=None):
     """Shared episode loop of the radio TD3/DDPG drivers
-    (main_td3.py:23-48 / main_ddpg.py)."""
-    from .blocks import train_obs
+    (main_td3.py:23-48 / main_ddpg.py).
+
+    ``args`` (the driver's parsed namespace) arms the shared
+    fault-tolerance surface — ``--ckpt-every``/``--resume``/
+    ``--max-recoveries`` (see train.blocks.add_runtime_args)."""
+    from smartcal_tpu.runtime import atomic_pickle
+
+    from .blocks import (TrainRuntime, apply_agent_recovery,
+                         pack_agent_loop, restore_agent_loop, train_obs)
 
     scores = []
     tob = obs_run or train_obs(prefix, metrics=metrics_path)
+    rt = TrainRuntime.from_args(args, prefix, tob=tob) if args is not None \
+        else TrainRuntime(prefix, tob=tob)
+    base_cfg = agent.cfg
+    i = 0
+    restored = rt.restore()
+    if restored is not None:
+        scores, i, _ = restore_agent_loop(agent, env, restored)
+
+    def ckpt_payload():
+        return pack_agent_loop(agent, env, scores, i)
+
     try:
-        for i in range(episodes):
+        while i < episodes:
             with tob.span("episode", episode=i):
                 obs = env.reset()
                 flat = flatten_obs(obs)
@@ -56,14 +73,22 @@ def run(env, agent, episodes, steps, use_hint, prefix, metrics_path=None,
                     score += reward
                     flat = flat2
                     loop += 1
+            if tob.tripped:
+                act = rt.on_trip()
+                if act is not None:
+                    scores, i, _ = restore_agent_loop(agent, env,
+                                                      act.payload)
+                    agent = apply_agent_recovery(agent, base_cfg, act)
+                    continue
             scores.append(score / max(loop, 1))
             tob.log_replay_health(agent.buffer, episode=i)
             tob.episode(i, scores[-1], scores, use_hint=use_hint)
             agent.save_models()
-            with open(f"{prefix}_scores.pkl", "wb") as fh:
-                pickle.dump(scores, fh)
+            atomic_pickle(scores, f"{prefix}_scores.pkl")
             if tob.tripped:
                 break
+            i += 1
+            rt.maybe_checkpoint(i, ckpt_payload)
     finally:
         tob.close()
     return scores
@@ -78,8 +103,9 @@ def build_backend(args):
 
 
 def add_common_args(p):
-    from .blocks import add_obs_args
+    from .blocks import add_obs_args, add_runtime_args
 
+    add_runtime_args(p)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--episodes", type=int, default=30)
     p.add_argument("--steps", type=int, default=10)
@@ -113,7 +139,8 @@ def main(argv=None):
     if args.load:
         agent.load_models()
     return run(env, agent, args.episodes, args.steps, args.use_hint,
-               args.prefix, obs_run=train_obs_from_args(args, "calib_td3"))
+               args.prefix, obs_run=train_obs_from_args(args, "calib_td3"),
+               args=args)
 
 
 if __name__ == "__main__":

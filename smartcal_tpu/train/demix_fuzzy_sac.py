@@ -18,14 +18,13 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import pickle
 
 import numpy as np
 
 from ..envs.demixing_fuzzy import FuzzyDemixingEnv
 from ..rl import sac
 from ..rl.networks import flatten_obs
-from .blocks import add_obs_args
+from .blocks import add_obs_args, add_runtime_args
 from .calib_td3 import build_backend
 from .demix_sac import run_warmup_loop
 
@@ -50,6 +49,7 @@ def main(argv=None):
     p.add_argument("--load", action="store_true")
     p.add_argument("--prefix", type=str, default="demix_fuzzy_sac")
     add_obs_args(p)
+    add_runtime_args(p)
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
@@ -75,9 +75,10 @@ def main(argv=None):
                          collect_diag=diag_from_args(args))
     scores = []
     if args.load:
+        # corruption-tolerant resume (see demix_sac.main)
+        from smartcal_tpu.runtime import safe_pickle_load
         agent.load_models()
-        with open(f"{args.prefix}_scores.pkl", "rb") as fh:
-            scores = pickle.load(fh)
+        scores = safe_pickle_load(f"{args.prefix}_scores.pkl", default=[])
 
     def to_flat(o):
         return (flatten_obs(o) if args.use_influence

@@ -13,7 +13,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import pickle
 
 import jax
 import numpy as np
@@ -22,7 +21,8 @@ from ..envs import DemixingEnv
 from ..envs.radio import RadioBackend
 from ..rl import sac
 from ..rl.networks import flatten_obs
-from .blocks import add_obs_args, diag_from_args, train_obs_from_args
+from .blocks import (add_obs_args, add_runtime_args, diag_from_args,
+                     train_obs_from_args)
 
 
 def main(argv=None):
@@ -49,6 +49,7 @@ def main(argv=None):
     p.add_argument("--load", action="store_true")
     p.add_argument("--prefix", type=str, default="demix_sac")
     add_obs_args(p)
+    add_runtime_args(p)
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
@@ -74,9 +75,11 @@ def main(argv=None):
                          collect_diag=diag_from_args(args))
     scores = []
     if args.load:
+        # corruption-tolerant resume: a truncated/corrupt file (e.g. a
+        # pre-atomic-write kill) warns and starts fresh instead of crashing
+        from smartcal_tpu.runtime import safe_pickle_load
         agent.load_models()
-        with open(f"{args.prefix}_scores.pkl", "rb") as fh:
-            scores = pickle.load(fh)
+        scores = safe_pickle_load(f"{args.prefix}_scores.pkl", default=[])
 
     def to_flat(o):
         return (flatten_obs(o) if args.provide_influence
@@ -129,12 +132,42 @@ def run_warmup_loop(env, agent, args, scores, to_flat, n_actions,
     """Shared warmup/step/store/learn episode loop of the demixing-family
     drivers (demixing_rl/main_sac.py:54-98, demixing_fuzzy/main_sac.py:
     70-99 — identical control flow, differing only in the reward-shaping
-    rule and the observation flattening)."""
+    rule and the observation flattening).
+
+    Fault tolerance (``add_runtime_args`` flags): ``--ckpt-every`` writes
+    an atomic versioned checkpoint capturing agent + replay (incl. PER
+    priorities) + the agent/env key streams + the warmup numpy RNG +
+    scores, ``--resume`` restarts from it bit-continuably, and a
+    watchdog trip with ``--max-recoveries`` rolls back and retries with
+    the policy's mitigation before the graceful halt."""
+    from smartcal_tpu.runtime import atomic_pickle
+
+    from .blocks import (TrainRuntime, apply_agent_recovery,
+                         pack_agent_loop, restore_agent_loop)
+
     tob = train_obs_from_args(args, getattr(args, "prefix", "demix"))
+    rt = TrainRuntime.from_args(args, getattr(args, "prefix", "demix"),
+                                tob=tob)
+    base_cfg = agent.cfg
     total_steps = 0
     warmup_steps = args.warmup * args.steps
+    i = 0
+    restored = rt.restore()
+    if restored is not None:
+        scores_r, i, extra = restore_agent_loop(agent, env, restored)
+        scores[:] = scores_r
+        total_steps = int(extra.get("total_steps", 0))
+        if "np_rng" in extra:
+            rng.bit_generator.state = extra["np_rng"]
+
+    def ckpt_payload():
+        return pack_agent_loop(
+            agent, env, scores, i,
+            extra={"total_steps": total_steps,
+                   "np_rng": rng.bit_generator.state})
+
     try:
-        for i in range(args.iteration):
+        while i < args.iteration:
             with tob.span("episode", episode=i):
                 obs = env.reset()
                 flat = to_flat(obs)
@@ -164,17 +197,31 @@ def run_warmup_loop(env, agent, args, scores, to_flat, n_actions,
                     flat = flat2
                     loop += 1
                     total_steps += 1
+            if tob.tripped:
+                act = rt.on_trip()
+                if act is not None:
+                    # rollback-and-retry: discard the poisoned episodes,
+                    # restore the checkpoint, apply the mitigation
+                    scores_r, i, extra = restore_agent_loop(agent, env,
+                                                            act.payload)
+                    scores[:] = scores_r
+                    total_steps = int(extra.get("total_steps", 0))
+                    if "np_rng" in extra:
+                        rng.bit_generator.state = extra["np_rng"]
+                    agent = apply_agent_recovery(agent, base_cfg, act)
+                    continue
             scores.append(score / max(loop, 1))
             tob.log_replay_health(agent.buffer, episode=i)
             tob.episode(i, scores[-1], scores, seed=args.seed,
                         use_hint=args.use_hint,
                         warmup=total_steps <= warmup_steps)
             agent.save_models()
-            with open(f"{args.prefix}_scores.pkl", "wb") as fh:
-                pickle.dump(scores, fh)
+            atomic_pickle(scores, f"{args.prefix}_scores.pkl")
             if tob.tripped:
                 break
-            if (i + 1) % _clear_every() == 0:
+            i += 1
+            rt.maybe_checkpoint(i, ckpt_payload)
+            if i % _clear_every() == 0:
                 # bound live compiled executables: long hint-mode runs
                 # segfault the XLA CPU client near episode ~43 otherwise
                 # (the same deterministic crash the test suite hit in

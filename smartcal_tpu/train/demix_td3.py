@@ -24,14 +24,13 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import pickle
 
 import numpy as np
 
 from ..envs import DemixingEnv
 from ..rl import td3
 from ..rl.networks import flatten_obs
-from .blocks import add_obs_args
+from .blocks import add_obs_args, add_runtime_args
 from .demix_sac import make_backend, run_warmup_loop
 
 
@@ -59,6 +58,7 @@ def main(argv=None):
     p.add_argument("--batch_size", type=int, default=64)
     p.add_argument("--memory", type=int, default=4096)
     add_obs_args(p)
+    add_runtime_args(p)
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
@@ -85,9 +85,10 @@ def main(argv=None):
                          collect_diag=diag_from_args(args))
     scores = []
     if args.load:
+        # corruption-tolerant resume (see demix_sac.main)
+        from smartcal_tpu.runtime import safe_pickle_load
         agent.load_models()
-        with open(f"{args.prefix}_scores.pkl", "rb") as fh:
-            scores = pickle.load(fh)
+        scores = safe_pickle_load(f"{args.prefix}_scores.pkl", default=[])
 
     def to_flat(o):
         return (flatten_obs(o) if args.provide_influence

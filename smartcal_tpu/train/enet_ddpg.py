@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import argparse
-import pickle
 import time
 
 import jax
@@ -61,8 +60,15 @@ def make_episode_block_fn(env_cfg: enet.EnetConfig, cfg: ddpg.DDPGConfig,
 
 def train_fused(seed=0, episodes=1000, steps=5, M=20, N=20, quiet=False,
                 prefix="", metrics_path=None, run_id=None, trace=None,
-                diag=False, watchdog=False):
-    from .blocks import train_obs
+                diag=False, watchdog=False, ckpt_dir=None, ckpt_every=0,
+                keep_ckpts=3, resume=False, max_recoveries=0,
+                recovery_lr_shrink=0.5, recovery_reseed=True):
+    import dataclasses
+
+    from smartcal_tpu.runtime import (atomic_pickle, pack_replay,
+                                      unpack_replay)
+
+    from .blocks import TrainRuntime, train_obs
 
     env_cfg = enet.EnetConfig(M=M, N=N)
     cfg = ddpg.DDPGConfig(obs_dim=env_cfg.obs_dim, n_actions=2,
@@ -75,12 +81,40 @@ def train_fused(seed=0, episodes=1000, steps=5, M=20, N=20, quiet=False,
     scores = []
     t0 = time.time()
     tob = train_obs("enet_ddpg", metrics=metrics_path, run_id=run_id,
-                    trace=trace, quiet=quiet, diag=diag, watchdog=watchdog,
-                    seed=seed)
+                    trace=trace, quiet=quiet, diag=diag,
+                    watchdog=watchdog or max_recoveries > 0, seed=seed)
+    rt = TrainRuntime("enet_ddpg", ckpt_dir=ckpt_dir, ckpt_every=ckpt_every,
+                      keep=keep_ckpts, resume=resume,
+                      max_recoveries=max_recoveries,
+                      lr_shrink=recovery_lr_shrink, reseed=recovery_reseed,
+                      tob=tob)
     collect = tob.collect_diag
-    episode_fn = make_episode_fn(env_cfg, cfg, steps, collect_diag=collect)
+
+    def build_fn(lr_scale=1.0):
+        c = (cfg if lr_scale == 1.0 else dataclasses.replace(
+            cfg, lr_a=cfg.lr_a * lr_scale, lr_c=cfg.lr_c * lr_scale))
+        return make_episode_fn(env_cfg, c, steps, collect_diag=collect)
+
+    episode_fn = build_fn()
+
+    i = 0
+    restored = rt.restore()
+    if restored is not None:
+        agent_state = jax.tree_util.tree_map(jnp.asarray,
+                                             restored["agent_state"])
+        buf = unpack_replay(restored["replay"])
+        key = jnp.asarray(restored["key"])
+        scores = list(restored["scores"])
+        i = int(restored["episode"])
+
+    def ckpt_payload():
+        return {"kind": "enet_fused", "entry": "enet_ddpg", "seed": seed,
+                "episode": i, "scores": list(scores),
+                "agent_state": jax.device_get(agent_state),
+                "replay": pack_replay(buf), "key": jax.device_get(key)}
+
     try:
-        for i in range(episodes):
+        while i < episodes:
             key, k = jax.random.split(key)
             with tob.span("episode", episode=i):
                 out = episode_fn(agent_state, buf, k)
@@ -93,35 +127,58 @@ def train_fused(seed=0, episodes=1000, steps=5, M=20, N=20, quiet=False,
             else:
                 agent_state, buf, score = out
                 halted = False
+            if halted or tob.tripped:
+                act = rt.on_trip()
+                if act is None:
+                    scores.append(float(score))
+                    tob.episode(i, scores[-1], scores, seed=seed)
+                    break
+                # rollback-and-retry (shared restore+mitigation helper)
+                from .blocks import rollback_fused
+
+                def rebuild(scale):
+                    nonlocal episode_fn
+                    episode_fn = build_fn(scale)
+
+                agent_state, buf, key, scores, i = rollback_fused(act,
+                                                                  rebuild)
+                continue
             scores.append(float(score))
             tob.episode(i, scores[-1], scores, seed=seed)
-            if halted or tob.tripped:
-                break
+            i += 1
+            rt.maybe_checkpoint(i, ckpt_payload)
         wall = time.time() - t0
     finally:
         tob.close()
-    with open(f"{prefix}scores_ddpg.pkl", "wb") as f:
-        pickle.dump(scores, f)
+    atomic_pickle(scores, f"{prefix}scores_ddpg.pkl")
     return scores, wall, agent_state, buf
 
 
 def main():
     from smartcal_tpu import obs as smartcal_obs
 
-    from .blocks import add_obs_args
+    from .blocks import add_obs_args, add_runtime_args
 
     p = argparse.ArgumentParser(description="Elastic net DDPG (TPU)")
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--episodes", default=1000, type=int)
     p.add_argument("--steps", default=5, type=int)
     add_obs_args(p)
+    add_runtime_args(p)
     args = p.parse_args()
     scores, wall, _, _ = train_fused(seed=args.seed, episodes=args.episodes,
                                      steps=args.steps,
                                      metrics_path=args.metrics,
                                      run_id=args.run_id, trace=args.trace,
                                      quiet=args.quiet, diag=args.diag,
-                                     watchdog=args.watchdog)
+                                     watchdog=args.watchdog,
+                                     ckpt_dir=args.ckpt_dir,
+                                     ckpt_every=args.ckpt_every,
+                                     keep_ckpts=args.keep_ckpts,
+                                     resume=args.resume,
+                                     max_recoveries=args.max_recoveries,
+                                     recovery_lr_shrink=args.recovery_lr_shrink,
+                                     recovery_reseed=args.recovery_reseed)
     smartcal_obs.emit_json(
         {"episodes": args.episodes, "wall_s": round(wall, 2),
          "env_steps_per_sec": round(args.episodes * args.steps / wall, 2),

@@ -18,7 +18,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import pickle
 import time
 
 import jax
@@ -111,8 +110,14 @@ def make_episode_block_fn(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
 def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,
                 M=20, N=20, log_every=1, save_every=500, prefix="",
                 quiet=False, metrics_path=None, block=1, run_id=None,
-                trace=None, diag=False, watchdog=False):
-    from .blocks import train_obs
+                trace=None, diag=False, watchdog=False, ckpt_dir=None,
+                ckpt_every=0, keep_ckpts=3, resume=False, max_recoveries=0,
+                recovery_lr_shrink=0.5, recovery_reseed=True):
+    import dataclasses
+
+    from smartcal_tpu.runtime import pack_replay, unpack_replay
+
+    from .blocks import TrainRuntime, train_obs
 
     env_cfg = enet.EnetConfig(M=M, N=N)
     agent_cfg = sac.SACConfig(
@@ -130,19 +135,36 @@ def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,
     scores = []
     t0 = time.time()
     tob = train_obs("enet_sac", metrics=metrics_path, run_id=run_id,
-                    trace=trace, quiet=quiet, diag=diag, watchdog=watchdog,
+                    trace=trace, quiet=quiet, diag=diag,
+                    watchdog=watchdog or max_recoveries > 0,
                     seed=seed, block=block)
+    rt = TrainRuntime("enet_sac", ckpt_dir=ckpt_dir, ckpt_every=ckpt_every,
+                      keep=keep_ckpts, resume=resume,
+                      max_recoveries=max_recoveries,
+                      lr_shrink=recovery_lr_shrink, reseed=recovery_reseed,
+                      tob=tob)
     collect = tob.collect_diag
     if collect and block > 1:
         # diagnostics stream at per-episode cadence: the watchdog must
         # see updates before committing to a whole block's compute
         tob.echo("diag/watchdog: forcing block=1")
         block = 1
-    block_fn = (make_episode_block_fn(env_cfg, agent_cfg, steps, use_hint,
-                                      block) if block > 1 else None)
-    episode_fn = (make_episode_fn(env_cfg, agent_cfg, steps, use_hint,
-                                  collect_diag=collect)
-                  if block == 1 or episodes % block else None)
+
+    def build_fns(lr_scale=1.0):
+        # recovery's LR mitigation rebuilds the jitted programs at the
+        # scaled config (optimizer state structure is unchanged — the
+        # learning rate lives in the update closure, not the moments)
+        cfg = (agent_cfg if lr_scale == 1.0 else dataclasses.replace(
+            agent_cfg, lr_a=agent_cfg.lr_a * lr_scale,
+            lr_c=agent_cfg.lr_c * lr_scale))
+        bf = (make_episode_block_fn(env_cfg, cfg, steps, use_hint, block)
+              if block > 1 else None)
+        ef = (make_episode_fn(env_cfg, cfg, steps, use_hint,
+                              collect_diag=collect)
+              if block == 1 or episodes % block else None)
+        return bf, ef
+
+    block_fn, episode_fn = build_fns()
 
     def _log_one(i, score):
         scores.append(float(score))
@@ -151,6 +173,35 @@ def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,
                     seed=seed, use_hint=use_hint)
 
     i, saved_marker = 0, 0
+    restored = rt.restore()
+    if restored is not None:
+        agent_state = jax.tree_util.tree_map(jnp.asarray,
+                                             restored["agent_state"])
+        buf = unpack_replay(restored["replay"])
+        key = jnp.asarray(restored["key"])
+        scores = list(restored["scores"])
+        i = int(restored["episode"])
+        saved_marker = int(restored.get("saved_marker", 0))
+
+    def ckpt_payload():
+        return {"kind": "enet_fused", "entry": "enet_sac", "seed": seed,
+                "episode": i, "scores": list(scores),
+                "agent_state": jax.device_get(agent_state),
+                "replay": pack_replay(buf), "key": jax.device_get(key),
+                "saved_marker": saved_marker}
+
+    def _rollback(act):
+        nonlocal agent_state, buf, key, scores, i, saved_marker
+        nonlocal block_fn, episode_fn
+
+        def rebuild(scale):
+            nonlocal block_fn, episode_fn
+            block_fn, episode_fn = build_fns(scale)
+
+        from .blocks import rollback_fused
+        agent_state, buf, key, scores, i = rollback_fused(act, rebuild)
+        saved_marker = int(act.payload.get("saved_marker", 0))
+
     try:
         while i < episodes:
             if block_fn is not None and episodes - i >= block:
@@ -173,15 +224,23 @@ def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,
                     halted = tob.record_diag(ep_diag, episode=i)
                     tob.log_replay_health(buf, episode=i)
                     if halted or tob.tripped:
-                        _log_one(i, score)
-                        i += 1
-                        break
+                        act = rt.on_trip()
+                        if act is None:
+                            _log_one(i, score)
+                            i += 1
+                            break
+                        # rollback-and-retry: the poisoned episodes since
+                        # the checkpoint are discarded (not logged)
+                        _rollback(act)
+                        continue
                 else:
                     agent_state, buf, score = out
                 _log_one(i, score)
                 i += 1
-            # checkpoint cadence: save whenever a save_every multiple was
-            # crossed since the last save (block mode crosses in strides)
+            rt.maybe_checkpoint(i, ckpt_payload)
+            # classic side-files cadence: save whenever a save_every
+            # multiple was crossed since the last save (block mode
+            # crosses in strides)
             if save_every and i < episodes and i // save_every > saved_marker:
                 _save(agent_state, buf, scores, prefix)
                 saved_marker = i // save_every
@@ -193,11 +252,11 @@ def train_fused(seed=0, episodes=1000, steps=5, use_hint=False,
 
 
 def _save(agent_state, buf, scores, prefix):
-    with open(f"{prefix}sac_state.pkl", "wb") as f:
-        pickle.dump(jax.device_get(agent_state), f)
+    from smartcal_tpu.runtime import atomic_pickle
+
+    atomic_pickle(jax.device_get(agent_state), f"{prefix}sac_state.pkl")
     rp.save_replay(buf, f"{prefix}replaymem_sac.pkl")
-    with open(f"{prefix}scores.pkl", "wb") as f:
-        pickle.dump(scores, f)
+    atomic_pickle(scores, f"{prefix}scores.pkl")
 
 
 def train_loop(seed=0, episodes=1000, steps=5, use_hint=False, M=20, N=20):
@@ -238,7 +297,7 @@ def train_loop(seed=0, episodes=1000, steps=5, use_hint=False, M=20, N=20):
 def main():
     from smartcal_tpu import obs as smartcal_obs
 
-    from .blocks import add_obs_args
+    from .blocks import add_obs_args, add_runtime_args
 
     p = argparse.ArgumentParser(
         description="Elastic net regression hyperparameter tuning (SAC, TPU)")
@@ -251,6 +310,7 @@ def main():
                    help="episodes per device dispatch (lax.scan of whole "
                         "episodes; 1 = reference per-episode cadence)")
     add_obs_args(p)
+    add_runtime_args(p)
     args = p.parse_args()
 
     if args.mode == "fused":
@@ -258,7 +318,12 @@ def main():
             seed=args.seed, episodes=args.episodes, steps=args.steps,
             use_hint=args.use_hint, metrics_path=args.metrics,
             block=args.block, run_id=args.run_id, trace=args.trace,
-            quiet=args.quiet, diag=args.diag, watchdog=args.watchdog)
+            quiet=args.quiet, diag=args.diag, watchdog=args.watchdog,
+            ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
+            keep_ckpts=args.keep_ckpts, resume=args.resume,
+            max_recoveries=args.max_recoveries,
+            recovery_lr_shrink=args.recovery_lr_shrink,
+            recovery_reseed=args.recovery_reseed)
         smartcal_obs.emit_json({"episodes": args.episodes,
                                 "steps_per_episode": args.steps,
                                 "wall_s": round(wall, 2),

@@ -5,7 +5,6 @@ prioritized replay + hint-constrained adaptive-ADMM actor updates,
 from __future__ import annotations
 
 import argparse
-import pickle
 import time
 
 import jax
@@ -76,8 +75,14 @@ def make_episode_block_fn(env_cfg: enet.EnetConfig, cfg: td3.TD3Config,
 def train_fused(seed=0, episodes=1000, steps=4, use_hint=True,
                 prioritized=True, M=20, N=20, quiet=False, save_every=500,
                 prefix="", metrics_path=None, run_id=None, trace=None,
-                diag=False, watchdog=False):
-    from .blocks import train_obs
+                diag=False, watchdog=False, ckpt_dir=None, ckpt_every=0,
+                keep_ckpts=3, resume=False, max_recoveries=0,
+                recovery_lr_shrink=0.5, recovery_reseed=True):
+    import dataclasses
+
+    from smartcal_tpu.runtime import pack_replay, unpack_replay
+
+    from .blocks import TrainRuntime, train_obs
 
     env_cfg = enet.EnetConfig(M=M, N=N)
     cfg = td3.TD3Config(
@@ -94,13 +99,41 @@ def train_fused(seed=0, episodes=1000, steps=4, use_hint=True,
     scores = []
     t0 = time.time()
     tob = train_obs("enet_td3", metrics=metrics_path, run_id=run_id,
-                    trace=trace, quiet=quiet, diag=diag, watchdog=watchdog,
-                    seed=seed)
+                    trace=trace, quiet=quiet, diag=diag,
+                    watchdog=watchdog or max_recoveries > 0, seed=seed)
+    rt = TrainRuntime("enet_td3", ckpt_dir=ckpt_dir, ckpt_every=ckpt_every,
+                      keep=keep_ckpts, resume=resume,
+                      max_recoveries=max_recoveries,
+                      lr_shrink=recovery_lr_shrink, reseed=recovery_reseed,
+                      tob=tob)
     collect = tob.collect_diag
-    episode_fn = make_episode_fn(env_cfg, cfg, steps, use_hint,
-                                 collect_diag=collect)
+
+    def build_fn(lr_scale=1.0):
+        c = (cfg if lr_scale == 1.0 else dataclasses.replace(
+            cfg, lr_a=cfg.lr_a * lr_scale, lr_c=cfg.lr_c * lr_scale))
+        return make_episode_fn(env_cfg, c, steps, use_hint,
+                               collect_diag=collect)
+
+    episode_fn = build_fn()
+
+    i = 0
+    restored = rt.restore()
+    if restored is not None:
+        agent_state = jax.tree_util.tree_map(jnp.asarray,
+                                             restored["agent_state"])
+        buf = unpack_replay(restored["replay"])
+        key = jnp.asarray(restored["key"])
+        scores = list(restored["scores"])
+        i = int(restored["episode"])
+
+    def ckpt_payload():
+        return {"kind": "enet_fused", "entry": "enet_td3", "seed": seed,
+                "episode": i, "scores": list(scores),
+                "agent_state": jax.device_get(agent_state),
+                "replay": pack_replay(buf), "key": jax.device_get(key)}
+
     try:
-        for i in range(episodes):
+        while i < episodes:
             key, k = jax.random.split(key)
             with tob.span("episode", episode=i):
                 out = episode_fn(agent_state, buf, k)
@@ -113,11 +146,28 @@ def train_fused(seed=0, episodes=1000, steps=4, use_hint=True,
             else:
                 agent_state, buf, score = out
                 halted = False
+            if halted or tob.tripped:
+                act = rt.on_trip()
+                if act is None:
+                    scores.append(float(score))
+                    tob.episode(i, scores[-1], scores, seed=seed,
+                                use_hint=use_hint)
+                    break
+                # rollback-and-retry (shared restore+mitigation helper)
+                from .blocks import rollback_fused
+
+                def rebuild(scale):
+                    nonlocal episode_fn
+                    episode_fn = build_fn(scale)
+
+                agent_state, buf, key, scores, i = rollback_fused(act,
+                                                                  rebuild)
+                continue
             scores.append(float(score))
             tob.episode(i, scores[-1], scores, seed=seed, use_hint=use_hint)
-            if halted or tob.tripped:
-                break
-            if save_every and i and i % save_every == 0:
+            i += 1
+            rt.maybe_checkpoint(i, ckpt_payload)
+            if save_every and i < episodes and i % save_every == 0:
                 _save(agent_state, buf, scores, prefix)
         wall = time.time() - t0
     finally:
@@ -127,17 +177,17 @@ def train_fused(seed=0, episodes=1000, steps=4, use_hint=True,
 
 
 def _save(agent_state, buf, scores, prefix):
-    with open(f"{prefix}td3_state.pkl", "wb") as f:
-        pickle.dump(jax.device_get(agent_state), f)
+    from smartcal_tpu.runtime import atomic_pickle
+
+    atomic_pickle(jax.device_get(agent_state), f"{prefix}td3_state.pkl")
     rp.save_replay(buf, f"{prefix}replaymem_td3.pkl")
-    with open(f"{prefix}scores_td3.pkl", "wb") as f:
-        pickle.dump(scores, f)
+    atomic_pickle(scores, f"{prefix}scores_td3.pkl")
 
 
 def main():
     from smartcal_tpu import obs as smartcal_obs
 
-    from .blocks import add_obs_args
+    from .blocks import add_obs_args, add_runtime_args
 
     p = argparse.ArgumentParser(
         description="Elastic net TD3 + PER + hint-ADMM (TPU)")
@@ -147,12 +197,18 @@ def main():
     p.add_argument("--no_hint", action="store_true", default=False)
     p.add_argument("--no_per", action="store_true", default=False)
     add_obs_args(p)
+    add_runtime_args(p)
     args = p.parse_args()
     scores, wall, _, _ = train_fused(
         seed=args.seed, episodes=args.episodes, steps=args.steps,
         use_hint=not args.no_hint, prioritized=not args.no_per,
         metrics_path=args.metrics, run_id=args.run_id, trace=args.trace,
-        quiet=args.quiet, diag=args.diag, watchdog=args.watchdog)
+        quiet=args.quiet, diag=args.diag, watchdog=args.watchdog,
+        ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
+        keep_ckpts=args.keep_ckpts, resume=args.resume,
+        max_recoveries=args.max_recoveries,
+        recovery_lr_shrink=args.recovery_lr_shrink,
+        recovery_reseed=args.recovery_reseed)
     smartcal_obs.emit_json(
         {"episodes": args.episodes, "wall_s": round(wall, 2),
          "env_steps_per_sec": round(args.episodes * args.steps / wall, 2),

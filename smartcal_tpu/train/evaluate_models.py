@@ -78,8 +78,11 @@ def main(argv=None):
                             batch_size=256, mem_size=4096, alpha=0.03,
                             use_hint=use_hint, img_shape=(npix, npix))
         a = sac.SACAgent(cfg, name_prefix=prefix)
-        if prefix:
-            a.load_models()
+        if prefix and not a.load_models():
+            # an evaluation of a fresh random agent under a trained name
+            # would be silently misleading — fail loudly instead
+            raise FileNotFoundError(
+                f"no loadable checkpoint for prefix {prefix!r}")
         return a
 
     agents = {"nohint": make_agent(args.nohint, False),

@@ -1,0 +1,187 @@
+"""Supervised actor-thread fleets (runtime.supervisor + the parallel
+learners' supervised mode): heartbeat supervision, restart-with-backoff
+on injected actor kills, learning from the surviving fleet, and the
+clean join on a watchdog trip."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from smartcal_tpu.runtime import (BackoffPolicy, FaultPlan, Fleet,
+                                  clear_faults, install_faults)
+
+ENV_KW = {"M": 5, "N": 5}
+AGENT_KW = {"batch_size": 8, "mem_size": 64}
+
+
+@pytest.fixture(autouse=True)
+def in_tmp(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    yield
+    clear_faults()
+
+
+# ---------------------------------------------------------------------------
+# Fleet unit behavior (no jax, cheap work functions)
+# ---------------------------------------------------------------------------
+
+def _fast_backoff():
+    return BackoffPolicy(base_s=0.01, factor=2.0, max_s=0.05, jitter=0.0)
+
+
+def test_fleet_collects_and_versions_weights():
+    def work(actor_id, iteration, weights):
+        return {"actor": actor_id, "iteration": iteration, "w": weights}
+
+    fleet = Fleet(2, work, heartbeat_timeout=5.0, backoff=_fast_backoff())
+    fleet.start("w0")
+    try:
+        got = fleet.collect(max_items=4, timeout=5.0)
+        assert got and all(item[3]["w"] == "w0" for item in got)
+        v = fleet.set_weights("w1")
+        assert v > fleet.n_actors - 1
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            items = fleet.collect(max_items=8, timeout=1.0)
+            if any(item[3]["w"] == "w1" for item in items):
+                break
+        else:
+            pytest.fail("actors never picked up the new weights")
+    finally:
+        fleet.stop(join=True)
+    assert fleet.alive_count == 0
+
+
+def test_fleet_restarts_dead_actor_and_skips_poison_iteration():
+    seen = []
+
+    def work(actor_id, iteration, weights):
+        seen.append((actor_id, iteration))
+        if actor_id == 0 and iteration == 1:
+            raise RuntimeError("boom")
+        time.sleep(0.01)
+        return iteration
+
+    fleet = Fleet(1, work, heartbeat_timeout=5.0, max_restarts=2,
+                  backoff=_fast_backoff())
+    fleet.start(None)
+    try:
+        deadline = time.monotonic() + 10.0
+        restarted = False
+        while time.monotonic() < deadline and not restarted:
+            fleet.poll()
+            restarted = fleet.restarts_total() >= 1 and fleet.alive_count
+            time.sleep(0.01)
+        assert restarted, "supervisor never restarted the dead actor"
+        # the replacement resumed AFTER the poison-pill iteration
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if any(it == 2 for (_, it) in seen):
+                break
+            time.sleep(0.01)
+        assert (0, 2) in seen
+        assert seen.count((0, 1)) == 1      # poisoned iteration not retried
+    finally:
+        fleet.stop(join=True)
+
+
+def test_fleet_abandons_slot_after_max_restarts():
+    def work(actor_id, iteration, weights):
+        raise RuntimeError("always dies")
+
+    fleet = Fleet(1, work, heartbeat_timeout=5.0, max_restarts=2,
+                  backoff=_fast_backoff())
+    fleet.start(None)
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not fleet.failed_slots:
+            fleet.poll()
+            time.sleep(0.01)
+        assert fleet.failed_slots == {0}
+        assert fleet.restarts_total() == 2
+    finally:
+        fleet.stop(join=True)
+
+
+def test_fleet_detects_hung_actor():
+    release = threading.Event()
+
+    def work(actor_id, iteration, weights):
+        if iteration == 0:
+            release.wait(timeout=30.0)       # simulate a wedged rollout
+        return iteration
+
+    fleet = Fleet(1, work, heartbeat_timeout=0.2, max_restarts=1,
+                  backoff=_fast_backoff())
+    fleet.start(None)
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and fleet.restarts_total() < 1:
+            fleet.poll()
+            time.sleep(0.05)
+        assert fleet.restarts_total() == 1   # replacement spawned
+        got = fleet.collect(max_items=1, timeout=5.0)
+        assert got and got[0][1] == 1        # replacement works from iter 1
+    finally:
+        release.set()
+        fleet.stop(join=True)
+
+
+# ---------------------------------------------------------------------------
+# the enet supervised learner end-to-end (jitted rollouts, real SAC learn)
+# ---------------------------------------------------------------------------
+
+def test_train_supervised_actor_kill_restart(tmp_path):
+    """Injected actor kill: the run completes every episode, the
+    supervisor logs actor_down/actor_restart, and learning continued
+    from the surviving fleet meanwhile."""
+    from smartcal_tpu.parallel import learner
+
+    install_faults(FaultPlan(kill_actor=1, kill_at=1))
+    run = str(tmp_path / "sup.jsonl")
+    (st, buf), scores, summary = learner.train_supervised(
+        seed=0, episodes=5, n_actors=2, env_kwargs=ENV_KW,
+        agent_kwargs=AGENT_KW, rollout_epochs=1, rollout_steps=4,
+        quiet=True, queue_timeout=30.0, metrics=run,
+        restart_backoff=_fast_backoff())
+    clear_faults()
+    assert len(scores) == 5
+    assert np.all(np.isfinite(scores))
+    assert summary["restarts"] == 1 and not summary["failed_slots"]
+    assert summary["alive_at_exit"] == 0          # stop() joined the fleet
+    assert int(buf.cntr) > 0
+    events = [json.loads(ln) for ln in open(run) if ln.strip()]
+    kinds = [e["event"] for e in events]
+    for want in ("fault_injected", "actor_down", "actor_restart",
+                 "actors_stopped"):
+        assert want in kinds, (want, sorted(set(kinds)))
+    down = [e for e in events if e["event"] == "actor_down"][0]
+    assert down["actor"] == 1 and "FaultInjected" in down["reason"]
+    restart = [e for e in events if e["event"] == "actor_restart"][0]
+    assert restart["iteration"] == 2              # poison iteration skipped
+
+
+def test_train_supervised_trip_joins_actors(tmp_path):
+    """Watchdog trip in the supervised learner stops AND joins the actor
+    threads (no actor left running against a dead learner)."""
+    from smartcal_tpu.parallel import learner
+
+    # critic_loss NaN at learner update 2 -> watchdog trips mid-run
+    install_faults(FaultPlan(nan_field="critic_loss", nan_step=2))
+    run = str(tmp_path / "trip.jsonl")
+    (st, buf), scores, summary = learner.train_supervised(
+        seed=0, episodes=8, n_actors=2, env_kwargs=ENV_KW,
+        agent_kwargs=AGENT_KW, rollout_epochs=1, rollout_steps=4,
+        quiet=True, queue_timeout=30.0, metrics=run, watchdog=True)
+    clear_faults()
+    assert len(scores) < 8                        # halted early
+    assert summary["alive_at_exit"] == 0          # every thread joined
+    events = [json.loads(ln) for ln in open(run) if ln.strip()]
+    kinds = [e["event"] for e in events]
+    assert "watchdog_trip" in kinds
+    assert "actors_stopped" in kinds
+    stop_evs = [e for e in events if e["event"] == "actors_stopped"]
+    assert stop_evs[0]["joined"] == stop_evs[0]["total"]

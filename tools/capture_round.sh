@@ -39,35 +39,38 @@ trap 'cleanup' EXIT
 trap 'cleanup; exit 130' INT TERM
 
 ATTEMPT_TIMEOUT=${ATTEMPT_TIMEOUT:-3000}   # 50 min: compiles alone can eat 25
-MAX_ATTEMPTS=${MAX_ATTEMPTS:-12}           # dead-tunnel probes are cheap (~2.5 min)
+MAX_ATTEMPTS=${MAX_ATTEMPTS:-12}           # probe attempts per item (chip_probe.py)
 HEAVY_MAX=${HEAVY_MAX:-4}                  # full attempts are not (up to 50 min each)
 BACKOFF=${BACKOFF:-300}
+PROBE_BUDGET=${PROBE_BUDGET:-3600}         # total probe backoff-sleep per item (s)
 
 # Healthy backend init is fast (<1 min observed); a sick tunnel hangs
-# ~25-27 min and then fails UNAVAILABLE.  Gate every heavy attempt on a
-# 150 s probe so dead-tunnel cycles cost ~2.5 min, not 27.  (Probe and
-# attempt are sequential — never two TPU clients at once.)
-tunnel_ok () {
-  local p
-  p=$(timeout --kill-after=15 150 python -c \
-      "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
-  [ "$p" = "axon" ] || [ "$p" = "tpu" ]
+# ~25-27 min and then fails UNAVAILABLE.  Gate every heavy attempt on
+# tools/chip_probe.py: bounded 150 s probes with exponential backoff +
+# jitter under MAX_ATTEMPTS and a PROBE_BUDGET total-sleep bound — the
+# replacement for the blind fixed-sleep loop that burned 87 dead probes
+# in results/chip_attempts_r5.log.  Structured probe events (attempt /
+# next_retry_s fields) land in results/chip_probe_${R}.jsonl.  (Probe
+# and attempt are sequential — never two TPU clients at once.)
+tunnel_wait () {
+  python tools/chip_probe.py --attempts "$MAX_ATTEMPTS" \
+      --budget "$PROBE_BUDGET" --base 60 \
+      --metrics "results/chip_probe_${R}.jsonl"
 }
 
-# Probe failures and heavy-attempt failures count SEPARATELY: probes are
-# ~2.5 min (12 allowed), heavy attempts can burn ATTEMPT_TIMEOUT+BACKOFF
-# each (4 allowed) — otherwise a tunnel that passes the probe but drops
-# mid-capture could loop for ~11 h on one item.
+# Probe exhaustion and heavy-attempt failures count SEPARATELY: the probe
+# walk is bounded by MAX_ATTEMPTS/PROBE_BUDGET inside chip_probe.py,
+# heavy attempts can burn ATTEMPT_TIMEOUT+BACKOFF each (4 allowed) —
+# otherwise a tunnel that passes the probe but drops mid-capture could
+# loop for ~11 h on one item.
 try_capture () {
   local name="$1" check="$2"; shift 2
-  local probes=0 heavies=0 rc
+  local heavies=0 rc
   if eval "$check"; then echo "[capture] $name: already done, skipping"; return 0; fi
-  while [ "$probes" -lt "$MAX_ATTEMPTS" ] && [ "$heavies" -lt "$HEAVY_MAX" ]; do
-    if ! tunnel_ok; then
-      probes=$((probes + 1))
-      echo "[capture] $name: probe $probes/$MAX_ATTEMPTS found tunnel dead ($(date -u +%H:%M:%S))"
-      sleep "$BACKOFF"
-      continue
+  while [ "$heavies" -lt "$HEAVY_MAX" ]; do
+    if ! tunnel_wait; then
+      echo "[capture] $name: probe budget exhausted, tunnel still dead ($(date -u +%H:%M:%S))"
+      break
     fi
     heavies=$((heavies + 1))
     echo "[capture] $name: attempt $heavies/$HEAVY_MAX ($(date -u +%H:%M:%S))"
@@ -92,7 +95,7 @@ try_capture () {
     echo "[capture] $name: attempt $heavies failed rc=$rc"
     sleep "$BACKOFF"
   done
-  echo "[capture] $name: GAVE UP (probes=$probes heavies=$heavies)"
+  echo "[capture] $name: GAVE UP (heavies=$heavies)"
   return 1
 }
 

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Backoff-governed TPU tunnel probe for the capture scripts.
+
+Replaces the blind fixed-sleep probe loop in ``tools/capture_round.sh``
+(the loop behind the 87 dead probes of ``results/chip_attempts_r5.log``):
+each probe runs ``import jax; jax.devices()`` in a bounded subprocess,
+failures back off exponentially with jitter under BOTH an attempt cap
+and a total-sleep budget, and every attempt emits the structured
+``probe`` event (the same record bench.py writes) with ``attempt`` /
+``next_retry_s`` / ``backoff_spent_s`` fields into a JSONL stream.
+
+Exit status: 0 = tunnel alive (a capture may start), 1 = budget/attempts
+exhausted with the tunnel still dead, so shell callers can gate on it::
+
+    python tools/chip_probe.py --metrics results/chip_probe_r6.jsonl \
+        --attempts 12 --budget 3600 || exit 1
+
+``--probe-cmd`` overrides the probed command (tests use ``echo tpu``).
+One TPU client at a time: probe and capture run sequentially, never
+concurrently (see capture_round.sh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from smartcal_tpu import obs                               # noqa: E402
+from smartcal_tpu.runtime import Backoff, BackoffPolicy    # noqa: E402
+
+DEFAULT_PROBE = (f"{sys.executable} -c "
+                 "'import jax; print(jax.devices()[0].platform)'")
+
+
+def probe_once(cmd: str, timeout: float):
+    """(ok, detail) for one probe subprocess run."""
+    try:
+        r = subprocess.run(cmd, shell=True, capture_output=True,
+                           text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False, f"timeout ({timeout:g}s)"
+    out = (r.stdout or "").strip().splitlines()
+    platform = out[-1] if out else ""
+    ok = r.returncode == 0 and platform in ("axon", "tpu")
+    return ok, platform or f"rc={r.returncode}"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--attempts", type=int, default=12,
+                   help="max probe attempts")
+    p.add_argument("--budget", type=float, default=3600.0,
+                   help="total backoff-sleep budget in seconds")
+    p.add_argument("--timeout", type=float, default=150.0,
+                   help="per-probe subprocess timeout (a sick tunnel "
+                        "hangs backend init ~25 min; healthy init is "
+                        "under a minute)")
+    p.add_argument("--base", type=float, default=60.0,
+                   help="first backoff delay")
+    p.add_argument("--max-delay", type=float, default=600.0)
+    p.add_argument("--metrics", type=str, default=None,
+                   help="JSONL stream for the structured probe events")
+    p.add_argument("--probe-cmd", type=str, default=DEFAULT_PROBE,
+                   help="command whose last stdout line must be "
+                        "axon/tpu (override for tests)")
+    args = p.parse_args(argv)
+
+    # side process: never let the event stream's device-metadata probe
+    # touch the TPU client the probe subprocess owns
+    os.environ.setdefault("SMARTCAL_OBS_NO_DEVICE_META", "1")
+    rl = obs.RunLog(args.metrics, meta={"entry": "chip_probe"}) \
+        if args.metrics else None
+    bo = Backoff(BackoffPolicy(base_s=args.base, factor=2.0,
+                               max_s=args.max_delay, jitter=0.25,
+                               max_attempts=max(0, args.attempts - 1),
+                               budget_s=args.budget),
+                 seed=os.getpid())
+    try:
+        for attempt in range(max(1, args.attempts)):
+            ok, detail = probe_once(args.probe_cmd, args.timeout)
+            delay = None if ok else bo.next_delay()
+            if rl is not None:
+                rl.log("probe", ok=ok, attempt=attempt, platform=detail,
+                       next_retry_s=None if delay is None
+                       else round(delay, 1),
+                       backoff_spent_s=round(bo.spent_s, 1))
+                rl.flush()
+            if ok:
+                obs.echo(f"tunnel alive ({detail}) after {attempt + 1} "
+                         f"probe(s)", event=None)
+                return 0
+            if delay is None:
+                break
+            obs.echo(f"probe {attempt + 1}/{args.attempts} dead "
+                     f"({detail}); retrying in {delay:.0f}s "
+                     f"(spent {bo.spent_s:.0f}/{args.budget:.0f}s)",
+                     event=None)
+            time.sleep(delay)
+        obs.echo(f"tunnel still dead after {bo.attempt + 1} probe(s), "
+                 f"{bo.spent_s:.0f}s backoff spent — giving up",
+                 event=None)
+        return 1
+    finally:
+        if rl is not None:
+            rl.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

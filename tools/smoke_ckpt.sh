@@ -1,0 +1,86 @@
+#!/bin/bash
+# Checkpoint/restore smoke: record an enet_sac run with per-episode
+# checkpointing, SIGTERM it mid-run (the preemption case — possibly mid
+# checkpoint write), then --resume from the surviving store and assert
+#   * the resumed run continues exactly at the checkpointed episode
+#     (continuity — no repeated and no skipped episode indices),
+#   * the store survived the kill (LATEST + sha-validated payload),
+#   * both RunLog streams are free of `recovery`/`watchdog_trip` events
+#     (a clean kill-resume must not look like a divergence).
+# Companion of tools/smoke_obs.sh; ~1 min on CPU.
+#
+#   bash tools/smoke_ckpt.sh [workdir]
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+REPO="$PWD"
+WORK="${1:-$(mktemp -d /tmp/smoke_ckpt.XXXXXX)}"
+RUN1="$WORK/record.jsonl"
+RUN2="$WORK/resume.jsonl"
+CK="$WORK/ckpt"
+mkdir -p "$WORK"
+
+echo "[smoke_ckpt] recording enet_sac with --ckpt-every 1 -> $CK" >&2
+(cd "$WORK" && PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+    exec python -m smartcal_tpu.train.enet_sac \
+    --episodes 100000 --steps 4 --seed 3 --quiet \
+    --metrics "$RUN1" --ckpt-dir "$CK" --ckpt-every 1) &
+PID=$!
+# never leak the open-ended recorder, even if this script is killed
+trap 'kill -9 "$PID" 2>/dev/null || true' EXIT INT TERM
+
+# wait for a few checkpoints, then SIGTERM mid-run (the count must not
+# trip set -e/pipefail while the store is still empty)
+for _ in $(seq 1 180); do
+  n=$({ ls "$CK" 2>/dev/null || true; } | { grep -c '^ckpt_' || true; })
+  if [ "${n:-0}" -ge 3 ]; then break; fi
+  sleep 1
+done
+kill -TERM "$PID" 2>/dev/null || true
+wait "$PID" 2>/dev/null || true
+[ -f "$CK/LATEST" ] || { echo "[smoke_ckpt] FAIL: no LATEST pointer"; exit 1; }
+
+STEP=$(python - "$CK/LATEST" <<'EOF'
+import json, sys
+print(json.load(open(sys.argv[1]))["step"])
+EOF
+)
+TARGET=$((STEP + 5))
+echo "[smoke_ckpt] killed at >= episode $STEP; resuming to $TARGET" >&2
+
+(cd "$WORK" && PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m smartcal_tpu.train.enet_sac \
+    --episodes "$TARGET" --steps 4 --seed 3 --quiet \
+    --metrics "$RUN2" --ckpt-dir "$CK" --resume > "$WORK/resume_out.json")
+
+python - "$RUN1" "$RUN2" "$STEP" "$TARGET" <<'EOF'
+import json
+import sys
+
+run1, run2, step, target = sys.argv[1], sys.argv[2], int(sys.argv[3]), \
+    int(sys.argv[4])
+
+
+def events(path):
+    return [json.loads(ln) for ln in open(path) if ln.strip()]
+
+
+e1, e2 = events(run1), events(run2)
+for name, evs in (("record", e1), ("resume", e2)):
+    bad = [e for e in evs if e["event"] in ("recovery", "watchdog_trip")]
+    assert not bad, f"{name} stream has recovery/trip events: {bad}"
+assert any(e["event"] == "resume" and e["step"] == step for e in e2), \
+    f"resume stream missing resume@{step} event"
+eps = [e["episode"] for e in e2 if e["event"] == "episode"]
+assert eps == list(range(step, target)), \
+    f"resumed episode indices not continuous from {step}: {eps}"
+end = [e for e in e2 if e["event"] == "run_end"][-1]
+assert end["episodes"] == target - step, end
+# the record stream may be missing its last <2 s of buffered events (the
+# RunLog's bounded-loss flush contract) but must at least have a header
+assert e1 and e1[0]["event"] == "run_header", e1[:1]
+rec_eps = [e["episode"] for e in e1 if e["event"] == "episode"]
+print(f"[smoke_ckpt] OK: killed at >= episode {step} "
+      f"({len(rec_eps)} episode events survived the kill), resumed "
+      f"{step}..{target - 1} cleanly, no recovery events")
+EOF
