@@ -689,6 +689,88 @@ def bench_calib_episode(pipeline_episodes: int = 2, small: bool = False):
     return out
 
 
+def bench_calib_batched(batch_sizes=(1, 4, 8), steps=2):
+    """Aggregate env-steps/s of the BATCHED radio episode mode vs the
+    sequential loop (ISSUE 9 tentpole metric).
+
+    For each B: the sequential arm runs B whole CalibEnv episodes
+    (reset-calibration + ``steps`` steps, each a full solve + influence
+    + reward images) one at a time; the batched arm runs ONE
+    BatchedCalibEnv vector episode with B lanes — the same env-step
+    budget as one batched program per stage.  Both arms are timed warm
+    (a full untimed episode first), so the comparison is steady-state
+    throughput, not compile amortization.  CPU-safe scale (N=8, Nf=2):
+    the N=62 amortized number needs a chip window — reported as skipped
+    otherwise.
+    """
+    from smartcal_tpu.envs import BatchedCalibEnv, CalibEnv
+    from smartcal_tpu.envs.radio import RadioBackend
+
+    M = 4
+    kw = dict(n_stations=8, n_freqs=2, n_times=8, tdelta=4, admm_iters=3,
+              lbfgs_iters=3, init_iters=6, npix=32)
+    per_b = []
+    for nb in batch_sizes:
+        acts_b = np.zeros((nb, 2 * M), np.float32)
+        # sequential arm: B whole episodes, one at a time
+        env = CalibEnv(M=M, backend=RadioBackend(**kw), seed=0)
+        env.reset()                       # warm: compiles + first episode
+        for _ in range(steps):
+            env.step(acts_b[0])
+        t0 = time.time()
+        for _ in range(nb):
+            env.reset()
+            for _ in range(steps):
+                env.step(acts_b[0])
+        seq_wall = time.time() - t0
+
+        # batched arm: one vector episode of B lanes
+        benv = BatchedCalibEnv(M=M, n_envs=nb,
+                               backend=RadioBackend(**kw), seed=0)
+        benv.reset()                      # warm the batched programs
+        for _ in range(steps):
+            benv.step(acts_b)
+        t0 = time.time()
+        benv.reset()
+        for _ in range(steps):
+            benv.step(acts_b)
+        bat_wall = time.time() - t0
+
+        env_steps = nb * steps
+        per_b.append({
+            "n_envs": nb,
+            "seq_env_steps_per_sec": round(env_steps / seq_wall, 3),
+            "bat_env_steps_per_sec": round(env_steps / bat_wall, 3),
+            "seq_s_per_episode": round(seq_wall / nb, 3),
+            "bat_amortized_s_per_episode": round(bat_wall / nb, 3),
+            "speedup_vs_sequential": round(seq_wall / max(bat_wall, 1e-9),
+                                           3),
+        })
+    best = max(per_b, key=lambda r: r["bat_env_steps_per_sec"])
+    out = {
+        "metric": "calib_batched_env_steps_per_sec",
+        "value": best["bat_env_steps_per_sec"],
+        "unit": "env-steps/sec",
+        "vs_baseline": None,
+        "scale": f"N=8 B=28 Nf=2 Tdelta=4 M={M} npix=32 (CPU-safe)",
+        "steps_per_episode": steps,
+        "batch_sizes": list(batch_sizes),
+        "results": per_b,
+        "note": "sequential arm = B whole episodes one at a time; "
+                "batched arm = one B-lane vector episode "
+                "(RadioBackend.calibrate_batched route)",
+    }
+    if jax.devices()[0].platform in ("tpu", "axon"):
+        out["n62_amortized"] = "run bench_calib_episode for the N=62 "\
+            "anchor; batched N=62 needs a dedicated chip window"
+    else:
+        out["n62_amortized_skipped"] = ("no TPU: the N=62 batched "
+                                        "amortized number needs a chip "
+                                        "window (135 s/episode anchor "
+                                        "is hours at B>=4 on one core)")
+    return out
+
+
 def main():
     # SMARTCAL_OBS=<path> records the whole bench as an obs run: backend
     # spans (simulate/solve/influence routes), solver telemetry, compile
@@ -846,7 +928,9 @@ def _measured_main():
                   (bench_batched_block_throughput,
                    "enet_sac_env_steps_per_sec_batched_epblock"),
                   (bench_per_episode_dispatch,
-                   "enet_sac_env_steps_per_sec_per_episode_dispatch")]
+                   "enet_sac_env_steps_per_sec_per_episode_dispatch"),
+                  (bench_calib_batched,
+                   "calib_batched_env_steps_per_sec")]
         if os.environ.get("BENCH_SKIP_CALIB"):
             out["extra"].append({"metric": "calib_episode_wall_clock",
                                  "skipped": "BENCH_SKIP_CALIB=1"})

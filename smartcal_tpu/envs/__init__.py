@@ -1,8 +1,8 @@
-from .calib import CalibEnv  # noqa: F401
-from .demixing import DemixingEnv  # noqa: F401
+from .calib import BatchedCalibEnv, CalibEnv  # noqa: F401
+from .demixing import BatchedDemixingEnv, DemixingEnv  # noqa: F401
 from .demixing_fuzzy import FuzzyDemixingEnv  # noqa: F401
 from .enet import EnetConfig, EnetEnv, EnetState  # noqa: F401
 from .enet import get_hint as enet_get_hint  # noqa: F401
 from .enet import reset as enet_reset  # noqa: F401
 from .enet import step as enet_step  # noqa: F401
-from .radio import Episode, RadioBackend  # noqa: F401
+from .radio import BatchedEpisode, Episode, RadioBackend  # noqa: F401

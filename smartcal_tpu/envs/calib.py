@@ -16,6 +16,7 @@ compiled solver serves every K.
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from smartcal_tpu import obs
@@ -210,3 +211,241 @@ class CalibEnv:
         if self._pf_tag is not None:
             self.backend.discard_prefetched(self._pf_tag)
             self._pf_tag = None
+
+
+class BatchedCalibEnv:
+    """``n_envs`` CalibEnv lanes advanced as ONE batched program.
+
+    Lane ``i`` reproduces ``CalibEnv(M, seed=seed + i)`` exactly at the
+    episode level: each lane owns an independent key stream (the same
+    ``split`` chain a sequential env walks), episode construction stays
+    host-side per lane, and everything downstream — the masked ADMM
+    solve, the influence chain, the reward images — runs as one vmapped
+    (or lane-sharded, on a mesh) program over the lane axis
+    (``RadioBackend.calibrate_batched`` and friends).  ``reset``/``step``
+    take and return stacked arrays: actions (E, 2M) in, observations
+    {'img' (E, npix, npix), 'sky' (E, M+1, 7)}, rewards (E,), dones (E,)
+    out.
+
+    Per-lane episode boundaries are MASKED RESETS (``reset_lanes``):
+    a done lane's fresh episode splices into the batch through a donated
+    per-lane update (static shapes — never a recompile), while live
+    lanes keep their state and observation.
+
+    ``fused=False`` is the retained parity oracle (static flag): the
+    same lanes route one by one through the sequential
+    ``RadioBackend.calibrate``/``influence_image`` path and the results
+    are stacked — the oracle the batched program is certified against
+    (tests/test_batched_radio.py, tools/certify_batched.py --mode calib).
+    """
+
+    def __init__(self, M=5, n_envs=4, provide_hint=False,
+                 backend: Optional[radio.RadioBackend] = None, seed=0,
+                 fixed_K: Optional[int] = None, baseline_reward=False,
+                 fused=True):
+        self.M = M
+        self.n_envs = int(n_envs)
+        self.provide_hint = provide_hint
+        self.backend = backend or radio.RadioBackend()
+        if fixed_K is not None and not 2 <= fixed_K <= M:
+            raise ValueError(f"fixed_K={fixed_K} outside [2, M={M}]")
+        self.fixed_K = fixed_K
+        self.baseline_reward = baseline_reward
+        self.fused = fused
+        E = self.n_envs
+        # per-lane key streams: lane i walks CalibEnv(seed=seed+i)'s chain
+        self._keys = [jax.random.PRNGKey(seed + i) for i in range(E)]
+        self.K = np.zeros(E, np.int32)
+        self.rho_spectral = np.ones((E, M), np.float32)
+        self.rho_spatial = np.ones((E, M), np.float32)
+        self.sky = np.zeros((E, M + 1, 7), np.float32)
+        self.hint = None
+        self._sigma_data_img = np.ones(E, np.float32)
+        self._reward0 = np.zeros(E, np.float32)
+        # per-lane counters (checkpointed: runtime --resume bit-parity)
+        self.lane_episode = np.zeros(E, np.int64)
+        self.lane_step = np.zeros(E, np.int64)
+        self.eps = [None] * E
+        self.mdls = [None] * E
+        self.bep = None
+        self._last_obs = None
+
+    @property
+    def n_actions(self):
+        return 2 * self.M
+
+    def _next_lane_key(self, i):
+        self._keys[i], k = jax.random.split(self._keys[i])
+        return k
+
+    def _build_episode(self, key):
+        rng = radio.observation.host_rng(key, salt=21)
+        K = int(rng.integers(2, self.M + 1))      # draw ALWAYS happens
+        if self.fixed_K is not None:
+            K = self.fixed_K
+        ep, mdl = self.backend.new_calib_episode(key, K, self.M)
+        return K, ep, mdl
+
+    # -- batched calibrate + reward inputs -----------------------------------
+
+    def _lane_rho_mask(self):
+        E, M = self.n_envs, self.M
+        sel = np.arange(M)[None, :] < self.K[:, None]      # (E, M) live dirs
+        mask = sel.astype(np.float32)
+        rho = np.where(sel, self.rho_spectral, 1.0).astype(np.float32)
+        alpha = np.where(sel, self.rho_spatial, 0.0).astype(np.float32)
+        return rho, mask, alpha
+
+    def _run_calibration(self):
+        rho, mask, alpha = self._lane_rho_mask()
+        if self.fused:
+            res = self.backend.calibrate_batched(self.bep, rho, mask=mask)
+            imgs = np.asarray(self.backend.influence_images_batched(
+                self.bep, res, rho, alpha))
+            sig_data, sig_res = self.backend.image_sigmas_batched(
+                self.bep, res)
+            return (res, imgs, np.asarray(sig_data), np.asarray(sig_res),
+                    np.asarray(res.sigma_res))
+        # sequential parity oracle: per-lane routes, stacked
+        imgs, sig_d, sig_r, sig_res = [], [], [], []
+        for i in range(self.n_envs):
+            r = self.backend.calibrate(self.eps[i], rho[i], mask=mask[i])
+            imgs.append(np.asarray(self.backend.influence_image(
+                self.eps[i], r, rho[i], alpha[i])))
+            sig_d.append(float(np.std(np.asarray(
+                self.backend.data_image(self.eps[i])))))
+            sig_r.append(float(np.std(np.asarray(
+                self.backend.residual_image(self.eps[i], r)))))
+            sig_res.append(float(r.sigma_res))
+        return (None, np.stack(imgs), np.asarray(sig_d, np.float32),
+                np.asarray(sig_r, np.float32),
+                np.asarray(sig_res, np.float32))
+
+    def _observation(self, imgs):
+        sel = np.arange(self.M)[None, :] < self.K[:, None]
+        self.sky[:, :-1, 5] = np.where(sel, _to_unit(self.rho_spectral),
+                                       self.sky[:, :-1, 5])
+        self.sky[:, :-1, 6] = np.where(sel, _to_unit(self.rho_spatial),
+                                       self.sky[:, :-1, 6])
+        return {"img": imgs * INF_SCALE, "sky": self.sky * META_SCALE}
+
+    def reset(self):
+        """Reset ALL lanes (the start-of-vector-episode form)."""
+        return self.reset_lanes(np.ones(self.n_envs, bool))
+
+    def reset_lanes(self, done):
+        """Masked reset: rebuild only the lanes where ``done`` is True
+        (host construction + donated splice), then run the batched
+        reset-time calibration; live lanes keep their current
+        observation/baselines."""
+        done = np.asarray(done, bool)
+        with obs.span("episode_reset", env="calib_batched",
+                      lanes=int(done.sum())):
+            return self._reset_lanes(done)
+
+    def _reset_lanes(self, done):
+        for i in np.where(done)[0]:
+            key = self._next_lane_key(i)
+            self.K[i], self.eps[i], self.mdls[i] = self._build_episode(key)
+            self.lane_episode[i] += 1
+            self.lane_step[i] = 0
+            mdl = self.mdls[i]
+            self.rho_spectral[i] = 1.0
+            self.rho_spatial[i] = 1.0
+            self.rho_spectral[i, :self.K[i]] = mdl.rho
+            self.rho_spatial[i, :self.K[i]] = mdl.rho_spatial
+            freqs = np.asarray(self.eps[i].obs.freqs)
+            self.sky[i] = 0.0
+            self.sky[i, :self.K[i], :5] = mdl.sky_table
+            self.sky[i, -1, :5] = [self.eps[i].obs.ra0,
+                                   self.eps[i].obs.dec0, self.K[i],
+                                   freqs[0] / 1e9, freqs[-1] / 1e9]
+            if self.bep is not None:
+                self.bep = self.backend.splice_episode(self.bep, int(i),
+                                                       self.eps[i])
+        if self.bep is None:
+            self.bep = self.backend.stack_episodes(self.eps)
+
+        _, imgs, sig_data, sig_res_img, _ = self._run_calibration()
+        self._sigma_data_img[done] = sig_data[done]
+        self._reward0[done] = 0.0
+        if self.baseline_reward:
+            r0 = (sig_data / np.maximum(sig_res_img, 1e-12)
+                  + 1e-4 / (imgs.std(axis=(1, 2)) + EPS))
+            self._reward0[done] = r0[done]
+        if self.provide_hint:
+            if self.hint is None:
+                self.hint = np.zeros((self.n_envs, 2 * self.M), np.float32)
+            # only the RESET lanes re-derive their hint (the analytic
+            # reset-time rho); live lanes keep the hint of their own
+            # episode — their rho_spectral has moved with the steps
+            for i in np.where(done)[0]:
+                Ki = self.K[i]
+                self.hint[i] = 0.0
+                self.hint[i, :Ki] = _to_unit(self.rho_spectral[i, :Ki])
+                self.hint[i, self.M:self.M + Ki] = _to_unit(
+                    0.05 * self.rho_spectral[i, :Ki])
+        new_obs = self._observation(imgs)
+        if self._last_obs is not None:
+            # live lanes keep their pre-reset observation
+            keep = ~done
+            for k in new_obs:
+                new_obs[k][keep] = self._last_obs[k][keep]
+        self._last_obs = new_obs
+        return new_obs
+
+    def step(self, actions):
+        actions = np.asarray(actions, np.float32).reshape(
+            self.n_envs, 2 * self.M)
+        rho = actions * (HIGH - LOW) / 2 + (HIGH + LOW) / 2
+        sel = np.arange(self.M)[None, :] < self.K[:, None]
+        self.rho_spectral = np.where(sel, rho[:, :self.M],
+                                     self.rho_spectral)
+        self.rho_spatial = np.where(sel, rho[:, self.M:],
+                                    self.rho_spatial)
+        penalty = np.zeros(self.n_envs, np.float32)
+        for arr in (self.rho_spectral, self.rho_spatial):
+            penalty += -0.1 * np.sum(sel & (arr < LOW), axis=1)
+            penalty += -0.1 * np.sum(sel & (arr > HIGH), axis=1)
+            np.clip(arr, LOW, HIGH, out=arr)
+
+        with obs.span("episode_step", env="calib_batched",
+                      lanes=self.n_envs):
+            _, imgs, _, sig_res_img, sigma_res = self._run_calibration()
+            rewards = (self._sigma_data_img
+                       / np.maximum(sig_res_img, 1e-12)
+                       + 1e-4 / (imgs.std(axis=(1, 2)) + EPS) + penalty
+                       - self._reward0).astype(np.float32)
+        self.lane_step += 1
+        observation = self._observation(imgs)
+        self._last_obs = observation
+        dones = np.zeros(self.n_envs, bool)
+        infos = {"sigma_res": sigma_res}
+        if self.provide_hint:
+            return observation, rewards, dones, self.hint, infos
+        return observation, rewards, dones, infos
+
+    # -- checkpoint round-trip (runtime --resume bit-parity) -----------------
+
+    def state_dict(self):
+        """Host payload of everything a resumed run needs to continue the
+        lane streams bit-continuably: the per-lane key ARRAY and the
+        per-lane episode/step counters (episodes themselves are a pure
+        function of the keys and are rebuilt by the next reset)."""
+        return {
+            "kind": "batched_calib_env",
+            "keys": np.stack([np.asarray(k) for k in self._keys]),
+            "lane_episode": self.lane_episode.copy(),
+            "lane_step": self.lane_step.copy(),
+        }
+
+    def load_state_dict(self, state):
+        keys = np.asarray(state["keys"])
+        assert keys.shape[0] == self.n_envs, \
+            f"checkpoint has {keys.shape[0]} lanes, env has {self.n_envs}"
+        self._keys = [jnp.asarray(k) for k in keys]
+        self.lane_episode = np.asarray(state["lane_episode"]).copy()
+        self.lane_step = np.asarray(state["lane_step"]).copy()
+
+    def close(self):
+        pass

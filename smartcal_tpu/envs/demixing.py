@@ -18,6 +18,7 @@ batched masked solve here (radio.RadioBackend.hint_sweep).
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from smartcal_tpu import obs
@@ -233,3 +234,200 @@ class DemixingEnv:
         if self._pf_tag is not None:
             self.backend.discard_prefetched(self._pf_tag)
             self._pf_tag = None
+
+
+class BatchedDemixingEnv:
+    """``n_envs`` DemixingEnv lanes advanced as ONE batched program.
+
+    Lane ``i`` reproduces ``DemixingEnv(K, seed=seed + i)`` at the
+    episode level (independent per-lane key streams; host-side episode
+    construction; batched masked solve + reward statistics downstream).
+    The per-lane max-ADMM-iterations action rides as a traced (E,)
+    argument of the one batched solve — no recompile across maxiter
+    draws, exactly like the sequential path's traced ``admm_iters``.
+
+    ``fused=False`` keeps the sequential per-lane route as the parity
+    oracle (same flag discipline as BatchedCalibEnv).  The exhaustive
+    hint sweep stays a per-lane call (it is already a batched masked
+    solve internally — ``RadioBackend.hint_sweep``); ``provide_hint``
+    is therefore not vectorized here and raises.
+    """
+
+    def __init__(self, K=6, n_envs=4, provide_influence=False,
+                 backend: Optional[radio.RadioBackend] = None, seed=0,
+                 fused=True):
+        self.K = K
+        self.n_envs = int(n_envs)
+        self.provide_influence = provide_influence
+        self.backend = backend or radio.RadioBackend(admm_iters=30)
+        self.fused = fused
+        self.npix = self.backend.npix
+        E = self.n_envs
+        self._keys = [jax.random.PRNGKey(seed + i) for i in range(E)]
+        self.metadata = np.zeros((E, 3 * K + 2), np.float32)
+        self.elevation = [None] * E
+        self.rho = np.ones((E, K), np.float32)
+        self.maxiter = np.full(E, 10, np.int32)
+        self.std_data = np.ones(E, np.float32)
+        self.std_residual = np.ones(E, np.float32)
+        self.reward0 = np.zeros(E, np.float32)
+        self.lane_episode = np.zeros(E, np.int64)
+        self.lane_step = np.zeros(E, np.int64)
+        self.eps = [None] * E
+        self.mdls = [None] * E
+        self.bep = None
+        self._last_obs = None
+
+    @property
+    def n_actions(self):
+        return self.K
+
+    def _next_lane_key(self, i):
+        self._keys[i], k = jax.random.split(self._keys[i])
+        return k
+
+    def _masks(self, sel_rows):
+        """(E, K) masks from per-lane selected-outlier index lists (the
+        target, lane-wise the LAST direction, is always selected)."""
+        m = np.zeros((self.n_envs, self.K), np.float32)
+        for i, sel in enumerate(sel_rows):
+            m[i, sel] = 1.0
+            m[i, self.K - 1] = 1.0
+        return m
+
+    def _calibrate(self, masks):
+        if self.fused:
+            res = self.backend.calibrate_batched(
+                self.bep, self.rho, mask=masks, admm_iters=self.maxiter)
+            # np.array (not asarray): jax buffers surface read-only and
+            # callers assign into the returned statistics in place
+            sig = np.array(self.backend.noise_std_batched(res.residual))
+            return res, sig
+        sigs, residuals = [], []
+        for i in range(self.n_envs):
+            r = self.backend.calibrate(self.eps[i], self.rho[i],
+                                       mask=masks[i],
+                                       admm_iters=int(self.maxiter[i]))
+            residuals.append(r)
+            sigs.append(float(self.backend.noise_std(r.residual)))
+        return residuals, np.asarray(sigs, np.float32)
+
+    def _influence_maps(self, res, masks):
+        if not self.provide_influence:
+            return np.zeros((self.n_envs, self.npix, self.npix),
+                            np.float32)
+        alpha = np.zeros((self.n_envs, self.K), np.float32)
+        rho_eff = self.rho * masks + (1 - masks)
+        if self.fused:
+            return np.asarray(self.backend.influence_images_batched(
+                self.bep, res, rho_eff, alpha))
+        return np.stack([np.asarray(self.backend.influence_image(
+            self.eps[i], res[i], rho_eff[i], alpha[i]))
+            for i in range(self.n_envs)])
+
+    def calculate_rewards(self, Kselected):
+        """Vectorized ``DemixingEnv.calculate_reward_`` over lanes."""
+        data_var = self.std_data ** 2
+        noise_var = self.std_residual ** 2
+        N = self.backend.n_stations
+        reward = (-N * N * noise_var / (data_var + EPS)
+                  - np.asarray(Kselected) * N)
+        reward = (reward - REWARD_MEAN) / REWARD_STD
+        return (reward - self.maxiter / 100.0).astype(np.float32)
+
+    def reset(self):
+        return self.reset_lanes(np.ones(self.n_envs, bool))
+
+    def reset_lanes(self, done):
+        done = np.asarray(done, bool)
+        with obs.span("episode_reset", env="demix_batched",
+                      lanes=int(done.sum())):
+            return self._reset_lanes(done)
+
+    def _reset_lanes(self, done):
+        for i in np.where(done)[0]:
+            key = self._next_lane_key(i)
+            self.eps[i], self.mdls[i] = \
+                self.backend.new_demixing_episode(key, self.K)
+            self.lane_episode[i] += 1
+            self.lane_step[i] = 0
+            mdl = self.mdls[i]
+            self.elevation[i] = mdl.elevation
+            self.rho[i] = mdl.rho.astype(np.float32)
+            self.maxiter[i] = 10
+            freqs = np.asarray(self.eps[i].obs.freqs)
+            md = np.zeros(3 * self.K + 2, np.float32)
+            md[:self.K] = mdl.separations
+            md[self.K:2 * self.K] = mdl.azimuth
+            md[2 * self.K:3 * self.K] = mdl.elevation
+            md[-2] = np.log(freqs[0] / 1e6)
+            md[-1] = self.backend.n_stations
+            self.metadata[i] = md
+            if self.bep is not None:
+                self.bep = self.backend.splice_episode(self.bep, int(i),
+                                                       self.eps[i])
+        if self.bep is None:
+            self.bep = self.backend.stack_episodes(self.eps)
+
+        masks = self._masks([[] for _ in range(self.n_envs)])
+        res, sig = self._calibrate(masks)
+        self.std_data[done] = np.asarray(
+            self.backend.noise_std_batched(self.bep.V))[done]
+        self.std_residual[done] = sig[done]
+        self.reward0[done] = self.calculate_rewards(
+            np.ones(self.n_envs))[done]
+        infmaps = self._influence_maps(res, masks)
+        new_obs = {"infmap": infmaps * INF_SCALE,
+                   "metadata": self.metadata * META_SCALE}
+        if self._last_obs is not None:
+            keep = ~done
+            for k in new_obs:
+                new_obs[k][keep] = self._last_obs[k][keep]
+        self._last_obs = new_obs
+        return new_obs
+
+    def step(self, actions):
+        actions = np.asarray(actions, np.float32).reshape(
+            self.n_envs, self.K)
+        sel = actions[:, :self.K - 1] * (HIGH - LOW) / 2 \
+            + (HIGH + LOW) / 2
+        self.maxiter = (actions[:, self.K - 1]
+                        * (HIGH_ITER - LOW_ITER) / 2
+                        + (HIGH_ITER + LOW_ITER) / 2).astype(np.int32)
+        sel_rows = [np.where(s > 0.5)[0].tolist() for s in sel]
+        masks = self._masks(sel_rows)
+        Kselected = masks.sum(axis=1)
+
+        with obs.span("episode_step", env="demix_batched",
+                      lanes=self.n_envs):
+            res, self.std_residual = self._calibrate(masks)
+            infmaps = self._influence_maps(res, masks)
+        self.lane_step += 1
+        md = self.metadata.copy()
+        md[:, :self.K][masks > 0] = 0.0   # separations of calibrated dirs
+        observation = {"infmap": infmaps * INF_SCALE,
+                       "metadata": md * META_SCALE}
+        self._last_obs = observation
+        rewards = self.calculate_rewards(Kselected) - self.reward0
+        dones = np.zeros(self.n_envs, bool)
+        infos = {"sigma_res": self.std_residual.copy()}
+        return observation, rewards, dones, infos
+
+    def state_dict(self):
+        return {
+            "kind": "batched_demix_env",
+            "keys": np.stack([np.asarray(k) for k in self._keys]),
+            "lane_episode": self.lane_episode.copy(),
+            "lane_step": self.lane_step.copy(),
+        }
+
+    def load_state_dict(self, state):
+        keys = np.asarray(state["keys"])
+        assert keys.shape[0] == self.n_envs, \
+            f"checkpoint has {keys.shape[0]} lanes, env has {self.n_envs}"
+        self._keys = [jnp.asarray(k) for k in keys]
+        self.lane_episode = np.asarray(state["lane_episode"]).copy()
+        self.lane_step = np.asarray(state["lane_step"]).copy()
+
+    def close(self):
+        pass

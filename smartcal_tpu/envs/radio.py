@@ -72,6 +72,42 @@ class Episode(NamedTuple):
     snr: float
 
 
+class BatchedEpisode(NamedTuple):
+    """B stacked episodes: the per-lane arrays of :class:`Episode` with a
+    leading lane axis, the operand form of the batched (vmapped/sharded)
+    calibrate -> influence chain.
+
+    Construction stays host-side per lane (the sky draws are variable-
+    length numpy), so stacking is the batching boundary: everything
+    downstream of ``stack_episodes`` is one keyed, static-shape program
+    over the lane axis.  ``V``/``Ccal`` are device arrays (the big
+    operands; lane replacement on masked resets goes through a DONATED
+    splice so the batch buffer is reused in place on accelerators);
+    the small per-lane scalars stay host numpy.
+    """
+
+    V: jnp.ndarray          # (E, Nf, T, B, 2, 2, 2)
+    Ccal: jnp.ndarray       # (E, Nf, K, T*B, 4, 2)
+    freqs: np.ndarray       # (E, Nf) Hz
+    f0: np.ndarray          # (E,)
+    uvw: np.ndarray         # (E, T*B, 3) meters
+    cell: np.ndarray        # (E,) imaging pixel size (rad)
+    n_dirs: int             # static K/M (equal across lanes)
+
+    @property
+    def n_envs(self) -> int:
+        return self.V.shape[0]
+
+
+# donated per-lane splice for masked resets: lane i's fresh episode
+# overwrites its slot of the batched buffer IN PLACE on accelerators
+# (donation is a no-op on CPU) — one compiled program per array shape,
+# reused for every lane index and reset count (the index is traced), so
+# per-lane episode boundaries never recompile the batch.
+_lane_splice = jax.jit(lambda full, new, lane: full.at[lane].set(new),
+                       donate_argnums=(0,))
+
+
 class RadioBackend:
     """Hermetic observation + calibration service for the envs.
 
@@ -128,6 +164,7 @@ class RadioBackend:
         self.solver_max_retries = solver_max_retries
         self.solver_rho_boost = solver_rho_boost
         self._sweep_fns = {}     # (n_dirs, n_masks, batch) -> jitted sweep
+        self._batched_fns = {}   # (kind, shape sig) -> jitted batched prog
         self._meshes = {}        # axis size -> cached 1D mesh
         # double-buffer worker (run_pipelined / env prefetch)
         self._prefetch_lock = threading.Lock()
@@ -758,3 +795,214 @@ class RadioBackend:
         (demixingenv.py:233-252) over MS columns."""
         stds = jax.vmap(solver.stokes_i_std)(V)
         return jnp.sqrt(jnp.mean(stds ** 2))
+
+    # -- batched-episode mode ------------------------------------------------
+    #
+    # PR 1/5 made the whole simulate -> ADMM -> influence chain a pure,
+    # keyed, static-shape, matmul-only function — exactly the shape vmap
+    # wants.  The methods below run B independent episodes as ONE batched
+    # program over a leading lane axis: a vmapped fused solve on a single
+    # device, or a shard_map over the lane axis when a mesh divides the
+    # batch (each lane keeps its full frequency axis locally, so no
+    # collective crosses an episode boundary; the 2D batch x frequency
+    # mesh form lives in parallel/sharded_cal.solve_admm_sharded2d).
+    # The per-lane sequential methods above REMAIN the parity oracle —
+    # the batched envs route through them under ``fused=False``.
+
+    def stack_episodes(self, eps) -> BatchedEpisode:
+        """Stack per-lane :class:`Episode`s into one :class:`BatchedEpisode`
+        (the batching boundary — see BatchedEpisode docstring)."""
+        from smartcal_tpu.cal import imager
+
+        n_dirs = eps[0].n_dirs
+        assert all(e.n_dirs == n_dirs for e in eps), \
+            "batched lanes must share a (padded) direction count"
+        freqs = np.stack([np.asarray(e.obs.freqs) for e in eps])
+        return BatchedEpisode(
+            V=jnp.stack([e.V for e in eps]),
+            Ccal=jnp.stack([e.Ccal for e in eps]),
+            freqs=freqs,
+            f0=np.asarray([e.f0 for e in eps], np.float32),
+            uvw=np.stack([np.asarray(e.obs.uvw).reshape(-1, 3)
+                          for e in eps]),
+            cell=np.asarray([imager.default_cell(e.obs.uvw,
+                                                 float(freqs[i][-1]))
+                             for i, e in enumerate(eps)], np.float32),
+            n_dirs=n_dirs)
+
+    def splice_episode(self, bep: BatchedEpisode, lane: int,
+                       ep: Episode) -> BatchedEpisode:
+        """Replace lane ``lane`` of ``bep`` with a fresh episode (masked
+        reset): the V/Ccal batch buffers update through the DONATED
+        ``_lane_splice`` (in-place on accelerators, no recompile — the
+        lane index is traced), the small host fields through numpy."""
+        from smartcal_tpu.cal import imager
+
+        assert ep.n_dirs == bep.n_dirs
+        freqs = np.asarray(ep.obs.freqs)
+        f0 = bep.f0.copy()
+        f0[lane] = ep.f0
+        freqs_b = bep.freqs.copy()
+        freqs_b[lane] = freqs
+        uvw = bep.uvw.copy()
+        uvw[lane] = np.asarray(ep.obs.uvw).reshape(-1, 3)
+        cell = bep.cell.copy()
+        cell[lane] = imager.default_cell(ep.obs.uvw, float(freqs[-1]))
+        lane_ = jnp.asarray(lane, jnp.int32)
+        return bep._replace(
+            V=_lane_splice(bep.V, ep.V, lane_),
+            Ccal=_lane_splice(bep.Ccal, ep.Ccal, lane_),
+            freqs=freqs_b, f0=f0, uvw=uvw, cell=cell)
+
+    def _batch_shard_size(self, n_lanes):
+        """Lane-axis mesh size for the batched routes (0 = run the plain
+        vmap): same policy as the per-episode ``_shard_size`` — the work
+        gate uses the whole BATCH's calibration units, since that is the
+        one fused program's size."""
+        return self._shard_size(n_lanes, self._fused_work() * n_lanes)
+
+    def _batched_solve_fn(self, n_dirs, n_lanes, nbp):
+        key = ("solve", n_dirs, n_lanes, nbp)
+        fn = self._batched_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg = self._solver_cfg(n_dirs)
+        n_chunks = self.n_chunks
+
+        def one(v, c, f, f0_, r, m, it):
+            cm = c * m[None, :, None, None, None]
+            return solver.solve_admm(v, cm, f, f0_, r, cfg,
+                                     n_chunks=n_chunks, admm_iters=it)
+
+        if nbp:
+            from jax.sharding import PartitionSpec as P
+
+            from smartcal_tpu.parallel import sharded_cal
+
+            mesh = self._mesh(nbp)
+            ax = "fp"  # the backend's generic 1D mesh axis name
+            out_specs = solver.SolveResult(
+                J=P(ax), Z=P(ax), residual=P(ax), sigma_res=P(ax),
+                sigma_data=P(ax), final_cost=P(ax), stats=None)
+            inner = sharded_cal.shard_map(
+                jax.vmap(one), mesh=mesh, in_specs=(P(ax),) * 7,
+                out_specs=out_specs)
+            fn = jax.jit(inner)
+        else:
+            fn = jax.jit(jax.vmap(one))
+        self._batched_fns[key] = fn
+        return fn
+
+    def calibrate_batched(self, bep: BatchedEpisode, rho, mask=None,
+                          admm_iters=None) -> solver.SolveResult:
+        """Batched :meth:`calibrate`: B lanes' masked ADMM solves as ONE
+        program.  ``rho`` (E, K) per-lane regularization; ``mask``
+        (E, K) in {0, 1} (None = all directions); ``admm_iters`` a
+        scalar, an (E,) per-lane iteration count (the demixing action's
+        maxiter), or None for the constructor default.  Every per-lane
+        value is a traced argument, so one compile serves every episode
+        batch of this shape.  Solver stats are not collected on this
+        route (the batched program's output tree stays the fused-solve
+        shape, same rule as the traced hint sweep)."""
+        E = int(bep.V.shape[0])
+        rho = jnp.asarray(rho, jnp.float32).reshape(E, bep.n_dirs)
+        masks = (jnp.ones((E, bep.n_dirs), jnp.float32) if mask is None
+                 else jnp.asarray(mask, jnp.float32).reshape(E, bep.n_dirs))
+        if admm_iters is None:
+            iters = jnp.full((E,), self.admm_iters, jnp.int32)
+        else:
+            iters = jnp.broadcast_to(
+                jnp.asarray(admm_iters, jnp.int32).reshape(-1), (E,))
+        nbp = self._batch_shard_size(E)
+        route = "batched_sharded" if nbp else "batched_vmap"
+        fn = self._batched_solve_fn(bep.n_dirs, E, nbp)
+        with obs.span("solve", route=route, lanes=E,
+                      **({"shards": nbp} if nbp else {})):
+            obs.gauge_set("batched_lanes", E)
+            return fn(bep.V, bep.Ccal, jnp.asarray(bep.freqs),
+                      jnp.asarray(bep.f0, jnp.float32), rho, masks, iters)
+
+    def _batched_influence_fn(self, n_dirs, n_lanes, npix):
+        key = ("influence", n_dirs, n_lanes, npix)
+        fn = self._batched_fns.get(key)
+        if fn is not None:
+            return fn
+        n_stations, n_chunks = self.n_stations, self.n_chunks
+        n_poly, polytype = self.n_poly, self.polytype
+
+        def one(res, c, j, r, a, f, f0_, u, cl):
+            hadd = influence.consensus_hadd_all(
+                r, a, f, f0_, n_poly=n_poly, polytype=polytype)
+            imgs = influence.influence_images_multi(
+                res, c, j, hadd, f, u, cl, n_stations, n_chunks, npix)
+            return jnp.mean(imgs, axis=0)
+
+        fn = jax.jit(jax.vmap(one))
+        self._batched_fns[key] = fn
+        return fn
+
+    def influence_images_batched(self, bep: BatchedEpisode,
+                                 result: solver.SolveResult, rho,
+                                 rho_spatial, npix=None):
+        """Batched :meth:`influence_image`: (E, npix, npix) mean influence
+        dirty images, the whole formulation-optimized chain (scatter-free
+        Hessian, adjoint 4-RHS transpose solve, rank-factored DFT imager
+        — matmul-only, so it vmaps/shards cleanly) over the lane axis in
+        one dispatch.  ``rho``/``rho_spatial`` are (E, K) per lane."""
+        E = int(bep.V.shape[0])
+        npix = npix or self.npix
+        rho = jnp.asarray(rho, jnp.float32).reshape(E, bep.n_dirs)
+        alpha = jnp.asarray(rho_spatial, jnp.float32).reshape(E, bep.n_dirs)
+        fn = self._batched_influence_fn(bep.n_dirs, E, npix)
+        with obs.span("influence") as sp:
+            sp.tag(route="batched_vmap", lanes=E)
+            return fn(result.residual, bep.Ccal, result.J, rho, alpha,
+                      jnp.asarray(bep.freqs),
+                      jnp.asarray(bep.f0, jnp.float32),
+                      jnp.asarray(bep.uvw), jnp.asarray(bep.cell))
+
+    def _batched_sigma_fn(self, n_lanes, npix):
+        key = ("sigmas", n_lanes, npix)
+        fn = self._batched_fns.get(key)
+        if fn is not None:
+            return fn
+        from smartcal_tpu.cal import imager
+
+        def one(v, res, f, u, cl):
+            def img_std(x):
+                imgs = jax.vmap(lambda vv, ff: imager.dirty_image_factored_sr(
+                    u, imager.stokes_i_vis(vv), ff, cl, npix=npix))(x, f)
+                return jnp.std(jnp.mean(imgs, axis=0))
+
+            return img_std(v), img_std(res)
+
+        fn = jax.jit(jax.vmap(one))
+        self._batched_fns[key] = fn
+        return fn
+
+    def image_sigmas_batched(self, bep: BatchedEpisode,
+                             result: solver.SolveResult, npix=None):
+        """Per-lane (sigma_data_img, sigma_res_img) — the std of the
+        multi-frequency data and residual dirty images (the CalibEnv
+        reward inputs) for all lanes in one dispatch.  Uses the
+        rank-factored DFT imager (same math as the oracle's XLA imager
+        to float round-off; matmul-only, so it batches)."""
+        npix = npix or self.npix
+        fn = self._batched_sigma_fn(int(bep.V.shape[0]), npix)
+        with obs.span("reward", route="batched_vmap"):
+            return fn(bep.V, result.residual, jnp.asarray(bep.freqs),
+                      jnp.asarray(bep.uvw), jnp.asarray(bep.cell))
+
+    def noise_std_batched(self, V):
+        """Per-lane :meth:`noise_std` over a (E, Nf, ...) batch in one
+        dispatch."""
+        key = ("noise_std",)
+        fn = self._batched_fns.get(key)
+        if fn is None:
+            def one(v):
+                stds = jax.vmap(solver.stokes_i_std)(v)
+                return jnp.sqrt(jnp.mean(stds ** 2))
+
+            fn = jax.jit(jax.vmap(one))
+            self._batched_fns[key] = fn
+        return fn(V)

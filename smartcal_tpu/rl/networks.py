@@ -308,6 +308,23 @@ def flatten_obs(obs_dict, img_key=None, meta_key=None):
                            np.asarray(obs_dict[meta_key]).ravel()])
 
 
+def flatten_obs_batch(obs_dict, img_key=None, meta_key=None):
+    """Batched :func:`flatten_obs`: dict of (E, ...) stacked observations
+    -> (E, obs_dim) flat matrix (the batched radio envs' form; row e is
+    exactly ``flatten_obs`` of lane e)."""
+    import numpy as np
+
+    if img_key is None:
+        img_key = "img" if "img" in obs_dict else "infmap"
+    if meta_key is None:
+        meta_key = "sky" if "sky" in obs_dict else "metadata"
+    img = np.asarray(obs_dict[img_key])
+    meta = np.asarray(obs_dict[meta_key])
+    E = img.shape[0]
+    return np.concatenate([img.reshape(E, -1), meta.reshape(E, -1)],
+                          axis=1)
+
+
 def gaussian_sample(mu, logsigma, key):
     """Tanh-squashed reparameterised sample + log-prob.
 

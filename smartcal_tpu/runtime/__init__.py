@@ -34,7 +34,8 @@ from .atomic import (atomic_pickle, atomic_write_bytes,      # noqa: F401
                      atomic_write_text, safe_pickle_load)
 from .backoff import Backoff, BackoffPolicy                  # noqa: F401
 from .checkpoint import (Checkpointer, load_latest,          # noqa: F401
-                         pack_replay, save_checkpoint, unpack_replay)
+                         pack_env_state, pack_replay, restore_env_state,
+                         save_checkpoint, unpack_replay)
 from .faults import (FaultInjected, FaultPlan,               # noqa: F401
                      clear as clear_faults, install as install_faults,
                      plan_from_env)

@@ -205,6 +205,50 @@ def unpack_replay(obj: dict):
     raise ValueError(f"unknown replay payload kind {kind!r}")
 
 
+# ---------------------------------------------------------------------------
+# Env-state payload forms (sequential key chain + batched lane state)
+# ---------------------------------------------------------------------------
+
+def pack_env_state(env) -> Optional[dict]:
+    """Uniform host form of an env's RNG/episode state for the checkpoint
+    payload.
+
+    Batched envs (``BatchedCalibEnv``/``BatchedDemixingEnv``) carry a
+    per-lane key ARRAY plus per-lane episode/step counters and expose
+    them through ``state_dict()`` — the sequential single-key form
+    (``env._key``) cannot represent them, which is why a batched
+    ``--resume`` needs this hook to keep the same-seed bit-parity
+    guarantee.  Sequential envs fall back to the single-key form;
+    stateless envs return None."""
+    import jax
+
+    if hasattr(env, "state_dict"):
+        return {"kind": "env_state_dict", "state": env.state_dict()}
+    if hasattr(env, "_key"):
+        return {"kind": "env_key", "key": jax.device_get(env._key)}
+    return None
+
+
+def restore_env_state(env, obj: Optional[dict]) -> None:
+    """Inverse of :func:`pack_env_state`: no-op on None, but a payload
+    whose kind does not match the env (e.g. a batched checkpoint resumed
+    into a sequential run, or vice versa) raises ValueError — silently
+    continuing with the wrong RNG state would void the same-seed
+    bit-parity guarantee the checkpoint exists to keep."""
+    import jax.numpy as jnp
+
+    if obj is None or env is None:
+        return
+    kind = obj.get("kind")
+    if kind == "env_state_dict" and hasattr(env, "load_state_dict"):
+        env.load_state_dict(obj["state"])
+    elif kind == "env_key" and hasattr(env, "_key"):
+        env._key = jnp.asarray(obj["key"])
+    else:
+        raise ValueError(
+            f"env payload kind {kind!r} does not match env {type(env)!r}")
+
+
 class Checkpointer:
     """Bound (root, keep) pair with cadence bookkeeping for a run."""
 

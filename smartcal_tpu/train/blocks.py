@@ -443,10 +443,14 @@ def rollback_fused(act, rebuild=None):
 def pack_agent_loop(agent, env, scores, episode, extra=None) -> dict:
     """Host payload capturing EVERYTHING a host-driven agent loop needs
     to restart bit-continuably: agent pytree (params + opt + targets +
-    alpha/rho counters), the agent's jax key stream, the replay buffer
-    (incl. PER priorities, both backends), the env's episode key stream,
-    the native sampler's numpy RNG, scores, and the episode counter."""
-    from smartcal_tpu.runtime import pack_replay
+    alpha/rho counters + per-lane exploration state like DDPG's OU
+    noise, all inside ``agent.state``), the agent's jax key stream, the
+    replay buffer (incl. PER priorities, both backends), the env's
+    episode RNG state — the single key chain for sequential envs, the
+    per-lane key ARRAY + episode/step counters for batched envs
+    (runtime.pack_env_state) — the native sampler's numpy RNG, scores,
+    and the episode counter."""
+    from smartcal_tpu.runtime import pack_env_state, pack_replay
 
     payload = {
         "kind": "agent_loop",
@@ -458,8 +462,10 @@ def pack_agent_loop(agent, env, scores, episode, extra=None) -> dict:
     }
     if getattr(agent, "_rng", None) is not None:
         payload["agent_sample_rng"] = agent._rng.bit_generator.state
-    if env is not None and hasattr(env, "_key"):
-        payload["env_key"] = jax.device_get(env._key)
+    if env is not None:
+        env_state = pack_env_state(env)
+        if env_state is not None:
+            payload["env_state"] = env_state
     if extra:
         payload["extra"] = dict(extra)
     return payload
@@ -470,7 +476,7 @@ def restore_agent_loop(agent, env, payload):
     ``agent``/``env`` in place; returns (scores, episode, extra)."""
     import jax.numpy as jnp
 
-    from smartcal_tpu.runtime import unpack_replay
+    from smartcal_tpu.runtime import restore_env_state, unpack_replay
 
     agent.state = jax.tree_util.tree_map(jnp.asarray,
                                          payload["agent_state"])
@@ -479,7 +485,10 @@ def restore_agent_loop(agent, env, payload):
     if "agent_sample_rng" in payload and getattr(agent, "_rng", None) \
             is not None:
         agent._rng.bit_generator.state = payload["agent_sample_rng"]
-    if env is not None and "env_key" in payload and hasattr(env, "_key"):
+    if env is not None and "env_state" in payload:
+        restore_env_state(env, payload["env_state"])
+    elif env is not None and "env_key" in payload and hasattr(env, "_key"):
+        # pre-batched-mode payloads carried the bare key
         env._key = jnp.asarray(payload["env_key"])
     return list(payload["scores"]), int(payload["episode"]), \
         payload.get("extra") or {}
@@ -508,6 +517,127 @@ def apply_agent_recovery(agent, base_cfg, act):
             new._rng = agent._rng
         agent = new
     return agent
+
+
+def add_batched_args(p):
+    """Attach the batched-env flag shared by the radio train drivers."""
+    p.add_argument("--batch-envs", dest="batch_envs", type=int, default=1,
+                   help="run N env lanes as one batched program "
+                        "(vmapped/lane-sharded episode batch; 1 = the "
+                        "sequential reference loop).  Each vector step "
+                        "stores N transitions and runs ONE learn — the "
+                        "1:N learn:env-step regime of the enet batched "
+                        "mode, certified by tools/certify_batched.py")
+    return p
+
+
+def run_batched_agent_loop(env, agent, agent_cfg, args, tob, rt,
+                           scale_reward, use_hint=False, warmup=0,
+                           warmup_rng=None, episodes=None, to_flat=None,
+                           scores=None):
+    """Vector-episode driver loop for the batched radio envs: each vector
+    episode resets all E lanes, each vector step advances all lanes in
+    ONE batched program, stores the E transitions, and runs ONE learn on
+    the fat batch (the 1:E learn:env-step regime of the enet batched
+    mode — certified against the sequential 1:1 loop by
+    tools/certify_batched.py).
+
+    ``scores`` keeps the sequential drivers' format: E per-lane
+    mean-step-reward entries per vector episode, so the learning-curve
+    tooling (summarize/obs_report) reads batched runs unchanged.
+    ``warmup`` vector episodes act randomly (the demixing drivers'
+    warmup phase) through ``warmup_rng``.  Checkpoint/resume and
+    watchdog rollback ride the same TrainRuntime wiring as the
+    sequential loops — payloads carry the per-lane env key array and
+    counters (runtime.pack_env_state), so --resume keeps the same-seed
+    bit-parity guarantee at B>1.
+    """
+    import numpy as np
+
+    from smartcal_tpu.rl.networks import flatten_obs_batch
+    from smartcal_tpu.runtime import atomic_pickle
+
+    if to_flat is None:
+        to_flat = flatten_obs_batch
+    E = env.n_envs
+    if episodes is None:
+        episodes = getattr(args, "episodes", None)
+        if episodes is None:
+            episodes = args.iteration      # the demixing drivers' name
+    n_vec = -(-episodes // E)              # ceil: full lane coverage
+    # --load callers pass their pickled score history in; a checkpoint
+    # restore below replaces it (same precedence as run_warmup_loop)
+    scores = list(scores) if scores else []
+    i = 0
+    restored = rt.restore()
+    if restored is not None:
+        scores, i, extra = restore_agent_loop(agent, env, restored)
+        if warmup_rng is not None and "np_rng" in extra:
+            warmup_rng.bit_generator.state = extra["np_rng"]
+
+    def ckpt_payload():
+        # the warmup numpy RNG rides in extra (as in run_warmup_loop):
+        # a kill/resume inside the warmup window must replay the same
+        # random actions or the bit-parity guarantee breaks at B>1
+        extra = ({"np_rng": warmup_rng.bit_generator.state}
+                 if warmup_rng is not None else None)
+        return pack_agent_loop(agent, env, scores, i, extra=extra)
+
+    try:
+        while i < n_vec:
+            with tob.span("episode", episode=i, lanes=E):
+                ob = env.reset()
+                flat = to_flat(ob)
+                score = np.zeros(E, np.float64)
+                loop, done = 0, False
+                while not done and loop < args.steps:
+                    if i < warmup and warmup_rng is not None:
+                        actions = warmup_rng.uniform(
+                            -1.0, 1.0, (E, agent.cfg.n_actions)).astype(
+                                np.float32)
+                    else:
+                        actions = np.asarray(
+                            agent.choose_action(flat)).reshape(E, -1)
+                    out = env.step(actions)
+                    if use_hint:
+                        ob2, rewards, dones, hints, _ = out
+                    else:
+                        ob2, rewards, dones, _ = out
+                        hints = np.zeros((E, agent.cfg.n_actions),
+                                         np.float32)
+                    flat2 = to_flat(ob2)
+                    for e in range(E):
+                        agent.store_transition(
+                            flat[e], actions[e],
+                            scale_reward(float(rewards[e])), flat2[e],
+                            bool(dones[e]), hints[e])
+                    agent.learn()          # one fat learn per vector step
+                    if tob.record_diag(agent.last_diag, episode=i):
+                        done = True
+                    score += np.asarray(rewards, np.float64)
+                    flat = flat2
+                    loop += 1
+            if tob.tripped:
+                act = rt.on_trip()
+                if act is not None:
+                    scores, i, _ = restore_agent_loop(agent, env,
+                                                      act.payload)
+                    agent = apply_agent_recovery(agent, agent_cfg, act)
+                    continue
+            per_lane = score / max(loop, 1)
+            scores.extend(float(s) for s in per_lane)
+            tob.log_replay_health(agent.buffer, episode=i)
+            tob.episode(i, float(per_lane.mean()), scores,
+                        seed=getattr(args, "seed", None), lanes=E)
+            agent.save_models()
+            atomic_pickle(scores, f"{args.prefix}_scores.pkl")
+            if tob.tripped:
+                break
+            i += 1
+            rt.maybe_checkpoint(i, ckpt_payload)
+    finally:
+        tob.close()
+    return scores
 
 
 def make_block_fn(episode_body, block: int):

@@ -21,8 +21,8 @@ from ..envs import CalibEnv
 from ..envs.radio import RadioBackend
 from ..rl import sac
 from ..rl.networks import flatten_obs
-from .blocks import (add_obs_args, add_runtime_args, diag_from_args,
-                     train_obs_from_args)
+from .blocks import (add_batched_args, add_obs_args, add_runtime_args,
+                     diag_from_args, train_obs_from_args)
 
 
 def main(argv=None):
@@ -55,6 +55,7 @@ def main(argv=None):
                         "pattern; sweep variance reduction)")
     add_obs_args(p)
     add_runtime_args(p)
+    add_batched_args(p)
     args = p.parse_args(argv)
 
     if args.small:
@@ -69,9 +70,18 @@ def main(argv=None):
         backend = make_backend(args)
     else:
         backend = RadioBackend(n_stations=args.stations, npix=args.npix)
-    env = CalibEnv(M=args.M, provide_hint=args.use_hint, backend=backend,
-                   seed=args.seed, fixed_K=args.fixed_K,
-                   baseline_reward=args.baseline_reward)
+    batched = getattr(args, "batch_envs", 1) > 1
+    if batched:
+        from ..envs import BatchedCalibEnv
+        env = BatchedCalibEnv(M=args.M, n_envs=args.batch_envs,
+                              provide_hint=args.use_hint, backend=backend,
+                              seed=args.seed, fixed_K=args.fixed_K,
+                              baseline_reward=args.baseline_reward)
+    else:
+        env = CalibEnv(M=args.M, provide_hint=args.use_hint,
+                       backend=backend, seed=args.seed,
+                       fixed_K=args.fixed_K,
+                       baseline_reward=args.baseline_reward)
     npix = backend.npix
     obs_dim = npix * npix + (args.M + 1) * 7
     agent_cfg = sac.SACConfig(
@@ -93,6 +103,14 @@ def main(argv=None):
     scores = []
     tob = train_obs_from_args(args, "calib_sac")
     rt = TrainRuntime.from_args(args, args.prefix, tob=tob)
+    if batched:
+        # batched-episode mode: E lanes per vector step, one fat learn
+        # per vector step; rewards keep the main_sac.py >1 x10 scaling
+        from .blocks import run_batched_agent_loop
+        return run_batched_agent_loop(
+            env, agent, agent_cfg, args, tob, rt,
+            scale_reward=lambda r: r * 10 if r > 1 else r,
+            use_hint=args.use_hint)
     i = 0
     restored = rt.restore()
     if restored is not None:

@@ -21,7 +21,8 @@ from ..envs import DemixingEnv
 from ..envs.radio import RadioBackend
 from ..rl import sac
 from ..rl.networks import flatten_obs
-from .blocks import (add_obs_args, add_runtime_args, diag_from_args,
+from .blocks import (add_batched_args, add_obs_args, add_runtime_args,
+                     diag_from_args,
                      train_obs_from_args)
 
 
@@ -50,13 +51,25 @@ def main(argv=None):
     p.add_argument("--prefix", type=str, default="demix_sac")
     add_obs_args(p)
     add_runtime_args(p)
+    add_batched_args(p)
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
     backend = make_backend(args)
-    env = DemixingEnv(K=args.K, provide_hint=args.use_hint,
-                      provide_influence=args.provide_influence,
-                      backend=backend, seed=args.seed)
+    batched = getattr(args, "batch_envs", 1) > 1
+    if batched:
+        if args.use_hint:
+            raise SystemExit("--use_hint is not supported with "
+                             "--batch-envs (the exhaustive hint sweep "
+                             "stays per-lane; run it sequentially)")
+        from ..envs import BatchedDemixingEnv
+        env = BatchedDemixingEnv(K=args.K, n_envs=args.batch_envs,
+                                 provide_influence=args.provide_influence,
+                                 backend=backend, seed=args.seed)
+    else:
+        env = DemixingEnv(K=args.K, provide_hint=args.use_hint,
+                          provide_influence=args.provide_influence,
+                          backend=backend, seed=args.seed)
     npix = backend.npix
     # without influence maps the observation is metadata-only: storing the
     # all-zero npix^2 image in replay would waste ~2 GB at mem_size=16000
@@ -84,6 +97,23 @@ def main(argv=None):
     def to_flat(o):
         return (flatten_obs(o) if args.provide_influence
                 else np.asarray(o["metadata"], np.float32))
+
+    if batched:
+        from ..rl.networks import flatten_obs_batch
+        from .blocks import (TrainRuntime, run_batched_agent_loop,
+                             train_obs_from_args)
+
+        def to_flat_b(o):
+            return (flatten_obs_batch(o) if args.provide_influence
+                    else np.asarray(o["metadata"], np.float32))
+
+        tob = train_obs_from_args(args, args.prefix)
+        rt = TrainRuntime.from_args(args, args.prefix, tob=tob)
+        return run_batched_agent_loop(
+            env, agent, agent_cfg, args, tob, rt,
+            scale_reward=lambda r: r * 10 if r > 0 else r,
+            warmup=-(-args.warmup // args.batch_envs), warmup_rng=rng,
+            episodes=args.iteration, to_flat=to_flat_b, scores=scores)
 
     # rewards > 0 scaled by 10 (demixing_rl/main_sac.py reward shaping)
     return run_warmup_loop(

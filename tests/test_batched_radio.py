@@ -1,0 +1,213 @@
+"""Batched-episode radio mode: batched-vs-sequential parity + masked
+resets + checkpoint round-trip.
+
+The batched envs (envs/calib.BatchedCalibEnv, envs/demixing.
+BatchedDemixingEnv) advance B lanes as ONE vmapped/lane-sharded program
+(RadioBackend.calibrate_batched / influence_images_batched); lane i must
+reproduce the sequential env with seed ``seed + i`` — the parity oracle
+every prior rewrite kept.  Tolerances are float-round-off class: the
+batched chain reassociates reductions (vmap fusion, the factored imager)
+but computes the same math.
+"""
+
+import numpy as np
+import pytest
+
+from smartcal_tpu.envs import (BatchedCalibEnv, BatchedDemixingEnv,
+                               CalibEnv, DemixingEnv)
+from smartcal_tpu.envs.radio import RadioBackend
+
+SEED = 11
+M = 3
+
+
+def tiny_backend(**kw):
+    args = dict(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                admm_iters=2, lbfgs_iters=3, init_iters=5, npix=32)
+    args.update(kw)
+    return RadioBackend(**args)
+
+
+def _actions(E, n):
+    return np.linspace(-0.5, 0.5, E * n).reshape(E, n).astype(np.float32)
+
+
+@pytest.fixture(scope="module", params=[1, 4])
+def calib_rollout(request):
+    """One reset + one step of a batched env and its sequential twins."""
+    E = request.param
+    benv = BatchedCalibEnv(M=M, n_envs=E, backend=tiny_backend(),
+                           seed=SEED)
+    bobs = benv.reset()
+    acts = _actions(E, 2 * M)
+    bobs2, brew, bdone, binfo = benv.step(acts)
+
+    seq = []
+    for i in range(E):
+        env = CalibEnv(M=M, backend=tiny_backend(), seed=SEED + i)
+        o = env.reset()
+        sky_reset = env.sky.copy()
+        o2, r, d, info = env.step(acts[i])
+        seq.append(dict(obs=o, sky_reset=sky_reset, obs2=o2, reward=r,
+                        sigma_res=info["sigma_res"], K=env.K))
+    return E, benv, bobs, bobs2, brew, binfo, seq
+
+
+class TestBatchedCalibParity:
+    def test_reset_observation_matches_oracle(self, calib_rollout):
+        E, benv, bobs, _, _, _, seq = calib_rollout
+        assert bobs["img"].shape == (E, 32, 32)
+        assert bobs["sky"].shape == (E, M + 1, 7)
+        for i in range(E):
+            assert benv.K[i] == seq[i]["K"]
+            np.testing.assert_allclose(bobs["sky"][i],
+                                       seq[i]["sky_reset"] * 1e-3,
+                                       rtol=1e-5, atol=1e-7)
+            np.testing.assert_allclose(bobs["img"][i], seq[i]["obs"]["img"],
+                                       rtol=2e-3, atol=2e-5)
+
+    def test_step_reward_and_sigma_match_oracle(self, calib_rollout):
+        E, _, _, bobs2, brew, binfo, seq = calib_rollout
+        for i in range(E):
+            np.testing.assert_allclose(brew[i], seq[i]["reward"],
+                                       rtol=2e-3, atol=1e-4)
+            np.testing.assert_allclose(binfo["sigma_res"][i],
+                                       seq[i]["sigma_res"], rtol=1e-3)
+            np.testing.assert_allclose(bobs2["sky"][i], seq[i]["obs2"]["sky"],
+                                       rtol=1e-5, atol=1e-7)
+            np.testing.assert_allclose(bobs2["img"][i],
+                                       seq[i]["obs2"]["img"],
+                                       rtol=2e-3, atol=2e-5)
+
+    def test_fused_matches_sequential_oracle_route(self, calib_rollout):
+        """fused=False (the retained sequential parity-oracle route)
+        agrees with the batched program on the same lanes."""
+        E, benv, bobs, _, _, _, _ = calib_rollout
+        oenv = BatchedCalibEnv(M=M, n_envs=E, backend=tiny_backend(),
+                               seed=SEED, fused=False)
+        oobs = oenv.reset()
+        np.testing.assert_allclose(bobs["img"], oobs["img"], rtol=2e-3,
+                                   atol=2e-5)
+        np.testing.assert_allclose(bobs["sky"], oobs["sky"], rtol=1e-5,
+                                   atol=1e-7)
+
+
+def test_batched_demix_parity():
+    E, K = 2, 3
+    benv = BatchedDemixingEnv(K=K, n_envs=E,
+                              backend=tiny_backend(admm_iters=6),
+                              seed=SEED, provide_influence=True)
+    bobs = benv.reset()
+    acts = np.zeros((E, K), np.float32)
+    acts[:, 0] = 0.9             # select outlier 0
+    acts[:, -1] = -1.0           # maxiter -> LOW_ITER
+    bobs2, brew, _, binfo = benv.step(acts)
+    assert np.all(benv.maxiter == 5)
+    for i in range(E):
+        env = DemixingEnv(K=K, backend=tiny_backend(admm_iters=6),
+                          seed=SEED + i, provide_influence=True)
+        o = env.reset()
+        np.testing.assert_allclose(bobs["metadata"][i], o["metadata"],
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(bobs["infmap"][i], o["infmap"],
+                                   rtol=2e-3, atol=2e-5)
+        o2, r, d, info = env.step(acts[i])
+        np.testing.assert_allclose(bobs2["metadata"][i], o2["metadata"],
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(brew[i], r, rtol=2e-3, atol=1e-4)
+        np.testing.assert_allclose(binfo["sigma_res"][i],
+                                   info["sigma_res"], rtol=1e-3)
+
+
+def test_masked_reset_boundary():
+    """Per-lane episode boundary: resetting a lane subset replaces only
+    those lanes (donated splice, no recompile), live lanes keep their
+    observation, and the reset lane lands exactly where a sequential env
+    at the same key-chain position would."""
+    E, K = 3, 3
+    benv = BatchedDemixingEnv(K=K, n_envs=E,
+                              backend=tiny_backend(admm_iters=6),
+                              seed=SEED, provide_influence=True)
+    benv.reset()
+    acts = np.zeros((E, K), np.float32)
+    acts[:, -1] = -1.0
+    bobs, _, _, _ = benv.step(acts)
+    prev = {k: v.copy() for k, v in bobs.items()}
+    prev_episode = benv.lane_episode.copy()
+
+    done = np.array([False, True, False])
+    bobs3 = benv.reset_lanes(done)
+    # live lanes: untouched observation + counters
+    for lane in (0, 2):
+        np.testing.assert_array_equal(bobs3["metadata"][lane],
+                                      prev["metadata"][lane])
+        np.testing.assert_array_equal(bobs3["infmap"][lane],
+                                      prev["infmap"][lane])
+    np.testing.assert_array_equal(benv.lane_episode,
+                                  prev_episode + done)
+    assert benv.lane_step[1] == 0 and benv.lane_step[0] == 1
+    # reset lane: matches the sequential env's SECOND episode
+    env = DemixingEnv(K=K, backend=tiny_backend(admm_iters=6),
+                      seed=SEED + 1, provide_influence=True)
+    env.reset()
+    o = env.reset()
+    np.testing.assert_allclose(bobs3["metadata"][1], o["metadata"],
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(bobs3["infmap"][1], o["infmap"],
+                               rtol=2e-3, atol=2e-5)
+
+
+def test_batched_sharded_route_matches_vmap():
+    """shard=True forces the lane-sharded (shard_map) batched solve on
+    the virtual mesh; results must match the plain vmapped route."""
+    E = 2
+    b_sh = BatchedCalibEnv(M=M, n_envs=E, backend=tiny_backend(shard=True),
+                           seed=7)
+    b_vm = BatchedCalibEnv(M=M, n_envs=E,
+                           backend=tiny_backend(shard=False), seed=7)
+    o_sh, o_vm = b_sh.reset(), b_vm.reset()
+    np.testing.assert_allclose(o_sh["img"], o_vm["img"], rtol=2e-3,
+                               atol=2e-5)
+    np.testing.assert_allclose(b_sh._sigma_data_img, b_vm._sigma_data_img,
+                               rtol=1e-3)
+
+
+def test_env_state_roundtrip():
+    """state_dict/load_state_dict round-trips the per-lane key array and
+    counters bit-exactly (the runtime --resume payload form)."""
+    E = 2
+    env = BatchedCalibEnv(M=M, n_envs=E, backend=tiny_backend(), seed=5)
+    env.reset()
+    state = env.state_dict()
+    keys_before = [np.asarray(k).copy() for k in env._keys]
+
+    env2 = BatchedCalibEnv(M=M, n_envs=E, backend=tiny_backend(), seed=99)
+    env2.load_state_dict(state)
+    for a, b in zip(keys_before, env2._keys):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    np.testing.assert_array_equal(env.lane_episode, env2.lane_episode)
+    np.testing.assert_array_equal(env.lane_step, env2.lane_step)
+    # a lane-count mismatch must refuse, not silently truncate
+    env3 = BatchedCalibEnv(M=M, n_envs=3, backend=tiny_backend(), seed=0)
+    with pytest.raises(AssertionError):
+        env3.load_state_dict(state)
+
+
+def test_batched_kill_resume_bit_parity(tmp_path, monkeypatch):
+    """train-2N ≙ train-N / kill / resume-N at B=2: the batched driver's
+    scores are bit-identical whether the run was interrupted or not
+    (same-seed guarantee under --resume with the per-lane key array in
+    the checkpoint payload)."""
+    from smartcal_tpu.train import calib_sac
+
+    monkeypatch.chdir(tmp_path)
+    common = ["--small", "--steps", "2", "--batch-envs", "2", "--seed",
+              "3", "--M", "3", "--quiet"]
+    full = calib_sac.main(["--episodes", "4", "--prefix", "a"] + common)
+    calib_sac.main(["--episodes", "2", "--prefix", "b", "--ckpt-every",
+                    "1", "--ckpt-dir", "b_ck"] + common)
+    resumed = calib_sac.main(["--episodes", "4", "--prefix", "b",
+                              "--ckpt-every", "1", "--ckpt-dir", "b_ck",
+                              "--resume"] + common)
+    assert len(full) == 4          # 2 vector episodes x 2 lanes
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(resumed))

@@ -61,6 +61,38 @@ print("[smoke_obs] influence OK:", len(inf_spans), "span(s), route",
       inf_spans[0].get("route") + ",", len(inf_costs), "cost event(s)")
 EOF
 
+echo "[smoke_obs] recording 1-vector-episode batched calib_sac run -> " \
+     "$WORK/smoke_batched.jsonl" >&2
+BATCHED="$WORK/smoke_batched.jsonl"
+# the batched-episode mode (--batch-envs): its solve/influence spans must
+# carry the batched route tags + lane count, or the obs story silently
+# loses the new hot path
+(cd "$WORK" && PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m smartcal_tpu.train.calib_sac \
+    --small --episodes 2 --steps 1 --batch-envs 2 --metrics "$BATCHED" \
+    --quiet)
+
+python - "$BATCHED" <<'EOF'
+import json
+import sys
+
+events = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+spans = [e for e in events if e["event"] == "span"]
+solve = [e for e in spans if e.get("name") == "solve"
+         and str(e.get("route", "")).startswith("batched")]
+assert solve, ("batched run emitted no batched-route solve spans: "
+               f"{[(e.get('name'), e.get('route')) for e in spans][:8]}")
+assert all(e.get("lanes") == 2 for e in solve), solve[:2]
+inf = [e for e in spans if e.get("name") == "influence"
+       and str(e.get("route", "")).startswith("batched")]
+assert inf, "batched run emitted no batched-route influence spans"
+eps = [e for e in spans if e.get("name") == "episode"
+       and e.get("lanes") == 2]
+assert eps, "batched vector-episode spans missing the lane count"
+print("[smoke_obs] batched OK:", len(solve), "solve +", len(inf),
+      "influence batched-route span(s), route", solve[0]["route"])
+EOF
+
 python - "$RUN" "$WORK/report.json" <<'EOF'
 import json
 import sys
