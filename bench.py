@@ -771,6 +771,71 @@ def bench_calib_batched(batch_sizes=(1, 4, 8), steps=2):
     return out
 
 
+def bench_actor_scaling(n_actors=(1, 2, 4), episodes=16, out_path=None):
+    """Aggregate env-steps/s of the supervised async actor-learner fleet
+    vs actor count on ONE host (ISSUE 10 tentpole metric).
+
+    Each arm runs the full pipeline — N actor threads, each driving 2
+    batched env lanes off an episode-frozen snapshot, feeding the
+    device-resident learner's fused store->PER-sample->learn->priority
+    step with IMPACT IS-clipping armed (is_clip=2) — and reports the
+    STEADY-STATE aggregate throughput: continuous wall clock from the
+    end of the warmup rounds through loop exit, counting ingest,
+    telemetry and bookkeeping (run_supervised_loop's summary), so queue
+    pre-fill bursts cannot inflate the number.  CPU-safe scale (tiny
+    enet MLPs); ``out_path`` additionally writes the payload as a
+    results artifact.
+    """
+    from smartcal_tpu.parallel import learner as plearner
+
+    per_n = []
+    for n in n_actors:
+        _, _, summary = plearner.train_supervised(
+            seed=0, episodes=episodes, n_actors=n,
+            agent_kwargs={"batch_size": 32, "mem_size": 4096},
+            rollout_epochs=2, rollout_steps=10, batch_envs=2,
+            is_clip=2.0, quiet=True)
+        per_n.append({
+            "n_actors": n,
+            "env_steps_per_s": summary["env_steps_per_s"],
+            "transitions_steady": summary["transitions_steady"],
+            "wall_steady_s": summary["wall_steady_s"],
+            "rounds": summary["rounds"],
+            "restarts": summary["restarts"],
+        })
+    base = per_n[0]["env_steps_per_s"]
+    for row in per_n:
+        # an arm that never reached steady state (too few non-empty
+        # rounds) reports None — mark it failed rather than fabricating
+        # a ratio against a sub-nanosecond denominator
+        if row["env_steps_per_s"] is None:
+            row["failed"] = "no steady-state window (run ended within " \
+                            "the warmup rounds)"
+        row["speedup_vs_1_actor"] = (
+            round(row["env_steps_per_s"] / base, 3)
+            if base and row["env_steps_per_s"] is not None else None)
+    best = max(per_n, key=lambda r: r["env_steps_per_s"] or 0.0)
+    out = {
+        "metric": "actor_scaling",
+        "value": best["env_steps_per_s"],
+        "unit": "env-steps/sec aggregate",
+        "vs_baseline": None,
+        "scale": "enet default env, 2 lanes/actor, rollout 2x10, "
+                 "is_clip=2.0 (CPU-safe)",
+        "platform": jax.devices()[0].platform,
+        "host_cores": os.cpu_count(),
+        "episodes_per_arm": episodes,
+        "results": per_n,
+        "note": "steady-state continuous-wall aggregate env-steps/s of "
+                "the supervised fleet (actors + fused device-resident "
+                "learner); warmup rounds excluded",
+    }
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(out, fh, indent=1)
+    return out
+
+
 def main():
     # SMARTCAL_OBS=<path> records the whole bench as an obs run: backend
     # spans (simulate/solve/influence routes), solver telemetry, compile
@@ -930,7 +995,8 @@ def _measured_main():
                   (bench_per_episode_dispatch,
                    "enet_sac_env_steps_per_sec_per_episode_dispatch"),
                   (bench_calib_batched,
-                   "calib_batched_env_steps_per_sec")]
+                   "calib_batched_env_steps_per_sec"),
+                  (bench_actor_scaling, "actor_scaling")]
         if os.environ.get("BENCH_SKIP_CALIB"):
             out["extra"].append({"metric": "calib_episode_wall_clock",
                                  "skipped": "BENCH_SKIP_CALIB=1"})

@@ -113,13 +113,15 @@ def make_demix_actor_rollout(backend: radio.RadioBackend, K: int,
                              agent_cfg: dsac.DSACConfig,
                              rollout_epochs: int, rollout_steps: int,
                              provide_influence: bool = False,
-                             maxiter: int = 10):
+                             maxiter: int = 10, record_logp: bool = False):
     """One demixing actor's rollout as a pure function ``(agent_state,
     wl, key) -> transitions`` — ``wl`` a :class:`DemixWorkload` slice
     with leading axis ``rollout_epochs``, output leading axis
     ``rollout_epochs * rollout_steps``.  Shared by the SPMD learner
     (vmapped over the actor axis) and the supervised actor-thread
-    fleet (jitted per thread)."""
+    fleet (jitted per thread).  ``record_logp`` adds the categorical
+    ``behavior_logp`` field for the learner's IMPACT importance ratio
+    (same keys, bitwise the same action stream)."""
     n_actions = 2 ** (K - 1)
     if agent_cfg.n_actions != n_actions:
         raise ValueError(f"agent n_actions={agent_cfg.n_actions} != "
@@ -194,8 +196,13 @@ def make_demix_actor_rollout(backend: radio.RadioBackend, K: int,
             def step_body(scarry, k):
                 obs = scarry
                 k_act, _ = jax.random.split(k)
-                a = dsac.choose_action(agent_cfg, agent_state, obs[None],
-                                       k_act)[0]
+                if record_logp:
+                    a, lp = dsac.choose_action_logp(
+                        agent_cfg, agent_state, obs[None], k_act)
+                    a, lp = a[0], lp[0]
+                else:
+                    a = dsac.choose_action(agent_cfg, agent_state,
+                                           obs[None], k_act)[0]
                 mask = tbl[a]
                 res = _calibrate(wl_ep, mask)
                 std_res = _noise_std(res.residual)
@@ -204,6 +211,8 @@ def make_demix_actor_rollout(backend: radio.RadioBackend, K: int,
                 obs2 = _obs(wl_ep, res, mask)
                 tr = {"state": obs, "action": a, "reward": reward,
                       "new_state": obs2, "done": jnp.asarray(False)}
+                if record_logp:
+                    tr["behavior_logp"] = lp
                 return obs2, tr
 
             _, trs = jax.lax.scan(step_body, obs0,
@@ -395,20 +404,26 @@ def train_supervised_demix(seed=0, episodes=5, n_actors=2, K=4,
                            diag=False, watchdog=False,
                            heartbeat_timeout=300.0, max_restarts=3,
                            queue_timeout=300.0, max_empty_rounds=10,
-                           restart_backoff=None):
+                           restart_backoff=None, batch_envs=1,
+                           is_clip=0.0, ere_eta=1.0, publish_every=1,
+                           ckpt_dir=None, ckpt_every=0, keep_ckpts=3,
+                           resume=False):
     """Supervised actor-thread fleet for the demixing workload (the
-    fault-tolerant sibling of :func:`train_distributed_demix`; see
+    scale-out async sibling of :func:`train_distributed_demix`; see
     parallel.learner.train_supervised for the architecture).
 
-    Each actor thread simulates ITS OWN workload slice on the host
-    (``make_workloads`` with one actor) and runs the jitted per-actor
-    rollout against the latest weights snapshot; the supervisor restarts
-    dead/hung actors with backoff and a watchdog trip joins the fleet
-    cleanly.  Returns ``((agent_state, buf), scores, fleet_summary)``.
+    Each actor thread simulates ITS OWN workload lanes on the host
+    (``make_workloads`` with ``batch_envs`` lanes) and runs the jitted
+    per-actor rollout — vmapped over the lane axis into ONE batched
+    dispatch — against the latest weights snapshot; the supervisor
+    restarts dead/hung actors with backoff and a watchdog trip joins the
+    fleet cleanly.  ``is_clip``/``ere_eta``/``publish_every`` and the
+    checkpoint flags behave as in ``train_supervised``.
+    Returns ``((agent_state, buf), scores, fleet_summary)``.
     """
     from smartcal_tpu.runtime import Fleet
     from smartcal_tpu.runtime import faults as rt_faults
-    from smartcal_tpu.train.blocks import train_obs
+    from smartcal_tpu.train.blocks import TrainRuntime, train_obs
 
     from .learner import run_supervised_loop
 
@@ -417,27 +432,50 @@ def train_supervised_demix(seed=0, episodes=5, n_actors=2, K=4,
     agent_cfg = dsac.DSACConfig(
         obs_dim=backend.npix * backend.npix + md_dim,
         n_actions=2 ** (K - 1), img_shape=(backend.npix, backend.npix),
-        use_image=provide_influence, **(agent_kwargs or {}))
-    n_trans = rollout_epochs * rollout_steps
-    rollout = jax.jit(make_demix_actor_rollout(
-        backend, K, agent_cfg, rollout_epochs, rollout_steps,
-        provide_influence=provide_influence))
+        use_image=provide_influence, is_clip=is_clip, ere_eta=ere_eta,
+        **(agent_kwargs or {}))
+    n_trans = batch_envs * rollout_epochs * rollout_steps
+    from .learner import flatten_lanes, lane_keys
 
-    def _ingest(agent, buf, flat, key):
+    rollout_one = make_demix_actor_rollout(
+        backend, K, agent_cfg, rollout_epochs, rollout_steps,
+        provide_influence=provide_influence, record_logp=is_clip > 0)
+    if batch_envs > 1:
+        # the demix twin of learner.make_fleet_rollout: same lane-key
+        # derivation + flatten, with the per-lane workload slice as the
+        # extra vmapped operand (enet lanes need no per-lane data)
+        def _rollout(weights, wl, key):
+            trs = jax.vmap(lambda w, k: rollout_one(weights, w, k))(
+                wl, lane_keys(key, batch_envs))
+            return flatten_lanes(trs, n_trans)
+
+        rollout = jax.jit(_rollout)
+    else:
+        rollout = jax.jit(rollout_one)
+
+    def _ingest(agent, buf, flat, key, learner_version):
         buf = rp.replay_add_batch(buf, flat)
-        return dsac.learn(agent_cfg, agent, buf, key)
+        return dsac.learn(agent_cfg, agent, buf, key,
+                          learner_version=learner_version)
 
     ingest = jax.jit(_ingest)
 
-    def ingest_batch(agent, buf, host_trs, kl):
+    def ingest_batch(agent, buf, host_trs, kl, weights_version,
+                     learner_version):
         flat = {k2: jnp.asarray(v) for k2, v in host_trs.items()}
-        return ingest(agent, buf, flat, kl)
+        if is_clip > 0:
+            flat["version"] = jnp.full((flat["reward"].shape[0],),
+                                       weights_version, jnp.int32)
+        return ingest(agent, buf, flat, kl,
+                      jnp.asarray(learner_version, jnp.int32))
 
     key = jax.random.PRNGKey(seed)
     key, k0 = jax.random.split(key)
     agent = dsac.dsac_init(k0, agent_cfg)
-    buf = rp.replay_init(agent_cfg.mem_size,
-                         dsac.transition_spec(agent_cfg.obs_dim))
+    spec = dsac.transition_spec(agent_cfg.obs_dim)
+    if is_clip > 0:
+        spec = rp.versioned_spec(spec)
+    buf = rp.replay_init(agent_cfg.mem_size, spec)
 
     base_key = jax.random.PRNGKey(seed ^ 0x0AC7D32)
 
@@ -449,15 +487,21 @@ def train_supervised_demix(seed=0, episodes=5, n_actors=2, K=4,
         k = jax.random.fold_in(jax.random.fold_in(base_key, actor_id),
                                iteration)
         k_wl, k_roll = jax.random.split(k)
-        # the actor simulates its own episodes (the host-side half the
-        # SPMD mode batches up front)
-        wl = make_workloads(backend, K, 1, rollout_epochs, k_wl)
+        # the actor simulates its own episode lanes (the host-side half
+        # the SPMD mode batches up front)
+        wl = make_workloads(backend, K, batch_envs, rollout_epochs, k_wl)
+        if batch_envs > 1:
+            return jax.device_get(rollout(weights, wl, k_roll))
         wl_one = jax.tree_util.tree_map(lambda x: x[0], wl)
         return jax.device_get(rollout(weights, wl_one, k_roll))
 
     tob = train_obs("demix_learner_supervised", metrics=metrics,
                     quiet=quiet, diag=diag, watchdog=watchdog, seed=seed,
-                    n_actors=n_actors, K=K)
+                    n_actors=n_actors, K=K, batch_envs=batch_envs,
+                    is_clip=is_clip, ere_eta=ere_eta)
+    rt = TrainRuntime("demix_learner_supervised", ckpt_dir=ckpt_dir,
+                      ckpt_every=ckpt_every, keep=keep_ckpts,
+                      resume=resume, tob=tob)
     fleet = Fleet(n_actors, work_fn, name="demix-actor",
                   heartbeat_timeout=heartbeat_timeout,
                   max_restarts=max_restarts, backoff=restart_backoff,
@@ -465,7 +509,8 @@ def train_supervised_demix(seed=0, episodes=5, n_actors=2, K=4,
     return run_supervised_loop(fleet, ingest_batch, agent, buf, key,
                                episodes, n_trans, tob,
                                queue_timeout=queue_timeout,
-                               max_empty_rounds=max_empty_rounds)
+                               max_empty_rounds=max_empty_rounds,
+                               rt=rt, publish_every=publish_every)
 
 
 def main(argv=None):
@@ -485,7 +530,8 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=main.__doc__)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--episodes", type=int, default=10)
-    p.add_argument("--actors", type=int, default=None)
+    p.add_argument("--actors", type=int, default=None,
+                   help="deprecated alias of --n-actors")
     p.add_argument("--K", type=int, default=6)
     p.add_argument("--stations", type=int, default=14)
     p.add_argument("--npix", type=int, default=128)
@@ -501,13 +547,17 @@ def main(argv=None):
     p.add_argument("--heartbeat_timeout", type=float, default=300.0)
     p.add_argument("--max_restarts", type=int, default=3)
     from smartcal_tpu import obs
-    from smartcal_tpu.train.blocks import (add_obs_args, add_runtime_args,
+    from smartcal_tpu.train.blocks import (add_batched_args, add_fleet_args,
+                                           add_obs_args, add_runtime_args,
                                            diag_from_args)
 
+    add_fleet_args(p)
+    add_batched_args(p)
     add_obs_args(p)
     add_runtime_args(p)
     multihost.add_cli_args(p)
     args = p.parse_args(argv)
+    n_actors = args.n_actors or args.actors
     if multihost.initialize_from_args(args):
         obs.echo(f"multihost: {multihost.runtime_summary()}",
                  event="multihost")
@@ -519,12 +569,9 @@ def main(argv=None):
         backend = radio.RadioBackend(n_stations=args.stations,
                                      npix=args.npix)
     if args.supervised:
-        if args.ckpt_every or args.resume:
-            obs.echo("checkpoint/resume is not yet supported in "
-                     "--supervised mode; flags ignored")
         _, scores, _ = train_supervised_demix(
             seed=args.seed, episodes=args.episodes,
-            n_actors=args.actors or 2, K=args.K, backend=backend,
+            n_actors=n_actors or 2, K=args.K, backend=backend,
             provide_influence=args.provide_influence,
             rollout_epochs=args.rollout_epochs,
             rollout_steps=args.rollout_steps,
@@ -532,10 +579,14 @@ def main(argv=None):
             diag=diag_from_args(args),
             watchdog=getattr(args, "watchdog", False),
             heartbeat_timeout=args.heartbeat_timeout,
-            max_restarts=args.max_restarts)
+            max_restarts=args.max_restarts,
+            batch_envs=args.batch_envs, is_clip=args.is_clip,
+            ere_eta=args.ere_eta, publish_every=args.publish_every,
+            ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
+            keep_ckpts=args.keep_ckpts, resume=args.resume)
         return scores
     _, scores = train_distributed_demix(
-        seed=args.seed, episodes=args.episodes, n_actors=args.actors,
+        seed=args.seed, episodes=args.episodes, n_actors=n_actors,
         K=args.K, backend=backend,
         provide_influence=args.provide_influence,
         rollout_epochs=args.rollout_epochs,

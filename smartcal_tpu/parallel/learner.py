@@ -51,12 +51,17 @@ class DistPERState(NamedTuple):
 
 def make_actor_rollout(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
                        rollout_epochs: int, rollout_steps: int,
-                       use_hint: bool = False):
+                       use_hint: bool = False, record_logp: bool = False):
     """One actor's rollout as a pure function ``(agent_state, key) ->
     transitions`` with leading axis ``rollout_epochs * rollout_steps``
     (reference Actor.run_observations, :123-146).  Shared by the SPMD
     learner (vmapped over the actor axis) and the supervised
-    actor-thread fleet (jitted per thread)."""
+    actor-thread fleet (jitted per thread).
+
+    ``record_logp`` adds a ``behavior_logp`` field (log pi of the sampled
+    action under the rollout's frozen params — the denominator of the
+    learner's IMPACT importance ratio); the action stream is bitwise the
+    plain path's (same keys, same sampler)."""
     n_trans = rollout_epochs * rollout_steps
 
     def _actor_rollout(agent_state, key):
@@ -71,12 +76,19 @@ def make_actor_rollout(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
                 k, first = inp
                 env_state, obs = scarry
                 k_act, k_env = jax.random.split(k)
-                a = sac.choose_action(agent_cfg, agent_state, obs[None],
-                                      k_act)[0]
+                if record_logp:
+                    a, lp = sac.choose_action_logp(agent_cfg, agent_state,
+                                                   obs[None], k_act)
+                    a, lp = a[0], lp[0]
+                else:
+                    a = sac.choose_action(agent_cfg, agent_state, obs[None],
+                                          k_act)[0]
                 env_state, obs2, r, done = enet.step(env_cfg, env_state, a,
                                                      k_env, keepnoise=first)
                 tr = {"state": obs, "action": a, "reward": r,
                       "new_state": obs2, "done": done, "hint": hint}
+                if record_logp:
+                    tr["behavior_logp"] = lp
                 return (env_state, obs2), tr
 
             keys = jax.random.split(k_scan, rollout_steps)
@@ -91,6 +103,44 @@ def make_actor_rollout(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
             lambda x: x.reshape((n_trans,) + x.shape[2:]), trs)
 
     return _actor_rollout
+
+
+def lane_keys(key, n_lanes: int):
+    """The fleet's per-lane key derivation — lane i follows the stream
+    ``fold_in(key, i)``.  ONE definition shared by the enet and demix
+    lane fan-outs so the derivation can never drift between workloads."""
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(n_lanes))
+
+
+def flatten_lanes(trs, n_trans: int):
+    """Collapse a ``(lanes, per_lane, ...)`` transition pytree into the
+    single ``(n_trans, ...)`` block the learner's ingest queue carries."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((n_trans,) + x.shape[2:]), trs)
+
+
+def make_fleet_rollout(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
+                       batch_envs: int, rollout_epochs: int,
+                       rollout_steps: int, use_hint: bool = False,
+                       record_logp: bool = True):
+    """A fleet actor's program: ``batch_envs`` env lanes vmapped through
+    :func:`make_actor_rollout` as ONE batched dispatch (the PR 9 regime,
+    lane streams from :func:`lane_keys`), flattened to a single
+    ``(batch_envs * epochs * steps, ...)`` transition block for the
+    learner's ingest queue."""
+    single = make_actor_rollout(env_cfg, agent_cfg, rollout_epochs,
+                                rollout_steps, use_hint=use_hint,
+                                record_logp=record_logp)
+    n_trans = batch_envs * rollout_epochs * rollout_steps
+
+    def _fleet_rollout(agent_state, key):
+        trs = jax.vmap(lambda k: single(agent_state, k))(
+            lane_keys(key, batch_envs))
+        return flatten_lanes(trs, n_trans)
+
+    return _fleet_rollout if batch_envs > 1 else (
+        lambda agent_state, key: single(agent_state, key))
 
 
 def make_distributed_per_sac(env_cfg: enet.EnetConfig,
@@ -282,51 +332,71 @@ def train_supervised(seed=0, episodes=50, n_actors=2, env_kwargs=None,
                      rollout_steps=5, metrics=None, quiet=False, diag=False,
                      watchdog=False, heartbeat_timeout=60.0, max_restarts=3,
                      queue_timeout=30.0, max_empty_rounds=20,
-                     restart_backoff=None):
-    """Supervised actor-thread fleet: the fault-tolerant sibling of
+                     restart_backoff=None, batch_envs=1, is_clip=0.0,
+                     ere_eta=1.0, publish_every=1, ckpt_dir=None,
+                     ckpt_every=0, keep_ckpts=3, resume=False):
+    """Supervised actor-thread fleet: the scale-out async sibling of
     :func:`train_distributed`.
 
     Where the SPMD learner fuses all actors into one jitted program
     (nothing can die independently), here each actor is a host THREAD
-    running the same jitted per-actor rollout against the latest weights
-    snapshot and queueing host transition batches; the learner ingests
-    whatever arrived (IMPACT-style: stale snapshots are expected — the
-    staleness-in-versions gauge records how stale), and a
+    driving ``batch_envs`` env lanes as ONE batched jitted rollout
+    (:func:`make_fleet_rollout`, the PR 9 regime) against an
+    episode-frozen weights snapshot, queueing version-stamped host
+    transition blocks; the learner ingests whatever arrived through one
+    fused device-resident step (store -> PER/ERE sample -> learn ->
+    priority update, no host round-trip of the sampled batch), and a
     :class:`~smartcal_tpu.runtime.supervisor.Fleet` restarts dead/hung
     actors with exponential backoff + jitter.  Learning continues from
     the surviving fleet; a watchdog trip stops AND joins every actor
-    thread before the driver exits (no actor left running against a
-    dead learner).  Deterministic faults (kill actor i at iteration n,
-    delay a rollout) come from :mod:`smartcal_tpu.runtime.faults`.
+    thread before the driver exits.  Deterministic faults (kill actor i
+    at iteration n, delay a rollout) come from
+    :mod:`smartcal_tpu.runtime.faults`.
 
-    Returns ``((agent_state, buf), scores, fleet_summary)``.
+    ``is_clip`` arms the IMPACT staleness-clipped importance weighting
+    (transitions carry the actor's snapshot version + behavior log-prob;
+    see :func:`smartcal_tpu.rl.sac.impact_weights`), ``ere_eta`` the
+    emphasizing-recent-experience sampling knob, and ``publish_every``
+    the weight-publication cadence in learner rounds (> 1 forces
+    staleness — the ablation knob of tools/ablate_isclip.py).
+    Checkpoints (``ckpt_every``/``resume``) capture the fleet state
+    including every actor slot's next rollout iteration, so a resumed
+    fleet continues each per-(actor, iteration) key stream.
+
+    Returns ``((agent_state, buf), scores, fleet_summary)`` — the
+    summary carries restart counts plus the steady-state aggregate
+    ``env_steps_per_s`` (measured after the warmup rounds).
     """
     from smartcal_tpu.runtime import Fleet
     from smartcal_tpu.runtime import faults as rt_faults
-    from smartcal_tpu.train.blocks import train_obs
+    from smartcal_tpu.train.blocks import TrainRuntime, train_obs
 
     env_cfg = enet.EnetConfig(**(env_kwargs or {}))
     agent_kwargs = dict(agent_kwargs or {})
     agent_kwargs.setdefault("prioritized", True)
     agent_cfg = sac.SACConfig(obs_dim=env_cfg.obs_dim, n_actions=2,
-                              use_hint=use_hint, **agent_kwargs)
-    n_trans = rollout_epochs * rollout_steps
+                              use_hint=use_hint, is_clip=is_clip,
+                              ere_eta=ere_eta, **agent_kwargs)
+    n_trans = batch_envs * rollout_epochs * rollout_steps
 
-    rollout = jax.jit(make_actor_rollout(env_cfg, agent_cfg, rollout_epochs,
-                                         rollout_steps, use_hint=use_hint))
+    rollout = jax.jit(make_fleet_rollout(
+        env_cfg, agent_cfg, batch_envs, rollout_epochs, rollout_steps,
+        use_hint=use_hint, record_logp=is_clip > 0))
 
-    def _ingest(agent, buf, flat, key):
+    def _ingest(agent, buf, flat, key, learner_version):
         buf = rp.replay_add_batch(buf, flat)
-        return sac.learn(agent_cfg, agent, buf, key)
+        return sac.learn(agent_cfg, agent, buf, key,
+                         learner_version=learner_version)
 
     ingest = jax.jit(_ingest)
 
     key = jax.random.PRNGKey(seed)
     key, k0 = jax.random.split(key)
     agent = sac.sac_init(k0, agent_cfg)
-    buf = rp.replay_init(
-        agent_cfg.mem_size,
-        rp.transition_spec(env_cfg.obs_dim, agent_cfg.n_actions))
+    spec = rp.transition_spec(env_cfg.obs_dim, agent_cfg.n_actions)
+    if is_clip > 0:
+        spec = rp.versioned_spec(spec)
+    buf = rp.replay_init(agent_cfg.mem_size, spec)
 
     # per-(actor, iteration) rollout keys: a restarted actor continues
     # its predecessor's deterministic stream from the next iteration
@@ -341,13 +411,25 @@ def train_supervised(seed=0, episodes=50, n_actors=2, env_kwargs=None,
                                iteration)
         return jax.device_get(rollout(weights, k))
 
-    def ingest_batch(agent, buf, host_trs, kl):
+    def ingest_batch(agent, buf, host_trs, kl, weights_version,
+                     learner_version):
         flat = {k2: jnp.asarray(v) for k2, v in host_trs.items()}
-        return ingest(agent, buf, flat, kl)
+        if is_clip > 0:
+            # the learner stamps the actor's snapshot version onto the
+            # whole block (the queue tuple carries it) — the staleness
+            # currency of the fused IS-clipped learn
+            flat["version"] = jnp.full((flat["reward"].shape[0],),
+                                       weights_version, jnp.int32)
+        return ingest(agent, buf, flat, kl,
+                      jnp.asarray(learner_version, jnp.int32))
 
     tob = train_obs("parallel_learner_supervised", metrics=metrics,
                     quiet=quiet, diag=diag, watchdog=watchdog, seed=seed,
-                    n_actors=n_actors)
+                    n_actors=n_actors, batch_envs=batch_envs,
+                    is_clip=is_clip, ere_eta=ere_eta)
+    rt = TrainRuntime("parallel_learner_supervised", ckpt_dir=ckpt_dir,
+                      ckpt_every=ckpt_every, keep=keep_ckpts,
+                      resume=resume, tob=tob)
     fleet = Fleet(n_actors, work_fn, name="enet-actor",
                   heartbeat_timeout=heartbeat_timeout,
                   max_restarts=max_restarts, backoff=restart_backoff,
@@ -355,31 +437,80 @@ def train_supervised(seed=0, episodes=50, n_actors=2, env_kwargs=None,
     return run_supervised_loop(fleet, ingest_batch, agent, buf, key,
                                episodes, n_trans, tob,
                                queue_timeout=queue_timeout,
-                               max_empty_rounds=max_empty_rounds)
+                               max_empty_rounds=max_empty_rounds,
+                               rt=rt, publish_every=publish_every)
 
 
 def run_supervised_loop(fleet, ingest_batch, agent, buf, key, episodes,
                         n_trans, tob, queue_timeout=30.0,
-                        max_empty_rounds=20):
+                        max_empty_rounds=20, rt=None, publish_every=1,
+                        warmup_rounds=2):
     """The supervised learners' shared ingest loop (enet + demix fleets).
 
     Per learner episode: collect whatever actor batches arrived (at most
-    one per actor slot), ingest + learn each, publish fresh weights, run
-    one supervision pass (restarts), and feed the watchdog.  A trip
-    stops AND joins the actor fleet before the loop exits.  Owns the
-    fleet and the TrainObs handle (always stopped/closed on the way
-    out)."""
+    one per actor slot), ingest + learn each through the fused
+    device-resident step, bump the learner's policy version, publish
+    fresh weights every ``publish_every`` rounds, run one supervision
+    pass (restarts), and feed the watchdog.  A trip stops AND joins the
+    actor fleet before the loop exits.  Owns the fleet and the TrainObs
+    handle (always stopped/closed on the way out).
+
+    ``ingest_batch(agent, buf, host_trs, key, weights_version,
+    learner_version)`` is the fused learn entry; the loop stamps each
+    block with the version the producing actor held, so the IS-clip
+    weighting and the staleness gauges share one currency (learner
+    rounds).  ``rt`` (a TrainRuntime) arms checkpoint/resume: payloads
+    capture agent + replay + key + scores + the learner version + every
+    actor slot's next rollout iteration (``fleet.slot_iterations``).
+
+    Telemetry per round: aggregate + per-actor ``transitions_per_s``
+    gauges, ``weight_staleness_versions`` (max) and, when the IS-clip is
+    armed, the ``staleness_mean``/``is_clip_saturation``/``is_clip_mean``
+    gauges off the fused step's metrics.  The summary reports the
+    steady-state aggregate env-steps/s measured AFTER ``warmup_rounds``
+    (compile excluded — the actor-scaling bench's metric).
+    """
     import time
 
     import numpy as np
 
     from smartcal_tpu import obs
+    from smartcal_tpu.runtime import pack_replay, unpack_replay
 
     scores = []
+    ep0 = 0
+    start_iters = None
+    version0 = None
+    if rt is not None:
+        restored = rt.restore()
+        if restored is not None and restored.get("kind") != "fleet":
+            # a foreign payload (e.g. an SPMD dist_per checkpoint dir)
+            # cannot restore per-actor iterations — refuse loudly rather
+            # than resume with every key stream silently replayed
+            raise ValueError(
+                f"checkpoint kind {restored.get('kind')!r} is not a "
+                "supervised-fleet payload; point --ckpt-dir at a fleet "
+                "run's checkpoints")
+        if restored is not None:
+            agent = jax.tree_util.tree_map(jnp.asarray,
+                                           restored["agent_state"])
+            buf = unpack_replay(restored["replay"])
+            key = jnp.asarray(restored["key"])
+            scores = list(restored["scores"])
+            ep0 = int(restored["episode"])
+            start_iters = {int(k): int(v) for k, v
+                           in restored["actor_iterations"].items()}
+            version0 = int(restored["learner_version"])
+    # steady-state throughput window: CONTINUOUS wall clock from the end
+    # of the warmup rounds (compile amortization) to loop exit — counting
+    # everything (ingest, gauges, logging, checkpoints), so the reported
+    # aggregate env-steps/s is the sustained pipeline rate, not just the
+    # queue-drain burst rate
+    meas_trans, meas_t0, rounds = 0, None, 0
     try:
-        fleet.start(agent)
-        learner_version = fleet.get_weights()[1]
-        ep, empty_rounds = 0, 0
+        fleet.start(agent, start_iterations=start_iters, version=version0)
+        learner_version = fleet.version
+        ep, empty_rounds = ep0, 0
         while ep < episodes:
             t0 = time.perf_counter()
             batches = fleet.collect(max_items=fleet.n_actors,
@@ -399,22 +530,51 @@ def run_supervised_loop(fleet, ingest_batch, agent, buf, key, episodes,
                 continue
             empty_rounds = 0
             staleness = 0
+            per_actor = {}
             with tob.span("learner_episode", episode=ep,
                           batches=len(batches)):
                 for actor_id, iteration, wv, host_trs in batches:
                     key, kl = jax.random.split(key)
-                    agent, buf, metrics_out = ingest_batch(agent, buf,
-                                                           host_trs, kl)
+                    agent, buf, metrics_out = ingest_batch(
+                        agent, buf, host_trs, kl, wv, learner_version)
                     staleness = max(staleness, learner_version - wv)
-            learner_version = fleet.set_weights(agent)
+                    per_actor[actor_id] = per_actor.get(actor_id, 0) \
+                        + n_trans
+            # the learner's policy advanced this round: bump ITS version;
+            # actors only see it when the publication cadence says so
+            # (publish_every > 1 is the forced-staleness ablation knob)
+            learner_version += 1
+            if publish_every <= 1 or (ep + 1) % publish_every == 0:
+                fleet.set_weights(agent, version=learner_version)
             wall = time.perf_counter() - t0
+            rounds += 1
+            if rounds == warmup_rounds:
+                meas_t0 = time.perf_counter()
+            elif rounds > warmup_rounds:
+                meas_trans += len(batches) * n_trans
             score = float(np.mean([np.mean(b[3]["reward"])
                                    for b in batches]))
             scores.append(score)
             obs.gauge_set("actor_transitions_per_s",
                           round(len(batches) * n_trans / max(wall, 1e-9),
                                 2))
+            for aid, tr_n in sorted(per_actor.items()):
+                obs.gauge_set("per_actor_transitions_per_s",
+                              round(tr_n / max(wall, 1e-9), 2), actor=aid)
             obs.gauge_set("weight_staleness_versions", staleness)
+            if "staleness_mean" in metrics_out:
+                # the fused step's IS-clip telemetry (batch-level means,
+                # already on device): the staleness distribution the
+                # clipped weights absorbed and how often the clip bound
+                # did real work
+                obs.gauge_set("transition_staleness_mean",
+                              round(float(metrics_out["staleness_mean"]),
+                                    4))
+                obs.gauge_set("is_clip_saturation",
+                              round(float(
+                                  metrics_out["is_clip_saturation"]), 4))
+                obs.gauge_set("is_clip_mean",
+                              round(float(metrics_out["is_clip_mean"]), 4))
             tripped = False
             if tob.collect_diag:
                 tripped = tob.record_diag(
@@ -433,17 +593,34 @@ def run_supervised_loop(fleet, ingest_batch, agent, buf, key, episodes,
             if tripped:
                 # watchdog trip: stop AND join the actor threads before
                 # leaving the loop — no actor may keep rolling out
-                # against a dead learner
+                # against a dead learner.  Never checkpoint the tripped
+                # round's (possibly poisoned) state.
                 joined = fleet.stop(join=True)
                 tob.echo(f"watchdog trip: stopped fleet "
                          f"({joined} actor thread(s) joined)")
                 break
+            if rt is not None:
+                rt.maybe_checkpoint(ep, lambda: {
+                    "kind": "fleet", "episode": ep, "scores": list(scores),
+                    "agent_state": jax.device_get(agent),
+                    "replay": pack_replay(buf),
+                    "key": jax.device_get(key),
+                    "learner_version": learner_version,
+                    "actor_iterations": fleet.slot_iterations()})
     finally:
+        meas_wall = (time.perf_counter() - meas_t0
+                     if meas_t0 is not None else 0.0)
         fleet.stop(join=True)
         tob.close()
     summary = {"restarts": fleet.restarts_total(),
                "failed_slots": sorted(fleet.failed_slots),
-               "alive_at_exit": fleet.alive_count}
+               "alive_at_exit": fleet.alive_count,
+               "rounds": rounds,
+               "transitions_steady": meas_trans,
+               "wall_steady_s": round(meas_wall, 4),
+               "env_steps_per_s": (round(meas_trans / meas_wall, 2)
+                                   if meas_wall > 0 and meas_trans
+                                   else None)}
     return (agent, buf), scores, summary
 
 
@@ -454,7 +631,8 @@ def main(argv=None):
     reference's MASTER_ADDR/world_size/rank plumbing).
 
     Usage: python -m smartcal_tpu.parallel.learner --episodes 100
-        [--actors 8] [--use_hint] [--learn_per_transition]
+        [--n-actors 8] [--batch-envs 4] [--is-clip 2.0] [--ere 0.98]
+        [--use_hint] [--learn_per_transition]
         [--coordinator host:port --num_processes N --process_id i]
     """
     import argparse
@@ -462,13 +640,15 @@ def main(argv=None):
     from . import multihost
 
     from smartcal_tpu import obs
-    from smartcal_tpu.train.blocks import (add_obs_args, add_runtime_args,
+    from smartcal_tpu.train.blocks import (add_batched_args, add_fleet_args,
+                                           add_obs_args, add_runtime_args,
                                            diag_from_args)
 
     p = argparse.ArgumentParser(description=main.__doc__)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--episodes", type=int, default=100)
-    p.add_argument("--actors", type=int, default=None)
+    p.add_argument("--actors", type=int, default=None,
+                   help="deprecated alias of --n-actors")
     p.add_argument("--use_hint", action="store_true")
     p.add_argument("--learn_per_transition", action="store_true")
     p.add_argument("--supervised", action="store_true",
@@ -482,28 +662,32 @@ def main(argv=None):
     p.add_argument("--max_restarts", type=int, default=3,
                    help="supervised mode: restarts per actor slot before "
                         "it is abandoned")
+    add_fleet_args(p)
+    add_batched_args(p)
     add_obs_args(p)
     add_runtime_args(p)
     multihost.add_cli_args(p)
     args = p.parse_args(argv)
+    n_actors = args.n_actors or args.actors
     if multihost.initialize_from_args(args):
         obs.echo(f"multihost: {multihost.runtime_summary()}",
                  event="multihost")
     if args.supervised:
-        if args.ckpt_every or args.resume:
-            obs.echo("checkpoint/resume is not yet supported in "
-                     "--supervised mode; flags ignored")
         _, scores, _ = train_supervised(
             seed=args.seed, episodes=args.episodes,
-            n_actors=args.actors or 2, use_hint=args.use_hint,
+            n_actors=n_actors or 2, use_hint=args.use_hint,
             quiet=args.quiet, metrics=args.metrics,
             diag=diag_from_args(args),
             watchdog=getattr(args, "watchdog", False),
             heartbeat_timeout=args.heartbeat_timeout,
-            max_restarts=args.max_restarts)
+            max_restarts=args.max_restarts,
+            batch_envs=args.batch_envs, is_clip=args.is_clip,
+            ere_eta=args.ere_eta, publish_every=args.publish_every,
+            ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
+            keep_ckpts=args.keep_ckpts, resume=args.resume)
         return scores
     _, scores = train_distributed(
-        seed=args.seed, episodes=args.episodes, n_actors=args.actors,
+        seed=args.seed, episodes=args.episodes, n_actors=n_actors,
         use_hint=args.use_hint,
         learn_per_transition=args.learn_per_transition,
         quiet=args.quiet, metrics=args.metrics,

@@ -39,14 +39,21 @@ from ..rl import replay as rp
 from ..rl import sac
 
 
-def _instrument(fn, kind: str, env_steps_per_call: int):
+def _instrument(fn, kind: str, env_steps_per_call: int,
+                gauge_every: int = 50):
     """Wrap a jitted train function with dispatch telemetry.
 
     With no RunLog active the wrapper is one function call + one ``None``
     check; with one active it records a ``dispatch`` event (submission
     wall time — NOT compute time: the call is async and deliberately not
     synchronized, so instrumentation never serializes the pipeline) and
-    accumulates env-step/dispatch counters."""
+    accumulates env-step/dispatch counters.  Every ``gauge_every``
+    dispatches it also emits an ``env_steps_per_s`` gauge over the
+    window — the aggregate-throughput number the async-fleet gauges use,
+    here for the synchronous SPMD trainer so the two architectures read
+    off the same telemetry name."""
+    window = {"n": 0, "t0": None}
+
     def wrapped(*args, **kwargs):
         rl = obs.active()
         if rl is None:
@@ -58,6 +65,16 @@ def _instrument(fn, kind: str, env_steps_per_call: int):
                env_steps=env_steps_per_call)
         obs.counter_add("train_dispatches")
         obs.counter_add("env_steps", env_steps_per_call)
+        if window["t0"] is None:
+            window["t0"] = t0
+        window["n"] += 1
+        if window["n"] >= gauge_every:
+            wall = time.perf_counter() - window["t0"]
+            obs.gauge_set(
+                "env_steps_per_s",
+                round(window["n"] * env_steps_per_call / max(wall, 1e-9),
+                      2), kind=kind)
+            window["n"], window["t0"] = 0, None
         return out
 
     wrapped.__wrapped__ = fn

@@ -338,3 +338,25 @@ def gaussian_sample(mu, logsigma, key):
                  - 0.5 * jnp.log(2.0 * jnp.pi))
     log_probs = log_probs - jnp.log(1.0 - a ** 2 + 1e-6)
     return a, jnp.sum(log_probs, axis=-1, keepdims=True)
+
+
+def tanh_gaussian_log_prob(mu, logsigma, actions):
+    """log pi(a|s) of an ALREADY-SQUASHED action under a tanh-gaussian
+    policy head — the evaluation counterpart of :func:`gaussian_sample`.
+
+    Inverts the squash (``z = atanh(a)``, clipped away from the
+    saturation poles where atanh diverges) and applies the same density
+    + change-of-variables correction, so a freshly sampled action
+    round-trips to its sampled log-prob up to the atanh(tanh(z))
+    reconstruction error.  This is the learner-side half of the
+    IMPACT-style clipped importance ratio: the actor stores
+    ``behavior_logp`` at sample time, the learner re-evaluates the
+    stored action under ITS current parameters with this function.
+    """
+    a = jnp.clip(actions, -1.0 + 1e-6, 1.0 - 1e-6)
+    z = jnp.arctanh(a)
+    sigma = jnp.exp(logsigma)
+    log_probs = (-0.5 * ((z - mu) / sigma) ** 2 - logsigma
+                 - 0.5 * jnp.log(2.0 * jnp.pi))
+    log_probs = log_probs - jnp.log(1.0 - a ** 2 + 1e-6)
+    return jnp.sum(log_probs, axis=-1)

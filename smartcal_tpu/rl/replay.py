@@ -76,6 +76,18 @@ def transition_spec(obs_dim: int, n_actions: int) -> dict:
     }
 
 
+def versioned_spec(spec: dict) -> dict:
+    """``spec`` extended with the async-fleet provenance fields:
+    ``version`` (the policy-snapshot version the acting actor held — the
+    learner's staleness currency) and ``behavior_logp`` (log pi_behavior
+    of the stored action at sample time, the denominator of the
+    IMPACT-style clipped importance ratio).  ``replay_add_batch`` writes
+    only the keys the buffer was initialised with, so versioned buffers
+    and plain buffers share every other code path."""
+    return {**spec, "version": ((), jnp.int32),
+            "behavior_logp": ((), jnp.float32)}
+
+
 def replay_init(size: int, spec: dict) -> ReplayState:
     return ReplayState(
         data=_zeros_like_spec(size, spec),
@@ -168,13 +180,23 @@ def replay_sample_uniform(buf: ReplayState, key, batch_size: int):
     return batch, idx
 
 
-def replay_sample_per(buf: ReplayState, key, batch_size: int):
+def replay_sample_per(buf: ReplayState, key, batch_size: int,
+                      recency_eta: Optional[float] = None):
     """Stratified priority sampling + IS weights (enet_sac.py:270-312).
+
+    ``recency_eta`` (python-static; None/1.0 = off) modulates the
+    sampling distribution by the emphasizing-recent-experience weights
+    (:func:`ere_weights`): the effective priority is ``p_i * eta_w_i``,
+    and the IS correction is computed against the distribution actually
+    sampled from, so PER and ERE compose without bias bookkeeping.
 
     Returns ``(batch, idx, is_weights, new_buf)`` — ``new_buf`` carries the
     annealed beta.
     """
-    csum = jnp.cumsum(buf.priority)
+    priority = buf.priority
+    if recency_eta is not None and recency_eta < 1.0:
+        priority = priority * ere_weights(buf, recency_eta)
+    csum = jnp.cumsum(priority)
     total = csum[-1]
     beta = jnp.minimum(1.0, buf.beta + PER_BETA_INCREMENT)
 
@@ -184,7 +206,7 @@ def replay_sample_per(buf: ReplayState, key, batch_size: int):
     idx = jnp.searchsorted(csum, values, side="left")
     idx = jnp.clip(idx, 0, buf.size - 1)
 
-    p = buf.priority[idx]
+    p = priority[idx]
     probs = p / total
     is_w = (batch_size * probs) ** (-beta)
     is_w = is_w / jnp.max(is_w)
@@ -193,12 +215,119 @@ def replay_sample_per(buf: ReplayState, key, batch_size: int):
     return batch, idx, is_w.astype(jnp.float32), buf._replace(beta=beta)
 
 
+# exponent span of the ERE recency weighting: the oldest filled slot is
+# down-weighted by eta**ERE_SPAN relative to the newest, independent of
+# the buffer fill level (so the knob's strength does not drift as the
+# ring fills)
+ERE_SPAN = 100.0
+
+
+def ere_weights(buf: ReplayState, eta: float):
+    """Emphasizing-recent-experience weights over the ring slots
+    (Wang & Ross, arXiv:1906.04009, re-expressed as a stateless
+    per-slot weighting so it fuses into the jitted sample step).
+
+    Slot weight = ``eta ** (ERE_SPAN * age / (filled-1))`` with age the
+    write recency (0 = newest) — a smooth device-side stand-in for the
+    paper's shrinking-window schedule.  ``eta=1`` gives exactly uniform
+    weights (the identity knob); unfilled slots weigh 0.
+    """
+    n = buf.size
+    filled = _filled(buf)
+    slots = jnp.arange(n)
+    ages = jnp.mod(buf.cntr - 1 - slots, jnp.maximum(n, 1))
+    x = ages.astype(jnp.float32) / jnp.maximum(filled - 1, 1)
+    w = jnp.asarray(eta, jnp.float32) ** (ERE_SPAN * x)
+    return jnp.where(slots < filled, w, 0.0)
+
+
+def replay_sample_ere(buf: ReplayState, key, batch_size: int, eta: float):
+    """Recency-weighted sampling for UNIFORM buffers (the ERE knob of the
+    async fleet's device-resident replay path; prioritized buffers get
+    the same knob through ``replay_sample_per(recency_eta=...)``).
+
+    Stratified draw (with replacement) from the :func:`ere_weights`
+    distribution — at ``eta=1`` the weights are uniform over the filled
+    prefix.  Returns ``(batch, idx)``; following the ERE paper, no IS
+    correction is applied on the uniform path.
+    """
+    w = ere_weights(buf, eta)
+    csum = jnp.cumsum(w)
+    total = csum[-1]
+    seg = total / batch_size
+    u = jax.random.uniform(key, (batch_size,))
+    values = (jnp.arange(batch_size) + u) * seg
+    idx = jnp.searchsorted(csum, values, side="left")
+    idx = jnp.clip(idx, 0, buf.size - 1)
+    batch = {k: v[idx] for k, v in buf.data.items()}
+    return batch, idx
+
+
 def replay_update_priorities(buf: ReplayState, idx, errors,
                              error_clip: float = 100.0) -> ReplayState:
     """``batch_update`` (enet_sac.py:314-323): p = min(|e|+eps, clip)^alpha."""
     clipped = jnp.minimum(jnp.abs(errors) + PER_EPSILON, error_clip)
     return buf._replace(
         priority=buf.priority.at[idx].set(clipped ** PER_ALPHA))
+
+
+def staleness_clip_weights(raw, versions, learner_version, clip_c):
+    """The staleness-gated clipped-weight core shared by the agents'
+    IMPACT-style weightings (``sac.impact_weights``, the discrete twin,
+    ``td3.staleness_weights``): clip the raw per-transition weight to
+    ``[1/clip_c, clip_c]`` and gate to EXACTLY 1.0 at staleness <= 0 —
+    the bit-identity contract every agent shares.
+
+    ``raw`` is the unclipped weight per transition (a policy ratio), or
+    a callable ``raw(staleness)`` for weights that are functions of the
+    staleness itself (TD3's exponential decay).  Returns ``(weights,
+    aux)`` with the shared staleness/saturation telemetry scalars
+    (``is_clip_saturation`` = fraction of STALE transitions whose raw
+    weight hit a clip bound)."""
+    stale = (jnp.asarray(learner_version, jnp.int32)
+             - jnp.asarray(versions, jnp.int32)).astype(jnp.float32)
+    if callable(raw):
+        raw = raw(stale)
+    is_stale = stale > 0
+    lo, hi = 1.0 / clip_c, clip_c
+    w = jnp.where(is_stale, jnp.clip(raw, lo, hi), 1.0)
+    n_stale = jnp.maximum(jnp.sum(is_stale.astype(jnp.float32)), 1.0)
+    saturated = is_stale & ((raw >= hi) | (raw <= lo))
+    aux = {
+        "staleness_mean": jnp.mean(stale),
+        "is_clip_mean": jnp.mean(w),
+        "is_clip_saturation": jnp.sum(saturated.astype(jnp.float32))
+        / n_stale,
+    }
+    return w, aux
+
+
+def zero_clip_aux() -> dict:
+    """The no-learn branch's counterpart of the ``staleness_clip_weights``
+    aux dict (identity weights, nothing stale)."""
+    return {"staleness_mean": jnp.asarray(0.0),
+            "is_clip_mean": jnp.asarray(1.0),
+            "is_clip_saturation": jnp.asarray(0.0)}
+
+
+def validate_fleet_knobs(is_clip: float, ere_eta: float,
+                         replay_backend: str = "hbm") -> None:
+    """Config-time validation of the async-fleet knobs, shared by the
+    agent configs' ``__post_init__``.  Rejects the native sum-tree
+    backend combinations outright: ERE and the IS-clip live in the fused
+    device-resident sample/learn step, which the native host-side
+    sampler never runs — silently ignoring the knob (ERE) or failing at
+    the first learn step (is_clip) would be worse than refusing here."""
+    if is_clip != 0.0 and is_clip < 1.0:
+        raise ValueError(
+            f"is_clip must be 0 (off) or >= 1, got {is_clip}")
+    if not 0.0 < ere_eta <= 1.0:
+        raise ValueError(f"ere_eta must be in (0, 1], got {ere_eta}")
+    if replay_backend == "native" and (is_clip > 0 or ere_eta < 1.0):
+        raise ValueError(
+            "is_clip/ere_eta are features of the device-resident (hbm) "
+            "replay path; the native sum-tree backend does not apply "
+            "them — use replay_backend='hbm'")
 
 
 def per_mse(expected, targets, is_weights):

@@ -81,6 +81,19 @@ class SACConfig:
     # demix_sac.py); use_image=False drops the CNN branch (demixing_fuzzy)
     img_shape: Optional[Tuple[int, int]] = None
     use_image: bool = True
+    # IMPACT-style staleness-clipped importance weighting for the async
+    # actor-learner fleet (arXiv:1912.00167): 0 = off; c >= 1 arms it —
+    # transitions must then carry 'version'/'behavior_logp'
+    # (replay.versioned_spec) and learn() must be given the learner's
+    # policy version.  The critic TD loss is weighted by
+    # clip(pi_now(a|s)/pi_behavior(a|s), 1/c, c) for STALE transitions;
+    # same-version transitions get weight exactly 1.0, so a zero-staleness
+    # run is bit-identical to the unweighted path (tested).
+    is_clip: float = 0.0
+    # emphasizing-recent-experience sampling knob (replay.ere_weights):
+    # 1.0 = off (uniform/PER unchanged); eta < 1 biases the device-side
+    # sample step toward recent slots
+    ere_eta: float = 1.0
 
     def __post_init__(self):
         if self.alpha_rule not in ("reference", "sac_v2"):
@@ -91,6 +104,8 @@ class SACConfig:
             raise ValueError(
                 f"replay_backend must be 'hbm' or 'native', got "
                 f"{self.replay_backend!r}")
+        rp.validate_fleet_knobs(self.is_clip, self.ere_eta,
+                                self.replay_backend)
 
 
 class SACState(NamedTuple):
@@ -167,6 +182,42 @@ def choose_action(cfg: SACConfig, st: SACState, obs, key,
     return a
 
 
+def choose_action_logp(cfg: SACConfig, st: SACState, obs, key):
+    """:func:`choose_action` that ALSO returns ``log pi(a|s)`` (shape
+    ``obs.shape[:-1]``) — the behavior log-prob the fleet actors store
+    per transition for the IMPACT importance ratio.  Same key usage as
+    ``choose_action``, so the sampled action is bitwise the one the
+    plain path would have drawn."""
+    actor, _ = _nets(cfg)
+    mu, logsigma = actor.apply({"params": st.actor_params}, obs)
+    a, lp = gaussian_sample(mu, logsigma, key)
+    return a, lp[..., 0]
+
+
+def impact_weights(cfg: SACConfig, actor_params, batch: dict,
+                   learner_version) -> Tuple[jnp.ndarray, dict]:
+    """Clipped importance weights for a versioned batch (IMPACT,
+    arXiv:1912.00167 eq. 2, adapted to one-step TD).
+
+    Ratio = ``pi_now(a|s) / pi_behavior(a|s)`` with the numerator
+    re-evaluated under the CURRENT actor parameters
+    (:func:`~smartcal_tpu.rl.networks.tanh_gaussian_log_prob`) and the
+    denominator the stored ``behavior_logp``; clipped to
+    ``[1/is_clip, is_clip]``.  Transitions whose ``version`` matches (or
+    exceeds) ``learner_version`` get weight EXACTLY 1.0 — the staleness-0
+    bit-identity contract.  Returns ``(weights, aux)`` with aux carrying
+    the staleness / clip-saturation telemetry scalars.
+    """
+    from .networks import tanh_gaussian_log_prob
+
+    actor, _ = _nets(cfg)
+    mu, logsigma = actor.apply({"params": actor_params}, batch["state"])
+    lp_now = tanh_gaussian_log_prob(mu, logsigma, batch["action"])
+    ratio = jnp.exp(lp_now - batch["behavior_logp"])
+    return rp.staleness_clip_weights(ratio, batch["version"],
+                                     learner_version, cfg.is_clip)
+
+
 def _hint_gap(cfg: SACConfig, actions, hints):
     """g = max(0, D(a, hint) - thresh)^2 with D mse or kld.
 
@@ -183,7 +234,7 @@ def _hint_gap(cfg: SACConfig, actions, hints):
 
 
 def learn_from_batch(cfg: SACConfig, st: SACState, batch: dict, is_w,
-                     key, collect_diag: bool = False
+                     key, collect_diag: bool = False, learner_version=None
                      ) -> Tuple[SACState, dict]:
     """The SAC learn core on an ALREADY-SAMPLED batch.
 
@@ -200,6 +251,11 @@ def learn_from_batch(cfg: SACConfig, st: SACState, batch: dict, is_w,
     health scalars computed from intermediates the step already holds.
     With it False the traced program is the exact pre-diagnostics
     computation (bit-identical outputs, tested).
+
+    ``learner_version`` (traced int, required when ``cfg.is_clip`` is
+    armed) drives the IMPACT staleness-clipped weighting
+    (:func:`impact_weights`): the critic TD loss is importance-weighted
+    per transition, same-version transitions at exactly 1.0.
     """
     actor, critic = _nets(cfg)
     opt_a = optax.adam(cfg.lr_a)
@@ -211,6 +267,18 @@ def learn_from_batch(cfg: SACConfig, st: SACState, batch: dict, is_w,
     s2 = batch["new_state"]
     done = batch["done"][:, None]
     hint = batch["hint"]
+
+    clip_aux = {}
+    if cfg.is_clip > 0:
+        if learner_version is None:
+            raise ValueError("cfg.is_clip armed but learn_from_batch was "
+                             "not given the learner_version")
+        w_clip, clip_aux = impact_weights(cfg, st.actor_params, batch,
+                                          learner_version)
+        # fold into the PER IS weights: with every transition at the
+        # learner's version w_clip is exactly 1.0 and is_w * 1.0 is
+        # bitwise is_w — the staleness-0 identity contract
+        is_w = is_w * w_clip
 
     # --- target value (enet_sac.py:569-575)
     mu2, ls2 = actor.apply({"params": st.actor_params}, s2)
@@ -225,7 +293,7 @@ def learn_from_batch(cfg: SACConfig, st: SACState, batch: dict, is_w,
     def critic_loss(c1p, c2p):
         q1 = critic.apply({"params": c1p}, s, a)
         q2 = critic.apply({"params": c2p}, s, a)
-        if cfg.prioritized:
+        if cfg.prioritized or cfg.is_clip > 0:
             l = rp.per_mse(q1, y, is_w) + rp.per_mse(q2, y, is_w)
         else:
             l = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
@@ -320,7 +388,7 @@ def learn_from_batch(cfg: SACConfig, st: SACState, batch: dict, is_w,
         log_alpha=log_alpha, alpha_opt=alpha_opt,
     )
     metrics = {"critic_loss": closs, "actor_loss": aloss,
-               "alpha": alpha, "rho": rho, "td": td}
+               "alpha": alpha, "rho": rho, "td": td, **clip_aux}
     if collect_diag:
         metrics["diag"] = dg.make_diag(
             critic_loss=closs, actor_loss=aloss,
@@ -338,7 +406,7 @@ def learn_from_batch(cfg: SACConfig, st: SACState, batch: dict, is_w,
 
 
 def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
-          key, collect_diag: bool = False
+          key, collect_diag: bool = False, learner_version=None
           ) -> Tuple[SACState, rp.ReplayState, dict]:
     """One SAC learn step, sampling from (and possibly re-prioritising) ``buf``.
 
@@ -346,7 +414,16 @@ def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
     transitions, so it can sit unconditionally inside a scanned train loop.
     ``collect_diag`` threads ``metrics['diag']`` out (see
     :func:`learn_from_batch`; the no-learn branch reports a zero diag).
+
+    The whole sample -> learn -> priority-update chain is device-resident
+    — ONE jitted step with no host round-trip of the sampled batch
+    (asserted under ``jax.transfer_guard`` in tests/test_fleet.py).
+    ``cfg.ere_eta < 1`` switches the sample distribution to (or, with
+    PER, modulates it by) the emphasizing-recent-experience weights;
+    ``learner_version`` (traced int) is required when ``cfg.is_clip``
+    arms the IMPACT staleness weighting.
     """
+    ere = cfg.ere_eta if cfg.ere_eta < 1.0 else None
 
     def do_learn(args):
         st, buf, key = args
@@ -354,13 +431,18 @@ def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
 
         if cfg.prioritized:
             batch, idx, is_w, buf2 = rp.replay_sample_per(
-                buf, k_samp, cfg.batch_size)
+                buf, k_samp, cfg.batch_size, recency_eta=ere)
+        elif ere is not None:
+            batch, idx = rp.replay_sample_ere(buf, k_samp, cfg.batch_size,
+                                              ere)
+            is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
         else:
             batch, idx = rp.replay_sample_uniform(buf, k_samp, cfg.batch_size)
             is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
 
         st_new, metrics = learn_from_batch(cfg, st, batch, is_w, k_core,
-                                           collect_diag=collect_diag)
+                                           collect_diag=collect_diag,
+                                           learner_version=learner_version)
         if cfg.prioritized:
             buf2 = rp.replay_update_priorities(buf2, idx, metrics["td"],
                                                cfg.error_clip)
@@ -371,6 +453,8 @@ def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
         zeros = {"critic_loss": jnp.asarray(0.0),
                  "actor_loss": jnp.asarray(0.0),
                  "alpha": st.alpha, "rho": st.rho}
+        if cfg.is_clip > 0:
+            zeros.update(rp.zero_clip_aux())
         if collect_diag:
             zeros["diag"] = dg.zero_diag()
         return st, buf, zeros

@@ -52,6 +52,15 @@ class DSACConfig:
     error_clip: float = 1.0        # demix_sac.py:160
     img_shape: Optional[Tuple[int, int]] = None
     use_image: bool = True
+    # IMPACT staleness-clipped weighting + ERE sampling knob — the
+    # categorical twin of sac.SACConfig.is_clip/ere_eta (the importance
+    # ratio is pi_now(a|s)/pi_behavior(a|s) from the stored action index
+    # and behavior_logp; see impact_weights below)
+    is_clip: float = 0.0
+    ere_eta: float = 1.0
+
+    def __post_init__(self):
+        rp.validate_fleet_knobs(self.is_clip, self.ere_eta)
 
 
 class DSACState(NamedTuple):
@@ -120,27 +129,70 @@ def choose_action(cfg: DSACConfig, st: DSACState, obs, key,
     return jax.random.categorical(key, logits, axis=-1)
 
 
+def choose_action_logp(cfg: DSACConfig, st: DSACState, obs, key):
+    """:func:`choose_action` that also returns ``log pi(a|s)`` of the
+    sampled index — the behavior log-prob the fleet actors store per
+    transition (same key usage, bitwise the same action)."""
+    actor, _ = _nets(cfg)
+    logits = actor.apply({"params": st.actor_params}, obs)
+    a = jax.random.categorical(key, logits, axis=-1)
+    logpi = jax.nn.log_softmax(logits, axis=-1)
+    return a, jnp.take_along_axis(logpi, a[..., None], -1)[..., 0]
+
+
+def impact_weights(cfg: DSACConfig, actor_params, batch: dict,
+                   learner_version) -> Tuple[jnp.ndarray, dict]:
+    """Clipped categorical importance weights (the discrete twin of
+    :func:`smartcal_tpu.rl.sac.impact_weights`): ratio =
+    ``pi_now(a|s) / pi_behavior(a|s)`` with the numerator re-evaluated
+    under the current actor logits, clipped to ``[1/is_clip, is_clip]``,
+    exactly 1.0 at staleness <= 0."""
+    actor, _ = _nets(cfg)
+    logits = actor.apply({"params": actor_params}, batch["state"])
+    logpi = jax.nn.log_softmax(logits, axis=-1)
+    lp_now = jnp.take_along_axis(logpi, batch["action"][:, None], -1)[:, 0]
+    ratio = jnp.exp(lp_now - batch["behavior_logp"])
+    return rp.staleness_clip_weights(ratio, batch["version"],
+                                     learner_version, cfg.is_clip)
+
+
 def learn(cfg: DSACConfig, st: DSACState, buf: rp.ReplayState,
-          key, collect_diag: bool = False
+          key, collect_diag: bool = False, learner_version=None
           ) -> Tuple[DSACState, rp.ReplayState, dict]:
     """One discrete-SAC learn step (no-op below batch_size, scannable).
 
     ``collect_diag`` (python-static) adds ``metrics['diag']`` — an
     :class:`~smartcal_tpu.obs.diagnostics.UpdateDiag`; with it False the
-    traced program is the exact pre-diagnostics computation."""
+    traced program is the exact pre-diagnostics computation.
+    ``cfg.is_clip`` + ``learner_version`` arm the IMPACT weighting,
+    ``cfg.ere_eta < 1`` the recency-emphasized sampling (see sac.learn)."""
     actor, critic = _nets(cfg)
     opt_a, opt_c = optax.adam(cfg.lr_a), optax.adam(cfg.lr_c)
+    ere = cfg.ere_eta if cfg.ere_eta < 1.0 else None
 
     def do_learn(args):
         st, buf, key = args
         k_samp, _ = jax.random.split(key)
         if cfg.prioritized:
             batch, idx, is_w, buf2 = rp.replay_sample_per(
-                buf, k_samp, cfg.batch_size)
+                buf, k_samp, cfg.batch_size, recency_eta=ere)
+        elif ere is not None:
+            batch, idx = rp.replay_sample_ere(buf, k_samp, cfg.batch_size,
+                                              ere)
+            is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
         else:
             batch, idx = rp.replay_sample_uniform(buf, k_samp,
                                                   cfg.batch_size)
             is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
+
+        clip_aux = {}
+        if cfg.is_clip > 0:
+            if learner_version is None:
+                raise ValueError("cfg.is_clip armed but learn was not "
+                                 "given the learner_version")
+            w_clip, clip_aux = impact_weights(cfg, st.actor_params, batch,
+                                              learner_version)
+            is_w = is_w * w_clip
 
         s, a = batch["state"], batch["action"]
         r = cfg.reward_scale * batch["reward"]
@@ -161,7 +213,7 @@ def learn(cfg: DSACConfig, st: DSACState, buf: rp.ReplayState,
                 critic.apply({"params": c1p}, s), a[:, None], -1)[:, 0]
             q2 = jnp.take_along_axis(
                 critic.apply({"params": c2p}, s), a[:, None], -1)[:, 0]
-            if cfg.prioritized:
+            if cfg.prioritized or cfg.is_clip > 0:
                 l = (rp.per_mse(q1[:, None], y[:, None], is_w)
                      + rp.per_mse(q2[:, None], y[:, None], is_w))
             else:
@@ -212,7 +264,7 @@ def learn(cfg: DSACConfig, st: DSACState, buf: rp.ReplayState,
             t2_params=lerp(st.t2_params, c2_params),
             actor_opt=actor_opt, c1_opt=c1_opt, c2_opt=c2_opt,
             alpha=st.alpha, learn_counter=st.learn_counter + 1)
-        metrics = {"critic_loss": closs, "actor_loss": aloss}
+        metrics = {"critic_loss": closs, "actor_loss": aloss, **clip_aux}
         if collect_diag:
             metrics["diag"] = dg.make_diag(
                 critic_loss=closs, actor_loss=aloss,
@@ -231,6 +283,8 @@ def learn(cfg: DSACConfig, st: DSACState, buf: rp.ReplayState,
         st, buf, _ = args
         zeros = {"critic_loss": jnp.asarray(0.0),
                  "actor_loss": jnp.asarray(0.0)}
+        if cfg.is_clip > 0:
+            zeros.update(rp.zero_clip_aux())
         if collect_diag:
             zeros["diag"] = dg.zero_diag()
         return st, buf, zeros

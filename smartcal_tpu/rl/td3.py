@@ -64,6 +64,23 @@ class TD3Config:
     error_clip: float = 100.0
     img_shape: Optional[Tuple[int, int]] = None   # see sac.SACConfig
     use_image: bool = True
+    # staleness-clipped update weighting for the async fleet.  TD3's
+    # deterministic policy admits no likelihood ratio (the IMPACT weight
+    # SAC uses), so the weight is an exponential staleness decay
+    # ``clip(is_decay**staleness, 1/is_clip, 1)`` — same clip constant,
+    # same exactly-1.0-at-staleness-0 bit-identity contract.  Armed
+    # buffers carry 'version' (replay.versioned_spec); learn() must be
+    # given the learner's policy version.
+    is_clip: float = 0.0
+    is_decay: float = 0.9
+    # emphasizing-recent-experience sampling knob (see sac.SACConfig)
+    ere_eta: float = 1.0
+
+    def __post_init__(self):
+        rp.validate_fleet_knobs(self.is_clip, self.ere_eta)
+        if not 0.0 < self.is_decay <= 1.0:
+            raise ValueError(
+                f"is_decay must be in (0, 1], got {self.is_decay}")
 
 
 class TD3State(NamedTuple):
@@ -124,6 +141,20 @@ def choose_action(cfg: TD3Config, st: TD3State, obs, key
     mu_prime = mu + cfg.noise * jax.random.normal(k2, shape)
     action = jnp.clip(mu_prime, -1.0, 1.0)
     return action, st._replace(time_step=st.time_step + 1)
+
+
+def staleness_weights(cfg: TD3Config, batch: dict, learner_version
+                      ) -> Tuple[jnp.ndarray, dict]:
+    """Clipped staleness-decay weights for a versioned batch (the
+    deterministic-policy stand-in for :func:`smartcal_tpu.rl.sac.
+    impact_weights`): ``clip(is_decay**staleness, 1/is_clip, ...)``,
+    exactly 1.0 at staleness <= 0.  With ``is_decay <= 1`` (validated)
+    the raw weight never exceeds 1, so the shared two-sided clip core
+    is effectively ``[1/is_clip, 1]``.  Returns ``(weights, aux)``."""
+    decay = jnp.asarray(cfg.is_decay, jnp.float32)
+    return rp.staleness_clip_weights(lambda stale: decay ** stale,
+                                     batch["version"], learner_version,
+                                     cfg.is_clip)
 
 
 def store_priority(cfg: TD3Config, reward):
@@ -238,7 +269,7 @@ def _actor_admm_update(cfg: TD3Config, st: TD3State, c1_params, s, hint,
 
 
 def learn(cfg: TD3Config, st: TD3State, buf: rp.ReplayState,
-          key, collect_diag: bool = False
+          key, collect_diag: bool = False, learner_version=None
           ) -> Tuple[TD3State, rp.ReplayState, dict]:
     """One TD3 learn step (enet_td3.py:222-364).
 
@@ -246,10 +277,16 @@ def learn(cfg: TD3Config, st: TD3State, buf: rp.ReplayState,
     :class:`~smartcal_tpu.obs.diagnostics.UpdateDiag`; with it False the
     traced program is the exact pre-diagnostics computation.  Actor
     fields report 0 on delayed-update skip steps (the watchdog treats
-    exact zeros as skips)."""
+    exact zeros as skips).
+
+    ``cfg.is_clip`` + ``learner_version`` arm the staleness-clipped
+    critic weighting (:func:`staleness_weights`); ``cfg.ere_eta < 1``
+    switches the device-side sample distribution to (or modulates it by)
+    the emphasizing-recent-experience weights."""
     actor, critic = _nets(cfg)
     opt_c = optax.adam(cfg.lr_c)
     opt_a = optax.adam(cfg.lr_a)
+    ere = cfg.ere_eta if cfg.ere_eta < 1.0 else None
 
     def do_learn(args):
         st, buf, key = args
@@ -257,10 +294,24 @@ def learn(cfg: TD3Config, st: TD3State, buf: rp.ReplayState,
 
         if cfg.prioritized:
             batch, idx, is_w, buf2 = rp.replay_sample_per(
-                buf, k_samp, cfg.batch_size)
+                buf, k_samp, cfg.batch_size, recency_eta=ere)
+        elif ere is not None:
+            batch, idx = rp.replay_sample_ere(buf, k_samp, cfg.batch_size,
+                                              ere)
+            is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
         else:
             batch, idx = rp.replay_sample_uniform(buf, k_samp, cfg.batch_size)
             is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
+
+        clip_aux = {}
+        if cfg.is_clip > 0:
+            if learner_version is None:
+                raise ValueError("cfg.is_clip armed but learn was not "
+                                 "given the learner_version")
+            w_clip, clip_aux = staleness_weights(cfg, batch,
+                                                 learner_version)
+            # staleness 0 -> w_clip exactly 1.0 -> is_w bitwise unchanged
+            is_w = is_w * w_clip
 
         s, a = batch["state"], batch["action"]
         r = batch["reward"]
@@ -288,7 +339,7 @@ def learn(cfg: TD3Config, st: TD3State, buf: rp.ReplayState,
         def critic_loss(c1p, c2p):
             q1 = critic.apply({"params": c1p}, s, a)
             q2 = critic.apply({"params": c2p}, s, a)
-            if cfg.prioritized:
+            if cfg.prioritized or cfg.is_clip > 0:
                 return rp.per_mse(q1, y, is_w) + rp.per_mse(q2, y, is_w)
             return jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
 
@@ -368,7 +419,7 @@ def learn(cfg: TD3Config, st: TD3State, buf: rp.ReplayState,
             c2_params=c2_params, t_actor_params=t_actor, t1_params=t1,
             t2_params=t2, actor_opt=actor_opt, c1_opt=c1_opt, c2_opt=c2_opt,
             learn_counter=counter, time_step=st.time_step)
-        metrics = {"critic_loss": closs}
+        metrics = {"critic_loss": closs, **clip_aux}
         if collect_diag:
             aloss, agn, aur, hres = cond_out[5]
             metrics["diag"] = dg.make_diag(
@@ -387,6 +438,8 @@ def learn(cfg: TD3Config, st: TD3State, buf: rp.ReplayState,
     def no_learn(args):
         st, buf, _ = args
         zeros = {"critic_loss": jnp.asarray(0.0)}
+        if cfg.is_clip > 0:
+            zeros.update(rp.zero_clip_aux())
         if collect_diag:
             zeros["diag"] = dg.zero_diag()
         return st, buf, zeros

@@ -62,7 +62,21 @@ class _Actor(threading.Thread):
             except BaseException as e:   # noqa: BLE001 — death IS the signal
                 self.error = e
                 return
-            f._q.put((self.actor_id, self.iteration, version, out))
+            # bounded ingest queue: when the learner falls behind, the
+            # put blocks (back-pressure — actors must not free-run
+            # arbitrarily far ahead of the policy they feed).  Re-beat
+            # the heartbeat while waiting so back-pressure is never
+            # mistaken for a hung rollout.
+            item = (self.actor_id, self.iteration, version, out)
+            while not self.stop_event.is_set():
+                try:
+                    # short tick: re-beat the heartbeat and re-check the
+                    # stop flag while waiting, so shutdown never stalls
+                    # behind a full queue
+                    f._q.put(item, timeout=0.2)
+                    break
+                except queue.Full:
+                    self.last_beat = time.monotonic()
             self.iteration += 1
 
 
@@ -72,7 +86,8 @@ class Fleet:
     def __init__(self, n_actors: int, work_fn: WorkFn, *,
                  name: str = "actor", heartbeat_timeout: float = 60.0,
                  max_restarts: int = 3,
-                 backoff: Optional[BackoffPolicy] = None, seed: int = 0):
+                 backoff: Optional[BackoffPolicy] = None, seed: int = 0,
+                 queue_depth: int = 2):
         self.n_actors = int(n_actors)
         self.work_fn = work_fn
         self.name = name
@@ -81,7 +96,12 @@ class Fleet:
         self.backoff = backoff or BackoffPolicy(base_s=0.25, factor=2.0,
                                                 max_s=30.0, jitter=0.25)
         self._seed = seed
-        self._q: "queue.Queue" = queue.Queue()
+        # bounded to queue_depth results per actor slot: actors block
+        # (with heartbeat) when the learner lags — staleness stays
+        # bounded by the queue depth plus the publication cadence
+        # instead of growing with every learner hiccup
+        self._q: "queue.Queue" = queue.Queue(
+            maxsize=max(1, int(queue_depth)) * self.n_actors)
         self._weights: Any = None
         self._version = 0
         self._wlock = threading.Lock()
@@ -94,22 +114,65 @@ class Fleet:
         self._rng = random.Random(seed)
 
     # -- weights snapshot --------------------------------------------------
-    def set_weights(self, weights: Any) -> int:
+    def set_weights(self, weights: Any, version: Optional[int] = None
+                    ) -> int:
+        """Publish a fresh snapshot.  ``version`` pins the snapshot's
+        version explicitly (the async learner stamps its own
+        learner-round counter so staleness-in-versions is measured in
+        learner rounds, and a resumed run continues its predecessor's
+        version stream); default keeps the auto-increment."""
         with self._wlock:
             self._weights = weights
-            self._version += 1
+            if version is not None:
+                self._version = int(version)
+            else:
+                self._version += 1
             return self._version
 
     def get_weights(self):
         with self._wlock:
             return self._weights, self._version
 
+    @property
+    def version(self) -> int:
+        with self._wlock:
+            return self._version
+
     # -- lifecycle ---------------------------------------------------------
-    def start(self, weights: Any) -> None:
-        self.set_weights(weights)
+    def start(self, weights: Any, start_iterations: Optional[dict] = None,
+              version: Optional[int] = None) -> None:
+        """Spawn every actor slot.  ``start_iterations`` (slot -> first
+        rollout iteration; default 0) lets a resumed run continue each
+        slot's deterministic key stream where its predecessor stopped —
+        the fleet half of the checkpoint payload (``slot_iterations``)."""
+        self.set_weights(weights, version=version)
+        start_iterations = start_iterations or {}
         for i in range(self.n_actors):
-            self._spawn(i, start_iteration=0)
+            self._spawn(i, start_iteration=int(start_iterations.get(i, 0)))
         self._gauge()
+
+    def slot_iterations(self) -> dict:
+        """slot -> the next rollout iteration that slot would run — what
+        a checkpoint must record so a resumed fleet continues every
+        per-(actor, iteration) key stream instead of replaying it.
+        Pending restarts report their scheduled resume iteration; a DEAD
+        actor reports the iteration AFTER the one that killed it (the
+        same poison-pill skip the live restart path applies — resuming
+        at the killing iteration would crash-loop the slot on every
+        resume)."""
+        out = {}
+        for slot in range(self.n_actors):
+            if slot in self._pending:
+                out[slot] = int(self._pending[slot][1])
+            elif slot in self._actors:
+                a = self._actors[slot]
+                it = int(a.iteration)
+                if not a.is_alive() and a.error is not None:
+                    it += 1
+                out[slot] = it
+            else:
+                out[slot] = 0
+        return out
 
     def _spawn(self, slot: int, start_iteration: int) -> None:
         a = _Actor(self, slot, start_iteration)

@@ -519,6 +519,41 @@ def apply_agent_recovery(agent, base_cfg, act):
     return agent
 
 
+def add_fleet_args(p):
+    """Attach the async actor-learner fleet flags shared by the parallel
+    learner CLIs (and any driver that spawns a supervised fleet): actor
+    count, the IMPACT IS-clip constant, the ERE sampling knob, and the
+    weight-publication cadence (the forced-staleness ablation knob)."""
+    p.add_argument("--n-actors", dest="n_actors", type=int, default=None,
+                   help="actor threads in the supervised fleet / logical "
+                        "actors in the SPMD program (default: 2 "
+                        "supervised, the mesh dp size SPMD)")
+    p.add_argument("--is-clip", dest="is_clip", type=float, default=0.0,
+                   help="IMPACT staleness-clipped importance weighting "
+                        "constant c >= 1 (0 = off): stale transitions' "
+                        "TD updates are weighted by the policy ratio "
+                        "clipped to [1/c, c]; same-version transitions "
+                        "are bit-identical to the unweighted path")
+    add_ere_arg(p)
+    p.add_argument("--publish-every", dest="publish_every", type=int,
+                   default=1,
+                   help="supervised fleet: publish learner weights every "
+                        "N learner rounds (N > 1 forces actor staleness "
+                        "— the IS-clip ablation knob)")
+    return p
+
+
+def add_ere_arg(p):
+    """Just the ERE knob, for single-learner drivers (the fleet CLIs get
+    it through ``add_fleet_args``)."""
+    p.add_argument("--ere", dest="ere_eta", type=float, default=1.0,
+                   help="emphasizing-recent-experience sampling knob "
+                        "eta in (0, 1]: 1 = off, smaller biases replay "
+                        "sampling toward recent transitions "
+                        "(composes with PER)")
+    return p
+
+
 def add_batched_args(p):
     """Attach the batched-env flag shared by the radio train drivers."""
     p.add_argument("--batch-envs", dest="batch_envs", type=int, default=1,

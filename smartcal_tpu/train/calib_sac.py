@@ -21,7 +21,8 @@ from ..envs import CalibEnv
 from ..envs.radio import RadioBackend
 from ..rl import sac
 from ..rl.networks import flatten_obs
-from .blocks import (add_batched_args, add_obs_args, add_runtime_args,
+from .blocks import (add_batched_args, add_ere_arg, add_obs_args,
+                     add_runtime_args,
                      diag_from_args, train_obs_from_args)
 
 
@@ -56,6 +57,7 @@ def main(argv=None):
     add_obs_args(p)
     add_runtime_args(p)
     add_batched_args(p)
+    add_ere_arg(p)
     args = p.parse_args(argv)
 
     if args.small:
@@ -89,7 +91,7 @@ def main(argv=None):
         batch_size=32, mem_size=10000, lr_a=1e-3, lr_c=1e-3,
         reward_scale=args.M, alpha=0.03, hint_threshold=0.01, admm_rho=1.0,
         use_hint=args.use_hint, hint_distance="kld",
-        img_shape=(npix, npix))
+        img_shape=(npix, npix), ere_eta=args.ere_eta)
     agent = sac.SACAgent(agent_cfg, seed=args.seed, name_prefix=args.prefix,
                          collect_diag=diag_from_args(args))
     if args.load:

@@ -21,7 +21,8 @@ from ..envs import DemixingEnv
 from ..envs.radio import RadioBackend
 from ..rl import sac
 from ..rl.networks import flatten_obs
-from .blocks import (add_batched_args, add_obs_args, add_runtime_args,
+from .blocks import (add_batched_args, add_ere_arg, add_obs_args,
+                     add_runtime_args,
                      diag_from_args,
                      train_obs_from_args)
 
@@ -52,6 +53,7 @@ def main(argv=None):
     add_obs_args(p)
     add_runtime_args(p)
     add_batched_args(p)
+    add_ere_arg(p)
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
@@ -83,7 +85,8 @@ def main(argv=None):
         obs_dim=obs_dim, n_actions=args.K, gamma=0.99, tau=0.005,
         batch_size=256, mem_size=16000, lr_a=3e-4, lr_c=1e-3, alpha=0.03,
         hint_threshold=0.01, admm_rho=1.0, use_hint=args.use_hint,
-        hint_distance="kld", img_shape=img_shape)
+        hint_distance="kld", img_shape=img_shape,
+        ere_eta=args.ere_eta)
     agent = sac.SACAgent(agent_cfg, seed=args.seed, name_prefix=args.prefix,
                          collect_diag=diag_from_args(args))
     scores = []

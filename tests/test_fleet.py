@@ -1,0 +1,365 @@
+"""Scale-out async actor-learner fleet: IMPACT IS-clip correctness
+(bounds + staleness-0 bit-identity), the fused device-resident PER step
+(zero host transfers), ERE sampling distribution, batched-env actors,
+kill-one-actor learning continuity, and fleet checkpoint capture of
+per-actor versions."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smartcal_tpu.rl import replay as rp
+from smartcal_tpu.rl import sac, td3
+from smartcal_tpu.runtime import (BackoffPolicy, FaultPlan, Fleet,
+                                  clear_faults, install_faults)
+
+ENV_KW = {"M": 5, "N": 5}
+AGENT_KW = {"batch_size": 8, "mem_size": 64}
+
+
+@pytest.fixture(autouse=True)
+def in_tmp(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    yield
+    clear_faults()
+
+
+def _fast_backoff():
+    return BackoffPolicy(base_s=0.01, factor=2.0, max_s=0.05, jitter=0.0)
+
+
+def _versioned_buffer(cfg, key, n, version):
+    """Fill a versioned buffer with n transitions sampled from the
+    behavior policy (actions + exact behavior_logp) — one jitted batch."""
+    spec = rp.versioned_spec(rp.transition_spec(cfg.obs_dim,
+                                                cfg.n_actions))
+    buf = rp.replay_init(cfg.mem_size, spec)
+    st = sac.sac_init(jax.random.PRNGKey(7), cfg)
+
+    @jax.jit
+    def _fill(buf, key):
+        k_obs, k_act = jax.random.split(key)
+        obs = jax.random.normal(k_obs, (n, cfg.obs_dim))
+        a, lp = sac.choose_action_logp(cfg, st, obs, k_act)
+        trs = {"state": obs, "new_state": obs + 0.1, "action": a,
+               "reward": (jnp.arange(n) % 3).astype(jnp.float32) - 1.0,
+               "done": jnp.zeros((n,), jnp.bool_),
+               "hint": jnp.zeros((n, cfg.n_actions)),
+               "version": jnp.full((n,), version, jnp.int32),
+               "behavior_logp": lp}
+        return rp.replay_add_batch(
+            buf, trs, priority=1.0 + 0.1 * jnp.arange(n, dtype=jnp.float32))
+
+    return _fill(buf, key), st
+
+
+# ---------------------------------------------------------------------------
+# IS-clip weight correctness
+# ---------------------------------------------------------------------------
+
+def test_impact_weights_contract():
+    """One buffer, three halves of the IMPACT-weight contract: (a) the
+    stored behavior_logp round-trips through a re-evaluation of the
+    stored action under the SAME params (atanh reconstruction
+    tolerance); (b) weights under a DIFFERENT policy at staleness > 0
+    are bounded by [1/c, c] with sane telemetry; (c) weights at
+    staleness 0 are EXACTLY 1.0."""
+    from smartcal_tpu.rl.networks import tanh_gaussian_log_prob
+
+    cfg = sac.SACConfig(obs_dim=6, n_actions=2, is_clip=2.0, **AGENT_KW)
+    buf, beh = _versioned_buffer(cfg, jax.random.PRNGKey(0), 16, version=3)
+    batch = {k: v[:16] for k, v in buf.data.items()}
+
+    # (a) behavior_logp round-trip under the behavior params
+    actor, _ = sac._nets(cfg)
+    mu, ls = actor.apply({"params": beh.actor_params}, batch["state"])
+    lp = tanh_gaussian_log_prob(mu, ls, batch["action"])
+    np.testing.assert_allclose(np.asarray(lp),
+                               np.asarray(batch["behavior_logp"]),
+                               rtol=1e-4, atol=1e-4)
+
+    # (b) bounded + telemetry under a fresh-init (different) policy,
+    # learner 3 versions ahead
+    st_now = sac.sac_init(jax.random.PRNGKey(99), cfg)
+    w, aux = sac.impact_weights(cfg, st_now.actor_params, batch,
+                                learner_version=jnp.asarray(6))
+    w = np.asarray(w)
+    assert np.all(w <= 2.0 + 1e-6) and np.all(w >= 0.5 - 1e-6), w
+    assert float(aux["staleness_mean"]) == 3.0
+    assert 0.0 <= float(aux["is_clip_saturation"]) <= 1.0
+
+    # (c) exactly 1.0 at staleness 0, same policy mismatch notwithstanding
+    w0, aux0 = sac.impact_weights(cfg, st_now.actor_params, batch,
+                                  learner_version=jnp.asarray(3))
+    assert np.all(np.asarray(w0) == 1.0)
+    assert float(aux0["staleness_mean"]) == 0.0
+
+
+@pytest.mark.parametrize(
+    "prioritized",
+    [True, pytest.param(False, marks=pytest.mark.slow)])
+def test_staleness0_bit_identical_to_unweighted(prioritized):
+    """is_clip armed + every transition at the learner's version ==
+    is_clip off, BIT-identical (the off<->on contract of collect_diag)."""
+    kw = dict(obs_dim=6, n_actions=2, prioritized=prioritized, **AGENT_KW)
+    cfg_on = sac.SACConfig(is_clip=2.0, **kw)
+    cfg_off = sac.SACConfig(**kw)
+    buf, _ = _versioned_buffer(cfg_on, jax.random.PRNGKey(1), 24,
+                               version=4)
+    st = sac.sac_init(jax.random.PRNGKey(2), cfg_on)
+    key = jax.random.PRNGKey(5)
+    st_on, buf_on, m_on = jax.jit(
+        lambda s, b, k: sac.learn(cfg_on, s, b, k,
+                                  learner_version=jnp.asarray(4)))(
+        st, buf, key)
+    st_off, buf_off, m_off = jax.jit(
+        lambda s, b, k: sac.learn(cfg_off, s, b, k))(st, buf, key)
+    for a, b in zip(jax.tree_util.tree_leaves(st_on),
+                    jax.tree_util.tree_leaves(st_off)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(buf_on.priority),
+                                  np.asarray(buf_off.priority))
+    assert float(m_on["is_clip_mean"]) == 1.0
+    assert float(m_on["is_clip_saturation"]) == 0.0
+
+
+def test_native_backend_rejects_fleet_knobs():
+    """is_clip/ERE live in the fused device-resident step; arming them
+    on the native sum-tree backend must fail at CONFIG time, not
+    silently no-op (ERE) or die at the first learn (is_clip)."""
+    with pytest.raises(ValueError, match="native"):
+        sac.SACConfig(obs_dim=6, n_actions=2, prioritized=True,
+                      replay_backend="native", is_clip=2.0, **AGENT_KW)
+    with pytest.raises(ValueError, match="native"):
+        sac.SACConfig(obs_dim=6, n_actions=2, prioritized=True,
+                      replay_backend="native", ere_eta=0.9, **AGENT_KW)
+
+
+def test_slot_iterations_skip_poison_iteration_of_dead_actor():
+    """A checkpoint taken while an actor is dead (not yet restarted, or
+    past max_restarts) must record the iteration AFTER the killing one —
+    otherwise every resume replays the poison pill."""
+    import time
+
+    def work(actor_id, iteration, weights):
+        if iteration == 1:
+            raise RuntimeError("poison")
+        return iteration
+
+    fleet = Fleet(1, work, max_restarts=0, backoff=_fast_backoff())
+    fleet.start(None)
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            a = fleet._actors[0]
+            if not a.is_alive() and a.error is not None:
+                break
+            time.sleep(0.01)
+        assert fleet.slot_iterations() == {0: 2}
+    finally:
+        fleet.stop(join=True)
+
+
+def test_td3_staleness_weights_bounds_and_identity():
+    cfg = td3.TD3Config(obs_dim=6, n_actions=2, is_clip=4.0, is_decay=0.5,
+                        **AGENT_KW)
+    batch = {"version": jnp.asarray([5, 5, 4, 3, 0], jnp.int32)}
+    w, aux = td3.staleness_weights(cfg, batch, learner_version=5)
+    w = np.asarray(w)
+    # staleness [0,0,1,2,5] -> [1, 1, .5, .25, clip(1/32 -> 1/4)]
+    np.testing.assert_allclose(w, [1.0, 1.0, 0.5, 0.25, 0.25])
+    assert np.all(w >= 1.0 / 4.0) and np.all(w <= 1.0)
+    # of the 3 stale transitions, staleness 2 sits AT the bound
+    # (0.5**2 == 1/4) and staleness 5 is past it -> 2/3 saturated
+    assert float(aux["is_clip_saturation"]) == pytest.approx(2.0 / 3.0)
+
+
+# ---------------------------------------------------------------------------
+# fused device-resident PER step: no host round-trip
+# ---------------------------------------------------------------------------
+
+def test_fused_per_learn_step_zero_host_transfers():
+    """The fused sample -> learn -> priority-update step runs start to
+    finish with device transfers DISALLOWED: the sampled batch (and the
+    priorities it re-writes) never round-trips the host."""
+    cfg = sac.SACConfig(obs_dim=6, n_actions=2, prioritized=True,
+                        is_clip=2.0, ere_eta=0.99, **AGENT_KW)
+    buf, _ = _versioned_buffer(cfg, jax.random.PRNGKey(1), 32, version=1)
+    st = sac.sac_init(jax.random.PRNGKey(2), cfg)
+    fused = jax.jit(lambda s, b, k, v: sac.learn(cfg, s, b, k,
+                                                 learner_version=v))
+    # commit every input to the device and warm the compile OUTSIDE the
+    # guard (tracing/compile may constant-fold through host values)
+    args = jax.device_put((st, buf, jax.random.PRNGKey(3),
+                           jnp.asarray(2, jnp.int32)))
+    out = fused(*args)
+    jax.block_until_ready(out)
+    k2 = jax.device_put(jax.random.PRNGKey(4))
+    with jax.transfer_guard("disallow"):
+        st2, buf2, metrics = fused(args[0], args[1], k2, args[3])
+        jax.block_until_ready((st2, buf2))
+    # the step really did learn + re-prioritise
+    assert int(st2.learn_counter) == int(st.learn_counter) + 1
+    assert not np.array_equal(np.asarray(buf2.priority),
+                              np.asarray(buf.priority))
+
+
+# ---------------------------------------------------------------------------
+# ERE sampling distribution
+# ---------------------------------------------------------------------------
+
+def _fill_uniform_buffer(n=64, size=64):
+    spec = {"x": ((), jnp.float32)}
+    buf = rp.replay_init(size, spec)
+    for i in range(n):
+        buf = rp.replay_add(buf, {"x": jnp.asarray(float(i))},
+                            priority=jnp.asarray(1.0))
+    return buf
+
+
+def test_ere_uniform_at_eta_one():
+    buf = _fill_uniform_buffer()
+    w = np.asarray(rp.ere_weights(buf, 1.0))
+    np.testing.assert_array_equal(w, np.ones(64, np.float32))
+    sample = jax.jit(lambda b, k: rp.replay_sample_ere(b, k, 16, 1.0))
+    counts = np.zeros(64)
+    for i in range(200):
+        _, idx = sample(buf, jax.random.PRNGKey(i))
+        np.add.at(counts, np.asarray(idx), 1)
+    freq = counts / counts.sum()
+    # uniform within a loose tolerance at 3200 draws
+    assert freq.max() < 3.5 / 64 and freq.min() > 0.2 / 64, freq
+
+
+def test_ere_oversamples_recent_at_eta_below_one():
+    buf = _fill_uniform_buffer()
+    ages = np.asarray((int(buf.cntr) - 1 - np.arange(64)) % 64)
+    sample = jax.jit(lambda b, k: rp.replay_sample_ere(b, k, 16, 0.9))
+    counts = np.zeros(64)
+    for i in range(200):
+        _, idx = sample(buf, jax.random.PRNGKey(i))
+        np.add.at(counts, np.asarray(idx), 1)
+    total = counts.sum()
+    frac_recent = counts[ages < 16].sum() / total   # newest quartile
+    mean_age = float((counts * ages).sum() / total)
+    # eta=0.9 with span 100: newest quartile should dominate
+    assert frac_recent > 0.5, frac_recent
+    assert mean_age < np.mean(ages), (mean_age, np.mean(ages))
+
+
+def test_ere_composes_with_per_priorities():
+    """PER + ERE: the effective distribution is priority * recency —
+    a high-priority OLD slot is still sampled less than under plain
+    PER."""
+    buf = _fill_uniform_buffer()
+    # give the OLDEST slot a huge priority
+    buf = buf._replace(priority=buf.priority.at[0].set(50.0))
+    sample_plain = jax.jit(lambda b, k: rp.replay_sample_per(b, k, 16))
+    sample_ere = jax.jit(
+        lambda b, k: rp.replay_sample_per(b, k, 16, recency_eta=0.9))
+    hits_plain, hits_ere = 0, 0
+    for i in range(100):
+        _, idx, _, _ = sample_plain(buf, jax.random.PRNGKey(i))
+        hits_plain += int(np.sum(np.asarray(idx) == 0))
+        _, idx2, _, _ = sample_ere(buf, jax.random.PRNGKey(i))
+        hits_ere += int(np.sum(np.asarray(idx2) == 0))
+    assert hits_ere < hits_plain, (hits_ere, hits_plain)
+
+
+# ---------------------------------------------------------------------------
+# fleet end-to-end: batched lanes, kill-one-actor continuity, checkpoints
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_kill_one_actor_keeps_learning(tmp_path):
+    """Injected kill of actor 1 mid-run with the IS-clip armed and
+    batched env lanes: the run completes, the supervisor restarts the
+    slot, and the learner genuinely learned (learn counter advanced,
+    versioned replay filled).  (Slow tier: the plain kill-restart path
+    stays in tier-1 via tests/test_supervised.py, and the CLI chain via
+    tools/smoke_fleet.sh.)"""
+    from smartcal_tpu.parallel import learner
+
+    install_faults(FaultPlan(kill_actor=1, kill_at=1))
+    run = str(tmp_path / "fleet.jsonl")
+    (st, buf), scores, summary = learner.train_supervised(
+        seed=0, episodes=6, n_actors=2, env_kwargs=ENV_KW,
+        agent_kwargs=AGENT_KW, rollout_epochs=1, rollout_steps=4,
+        batch_envs=2, is_clip=2.0, quiet=True, metrics=run,
+        restart_backoff=_fast_backoff())
+    clear_faults()
+    assert len(scores) == 6
+    assert np.all(np.isfinite(scores))
+    assert summary["restarts"] >= 1
+    assert int(st.learn_counter) > 0          # learning continued
+    assert int(buf.cntr) > 0
+    assert "version" in buf.data and "behavior_logp" in buf.data
+    events = [json.loads(ln) for ln in open(run) if ln.strip()]
+    kinds = {e["event"] for e in events}
+    assert {"fault_injected", "actor_down", "actor_restart"} <= kinds
+    gauges = {e["name"] for e in events if e["event"] == "gauge"}
+    assert "weight_staleness_versions" in gauges
+    assert "is_clip_saturation" in gauges
+    assert "per_actor_transitions_per_s" in gauges
+
+
+@pytest.mark.slow
+def test_fleet_checkpoint_resume_carries_actor_iterations(tmp_path):
+    """A fleet checkpoint captures per-actor rollout iterations and the
+    learner version; --resume restores them so the per-(actor,
+    iteration) key streams continue instead of replaying."""
+    from smartcal_tpu.parallel import learner
+    from smartcal_tpu.runtime.checkpoint import load_latest
+
+    kw = dict(seed=0, n_actors=2, env_kwargs=ENV_KW,
+              agent_kwargs=AGENT_KW, rollout_epochs=1, rollout_steps=4,
+              batch_envs=2, is_clip=2.0, quiet=True,
+              ckpt_dir=str(tmp_path / "ck"), ckpt_every=2,
+              restart_backoff=_fast_backoff())
+    (_, _), s1, _ = learner.train_supervised(episodes=4, **kw)
+    assert len(s1) == 4
+    payload, step = load_latest(str(tmp_path / "ck"))
+    assert payload["kind"] == "fleet"
+    assert set(payload["actor_iterations"]) == {0, 1}
+    assert all(v >= 1 for v in payload["actor_iterations"].values())
+    assert payload["learner_version"] >= step
+    saved_iters = dict(payload["actor_iterations"])
+
+    (_, buf2), s2, summ2 = learner.train_supervised(episodes=7,
+                                                    resume=True, **kw)
+    # resumed run continued the episode count and kept learning
+    assert len(s2) == 7
+    assert s2[:step] == pytest.approx(payload["scores"][:step])
+    payload2, step2 = load_latest(str(tmp_path / "ck"))
+    assert step2 > step
+    # the resumed fleet started at (not before) the saved iterations
+    assert all(payload2["actor_iterations"][k] >= saved_iters[k]
+               for k in saved_iters)
+    assert payload2["learner_version"] > payload["learner_version"]
+
+
+@pytest.mark.slow
+def test_publish_every_forces_staleness(tmp_path):
+    """publish_every > 1 (the ablation knob) produces genuinely stale
+    transitions: the staleness gauge exceeds 1 and the fused step's
+    transition-staleness telemetry is non-zero."""
+    from smartcal_tpu.parallel import learner
+
+    run = str(tmp_path / "stale.jsonl")
+    (_, _), scores, _ = learner.train_supervised(
+        seed=0, episodes=8, n_actors=2, env_kwargs=ENV_KW,
+        agent_kwargs=AGENT_KW, rollout_epochs=1, rollout_steps=4,
+        is_clip=2.0, publish_every=4, quiet=True, metrics=run,
+        restart_backoff=_fast_backoff())
+    events = [json.loads(ln) for ln in open(run) if ln.strip()]
+    stale_gauges = [e["value"] for e in events
+                    if e.get("event") == "gauge"
+                    and e["name"] == "weight_staleness_versions"]
+    assert max(stale_gauges) >= 2, stale_gauges
+    tr_stale = [e["value"] for e in events
+                if e.get("event") == "gauge"
+                and e["name"] == "transition_staleness_mean"]
+    assert tr_stale and max(tr_stale) > 0.0

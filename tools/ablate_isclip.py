@@ -1,0 +1,125 @@
+"""Staleness / IS-clip ablation for the supervised async fleet.
+
+Runs the SAME seed + actor layout through three arms of
+``parallel.learner.train_supervised``:
+
+* ``fresh``          — publish_every=1, clip off (the baseline cadence:
+                       actors are at most one learner round stale);
+* ``stale_noclip``   — publish_every=K (actors act on K-round-old
+                       snapshots), IS-clip OFF: stale transitions enter
+                       the TD update at full weight;
+* ``stale_clip``     — same forced staleness, IMPACT IS-clip ON
+                       (is_clip=c): stale transitions are weighted by
+                       the clipped policy ratio.
+
+Each arm records a ``--metrics`` JSONL; the artifact aggregates the
+learning signal (score trajectory, critic-loss stats, non-finite
+counts) next to the staleness/clip-saturation gauges so the clip-on vs
+clip-off comparison AT THE SAME forced staleness is one JSON document.
+
+    python tools/ablate_isclip.py [--out results/isclip_ablation_r10.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _run_arm(name, workdir, *, publish_every, is_clip, seed, episodes,
+             n_actors):
+    from smartcal_tpu.parallel import learner
+
+    run = os.path.join(workdir, f"isclip_{name}.jsonl")
+    (st, buf), scores, summary = learner.train_supervised(
+        seed=seed, episodes=episodes, n_actors=n_actors,
+        agent_kwargs={"batch_size": 32, "mem_size": 4096},
+        rollout_epochs=2, rollout_steps=10, batch_envs=2,
+        publish_every=publish_every, is_clip=is_clip,
+        quiet=True, metrics=run, diag=True)
+    events = [json.loads(ln) for ln in open(run) if ln.strip()]
+    closs = [e["critic_loss"] for e in events
+             if e.get("event") == "diag" and "critic_loss" in e]
+    gauges = {}
+    for e in events:
+        if e.get("event") == "gauge":
+            gauges.setdefault(e["name"], []).append(e["value"])
+    closs_arr = np.asarray(closs, np.float64) if closs else np.zeros(1)
+    finite = closs_arr[np.isfinite(closs_arr)]
+    out = {
+        "arm": name,
+        "publish_every": publish_every,
+        "is_clip": is_clip,
+        "episodes": len(scores),
+        "scores": [round(float(s), 4) for s in scores],
+        "score_mean": round(float(np.mean(scores)), 4),
+        "score_std": round(float(np.std(scores)), 4),
+        "critic_loss_mean": round(float(finite.mean()), 5)
+        if finite.size else None,
+        "critic_loss_max": round(float(finite.max()), 5)
+        if finite.size else None,
+        "critic_loss_nonfinite": int((~np.isfinite(closs_arr)).sum()),
+        "staleness_versions_max": max(
+            gauges.get("weight_staleness_versions", [0])),
+        "staleness_mean_transitions": (round(float(np.mean(
+            gauges["transition_staleness_mean"])), 4)
+            if "transition_staleness_mean" in gauges else None),
+        "is_clip_saturation_mean": (round(float(np.mean(
+            gauges["is_clip_saturation"])), 4)
+            if "is_clip_saturation" in gauges else None),
+        "restarts": summary["restarts"],
+        "env_steps_per_s": summary["env_steps_per_s"],
+    }
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default="results/isclip_ablation_r10.json")
+    p.add_argument("--workdir", default="/tmp/isclip_ablation")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--episodes", type=int, default=24)
+    p.add_argument("--n-actors", dest="n_actors", type=int, default=2)
+    p.add_argument("--publish-every", dest="publish_every", type=int,
+                   default=4, help="forced-staleness cadence of the "
+                                   "stale arms")
+    p.add_argument("--is-clip", dest="is_clip", type=float, default=2.0)
+    args = p.parse_args(argv)
+    os.makedirs(args.workdir, exist_ok=True)
+
+    common = dict(seed=args.seed, episodes=args.episodes,
+                  n_actors=args.n_actors)
+    arms = [
+        _run_arm("fresh", args.workdir, publish_every=1, is_clip=0.0,
+                 **common),
+        _run_arm("stale_noclip", args.workdir,
+                 publish_every=args.publish_every, is_clip=0.0, **common),
+        _run_arm("stale_clip", args.workdir,
+                 publish_every=args.publish_every, is_clip=args.is_clip,
+                 **common),
+    ]
+    payload = {
+        "experiment": "isclip_staleness_ablation",
+        "protocol": "same seed/actors/rollout across arms; staleness "
+                    "forced by the weight-publication cadence "
+                    "(publish_every); clip-on vs clip-off compared at "
+                    "the SAME forced staleness",
+        "seed": args.seed,
+        "n_actors": args.n_actors,
+        "forced_publish_every": args.publish_every,
+        "clip_constant": args.is_clip,
+        "arms": arms,
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(payload, fh, indent=1)
+    sys.stderr.write(f"[ablate_isclip] wrote {args.out}\n")
+    return payload
+
+
+if __name__ == "__main__":
+    main()

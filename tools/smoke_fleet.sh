@@ -1,0 +1,69 @@
+#!/bin/bash
+# Async actor-learner fleet smoke: record a short supervised fleet run
+# with the IS-clip armed, kill actor 1 mid-run through the deterministic
+# fault plan (SMARTCAL_FAULTS), and assert from the RunLog that
+#
+#   * the fault fired and the supervisor restarted the slot
+#     (fault_injected -> actor_down -> actor_restart),
+#   * the staleness-in-versions gauge was emitted,
+#   * the learner kept making progress (non-empty episode stream with
+#     finite scores after the kill).
+#
+# The CI companion of smoke_obs.sh / smoke_ckpt.sh; ~1 min on CPU.
+#
+#   bash tools/smoke_fleet.sh [workdir]
+#
+# Exits non-zero on any broken link in the chain.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+REPO="$PWD"
+WORK="${1:-$(mktemp -d /tmp/smoke_fleet.XXXXXX)}"
+RUN="$WORK/smoke_fleet.jsonl"
+mkdir -p "$WORK"
+
+echo "[smoke_fleet] recording supervised fleet run (kill actor 1 at" \
+     "iteration 1) -> $RUN" >&2
+(cd "$WORK" && PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+    JAX_PLATFORMS=cpu \
+    SMARTCAL_FAULTS='{"kill_actor": 1, "kill_at": 1}' \
+    python -m smartcal_tpu.parallel.learner \
+    --supervised --episodes 14 --n-actors 2 --batch-envs 2 \
+    --is-clip 2.0 --metrics "$RUN" --diag --quiet)
+
+python - "$RUN" <<'EOF'
+import json
+import math
+import sys
+
+events = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+kinds = [e.get("event") for e in events]
+
+# 1. the kill fired and the supervisor recovered the slot
+assert "fault_injected" in kinds, f"no fault_injected event: {sorted(set(kinds))}"
+downs = [e for e in events if e.get("event") == "actor_down"]
+assert downs and downs[0]["actor"] == 1, f"no actor_down for actor 1: {downs}"
+restarts = [e for e in events if e.get("event") == "actor_restart"]
+assert restarts, "supervisor never restarted the killed actor"
+assert restarts[0]["iteration"] == 2, \
+    f"poison iteration not skipped: {restarts[0]}"
+
+# 2. the staleness gauge stream exists
+gauges = {e["name"] for e in events if e.get("event") == "gauge"}
+assert "weight_staleness_versions" in gauges, \
+    f"no staleness gauge: {sorted(gauges)}"
+assert "is_clip_saturation" in gauges, \
+    f"no clip-saturation gauge (IS-clip armed): {sorted(gauges)}"
+
+# 3. the learner kept making progress past the kill
+episodes = [e for e in events if e.get("event") == "episode"]
+assert len(episodes) >= 6, f"too few learner episodes: {len(episodes)}"
+assert all(math.isfinite(e["score"]) for e in episodes), "non-finite scores"
+assert episodes[-1]["episode"] >= 5, "learner stalled after the kill"
+
+print("[smoke_fleet] OK:", len(episodes), "episodes,",
+      len(restarts), "restart(s), gauges:",
+      sorted(g for g in gauges if "staleness" in g or "clip" in g))
+EOF
+
+echo "[smoke_fleet] PASS (workdir $WORK)" >&2
