@@ -1,0 +1,39 @@
+"""graftlint — the repo's JAX-aware static-analysis suite (ISSUE 11).
+
+An AST-based lint framework that turns the invariants the test suite
+only catches at runtime (same-seed RNG parity, donated-buffer
+discipline, python-static flags, lock-guarded fleet state, atomic IO)
+into cheap pre-runtime gates.  See :mod:`smartcal_tpu.analysis.core`
+for the framework, :mod:`smartcal_tpu.analysis.rules` for the rules,
+and ``tools/lint.py`` for the CLI.
+
+Usage::
+
+    python tools/lint.py smartcal_tpu tools tests          # the gate
+    python tools/lint.py --json --changed                  # pre-commit
+    python tools/lint.py --types                           # typed core
+
+Programmatic::
+
+    from smartcal_tpu import analysis
+    findings = analysis.lint_paths(["smartcal_tpu"], root=repo_root)
+
+Stdlib-only on purpose: the linter runs on boxes where jax does not
+import (and in < 30 s over the whole package, so the tier-1 gate stays
+cheap).
+"""
+
+from .core import (  # noqa: F401
+    BAD_SUPPRESSION,
+    PARSE_ERROR,
+    FileContext,
+    Finding,
+    Rule,
+    all_rules,
+    iter_python_files,
+    lint_file,
+    lint_paths,
+    register,
+)
+from . import baseline  # noqa: F401
+from . import typecheck  # noqa: F401

@@ -1,0 +1,162 @@
+"""Checked-in baseline of grandfathered graftlint findings.
+
+The gate must be adoptable on a mature tree: findings that predate the
+linter are recorded here (with a MANDATORY reason each) and stop
+failing the gate, while anything NEW still does.  Entries are keyed by
+a line-number-free fingerprint — sha1 of (rule, path, stripped source
+text) plus an occurrence index — so unrelated edits above a
+grandfathered line don't invalidate the baseline, but changing or
+duplicating the flagged line itself does (the finding resurfaces and
+must be re-justified).
+
+Stale entries (fingerprint no longer produced by the lint run) are
+reported so the baseline shrinks as debt is paid instead of rotting.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = "graftlint.baseline.json"
+
+# (rule, path, fingerprint) -> reason
+BaselineMap = Dict[Tuple[str, str, str], str]
+
+
+def _fp_body(f: Finding) -> str:
+    h = hashlib.sha1(
+        f"{f.rule}|{f.path}|{f.source}".encode("utf-8")).hexdigest()
+    return h[:16]
+
+
+def fingerprints(findings: Sequence[Finding]) -> List[str]:
+    """Occurrence-indexed fingerprint per finding (aligned list).
+
+    Two identical flagged lines in one file get ``<hash>#0`` and
+    ``<hash>#1`` (source order), so baselining one of them does not
+    silently cover a copy-pasted second violation.
+    """
+    counts: Dict[str, int] = {}
+    out = []
+    for f in sorted(findings):
+        body = _fp_body(f)
+        k = counts.get(body, 0)
+        counts[body] = k + 1
+        out.append(f"{body}#{k}")
+    # re-align to the caller's order
+    order = {id(f): fp for f, fp in zip(sorted(findings), out)}
+    return [order[id(f)] for f in findings]
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def load(path: str) -> BaselineMap:
+    """Load a baseline file; every entry MUST carry a reason string."""
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        # a merge-conflicted/hand-mangled baseline is an infra error,
+        # not "findings" — surface it as BaselineError so the CLI can
+        # keep its exit-2 contract
+        raise BaselineError(f"{path}: unreadable baseline ({e})") from e
+    if not isinstance(doc, dict) or doc.get("version") != BASELINE_VERSION:
+        raise BaselineError(
+            f"{path}: unsupported baseline version "
+            f"{doc.get('version') if isinstance(doc, dict) else doc!r}")
+    out: BaselineMap = {}
+    for i, e in enumerate(doc.get("entries", [])):
+        if not isinstance(e, dict) or not all(
+                k in e for k in ("rule", "path", "fingerprint")):
+            raise BaselineError(
+                f"{path}: entry {i} is missing rule/path/fingerprint")
+        reason = (e.get("reason") or "").strip()
+        if not reason:
+            raise BaselineError(
+                f"{path}: entry {i} ({e.get('rule')}:{e.get('path')}) has "
+                "no reason — every baselined finding must say why it is "
+                "grandfathered")
+        out[(e["rule"], e["path"], e["fingerprint"])] = reason
+    return out
+
+
+# findings that may NEVER be grandfathered: a reasonless/unknown-rule
+# suppression must be fixed at its comment, and an unparseable file has
+# no stable fingerprint to pin
+UNBASELINEABLE = ("bad-suppression", "parse-error")
+
+
+def save(path: str, findings: Sequence[Finding],
+         reasons: Dict[Tuple[str, str, str], str] | None = None,
+         default_reason: str = "grandfathered: predates graftlint "
+                               "(ISSUE 11); burn down, don't add") -> None:
+    """Write ``findings`` as the new baseline (atomic), carrying forward
+    per-entry reasons from ``reasons`` where keys match.
+    :data:`UNBASELINEABLE` findings are dropped — they stay failing."""
+    findings = [f for f in findings if f.rule not in UNBASELINEABLE]
+    reasons = reasons or {}
+    entries = []
+    fps = fingerprints(findings)
+    for f, fp in sorted(zip(findings, fps), key=lambda t: t[0]):
+        key = (f.rule, f.path, fp)
+        entries.append({
+            "rule": f.rule, "path": f.path, "fingerprint": fp,
+            "line": f.line, "source": f.source,
+            "reason": reasons.get(key, default_reason),
+        })
+    doc = {"version": BASELINE_VERSION, "entries": entries}
+    text = json.dumps(doc, indent=1, sort_keys=False) + "\n"
+    # local tmp+replace (not runtime.atomic): the linter must stay
+    # importable on boxes where the jax-importing package half doesn't
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+
+
+def split(findings: Sequence[Finding], baseline: BaselineMap,
+          scanned_paths: "Sequence[str] | None" = None,
+          rules_run: "Sequence[str] | None" = None
+          ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """(new, grandfathered, stale_entries).
+
+    ``stale_entries`` are baseline rows whose fingerprint no longer
+    matches any finding — paid-down debt that should be pruned with
+    ``--update-baseline``.  A baseline entry pointing to a rule in
+    :data:`UNBASELINEABLE` never grandfathers (a hand-edited baseline
+    cannot launder those).  Staleness is only judged where this run
+    actually looked: with ``scanned_paths`` (repo-relative, as in
+    ``Finding.path``) entries for unlinted files are left alone, and
+    with ``rules_run`` entries for rules that didn't execute are too —
+    a ``--changed``/``--rules`` subset run must not call out-of-scope
+    debt "fixed".
+    """
+    fps = fingerprints(findings)
+    new, old = [], []
+    seen = set()
+    for f, fp in zip(findings, fps):
+        key = (f.rule, f.path, fp)
+        if key in baseline and f.rule not in UNBASELINEABLE:
+            old.append(f)
+            seen.add(key)
+        else:
+            new.append(f)
+    scanned = None if scanned_paths is None else set(scanned_paths)
+    ran = None if rules_run is None else set(rules_run)
+    stale = [{"rule": r, "path": p, "fingerprint": fp,
+              "reason": baseline[(r, p, fp)]}
+             for (r, p, fp) in sorted(baseline)
+             if (r, p, fp) not in seen
+             and (scanned is None or p in scanned)
+             and (ran is None or r in ran)]
+    return new, old, stale

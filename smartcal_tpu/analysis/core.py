@@ -1,0 +1,317 @@
+"""graftlint core: findings, rule registry, suppressions, file driver.
+
+The repo's correctness story rests on invariants that only fail at
+runtime — same-seed bit-parity (one extra RNG consumption perturbs every
+stream after it), donated-buffer splices (a read after donation is
+undefined on accelerators and silently fine on CPU), python-static diag
+flags, lock-guarded fleet state — and the tier-1 suite costs ~15 min per
+signal.  graftlint is the cheap pre-runtime gate: AST-based rules over
+the package that catch those bug classes at review time.
+
+Contracts:
+
+* **Rules** subclass :class:`Rule` and register with :func:`register`;
+  each sees a parsed :class:`FileContext` and yields
+  :class:`Finding`\\ s.  Rules must be deterministic (two runs over the
+  same tree produce byte-identical output) and side-effect free.
+* **Suppressions** are comments on the flagged line::
+
+      bad_call()  # graftlint: disable=rng-key-reuse -- reason why
+
+  or file-wide (anywhere in the file, conventionally near the top)::
+
+      # graftlint: disable-file=host-sync-in-jit -- reason why
+
+  The ``-- reason`` is MANDATORY: a disable comment without one (or
+  naming an unknown rule) is itself a finding (``bad-suppression``)
+  that cannot be suppressed — every silenced finding must say why.
+* **Baseline**: grandfathered findings live in a checked-in JSON file
+  (see :mod:`smartcal_tpu.analysis.baseline`); the gate fails only on
+  NEW findings.
+
+Stdlib only — the linter must run on a box where jax does not import.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+# path snippets never scanned by directory walks: the
+# intentional-violation fixture corpus and junk dirs.  Matched against
+# "/"-joined path components, so ".git" cannot catch "legit.py".
+EXCLUDE_PARTS = (
+    "tests/fixtures/lint",
+    "__pycache__",
+    ".git",
+)
+
+# meta-rule names emitted by the driver itself (not in the registry)
+BAD_SUPPRESSION = "bad-suppression"
+PARSE_ERROR = "parse-error"
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One lint hit.  ``path`` is repo-relative with forward slashes."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    source: str = ""  # stripped source text of the flagged line
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "source": self.source}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] " \
+               f"{self.message}"
+
+
+class FileContext:
+    """Parsed view of one file, shared by every rule (parse once)."""
+
+    def __init__(self, path: str, src: str, rel: str,
+                 options: Optional[dict] = None):
+        self.path = path          # absolute
+        self.rel = rel            # repo-relative, forward slashes
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src)  # may raise SyntaxError
+        self.options: dict = options or {}
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node_or_line, message: str,
+                col: Optional[int] = None) -> Finding:
+        if isinstance(node_or_line, int):
+            line, c = node_or_line, 0 if col is None else col
+        else:
+            line = getattr(node_or_line, "lineno", 1)
+            c = getattr(node_or_line, "col_offset", 0) if col is None else col
+        return Finding(path=self.rel, line=line, col=c, rule=rule,
+                       message=message, source=self.line_text(line))
+
+
+class Rule:
+    """Base class: subclass, set ``name``/``doc``, implement ``check``."""
+
+    name: str = ""
+    doc: str = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the global registry."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    if inst.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {inst.name!r}")
+    _REGISTRY[inst.name] = inst
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    """name -> rule instance, with the rule modules imported."""
+    from smartcal_tpu.analysis import rules as _rules  # noqa: F401
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Suppression comments
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_,\- ]+?)\s*(?:--\s*(.*\S)\s*)?$")
+
+
+@dataclasses.dataclass
+class Suppression:
+    kind: str          # "disable" | "disable-file"
+    rules: Tuple[str, ...]
+    reason: str        # "" when missing (a bad-suppression finding)
+    line: int
+
+
+def parse_suppressions(src: str) -> List[Suppression]:
+    """Suppressions from COMMENT tokens only — a docstring or string
+    literal that quotes the disable syntax (rule docs do) must never
+    become a live suppression."""
+    import io
+    import tokenize
+    out = []
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(src).readline)
+        comments = [(t.start[0], t.string) for t in toks
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return []  # unparseable files already carry a parse-error finding
+    for lineno, text in comments:
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        kind, names, reason = m.group(1), m.group(2), m.group(3) or ""
+        rules = tuple(r.strip() for r in names.split(",") if r.strip())
+        out.append(Suppression(kind=kind, rules=rules,
+                               reason=reason.strip(), line=lineno))
+    return out
+
+
+def apply_suppressions(ctx: FileContext, findings: List[Finding],
+                       known_rules: Iterable[str]) -> List[Finding]:
+    """Drop suppressed findings; emit ``bad-suppression`` meta-findings
+    for disables with no reason or an unknown rule name."""
+    sups = parse_suppressions(ctx.src)
+    known = set(known_rules) | {BAD_SUPPRESSION, PARSE_ERROR}
+    out: List[Finding] = []
+    file_off: set = set()
+    line_off: Dict[int, set] = {}
+    for s in sups:
+        if not s.reason:
+            out.append(ctx.finding(
+                BAD_SUPPRESSION, s.line,
+                "suppression without a reason — write "
+                "'# graftlint: disable=<rule> -- <why>'"))
+            continue  # a reasonless disable does not disable anything
+        bad = [r for r in s.rules if r not in known]
+        if bad:
+            out.append(ctx.finding(
+                BAD_SUPPRESSION, s.line,
+                f"suppression names unknown rule(s) {', '.join(bad)} "
+                f"(known: use tools/lint.py --list-rules)"))
+        good = [r for r in s.rules if r in known]
+        if s.kind == "disable-file":
+            file_off.update(good)
+        else:
+            line_off.setdefault(s.line, set()).update(good)
+    for f in findings:
+        if f.rule == BAD_SUPPRESSION:  # never suppressible
+            out.append(f)
+            continue
+        if f.rule in file_off:
+            continue
+        if f.rule in line_off.get(f.line, ()):
+            continue
+        out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# File driver
+# ---------------------------------------------------------------------------
+
+def is_excluded(path: str) -> bool:
+    """Public twin of the walk-time exclusion — callers assembling
+    their own file lists (``--changed``) must apply the same policy."""
+    return _excluded(path)
+
+
+def _excluded(path: str) -> bool:
+    comps = os.path.abspath(path).replace(os.sep, "/").split("/")
+    # component-boundary matching: "tests/fixtures/lint" must not catch
+    # "tests/fixtures/linty.py" or "tests/fixtures/lint_utils/"
+    bounded = "/" + "/".join(comps) + "/"
+    for part in EXCLUDE_PARTS:
+        if "/" in part:
+            if "/" + part + "/" in bounded:
+                return True
+        elif part in comps:
+            return True
+    return False
+
+
+def iter_python_files(paths: Sequence[str], root: str,
+                      include_excluded: bool = False) -> Iterator[str]:
+    """Yield absolute paths of ``.py`` files under ``paths`` (files or
+    directories), sorted, skipping :data:`EXCLUDE_PARTS`."""
+    seen = set()
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap):
+            # an explicitly-named file is always linted — the exclusion
+            # list only protects directory walks from the
+            # intentional-violation fixture corpus
+            cands = [ap] if ap.endswith(".py") else []
+            explicit = True
+        else:
+            cands = []
+            explicit = False
+            for d, subdirs, files in os.walk(ap):
+                subdirs.sort()
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        cands.append(os.path.join(d, fn))
+        for c in cands:
+            c = os.path.abspath(c)
+            if c in seen:
+                continue
+            if not (explicit or include_excluded) and _excluded(c):
+                continue
+            seen.add(c)
+            yield c
+
+
+def relpath(path: str, root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    return rel.replace(os.sep, "/")
+
+
+def lint_file(path: str, root: str,
+              rules: Optional[Dict[str, Rule]] = None,
+              options: Optional[dict] = None) -> List[Finding]:
+    """All (post-suppression) findings for one file, sorted."""
+    rules = rules if rules is not None else all_rules()
+    rel = relpath(path, root)
+    try:
+        with open(path, "rb") as fh:
+            src = fh.read().decode("utf-8")
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding(path=rel, line=1, col=0, rule=PARSE_ERROR,
+                        message=f"file is unreadable: {e}")]
+    try:
+        ctx = FileContext(path, src, rel, options=options)
+    except (SyntaxError, ValueError) as e:
+        lineno = int(getattr(e, "lineno", 1) or 1)
+        msg = getattr(e, "msg", None) or str(e)
+        return [Finding(path=rel, line=lineno, col=0, rule=PARSE_ERROR,
+                        message=f"file does not parse: {msg}")]
+    findings: List[Finding] = []
+    for rule in rules.values():
+        findings.extend(rule.check(ctx))
+    # suppressions validate against the FULL registry, not the subset
+    # being run — `--rules rng-key-reuse` must not call a valid
+    # disable=read-after-donation comment "unknown"
+    findings = apply_suppressions(ctx, findings,
+                                  set(all_rules()) | set(rules))
+    return sorted(findings)
+
+
+def lint_paths(paths: Sequence[str], root: str,
+               rules: Optional[Dict[str, Rule]] = None,
+               options: Optional[dict] = None,
+               include_excluded: bool = False) -> List[Finding]:
+    """Lint every python file under ``paths``; deterministic order."""
+    rules = rules if rules is not None else all_rules()
+    out: List[Finding] = []
+    for f in iter_python_files(paths, root,
+                               include_excluded=include_excluded):
+        out.extend(lint_file(f, root, rules=rules, options=options))
+    return sorted(out)

@@ -1,0 +1,281 @@
+"""Tiny source-order dataflow scaffolding for the graftlint rules.
+
+The rng-key-reuse and read-after-donation rules both need the same
+shape of analysis: walk ONE function scope's statements in source
+order, tracking a per-variable state machine (fresh -> consumed/donated
+-> cleared on reassignment), with two structural caveats:
+
+* **branches** (``if``/``elif``/``else``, ``try`` arms) are walked on
+  CLONED state and merged conservatively — a variable counts as
+  consumed after the branch only if EVERY arm consumed it, so mutually
+  exclusive uses never false-positive;
+* **loops** get a second look: a variable consumed inside a ``for``/
+  ``while`` body that the body never reassigns is consumed again on
+  the next iteration — the classic same-key-every-iteration bug — and
+  is reported once per loop.
+
+Scopes are module bodies and function bodies; nested ``def``/``class``
+bodies are separate scopes (closures get no cross-scope tracking —
+graftlint is a single-pass lint, not an escape analysis).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SCOPE_NODES = _FUNC_NODES + (ast.ClassDef, ast.Lambda)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a', 'self._key', 'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_func_name(call: ast.Call) -> Optional[str]:
+    """Dotted name of the called function ('jax.random.split', 'f')."""
+    return dotted(call.func)
+
+
+def iter_scopes(tree: ast.Module) -> Iterator[Tuple[ast.AST, List[ast.stmt]]]:
+    """Yield (scope_node, body) for the module and every function (any
+    nesting depth), each exactly once.  Callers walk each yielded body
+    flat — never descending into nested scope nodes — so every
+    statement is analyzed in exactly one scope."""
+    yield tree, tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_NODES):
+            yield node, node.body
+
+
+def walk_in_scope(body: List[ast.stmt]) -> Iterator[ast.AST]:
+    """Every AST node under these statements in a deterministic order,
+    NOT descending into nested function/class/lambda scopes (those are
+    separate scopes, yielded separately by :func:`iter_scopes`)."""
+    queue: List[ast.AST] = list(body)
+    i = 0
+    while i < len(queue):
+        node = queue[i]
+        i += 1
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _SCOPE_NODES):
+                continue
+            queue.append(child)
+
+
+def assigned_targets(stmt: ast.stmt) -> List[str]:
+    """Dotted names (re)bound by this single statement: assignment
+    targets, aug-assign, ``del``, ``with ... as``, and for-loop targets
+    (the loop header rebinds on every iteration)."""
+    out: List[str] = []
+
+    def add_target(t: ast.AST) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                add_target(e)
+        elif isinstance(t, ast.Starred):
+            add_target(t.value)
+        else:
+            name = dotted(t)
+            if name:
+                out.append(name)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            add_target(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        add_target(stmt.target)
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            add_target(t)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        add_target(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                add_target(item.optional_vars)
+    return out
+
+
+def stmt_expressions(stmt: ast.stmt) -> List[ast.AST]:
+    """The value-position expression roots of one statement (headers of
+    compound statements; full body of simple ones), EXCLUDING nested
+    compound bodies — the walkers recurse into those themselves."""
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value]
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.target, stmt.value]
+    if isinstance(stmt, ast.AnnAssign):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, (ast.Expr, ast.Return)):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.Assert):
+        return [stmt.test] + ([stmt.msg] if stmt.msg else [])
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, ast.Delete):
+        return []
+    return []
+
+
+def iter_calls(expr: ast.AST) -> Iterator[ast.Call]:
+    """Calls inside an expression, source order, not entering nested
+    scopes (lambda bodies are separate scopes)."""
+    calls = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.Call):
+            calls.append(node)
+    calls.sort(key=lambda c: (c.lineno, c.col_offset))
+    for c in calls:
+        yield c
+
+
+def read_names(expr: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """Dotted names in Load context inside ``expr`` (maximal chains:
+    ``self._key`` reports once, not also ``self``)."""
+    out: List[Tuple[str, ast.AST]] = []
+    covered: set = set()
+
+    class V(ast.NodeVisitor):
+        def _try(self, node: ast.AST) -> bool:
+            name = dotted(node)
+            if name is not None:
+                if id(node) not in covered:
+                    out.append((name, node))
+                    for sub in ast.walk(node):
+                        covered.add(id(sub))
+                return True
+            return False
+
+        def visit_Attribute(self, node: ast.Attribute) -> None:
+            if id(node) in covered:
+                return
+            if not self._try(node):
+                self.generic_visit(node)
+
+        def visit_Name(self, node: ast.Name) -> None:
+            if id(node) in covered:
+                return
+            self._try(node)
+
+        def visit_Lambda(self, node: ast.Lambda) -> None:
+            pass  # separate scope
+
+    V().visit(expr)
+    return [(n, node) for n, node in out
+            if isinstance(getattr(node, "ctx", ast.Load()), ast.Load)]
+
+
+def body_consumes_and_assigns(body: List[ast.stmt],
+                              consume_names_of_stmt) -> Tuple[dict, set]:
+    """For the loop-carry check: walk a loop body flat (not entering
+    nested scopes) and report {name: first_consuming_node} plus the set
+    of names the body ever (re)assigns."""
+    consumed: Dict[str, ast.AST] = {}
+    assigned: set = set()
+
+    def walk(stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, _SCOPE_NODES):
+                continue
+            for name, node in consume_names_of_stmt(stmt):
+                consumed.setdefault(name, node)
+            assigned.update(assigned_targets(stmt))
+            for sub in child_bodies(stmt):
+                walk(sub)
+
+    walk(body)
+    return consumed, assigned
+
+
+def walk_scope_linear(body: List[ast.stmt], state: Dict[str, ast.AST],
+                      visit_stmt, loop_extract=None,
+                      on_loop_carry=None) -> None:
+    """Source-order walk of one scope's statements (see module doc).
+
+    ``visit_stmt(stmt, state)`` handles one statement's own expressions
+    and assignments (compound statements pass their HEADER here; their
+    bodies are recursed into with branch-clone / loop-carry semantics).
+    ``loop_extract(stmt) -> [(name, node)]`` names the consume events of
+    one statement for the loop-carry check; ``on_loop_carry(name, node)``
+    fires for names consumed in a loop body that the body never
+    reassigns.
+    """
+    def recurse(sub, st):
+        walk_scope_linear(sub, st, visit_stmt, loop_extract, on_loop_carry)
+
+    def merge_into(state, arm_states):
+        merged = {k: v for k, v in arm_states[0].items()
+                  if all(k in s for s in arm_states[1:])}
+        state.clear()
+        state.update(merged)
+
+    for stmt in body:
+        if isinstance(stmt, _SCOPE_NODES):
+            continue  # nested scopes are analyzed independently
+        visit_stmt(stmt, state)
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            if loop_extract is not None and on_loop_carry is not None:
+                consumed, assigned = body_consumes_and_assigns(
+                    stmt.body, loop_extract)
+                for name, node in consumed.items():
+                    if name not in assigned:
+                        on_loop_carry(name, node)
+            st = dict(state)
+            recurse(stmt.body, st)
+            state.clear()
+            state.update(st)
+            if stmt.orelse:
+                recurse(stmt.orelse, state)
+        elif isinstance(stmt, ast.If):
+            arms = []
+            for arm in (stmt.body, stmt.orelse):
+                st = dict(state)
+                if arm:
+                    recurse(arm, st)
+                arms.append(st)
+            merge_into(state, arms)
+        elif isinstance(stmt, ast.Try):
+            main = dict(state)
+            recurse(stmt.body + stmt.orelse, main)
+            arms = [main]
+            for h in stmt.handlers:
+                st = dict(state)
+                recurse(h.body, st)
+                arms.append(st)
+            merge_into(state, arms)
+            if stmt.finalbody:
+                recurse(stmt.finalbody, state)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            recurse(stmt.body, state)
+
+
+def child_bodies(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    """Nested statement lists of a compound statement (branch arms,
+    loop bodies, with bodies, try arms)."""
+    out: List[List[ast.stmt]] = []
+    for field in ("body", "orelse", "finalbody"):
+        sub = getattr(stmt, field, None)
+        if sub and isinstance(sub, list) \
+                and all(isinstance(s, ast.stmt) for s in sub):
+            out.append(sub)
+    for h in getattr(stmt, "handlers", []) or []:
+        out.append(h.body)
+    return out

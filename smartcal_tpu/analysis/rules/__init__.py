@@ -1,0 +1,12 @@
+"""graftlint rule modules — importing this package registers every rule
+with :mod:`smartcal_tpu.analysis.core`.  One module per bug class; add a
+new rule by creating a module here that defines a ``Rule`` subclass
+decorated with ``@register`` and importing it below."""
+
+from . import donation     # noqa: F401
+from . import jit_sync     # noqa: F401
+from . import locks        # noqa: F401
+from . import pickle_io    # noqa: F401
+from . import prints       # noqa: F401
+from . import rng          # noqa: F401
+from . import static_flags  # noqa: F401

@@ -1,0 +1,201 @@
+"""read-after-donation: a buffer donated to a jitted call is read again.
+
+``donate_argnums``/``donate_argnames`` hands the argument's buffer to
+XLA for reuse: after the call, the caller-side array is INVALID on
+accelerators — and silently fine on CPU, where donation is a no-op,
+which is exactly why this bug class survives the CPU-only tier-1 suite
+(the ``_lane_splice``/``_seg_resume``/``_img_acc`` donation pattern from
+PRs 1/5/9).  The safe idiom rebinds the result over the operand::
+
+    acc = _img_acc(acc, img)        # ok: donated name is reassigned
+    x = _img_acc(acc, img); acc[0]  # BAD: acc's buffer was donated
+
+Donating callables are discovered per file (``jax.jit(...,
+donate_argnums=...)`` assignments and ``@partial(jax.jit,
+donate_argnames=...)`` decorated defs) and seeded with the repo's known
+cross-module donating helpers."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..core import FileContext, Finding, Rule, register
+from .. import flow
+
+# cross-module donating helpers (callee basename -> donated positional
+# indices); in-file definitions are discovered and take precedence.
+KNOWN_DONATING: Dict[str, Tuple[int, ...]] = {
+    "_lane_splice": (0,),   # envs/radio.py: batched-lane reset splice
+    "_img_acc": (0,),       # envs/radio.py: per-band image accumulator
+    "_seg_start": (0,),     # cal/solver.py: donated x0 carry
+    "_seg_resume": (0,),    # cal/solver.py: donated L-BFGS state carry
+    "_host_consensus": (1,),  # cal/solver.py: donated dual Y
+}
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def _literal_ints(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _literal_strs(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _param_positions(fn: ast.AST, names: Tuple[str, ...]) -> Tuple[int, ...]:
+    """Positional indices of ``names`` in a def's signature."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return ()
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    return tuple(params.index(n) for n in names if n in params)
+
+
+def _jit_donations(call: ast.Call) -> Optional[dict]:
+    """For a ``jax.jit(...)``/``partial(jax.jit, ...)`` call, the
+    donate kwargs: {'argnums': (...) or None, 'argnames': (...) or None}
+    (None when absent; returns None if this isn't a jit call)."""
+    fname = flow.call_func_name(call)
+    if fname in ("partial", "functools.partial") and call.args:
+        inner = flow.dotted(call.args[0])
+        if inner not in _JIT_NAMES:
+            return None
+    elif fname not in _JIT_NAMES:
+        return None
+    out = {"argnums": None, "argnames": None}
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            out["argnums"] = _literal_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            out["argnames"] = _literal_strs(kw.value)
+    if out["argnums"] is None and out["argnames"] is None:
+        return None
+    return out
+
+
+def donating_functions(tree: ast.Module,
+                       seed: Optional[Dict[str, Tuple[int, ...]]] = None
+                       ) -> Dict[str, Tuple[int, ...]]:
+    """basename -> donated positional indices, seeded + file-discovered."""
+    out = dict(KNOWN_DONATING if seed is None else seed)
+    for node in ast.walk(tree):
+        # NAME = jax.jit(fn, donate_argnums=(0,))
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            d = _jit_donations(node.value)
+            if d and d["argnums"]:
+                for t in node.targets:
+                    name = flow.dotted(t)
+                    if name:
+                        out[name.split(".")[-1]] = d["argnums"]
+        # @partial(jax.jit, donate_argnames=("x0",)) / @jax.jit(...)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                d = _jit_donations(dec)
+                if not d:
+                    continue
+                pos: Tuple[int, ...] = d["argnums"] or ()
+                if d["argnames"]:
+                    pos = pos + _param_positions(node, d["argnames"])
+                if pos:
+                    out[node.name] = tuple(sorted(set(pos)))
+    return out
+
+
+@register
+class ReadAfterDonation(Rule):
+    name = "read-after-donation"
+    doc = ("argument passed at a donate_argnums/argnames position and "
+           "then read again in the caller before reassignment")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        donators = donating_functions(
+            ctx.tree, seed=ctx.options.get("donating_funcs"))
+        findings: List[Finding] = []
+
+        def donation_events(stmt: ast.stmt) -> List[Tuple[str, ast.AST]]:
+            out = []
+            for expr in flow.stmt_expressions(stmt):
+                for call in flow.iter_calls(expr):
+                    fname = flow.call_func_name(call)
+                    if fname is None:
+                        continue
+                    base = fname.split(".")[-1]
+                    pos = donators.get(base)
+                    if not pos:
+                        continue
+                    for p in pos:
+                        if p < len(call.args):
+                            name = flow.dotted(call.args[p])
+                            if name:
+                                out.append((name, call))
+            return out
+
+        def run_scope(body: List[ast.stmt]) -> None:
+            state: Dict[str, ast.AST] = {}
+
+            def visit(stmt: ast.stmt, st: Dict[str, ast.AST]) -> None:
+                if st:
+                    # reads are checked against the PRE-statement state:
+                    # the donating use itself must not self-flag
+                    for expr in flow.stmt_expressions(stmt):
+                        for name, node in flow.read_names(expr):
+                            don = st.get(name)
+                            if don is None:  # attr read of a donated var
+                                for d, n in st.items():
+                                    if name.startswith(d + "."):
+                                        don, name = n, d
+                                        break
+                            if don is not None:
+                                findings.append(ctx.finding(
+                                    self.name, node,
+                                    f"'{name}' was donated to "
+                                    f"{flow.call_func_name(don)}() at line "
+                                    f"{don.lineno} and read again — its "
+                                    "buffer is invalid on accelerators "
+                                    "(donation is a silent no-op on CPU)"))
+                for name, node in donation_events(stmt):
+                    st[name] = node
+                for t in flow.assigned_targets(stmt):
+                    st.pop(t, None)
+                    pref = t + "."
+                    for k in [k for k in st if k.startswith(pref)]:
+                        st.pop(k)
+
+            def on_loop_carry(name: str, node: ast.AST) -> None:
+                findings.append(ctx.finding(
+                    self.name, node,
+                    f"'{name}' is donated inside this loop but never "
+                    "reassigned in the loop body — the next iteration "
+                    "re-reads a donated buffer (rebind the result: "
+                    f"{name} = {flow.call_func_name(node)}(...))"))
+
+            flow.walk_scope_linear(body, state, visit,
+                                   loop_extract=donation_events,
+                                   on_loop_carry=on_loop_carry)
+
+        for _scope, body in flow.iter_scopes(ctx.tree):
+            run_scope(body)
+        return iter(sorted(set(findings)))

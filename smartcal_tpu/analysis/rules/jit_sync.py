@@ -1,0 +1,269 @@
+"""host-sync-in-jit: host-side operations inside traced functions.
+
+Inside a function reachable from ``jit``/``vmap``/``pmap``/``shard_map``,
+host operations either fail at trace time in the best case or — the
+dangerous case — silently force a device->host sync / constant-fold on
+every call (``print``, ``time.time()``, ``np.asarray`` on a traced
+value, ``float()``/``.item()`` on a traced value, python ``if`` on a
+traced value which becomes a ConcretizationTypeError or a trace-time
+constant).
+
+Detection is deliberately conservative about what counts as *traced*:
+
+* params named in ``static_argnums``/``static_argnames`` are static;
+* params with a literal default are treated as python-static — that is
+  this repo's documented flag convention (``collect_diag=False``,
+  ``optimized=True``, ...), enforced separately by traced-static-flag;
+* ``self``/``cls`` and closure variables are not tracked;
+* ``x.shape``/``x.ndim``/``x.dtype``/``x.size`` accesses and
+  ``len()``/``isinstance()`` results are static even on traced values;
+* ``is``/``is not`` comparisons (structure checks like ``x is None``)
+  are python-static.
+
+Unconditionally host-side constructs (``print``, ``time.time()``,
+``.item()``, ``jax.device_get``) are flagged regardless of operand."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from ..core import FileContext, Finding, Rule, register
+from .. import flow
+
+_JIT_WRAPPERS = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.vmap", "vmap",
+                 "jax.pmap", "pmap", "shard_map", "jax.named_call",
+                 "checkpoint", "jax.checkpoint", "jax.remat"}
+
+# host-only calls, flagged unconditionally inside traced code
+_HOST_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.sleep",
+    "jax.device_get", "jax.block_until_ready",
+}
+_HOST_METHODS = {"item", "tolist", "block_until_ready"}
+
+# numpy entry points that concretize a traced operand
+_NP_SYNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+             "onp.asarray", "onp.array", "np.float32", "np.float64",
+             "np.int32", "np.int64"}
+
+_CONVERTERS = {"float", "int", "bool", "complex"}
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "weak_type"}
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type",
+                 "callable"}
+
+
+def _is_partial_jit(call: ast.Call) -> bool:
+    fname = flow.call_func_name(call)
+    if fname in ("partial", "functools.partial") and call.args:
+        return flow.dotted(call.args[0]) in _JIT_WRAPPERS
+    return False
+
+
+def _static_names_from_call(call: ast.Call) -> Set[str]:
+    """static_argnames literals of a jit(...) call."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            node = kw.value
+            elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) \
+                else [node]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.add(e.value)
+    return out
+
+
+def _static_nums_from_call(call: ast.Call) -> Set[int]:
+    out: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            node = kw.value
+            elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) \
+                else [node]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.add(e.value)
+    return out
+
+
+def _collect_jitted(tree: ast.Module) -> Dict[ast.AST, Tuple[Set[str],
+                                                             Set[int]]]:
+    """Map of function-def node -> (static names, static argnums) for
+    every def made traceable by a decorator or a same-file wrapper call
+    like ``g = jax.jit(f)`` / ``jax.vmap(f)(xs)``."""
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    jitted: Dict[ast.AST, Tuple[Set[str], Set[int]]] = {}
+
+    def mark(fn: ast.AST, names: Set[str], nums: Set[int]) -> None:
+        if fn in jitted:
+            old_names, old_nums = jitted[fn]
+            jitted[fn] = (old_names | names, old_nums | nums)
+        else:
+            jitted[fn] = (set(names), set(nums))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if flow.dotted(dec) in _JIT_WRAPPERS:
+                    mark(node, set(), set())
+                elif isinstance(dec, ast.Call) and (
+                        flow.call_func_name(dec) in _JIT_WRAPPERS
+                        or _is_partial_jit(dec)):
+                    mark(node, _static_names_from_call(dec),
+                         _static_nums_from_call(dec))
+        elif isinstance(node, ast.Call):
+            fname = flow.call_func_name(node)
+            if fname in _JIT_WRAPPERS or _is_partial_jit(node):
+                args = node.args[1:] if _is_partial_jit(node) else node.args
+                if args and isinstance(args[0], ast.Name) \
+                        and args[0].id in defs:
+                    mark(defs[args[0].id], _static_names_from_call(node),
+                         _static_nums_from_call(node))
+    return jitted
+
+
+def _traced_params(fn: ast.AST, static_names: Set[str],
+                   static_nums: Set[int]) -> Set[str]:
+    a = fn.args
+    params = [p.arg for p in a.posonlyargs + a.args]
+    traced: Set[str] = set()
+    n_pos = len(params)
+    defaults = a.defaults  # align right against positional params
+    first_default = n_pos - len(defaults)
+    for i, name in enumerate(params):
+        if name in ("self", "cls") or name in static_names \
+                or i in static_nums:
+            continue
+        if i >= first_default and isinstance(defaults[i - first_default],
+                                             ast.Constant):
+            continue  # literal default => python-static by repo convention
+        traced.add(name)
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if p.arg in static_names:
+            continue
+        if d is not None and isinstance(d, ast.Constant):
+            continue
+        traced.add(p.arg)
+    return traced
+
+
+def _traced_name_uses(expr: ast.AST, traced: Set[str]) -> List[ast.Name]:
+    """Name nodes of traced params used as VALUES in ``expr`` —
+    skipping static contexts (``x.shape``, ``len(x)``, ``x is None``)."""
+    hits: List[ast.Name] = []
+    skip: Set[int] = set()
+
+    def mark_skip(node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            skip.add(id(sub))
+
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            mark_skip(node)
+        elif isinstance(node, ast.Call) and \
+                flow.call_func_name(node) in _STATIC_CALLS:
+            mark_skip(node)
+        elif isinstance(node, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            mark_skip(node)
+        elif isinstance(node, (ast.Lambda, ast.FunctionDef,
+                               ast.AsyncFunctionDef)):
+            mark_skip(node)
+    for node in ast.walk(expr):
+        if id(node) in skip:
+            continue
+        if isinstance(node, ast.Name) and node.id in traced \
+                and isinstance(node.ctx, ast.Load):
+            hits.append(node)
+    return hits
+
+
+@register
+class HostSyncInJit(Rule):
+    name = "host-sync-in-jit"
+    doc = ("host-side op (print/.item()/np.asarray/time.time()/python if "
+           "on a traced value) inside a jit/vmap/shard_map-reachable "
+           "function")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        findings: List[Finding] = []
+        jitted = _collect_jitted(ctx.tree)
+
+        def scan_fn(fn: ast.AST, traced: Set[str]) -> None:
+            """Walk one traced function body; nested defs inherit the
+            enclosing traced names plus their own params (they are
+            traced when the outer trace calls them)."""
+            for stmt in fn.body:
+                self._scan_stmt(ctx, stmt, traced, findings)
+
+        for fn, (snames, snums) in jitted.items():
+            traced = _traced_params(fn, snames, snums)
+            scan_fn(fn, traced)
+        return iter(sorted(set(findings)))
+
+    # -- per-statement scan, recursing into nested defs ---------------------
+    def _scan_stmt(self, ctx: FileContext, stmt: ast.stmt,
+                   traced: Set[str], findings: List[Finding]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = traced | _traced_params(stmt, set(), set())
+            for s in stmt.body:
+                self._scan_stmt(ctx, s, inner, findings)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        # python `if`/`while` on a traced value
+        if isinstance(stmt, (ast.If, ast.While)):
+            for name in _traced_name_uses(stmt.test, traced):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                findings.append(ctx.finding(
+                    self.name, name,
+                    f"python `{kind}` on traced value '{name.id}' — "
+                    "inside jit this is a ConcretizationTypeError or a "
+                    "silent trace-time constant; use lax.cond/jnp.where"))
+        for expr in flow.stmt_expressions(stmt):
+            self._scan_expr(ctx, expr, traced, findings)
+        for sub in flow.child_bodies(stmt):
+            for s in sub:
+                self._scan_stmt(ctx, s, traced, findings)
+
+    def _scan_expr(self, ctx: FileContext, expr: ast.AST,
+                   traced: Set[str], findings: List[Finding]) -> None:
+        for call in flow.iter_calls(expr):
+            fname = flow.call_func_name(call)
+            if fname == "print":
+                findings.append(ctx.finding(
+                    self.name, call,
+                    "print() inside a traced function runs at trace "
+                    "time only — use jax.debug.print or move it out"))
+            elif fname in _HOST_CALLS:
+                findings.append(ctx.finding(
+                    self.name, call,
+                    f"{fname}() inside a traced function executes once "
+                    "at trace time (a frozen constant), not per call"))
+            elif isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in _HOST_METHODS \
+                    and not call.args:
+                findings.append(ctx.finding(
+                    self.name, call,
+                    f".{call.func.attr}() forces a device->host sync — "
+                    "illegal on traced values inside jit"))
+            elif fname in _NP_SYNCS and call.args and \
+                    _traced_name_uses(call.args[0], traced):
+                findings.append(ctx.finding(
+                    self.name, call,
+                    f"{fname}() on traced value concretizes it at trace "
+                    "time — use jnp equivalents inside jit"))
+            elif fname in _CONVERTERS and call.args and \
+                    _traced_name_uses(call.args[0], traced):
+                findings.append(ctx.finding(
+                    self.name, call,
+                    f"{fname}() on a traced value forces concretization "
+                    "inside jit — keep it an array (jnp.float32(...) / "
+                    ".astype) or hoist to the host side"))

@@ -1,0 +1,163 @@
+"""unlocked-shared-write: writes to fleet-shared state outside its lock.
+
+The async fleet (PR 10) shares host state across actor threads and the
+learner: the published weights snapshot in
+:class:`~smartcal_tpu.runtime.supervisor.Fleet` and the buffered RunLog
+internals every thread logs through.  Those objects declare a lock and
+the contract is lexical: every write to a shared field happens inside a
+``with <lock>:`` block (or in a method whose name ends ``_locked``,
+the repo's "caller holds the lock" convention, or in ``__init__``,
+which runs before the object is shared).
+
+The rule is SEEDED from :data:`SHARED_FIELD_SPECS` — an annotated list
+of (file, class, shared fields, lock attrs).  Declaring a new shared
+field means adding a row here; the rule then enforces the lock
+discipline on every write forever after.  Detected writes: attribute
+assignment/aug-assignment/deletion, subscript stores through the field,
+and calls to mutating container methods on the field."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from ..core import FileContext, Finding, Rule, register
+from .. import flow
+
+# The annotated shared-state registry.  ``path`` is a repo-relative
+# suffix; ``fields`` are attribute names shared across threads; every
+# write must be under a ``with`` on one of ``locks``.
+SHARED_FIELD_SPECS = [
+    {
+        "path": "smartcal_tpu/runtime/supervisor.py",
+        "class": "Fleet",
+        "fields": ["_weights", "_version"],
+        "locks": ["_wlock"],
+        "why": "weights snapshot + version read by every actor thread "
+               "per rollout (get_weights) while the learner publishes",
+    },
+    {
+        "path": "smartcal_tpu/obs/runlog.py",
+        "class": "RunLog",
+        "fields": ["_buf", "_bytes", "_fh", "_rotations", "_last_flush"],
+        "locks": ["_lock"],
+        "why": "every thread (actors, prefetch worker, watchdog) logs "
+               "through the active RunLog's shared buffer",
+    },
+]
+
+_MUTATORS = {"append", "add", "extend", "update", "insert", "pop",
+             "popleft", "remove", "discard", "clear", "setdefault",
+             "appendleft"}
+
+_EXEMPT_METHODS = ("__init__", "__new__")
+
+
+def _lock_exprs(node: ast.With) -> List[str]:
+    out = []
+    for item in node.items:
+        name = flow.dotted(item.context_expr)
+        if name is None and isinstance(item.context_expr, ast.Call):
+            name = flow.dotted(item.context_expr.func)
+        if name:
+            out.append(name)
+    return out
+
+
+@register
+class UnlockedSharedWrite(Rule):
+    name = "unlocked-shared-write"
+    doc = ("write to an annotated fleet-shared field outside its "
+           "`with <lock>:` block (see SHARED_FIELD_SPECS)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        specs = ctx.options.get("shared_specs", SHARED_FIELD_SPECS)
+        mine = [s for s in specs if ctx.rel.endswith(s["path"])]
+        if not mine:
+            return iter(())
+        findings: List[Finding] = []
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for spec in mine:
+                want = spec.get("class")
+                if want and cls.name != want:
+                    continue
+                self._check_class(ctx, cls, set(spec["fields"]),
+                                  set(spec["locks"]), findings)
+        return iter(sorted(set(findings)))
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef,
+                     fields: Set[str], locks: Set[str],
+                     findings: List[Finding]) -> None:
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if meth.name in _EXEMPT_METHODS or meth.name.endswith("_locked"):
+                continue  # construction / caller-holds-lock convention
+            self._scan(ctx, meth.name, meth.body, fields, locks,
+                       held=False, findings=findings)
+
+    def _scan(self, ctx: FileContext, meth: str, body: List[ast.stmt],
+              fields: Set[str], locks: Set[str], held: bool,
+              findings: List[Finding]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            now_held = held
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                names = _lock_exprs(stmt)
+                if any(n.split(".")[-1] in locks for n in names):
+                    now_held = True
+            if not held:
+                for field, node in self._writes_of(stmt, fields):
+                    findings.append(ctx.finding(
+                        self.name, node,
+                        f"write to shared field '{field}' in {meth}() "
+                        f"outside a `with {'/'.join(sorted(locks))}` "
+                        "block — racing every thread that reads it (take "
+                        "the lock, or rename the method *_locked if the "
+                        "caller holds it)"))
+            for sub in flow.child_bodies(stmt):
+                self._scan(ctx, meth, sub, fields, locks, now_held,
+                           findings)
+
+    def _writes_of(self, stmt: ast.stmt, fields: Set[str]):
+        """(field, node) for shared-field writes in THIS statement only
+        (header of compound statements)."""
+        out = []
+
+        def target_hit(t: ast.AST) -> None:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    target_hit(e)
+                return
+            if isinstance(t, ast.Starred):
+                target_hit(t.value)
+                return
+            if isinstance(t, ast.Subscript):
+                # self._buf[i] = x writes THROUGH the field
+                t = t.value
+            if isinstance(t, ast.Attribute) and t.attr in fields:
+                out.append((t.attr, t))
+
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                target_hit(t)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            target_hit(stmt.target)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                target_hit(t)
+        # mutating container-method calls on the field, in any
+        # value-position expression of this statement
+        for expr in flow.stmt_expressions(stmt):
+            for call in flow.iter_calls(expr):
+                f = call.func
+                if isinstance(f, ast.Attribute) and f.attr in _MUTATORS \
+                        and isinstance(f.value, ast.Attribute) \
+                        and f.value.attr in fields:
+                    out.append((f.value.attr, call))
+        return out

@@ -1,0 +1,53 @@
+"""unguarded-pickle-load: ``pickle.load`` outside the runtime IO layer.
+
+PR 6's atomic-IO contract: every load of persisted state goes through
+:mod:`smartcal_tpu.runtime.atomic` — ``safe_pickle_load`` (warn + default
+for resumable state that may start fresh) or ``strict_pickle_load``
+(clear CorruptStateError for state that must exist) — so a SIGTERM
+mid-write never surfaces as an opaque ``EOFError`` three frames deep in
+``pickle``.  A bare ``pickle.load(fh)`` bypasses both the corruption
+message and the policy decision about what happens on a torn file.
+
+Scope: ``smartcal_tpu/`` and ``tools/``; test code is exempt (tests
+read files they just wrote inside one process — there is no torn-write
+window to guard)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from ..core import FileContext, Finding, Rule, register
+from .. import flow
+
+# the one sanctioned call site: the guard implementation itself
+ALLOWED_PATHS = ("smartcal_tpu/runtime/atomic.py",)
+
+_LOADERS = {"pickle.load", "cPickle.load", "dill.load", "joblib.load"}
+
+
+@register
+class UnguardedPickleLoad(Rule):
+    name = "unguarded-pickle-load"
+    doc = ("pickle.load outside runtime.atomic "
+           "(safe_pickle_load/strict_pickle_load) — torn files become "
+           "opaque EOFErrors")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        allowed = ctx.options.get("pickle_allowed_paths", ALLOWED_PATHS)
+        if any(ctx.rel.endswith(p) for p in allowed):
+            return iter(())
+        if ctx.rel.startswith("tests/") or "/tests/" in ctx.rel:
+            return iter(())
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    flow.call_func_name(node) in _LOADERS:
+                findings.append(ctx.finding(
+                    self.name, node,
+                    "bare pickle.load — route through runtime.atomic."
+                    "safe_pickle_load (resumable state: warn + start "
+                    "fresh) or strict_pickle_load (must-exist state: "
+                    "clear CorruptStateError) so torn writes fail "
+                    "diagnosably"))
+        return iter(sorted(findings))

@@ -1,0 +1,100 @@
+"""bare-print: diagnostics must flow through the obs layer.
+
+The framework port of ``tests/test_no_bare_print.py`` (PR 3/4), same
+allowlist semantics: ``obs.echo`` routes human output to stderr plus a
+structured event, ``obs.emit_json`` is the stdout machine interface, so
+a bare ``print(`` is either an unstructured diagnostic (breaks
+``--quiet`` and the RunLog) or an undeclared stdout contract.
+``smartcal_tpu/obs/console.py`` is the one sanctioned package site; in
+``tools/`` an explicit allowlist names the CLIs whose stdout IS their
+product.  Tokenizer-based so strings, comments and ``.print(`` method
+calls never false-positive.  Test code is exempt."""
+
+from __future__ import annotations
+
+import io
+import tokenize
+from typing import Iterator, List
+
+from ..core import FileContext, Finding, Rule, register
+
+# relative paths (to smartcal_tpu/) allowed to call print()
+PKG_ALLOWLIST = frozenset({
+    "obs/console.py",
+})
+
+# tools/ files sanctioned to print to stdout directly: their stdout is
+# the tool's interface (report/sweep/bench output that scripts parse or
+# humans pipe).  A new tool must either route through
+# smartcal_tpu.obs.console or be added here deliberately.
+TOOLS_STDOUT_ALLOWLIST = frozenset({
+    "bench_host_seg.py",
+    "bench_per.py",
+    "bench_solve_eval.py",
+    "capture_calib_episode.py",
+    "certify_batched.py",
+    "chip_checks.py",
+    "convert_ateam.py",
+    "eig_mode_parity.py",
+    "enet_hint_stats.py",
+    "lint.py",
+    "measure_reference.py",
+    "obs_report.py",
+    "obs_tail.py",
+    "summarize_demix_curves.py",
+    "sweep_calib.py",
+    "sweep_demix.py",
+    "sweep_enet.py",
+})
+
+_SKIP_TYPES = (tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+               tokenize.DEDENT, tokenize.COMMENT)
+
+
+def bare_print_lines(src: str) -> List[int]:
+    """Line numbers of bare ``print(`` calls (NAME 'print' followed by
+    '(', not preceded by '.' or 'def')."""
+    toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    hits = []
+    for i, t in enumerate(toks):
+        if t.type != tokenize.NAME or t.string != "print":
+            continue
+        prev = next((p for p in reversed(toks[:i])
+                     if p.type not in _SKIP_TYPES), None)
+        if prev is not None and prev.string in (".", "def"):
+            continue
+        nxt = next((n for n in toks[i + 1:] if n.type not in _SKIP_TYPES),
+                   None)
+        if nxt is not None and nxt.string == "(":
+            hits.append(t.start[0])
+    return hits
+
+
+@register
+class BarePrint(Rule):
+    name = "bare-print"
+    doc = ("bare print() in smartcal_tpu/ or an unlisted tool — route "
+           "through obs.echo/obs.emit_json or extend the allowlist")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        rel = ctx.rel
+        if rel.startswith("smartcal_tpu/"):
+            if rel[len("smartcal_tpu/"):] in PKG_ALLOWLIST:
+                return iter(())
+            where = ("route human output through smartcal_tpu.obs.echo "
+                     "(stderr + structured event) or obs.emit_json "
+                     "(stdout machine payloads), or extend "
+                     "PKG_ALLOWLIST deliberately")
+        elif rel.startswith("tools/") and rel.count("/") == 1:
+            if rel[len("tools/"):] in TOOLS_STDOUT_ALLOWLIST:
+                return iter(())
+            where = ("route output through smartcal_tpu.obs.console "
+                     "(echo/emit_json) or add the file to "
+                     "TOOLS_STDOUT_ALLOWLIST deliberately")
+        else:
+            return iter(())  # tests/, examples/, etc. are exempt
+        findings = []
+        for line in bare_print_lines(ctx.src):
+            findings.append(ctx.finding(
+                "bare-print", line, f"bare print() — {where}"))
+        return iter(sorted(findings))

@@ -1,0 +1,126 @@
+"""rng-key-reuse: a jax.random key consumed twice without a split.
+
+Every sampling call (and ``split``/``fold_in`` themselves) CONSUMES the
+key passed to it: sampling from the same key twice yields correlated
+draws, and — worse for this repo — one accidental extra consumption
+shifts every downstream stream, breaking the same-seed bit-parity the
+kill/resume and batched-vs-sequential certifications depend on
+(lane i ≙ ``CalibEnv(seed+i)`` holds only while each stream advances by
+exactly the same splits).
+
+Tracked per scope in source order with branch-clone semantics; a key
+consumed inside a loop body that the body never re-splits is reported
+as loop-carried reuse (the same key every iteration)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..core import FileContext, Finding, Rule, register
+from .. import flow
+
+# jax.random functions whose first argument is a consumed PRNG key
+SAMPLERS = frozenset({
+    "ball", "bernoulli", "beta", "binomial", "bits", "categorical",
+    "cauchy", "chisquare", "choice", "dirichlet", "double_sided_maxwell",
+    "exponential", "gamma", "generalized_normal", "geometric", "gumbel",
+    "laplace", "loggamma", "logistic", "lognormal", "maxwell",
+    "multivariate_normal", "normal", "orthogonal", "pareto", "permutation",
+    "poisson", "rademacher", "randint", "rayleigh", "t",
+    "triangular", "truncated_normal", "uniform", "wald", "weibull_min",
+})
+# split consumes its key exactly like a sampler (split(key) twice
+# yields identical children).  fold_in is deliberately NOT a consumer:
+# fold_in(key, i) with varying data is the documented derive-a-stream
+# idiom — the guard graftlint checks for is rebinding, and
+# `key = jax.random.fold_in(key, i)` clears the state like any
+# assignment.
+KEY_CONSUMERS = SAMPLERS | {"split"}
+
+# call prefixes that mean "this is the jax PRNG module".  The bare
+# stdlib-colliding prefix "random" is deliberately NOT accepted
+# (stdlib random.choice/randint/uniform take no key and would track
+# their first argument); numpy's np.random.* likewise has no key.
+_JAX_RANDOM_PREFIXES = ("jax.random", "jrandom", "jr")
+
+
+def _consume_event(call: ast.Call) -> Optional[Tuple[str, ast.AST]]:
+    """(key dotted name, node) when ``call`` consumes a named key."""
+    fname = flow.call_func_name(call)
+    if fname is None or "." not in fname:
+        return None
+    prefix, tail = fname.rsplit(".", 1)
+    if tail not in KEY_CONSUMERS:
+        return None
+    if prefix not in _JAX_RANDOM_PREFIXES:
+        return None
+    key_arg: Optional[ast.AST] = None
+    if call.args:
+        key_arg = call.args[0]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "key":
+                key_arg = kw.value
+                break
+    if key_arg is None:
+        return None
+    name = flow.dotted(key_arg)
+    if name is None:
+        return None
+    return name, call
+
+
+def _events_of_stmt(stmt: ast.stmt) -> List[Tuple[str, ast.AST]]:
+    out = []
+    for expr in flow.stmt_expressions(stmt):
+        for call in flow.iter_calls(expr):
+            ev = _consume_event(call)
+            if ev is not None:
+                out.append(ev)
+    return out
+
+
+@register
+class RngKeyReuse(Rule):
+    name = "rng-key-reuse"
+    doc = ("jax.random key consumed by two sampling/split calls with no "
+           "split/fold_in between them in the same scope")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        findings: List[Finding] = []
+
+        def run_scope(body: List[ast.stmt]) -> None:
+            state: Dict[str, ast.AST] = {}
+
+            def visit(stmt: ast.stmt, st: Dict[str, ast.AST]) -> None:
+                for name, node in _events_of_stmt(stmt):
+                    prev = st.get(name)
+                    if prev is not None:
+                        findings.append(ctx.finding(
+                            self.name, node,
+                            f"key '{name}' was already consumed at line "
+                            f"{prev.lineno} — split/fold_in before reusing "
+                            "it (reuse correlates draws and breaks "
+                            "same-seed stream parity)"))
+                    st[name] = node
+                for t in flow.assigned_targets(stmt):
+                    st.pop(t, None)
+                    pref = t + "."
+                    for k in [k for k in st if k.startswith(pref)]:
+                        st.pop(k)
+
+            def on_loop_carry(name: str, node: ast.AST) -> None:
+                findings.append(ctx.finding(
+                    self.name, node,
+                    f"key '{name}' is consumed every loop iteration but "
+                    "never re-split in the loop body — each iteration "
+                    "samples from the SAME key"))
+
+            flow.walk_scope_linear(body, state, visit,
+                                   loop_extract=_events_of_stmt,
+                                   on_loop_carry=on_loop_carry)
+
+        for _scope, body in flow.iter_scopes(ctx.tree):
+            run_scope(body)
+        return iter(sorted(set(findings)))

@@ -1,0 +1,75 @@
+"""traced-static-flag: a python-static flag fed a jax-derived value.
+
+``collect_diag=``/``collect_stats=``/``optimized=``/``fused=`` (and
+``vectorized=``) are python-static by contract (PRs 3-5/9): each value
+selects a trace, so the argument must be a host bool known before
+tracing.  Passing a traced value either recompiles per call or raises a
+ConcretizationTypeError deep inside the callee — far from the cause.
+
+The check is traced-ness-by-construction: the value expression (or a
+local name it was assigned from) must not contain anything rooted at
+``jnp.``/``jax.``/``lax.`` — host-side config (``args.diag``,
+``diag_from_args(args)``, ``self.fused``) passes untouched."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from ..core import FileContext, Finding, Rule, register
+from .. import flow
+
+STATIC_FLAGS = ("collect_diag", "collect_stats", "optimized", "fused",
+                "vectorized")
+
+_JAX_ROOTS = ("jnp", "jax", "lax")
+
+
+def _jax_rooted(expr: ast.AST, jaxy_names: Set[str]) -> bool:
+    """True when any sub-expression is rooted at a jax module or a
+    local name known to hold a jax-derived value."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in _JAX_ROOTS or node.id in jaxy_names:
+                return True
+    return False
+
+
+def _jaxy_locals(body: List[ast.stmt]) -> Set[str]:
+    """Names assigned (once-level, no fixpoint) from jax-rooted
+    expressions in this scope — catches ``flag = jnp.any(x);
+    f(optimized=flag)``."""
+    out: Set[str] = set()
+    for node in flow.walk_in_scope(body):
+        if isinstance(node, ast.Assign) and node.value is not None \
+                and _jax_rooted(node.value, out):
+            for t in node.targets:
+                name = flow.dotted(t)
+                if name and "." not in name:
+                    out.add(name)
+    return out
+
+
+@register
+class TracedStaticFlag(Rule):
+    name = "traced-static-flag"
+    doc = ("python-static flag (collect_diag/collect_stats/optimized/"
+           "fused/vectorized) receiving a jax-derived (traced) value")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        findings: List[Finding] = []
+        for _scope, body in flow.iter_scopes(ctx.tree):
+            jaxy = _jaxy_locals(body)
+            for node in flow.walk_in_scope(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg in STATIC_FLAGS and \
+                            _jax_rooted(kw.value, jaxy):
+                        findings.append(ctx.finding(
+                            self.name, kw.value,
+                            f"{kw.arg}= is python-static by contract "
+                            "but receives a jax-derived value — each "
+                            "distinct value is a separate trace; pass "
+                            "a host bool decided before tracing"))
+        return iter(sorted(set(findings)))

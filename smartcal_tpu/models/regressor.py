@@ -49,5 +49,6 @@ class TrainingBuffer:
             pickle.dump(self.__dict__, fh)
 
     def load_checkpoint(self, path="databuffer.pkl"):
-        with open(path, "rb") as fh:
-            self.__dict__.update(pickle.load(fh))
+        from smartcal_tpu.runtime.atomic import strict_pickle_load
+
+        self.__dict__.update(strict_pickle_load(path))

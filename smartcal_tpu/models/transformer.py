@@ -130,7 +130,8 @@ class XYBuffer:
                          "mem_cntr": self.mem_cntr}, fh)
 
     def load(self, path):
-        with open(path, "rb") as fh:
-            d = pickle.load(fh)
+        from smartcal_tpu.runtime.atomic import strict_pickle_load
+
+        d = strict_pickle_load(path)
         self.x, self.y, self.mem_cntr = d["x"], d["y"], d["mem_cntr"]
         self.mem_size = self.x.shape[0]

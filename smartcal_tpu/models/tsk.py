@@ -115,5 +115,6 @@ def save_tsk(params: TSKParams, path="tsk.model.pkl"):
 
 
 def load_tsk(path="tsk.model.pkl") -> TSKParams:
-    with open(path, "rb") as fh:
-        return TSKParams(*pickle.load(fh))
+    from smartcal_tpu.runtime.atomic import strict_pickle_load
+
+    return TSKParams(*strict_pickle_load(path))

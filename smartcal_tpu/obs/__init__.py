@@ -31,7 +31,7 @@ from .spans import span                                    # noqa: F401
 from .watchdog import Watchdog, WatchdogConfig             # noqa: F401
 
 
-def log_solver_stats(stats, **tags):
+def log_solver_stats(stats: "object", **tags: object) -> None:
     """Record a ``solver`` event from a ``cal.solver.SolverStats`` (forces
     the small stat arrays to host — only called with telemetry on).
 

@@ -17,7 +17,8 @@ import sys
 from .runlog import active, sanitize
 
 
-def echo(msg, quiet: bool = False, event="log", **fields):
+def echo(msg: object, quiet: bool = False,
+         event: "str | None" = "log", **fields: object) -> None:
     """Human-facing diagnostic: structured event (when recording) plus a
     stderr echo (unless ``quiet``).  ``event=None`` skips the structured
     record — for echoes whose content was already logged under another
@@ -29,7 +30,7 @@ def echo(msg, quiet: bool = False, event="log", **fields):
         print(msg, file=sys.stderr, flush=True)
 
 
-def emit_json(payload: dict, event: str = "result"):
+def emit_json(payload: dict, event: str = "result") -> None:
     """Machine-facing result line: always printed to STDOUT (the contract
     bench/capture scripts parse), mirrored into the RunLog when active."""
     rl = active()

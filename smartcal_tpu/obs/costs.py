@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import sys
 import threading
+from typing import Callable, Optional, Sequence
 
 from .runlog import active
 
@@ -83,7 +84,9 @@ def _signature(args, kwargs) -> str:
     return str(treedef) + "|" + ";".join(sig)
 
 
-def stage_cost(fn, *args, static_argnames=(), **kwargs) -> dict:
+def stage_cost(fn: Callable, *args: object,
+               static_argnames: Sequence[str] = (),
+               **kwargs: object) -> dict:
     """XLA cost analysis of ``fn(*args, **kwargs)``: ``{"flops": ...,
     "bytes_accessed": ...}`` (floats; absent metrics -> 0.0).
 
@@ -117,8 +120,10 @@ def _compute_and_log(stage, fn, args, static_argnames, kwargs) -> dict:
     return cost
 
 
-def record_stage_cost(stage: str, fn, *args, static_argnames=(),
-                      defer: bool = False, **kwargs):
+def record_stage_cost(stage: str, fn: Callable, *args: object,
+                      static_argnames: Sequence[str] = (),
+                      defer: bool = False,
+                      **kwargs: object) -> Optional[dict]:
     """Log the ``cost`` event for ``stage`` once per abstract signature.
 
     Strict no-op unless BOTH a RunLog is active and collection is

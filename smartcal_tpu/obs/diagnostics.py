@@ -36,7 +36,7 @@ of never touching an accelerator backend.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any, Iterator, NamedTuple
 
 
 class UpdateDiag(NamedTuple):
@@ -62,7 +62,7 @@ def _jnp():
     return jnp
 
 
-def tree_norm(tree):
+def tree_norm(tree: object) -> "object":
     """Global L2 norm over every leaf of ``tree`` (0.0 for empty trees)."""
     import jax
     jnp = _jnp()
@@ -72,19 +72,20 @@ def tree_norm(tree):
     return jnp.sqrt(sum(sq))
 
 
-def update_ratio(update_tree, param_tree, eps: float = 1e-12):
+def update_ratio(update_tree: object, param_tree: object,
+                 eps: float = 1e-12) -> "object":
     """||update|| / ||params|| — the relative step the optimizer took."""
     return tree_norm(update_tree) / (tree_norm(param_tree) + eps)
 
 
-def target_drift(params, target_params):
+def target_drift(params: object, target_params: object) -> "object":
     """Global L2 norm of (params - target_params)."""
     import jax
     diff = jax.tree_util.tree_map(lambda a, b: a - b, params, target_params)
     return tree_norm(diff)
 
 
-def make_diag(**fields) -> UpdateDiag:
+def make_diag(**fields: object) -> UpdateDiag:
     """Build an :class:`UpdateDiag`, defaulting unset fields to 0.0 —
     agents fill what they have (DDPG has no alpha, TD3's skip steps have
     no actor update, ...)."""
@@ -118,7 +119,7 @@ def diag_to_host(diag: UpdateDiag) -> dict:
     return out
 
 
-def diag_steps(host_diag: dict):
+def diag_steps(host_diag: dict) -> "Iterator[dict]":
     """Iterate a ``diag_to_host`` dict as per-step dicts.  Scalar fields
     (an unstacked single update) yield exactly one step."""
     first = next(iter(host_diag.values()))

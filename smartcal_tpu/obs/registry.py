@@ -29,7 +29,7 @@ _lock = threading.Lock()
 _counters: dict = {}
 
 
-def counter_add(name: str, value=1.0):
+def counter_add(name: str, value: float = 1.0) -> None:
     """Accumulate ``value`` onto counter ``name`` (no-op when inactive)."""
     if active() is None:
         return
@@ -37,7 +37,7 @@ def counter_add(name: str, value=1.0):
         _counters[name] = _counters.get(name, 0.0) + value
 
 
-def gauge_set(name: str, value, **tags):
+def gauge_set(name: str, value: object, **tags: object) -> None:
     """Log gauge ``name`` as a ``gauge`` event (no-op when inactive)."""
     rl = active()
     if rl is None:
@@ -50,7 +50,7 @@ def counters_snapshot() -> dict:
         return dict(_counters)
 
 
-def flush_counters(reset: bool = False, **tags):
+def flush_counters(reset: bool = False, **tags: object) -> None:
     """Write all accumulated counters as one ``counters`` event.
 
     ``reset=True`` clears them afterwards — run teardown uses it so a
@@ -68,7 +68,7 @@ def flush_counters(reset: bool = False, **tags):
         rl.log("counters", values=snap, **tags)
 
 
-def reset_counters():
+def reset_counters() -> None:
     with _lock:
         _counters.clear()
 

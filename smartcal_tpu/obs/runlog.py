@@ -42,7 +42,7 @@ import socket
 import sys
 import threading
 import time
-from typing import Optional
+from typing import Iterator, Optional
 
 # Schema history (the header's ``schema`` field; readers should accept
 # >= their known version — every bump so far is purely additive):
@@ -62,7 +62,7 @@ def _gen_run_id() -> str:
     return f"{int(time.time()):x}-{os.urandom(4).hex()}"
 
 
-def sanitize(v):
+def sanitize(v: object) -> object:
     """Recursively convert ``v`` into JSON-safe data: non-finite floats ->
     None, numpy/jax scalars -> python scalars, arrays -> (sanitized)
     lists, unknown objects -> ``str``."""
@@ -150,7 +150,7 @@ class RunLog:
             self._bytes = 0
 
     @property
-    def path(self):
+    def path(self) -> Optional[str]:
         return self._path
 
     def _write_header(self):
@@ -162,7 +162,7 @@ class RunLog:
             rec["meta"] = self._meta
         self._emit(rec, force_flush=True)
 
-    def log(self, event: str, **fields):
+    def log(self, event: str, **fields: object) -> None:
         """Append one event record (buffered; see class docstring)."""
         if self._fh is None:
             return
@@ -203,12 +203,12 @@ class RunLog:
         if self._header:
             self._write_header()
 
-    def flush(self):
+    def flush(self) -> None:
         with self._lock:
             if self._fh is not None:
                 self._flush_locked()
 
-    def close(self):
+    def close(self) -> None:
         with self._lock:
             if self._fh is not None:
                 self._flush_locked()
@@ -242,7 +242,7 @@ def activate(runlog: RunLog) -> RunLog:
     return runlog
 
 
-def deactivate(runlog: Optional[RunLog] = None):
+def deactivate(runlog: Optional[RunLog] = None) -> None:
     """Pop the active run (or remove ``runlog`` specifically)."""
     with _active_lock:
         if not _active_stack:
@@ -262,7 +262,8 @@ def active() -> Optional[RunLog]:
 
 
 @contextlib.contextmanager
-def recording(path_or_runlog, **kwargs):
+def recording(path_or_runlog: "str | RunLog",
+              **kwargs: object) -> Iterator[RunLog]:
     """``with recording("run.jsonl") as rl:`` — create (when given a
     path), activate, and on exit deactivate (and close only if created
     here)."""

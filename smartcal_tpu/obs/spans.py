@@ -21,7 +21,7 @@ import sys
 import threading
 import time
 
-from .runlog import active
+from .runlog import RunLog, active
 
 _tls = threading.local()
 
@@ -61,7 +61,7 @@ class _NullSpan:
     def __exit__(self, *exc):
         return False
 
-    def tag(self, **tags):          # same surface as Span, still a no-op
+    def tag(self, **tags: object) -> "_NullSpan":  # Span surface, no-op
         return self
 
 
@@ -71,7 +71,8 @@ _NULL_SPAN = _NullSpan()
 class Span:
     __slots__ = ("_rl", "name", "tags", "path", "_t0", "_ann")
 
-    def __init__(self, rl, name, tags):
+    def __init__(self, rl: "RunLog", name: str,
+                 tags: dict) -> None:
         self._rl = rl
         self.name = name
         self.tags = tags
@@ -79,7 +80,7 @@ class Span:
         self._t0 = 0.0
         self._ann = None
 
-    def tag(self, **tags):
+    def tag(self, **tags: object) -> "Span":
         """Attach/override tags after entry (e.g. a routing decision made
         mid-region)."""
         self.tags.update(tags)
@@ -120,7 +121,7 @@ class Span:
         return False
 
 
-def span(name: str, **tags):
+def span(name: str, **tags: object) -> "Span | _NullSpan":
     """Time a stage: ``with span("solve", route="sharded"): ...``.
 
     Returns the shared null context manager when no RunLog is active."""

@@ -23,7 +23,6 @@ the same machinery with extra keys.
 from __future__ import annotations
 
 import math
-import pickle
 from typing import NamedTuple, Optional
 
 import jax
@@ -37,7 +36,8 @@ PER_BETA0 = 0.4
 PER_BETA_INCREMENT = 1e-4
 
 
-def priority_from_errors(errors, error_clip: float = 100.0):
+def priority_from_errors(errors: jnp.ndarray,
+                         error_clip: float = 100.0) -> jnp.ndarray:
     """Store-time priority rule min((|e|+eps)^alpha, clip)
     (``PER.store_transition``, enet_sac.py:237-243).  NOTE the deliberate
     asymmetry with :func:`replay_update_priorities`, which follows the
@@ -163,7 +163,8 @@ def _filled(buf: ReplayState):
     return jnp.minimum(buf.cntr, buf.size)
 
 
-def replay_sample_uniform(buf: ReplayState, key, batch_size: int):
+def replay_sample_uniform(buf: ReplayState, key: jnp.ndarray,
+                          batch_size: int) -> "tuple[dict, jnp.ndarray]":
     """Uniform sample w/o replacement over the filled prefix.
 
     Gumbel-top-k: add iid Gumbel noise to a 0/-inf mask and take the top
@@ -180,8 +181,10 @@ def replay_sample_uniform(buf: ReplayState, key, batch_size: int):
     return batch, idx
 
 
-def replay_sample_per(buf: ReplayState, key, batch_size: int,
-                      recency_eta: Optional[float] = None):
+def replay_sample_per(
+        buf: ReplayState, key: jnp.ndarray, batch_size: int,
+        recency_eta: Optional[float] = None,
+) -> "tuple[dict, jnp.ndarray, jnp.ndarray, ReplayState]":
     """Stratified priority sampling + IS weights (enet_sac.py:270-312).
 
     ``recency_eta`` (python-static; None/1.0 = off) modulates the
@@ -222,7 +225,7 @@ def replay_sample_per(buf: ReplayState, key, batch_size: int,
 ERE_SPAN = 100.0
 
 
-def ere_weights(buf: ReplayState, eta: float):
+def ere_weights(buf: ReplayState, eta: float) -> jnp.ndarray:
     """Emphasizing-recent-experience weights over the ring slots
     (Wang & Ross, arXiv:1906.04009, re-expressed as a stateless
     per-slot weighting so it fuses into the jitted sample step).
@@ -241,7 +244,9 @@ def ere_weights(buf: ReplayState, eta: float):
     return jnp.where(slots < filled, w, 0.0)
 
 
-def replay_sample_ere(buf: ReplayState, key, batch_size: int, eta: float):
+def replay_sample_ere(buf: ReplayState, key: jnp.ndarray,
+                      batch_size: int,
+                      eta: float) -> "tuple[dict, jnp.ndarray]":
     """Recency-weighted sampling for UNIFORM buffers (the ERE knob of the
     async fleet's device-resident replay path; prioritized buffers get
     the same knob through ``replay_sample_per(recency_eta=...)``).
@@ -263,7 +268,8 @@ def replay_sample_ere(buf: ReplayState, key, batch_size: int, eta: float):
     return batch, idx
 
 
-def replay_update_priorities(buf: ReplayState, idx, errors,
+def replay_update_priorities(buf: ReplayState, idx: jnp.ndarray,
+                             errors: jnp.ndarray,
                              error_clip: float = 100.0) -> ReplayState:
     """``batch_update`` (enet_sac.py:314-323): p = min(|e|+eps, clip)^alpha."""
     clipped = jnp.minimum(jnp.abs(errors) + PER_EPSILON, error_clip)
@@ -271,7 +277,9 @@ def replay_update_priorities(buf: ReplayState, idx, errors,
         priority=buf.priority.at[idx].set(clipped ** PER_ALPHA))
 
 
-def staleness_clip_weights(raw, versions, learner_version, clip_c):
+def staleness_clip_weights(raw: jnp.ndarray, versions: jnp.ndarray,
+                           learner_version: jnp.ndarray,
+                           clip_c: float) -> jnp.ndarray:
     """The staleness-gated clipped-weight core shared by the agents'
     IMPACT-style weightings (``sac.impact_weights``, the discrete twin,
     ``td3.staleness_weights``): clip the raw per-transition weight to
@@ -330,7 +338,8 @@ def validate_fleet_knobs(is_clip: float, ere_eta: float,
             "them — use replay_backend='hbm'")
 
 
-def per_mse(expected, targets, is_weights):
+def per_mse(expected: jnp.ndarray, targets: jnp.ndarray,
+            is_weights: jnp.ndarray) -> jnp.ndarray:
     """IS-weighted MSE (reference ``PER.mse``, enet_sac.py:326-329)."""
     td = expected - targets
     w = is_weights.reshape(is_weights.shape + (1,) * (td.ndim - 1))
@@ -408,8 +417,9 @@ def save_replay(buf: ReplayState, path: str) -> None:
 
 
 def load_replay(path: str) -> ReplayState:
-    with open(path, "rb") as f:
-        host = pickle.load(f)
+    from smartcal_tpu.runtime.atomic import strict_pickle_load
+
+    host = strict_pickle_load(path)
     return jax.tree_util.tree_map(jnp.asarray, host)
 
 

@@ -22,7 +22,6 @@ the replay payload is too large for HBM or the loop is host-driven anyway
 
 from __future__ import annotations
 
-import pickle
 
 import numpy as np
 
@@ -170,6 +169,7 @@ class NativePER:
 
     @classmethod
     def load(cls, path: str) -> "NativePER":
-        with open(path, "rb") as f:
-            state = pickle.load(f)
+        from smartcal_tpu.runtime.atomic import strict_pickle_load
+
+        state = strict_pickle_load(path)
         return cls.from_state_dict(state)

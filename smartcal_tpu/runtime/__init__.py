@@ -30,8 +30,9 @@ Import cost: stdlib only at package import; jax is read lazily inside
 the functions that move device arrays.
 """
 
-from .atomic import (atomic_pickle, atomic_write_bytes,      # noqa: F401
-                     atomic_write_text, safe_pickle_load)
+from .atomic import (CorruptStateError, atomic_pickle,       # noqa: F401
+                     atomic_write_bytes, atomic_write_text,
+                     safe_pickle_load, strict_pickle_load)
 from .backoff import Backoff, BackoffPolicy                  # noqa: F401
 from .checkpoint import (Checkpointer, load_latest,          # noqa: F401
                          pack_env_state, pack_replay, restore_env_state,

@@ -89,6 +89,33 @@ def sha256_file(path: str, chunk: int = 1 << 20) -> str:
     return h.hexdigest()
 
 
+class CorruptStateError(RuntimeError):
+    """A must-exist persisted payload is missing, truncated or
+    unreadable — the torn-write kill signature surfaced as a clear
+    error instead of an opaque ``EOFError`` deep inside pickle."""
+
+
+def strict_pickle_load(path: str) -> Any:
+    """Load a pickle that MUST exist and parse.
+
+    The counterpart of :func:`safe_pickle_load` for state with no
+    sane fresh-start (trained models, eval payloads): failures raise
+    :class:`CorruptStateError` naming the file and the likely cause so
+    the operator sees "restore or regenerate", not a pickle traceback.
+    """
+    if not os.path.exists(path):
+        raise CorruptStateError(
+            f"required state file {path!r} does not exist")
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except Exception as e:
+        raise CorruptStateError(
+            f"required state file {path!r} is unreadable ({e!r}) — "
+            "likely a torn write from a mid-save kill; restore from a "
+            "checkpoint or regenerate it") from e
+
+
 def safe_pickle_load(path: str, default: Any = None,
                      warn: Optional[Callable[[str], None]] = None) -> Any:
     """Load a pickle, degrading to ``default`` on ANY corruption.

@@ -52,7 +52,7 @@ def _ckpt_dirname(step: int) -> str:
     return f"{CKPT_PREFIX}{int(step):06d}"
 
 
-def list_checkpoints(root: str):
+def list_checkpoints(root: str) -> "list[Tuple[int, str]]":
     """[(step, absolute dir)] sorted ascending by step."""
     if not os.path.isdir(root):
         return []
@@ -152,7 +152,9 @@ def load_latest(root: str) -> Optional[Tuple[dict, int]]:
             continue
         try:
             with open(os.path.join(path, PAYLOAD), "rb") as f:
-                return pickle.load(f), step
+                # checksum-validated above + except->older-candidate
+                # fallback IS this loader's corruption guard
+                return pickle.load(f), step  # graftlint: disable=unguarded-pickle-load -- _validate checksum + newest-to-oldest fallback scan is a stronger guard than safe_pickle_load
         except Exception:
             continue
     return None
@@ -172,7 +174,7 @@ def _log_event(event: str, **fields) -> None:
 # Replay-buffer payload forms (HBM pytree + native sum tree)
 # ---------------------------------------------------------------------------
 
-def pack_replay(buf) -> dict:
+def pack_replay(buf: object) -> dict:
     """Uniform host form of a replay buffer for the checkpoint payload.
 
     HBM :class:`~smartcal_tpu.rl.replay.ReplayState` pytrees pull to
@@ -191,7 +193,7 @@ def pack_replay(buf) -> dict:
     raise TypeError(f"unsupported replay buffer {type(buf)!r}")
 
 
-def unpack_replay(obj: dict):
+def unpack_replay(obj: dict) -> object:
     import jax
     import jax.numpy as jnp
 
@@ -209,7 +211,7 @@ def unpack_replay(obj: dict):
 # Env-state payload forms (sequential key chain + batched lane state)
 # ---------------------------------------------------------------------------
 
-def pack_env_state(env) -> Optional[dict]:
+def pack_env_state(env: object) -> Optional[dict]:
     """Uniform host form of an env's RNG/episode state for the checkpoint
     payload.
 
@@ -229,7 +231,7 @@ def pack_env_state(env) -> Optional[dict]:
     return None
 
 
-def restore_env_state(env, obj: Optional[dict]) -> None:
+def restore_env_state(env: object, obj: Optional[dict]) -> None:
     """Inverse of :func:`pack_env_state`: no-op on None, but a payload
     whose kind does not match the env (e.g. a batched checkpoint resumed
     into a sequential run, or vice versa) raises ValueError — silently

@@ -13,7 +13,6 @@ truth by relative L1 error.
 from __future__ import annotations
 
 import argparse
-import pickle
 
 import jax
 import jax.numpy as jnp
@@ -39,8 +38,10 @@ def evaluate(agent_path: str = "sac_state.pkl", games: int = 2, steps: int = 4,
              M: int = 20, N: int = 20, seed: int = 0):
     env_cfg = enet.EnetConfig(M=M, N=N)
     agent_cfg = sac.SACConfig(obs_dim=env_cfg.obs_dim, n_actions=2)
-    with open(agent_path, "rb") as f:
-        agent_state = jax.tree_util.tree_map(jnp.asarray, pickle.load(f))
+    from smartcal_tpu.runtime.atomic import strict_pickle_load
+
+    agent_state = jax.tree_util.tree_map(jnp.asarray,
+                                         strict_pickle_load(agent_path))
 
     key = jax.random.PRNGKey(seed)
     results = []

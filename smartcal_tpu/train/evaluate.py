@@ -40,8 +40,9 @@ def save_model(path, params, K=6, npix=64, model_dim=66):
 
 
 def load_model(path):
-    with open(path, "rb") as fh:
-        ck = pickle.load(fh)
+    from smartcal_tpu.runtime.atomic import strict_pickle_load
+
+    ck = strict_pickle_load(path)
     K = ck["K"]
     npix = ck["npix"]
     model = TransformerEncoder(

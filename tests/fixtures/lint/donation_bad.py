@@ -1,0 +1,35 @@
+"""Positive fixture: reads after donation (every function has one)."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_acc_add = jax.jit(lambda acc, x: acc + x, donate_argnums=(0,))
+
+
+@partial(jax.jit, donate_argnames=("carry",))
+def _step(carry, x):
+    return carry + x
+
+
+def read_after_donating_call(acc, img):
+    out = _acc_add(acc, img)
+    return out + acc                # BAD: acc's buffer was donated
+
+
+def read_after_argnames_donation(carry, xs):
+    new = _step(carry, xs)
+    return new, carry.shape, carry  # BAD: carry read after donation
+
+
+def loop_without_rebind(acc, imgs):
+    for img in imgs:
+        out = _acc_add(acc, img)    # BAD: acc re-donated every iteration
+    return out
+
+
+def known_helper_from_other_module(full, new, lane):
+    from smartcal_tpu.envs.radio import _lane_splice
+    spliced = _lane_splice(full, new, lane)
+    total = jnp.sum(full)           # BAD: full was donated to the splice
+    return spliced, total

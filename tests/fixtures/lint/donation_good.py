@@ -1,0 +1,40 @@
+"""Negative fixture: disciplined donation — zero findings."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_acc_add = jax.jit(lambda acc, x: acc + x, donate_argnums=(0,))
+
+
+@partial(jax.jit, donate_argnames=("carry",))
+def _step(carry, x):
+    return carry + x
+
+
+def rebind_idiom(acc, imgs):
+    for img in imgs:
+        acc = _acc_add(acc, img)    # ok: result rebinds the operand
+    return acc
+
+
+def rebind_then_read(carry, xs):
+    carry = _step(carry, xs)
+    return jnp.sum(carry)           # ok: this is the NEW carry
+
+
+def non_donated_positions_are_free(acc, img):
+    out = _acc_add(acc, img)
+    return out + img                # ok: img (pos 1) was not donated
+
+
+def branch_exclusive(acc, img, flag):
+    if flag:
+        return _acc_add(acc, img)
+    return jnp.sum(acc)             # ok: donation on the other path only
+
+
+def plain_jit_no_donation(x):
+    f = jax.jit(lambda v: v * 2)
+    y = f(x)
+    return y + x                    # ok: no donate_argnums anywhere

@@ -1,0 +1,60 @@
+"""Positive fixture: host syncs inside traced functions."""
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def print_inside_jit(x):
+    print("step", x)                 # BAD: trace-time only
+    return x * 2
+
+
+@jax.jit
+def item_inside_jit(x):
+    return float(x.sum().item())     # BAD: .item() device->host sync
+
+
+@partial(jax.jit, static_argnames=("n",))
+def time_inside_jit(x, n):
+    t0 = time.time()                 # BAD: frozen at trace time
+    return x + t0 + n
+
+
+@jax.jit
+def asarray_on_traced(x):
+    host = np.asarray(x)             # BAD: concretizes the tracer
+    return jnp.sum(x) + host.size
+
+
+@jax.jit
+def float_on_traced(x):
+    return jnp.full((2,), float(x))  # BAD: float() concretizes
+
+
+@jax.jit
+def python_if_on_traced(x):
+    if x > 0:                        # BAD: ConcretizationTypeError
+        return x
+    return -x
+
+
+def _wrapped(x):
+    print("wrapped", x)              # BAD: wrapped below via jax.jit(f)
+    return x + 1
+
+
+apply_wrapped = jax.jit(_wrapped)
+
+
+@jax.jit
+def outer_with_nested(c0, xs):
+    def body(c, x):
+        if c:                        # BAD: nested fn param is traced too
+            return c + x, x
+        return c, x
+
+    return jax.lax.scan(body, c0, xs)

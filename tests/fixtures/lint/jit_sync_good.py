@@ -1,0 +1,58 @@
+"""Negative fixture: jit-safe idioms — zero findings."""
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def pure_math(x):
+    return jnp.sum(x) * 2.0
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def static_branching(x, mode):
+    if mode == "fast":               # ok: mode is static_argnames
+        return x * 2
+    return x
+
+
+@jax.jit
+def flag_with_literal_default(x, collect_diag=False):
+    if collect_diag:                 # ok: literal default => python-static
+        return x, jnp.sum(x)
+    return x, None
+
+
+@jax.jit
+def shape_branching(x):
+    if x.shape[0] > 4:               # ok: shapes are static under trace
+        return x[:4]
+    return x
+
+
+@jax.jit
+def structure_check(x, y):
+    if y is None:                    # ok: `is None` is python-static
+        return x
+    return x + y
+
+
+@jax.jit
+def debug_print_is_fine(x):
+    jax.debug.print("x = {}", x)     # ok: the sanctioned print
+    return x
+
+
+@jax.jit
+def lax_cond_instead_of_if(x):
+    return jax.lax.cond(x > 0, lambda v: v, lambda v: -v, x)
+
+
+def host_driver(x):
+    t0 = time.time()                 # ok: not traced
+    arr = np.asarray(x)
+    print("host side", arr.shape, time.time() - t0)
+    return float(arr.sum())

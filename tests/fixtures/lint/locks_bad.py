@@ -1,0 +1,28 @@
+"""Positive fixture: unlocked writes to annotated shared fields.
+
+The test registers this file with a spec: class Fleet, fields
+{_weights, _version, _queue}, lock {_wlock}.
+"""
+import threading
+
+
+class Fleet:
+    def __init__(self):
+        self._wlock = threading.Lock()
+        self._weights = None           # ok: __init__ runs pre-sharing
+        self._version = 0
+        self._queue = []
+
+    def set_weights(self, w):
+        self._weights = w              # BAD: no lock held
+        self._version += 1             # BAD: no lock held
+
+    def enqueue(self, item):
+        self._queue.append(item)       # BAD: mutator without the lock
+
+    def wrong_lock(self, w, other_lock):
+        with other_lock:
+            self._weights = w          # BAD: not the annotated lock
+
+    def store_slot(self, i, w):
+        self._queue[i] = w             # BAD: subscript store, no lock

@@ -1,0 +1,34 @@
+"""Negative fixture: lock-disciplined shared writes — zero findings.
+
+Registered with the same spec as locks_bad.py: class Fleet, fields
+{_weights, _version, _queue}, lock {_wlock}.
+"""
+import threading
+
+
+class Fleet:
+    def __init__(self):
+        self._wlock = threading.Lock()
+        self._weights = None
+        self._version = 0
+        self._queue = []
+
+    def set_weights(self, w):
+        with self._wlock:
+            self._weights = w          # ok: under the annotated lock
+            self._version += 1
+
+    def enqueue(self, item):
+        with self._wlock:
+            self._queue.append(item)
+
+    def _drain_locked(self):
+        self._queue.clear()            # ok: *_locked caller-holds-lock
+        self._weights = None
+
+    def get_weights(self):
+        with self._wlock:
+            return self._weights, self._version  # reads unchecked anyway
+
+    def unshared_state(self, n):
+        self.counter = n               # ok: not an annotated field

@@ -1,0 +1,14 @@
+"""Positive fixture: bare pickle.load sites (placed as if in-package)."""
+import pickle
+
+
+def load_state(path):
+    with open(path, "rb") as fh:
+        return pickle.load(fh)          # BAD: torn file -> opaque EOFError
+
+
+def load_two(path):
+    fh = open(path, "rb")
+    a = pickle.load(fh)                 # BAD
+    b = pickle.load(fh)                 # BAD
+    return a, b

@@ -1,0 +1,21 @@
+"""Negative fixture: guarded loads — zero findings."""
+import pickle
+
+
+def resumable(path):
+    from smartcal_tpu.runtime.atomic import safe_pickle_load
+    return safe_pickle_load(path, default=[])
+
+
+def must_exist(path):
+    from smartcal_tpu.runtime.atomic import strict_pickle_load
+    return strict_pickle_load(path)
+
+
+def dumps_is_not_load(obj):
+    return pickle.dumps(obj)            # writes are the atomic_* family
+
+
+def loads_on_in_memory_bytes(data):
+    # bytes already in memory: no torn-file window; not this rule's scope
+    return pickle.loads(data)

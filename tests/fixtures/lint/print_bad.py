@@ -1,0 +1,12 @@
+"""Positive fixture: bare prints (linted as if under smartcal_tpu/)."""
+
+
+def noisy(x):
+    print("value:", x)                  # BAD: bare print in package code
+    return x
+
+
+def also_noisy(x):
+    if x:
+        print(x)                        # BAD
+    return x

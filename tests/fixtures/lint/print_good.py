@@ -1,0 +1,15 @@
+"""Negative fixture: obs-routed output — zero findings."""
+
+
+def quiet(x, obs):
+    obs.echo(f"value: {x}")             # structured stderr route
+    obs.emit_json({"value": x})         # stdout machine route
+    return x
+
+
+def method_print_ok(printer):
+    printer.print("rendered table")     # .print( method: not bare
+
+
+def print_in_string_ok():
+    return "call print(x) to debug"     # tokenizer ignores strings

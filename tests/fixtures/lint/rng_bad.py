@@ -1,0 +1,36 @@
+"""Positive fixture: every function here contains rng-key-reuse."""
+import jax
+
+
+def straight_line_reuse(key):
+    a = jax.random.normal(key, (4,))          # consumes key
+    b = jax.random.uniform(key, (4,))         # BAD: same key again
+    return a + b
+
+
+def reuse_via_split(key):
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(k1, (2,))
+    y = jax.random.split(key)                 # BAD: key consumed twice
+    return x, y, k2
+
+
+def attribute_reuse(self_like):
+    n = jax.random.normal(self_like._key, (2,))
+    m = jax.random.normal(self_like._key, (2,))   # BAD: attr key reuse
+    return n + m
+
+
+def loop_carried_reuse(key, n):
+    total = 0.0
+    for _ in range(n):
+        total += jax.random.normal(key, ())   # BAD: same key each iter
+    return total
+
+
+def reuse_after_branchless_if(key, flag):
+    a = jax.random.normal(key, ())
+    if flag:
+        pass
+    b = jax.random.normal(key, ())            # BAD: both paths consumed
+    return a + b

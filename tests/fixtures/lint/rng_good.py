@@ -1,0 +1,54 @@
+"""Negative fixture: disciplined key handling — zero findings."""
+import jax
+import numpy as np
+
+
+def split_before_reuse(key):
+    key, k1 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    key, k2 = jax.random.split(key)
+    b = jax.random.uniform(k2, (4,))
+    return a + b
+
+
+def self_key_stream(self_like):
+    # the repo's _next_key idiom: consume-and-rebind in one statement
+    self_like._key, k = jax.random.split(self_like._key)
+    return jax.random.normal(k, (2,))
+
+
+def loop_with_fold_in(key, n):
+    total = 0.0
+    for i in range(n):
+        k = jax.random.fold_in(key, i)        # ok: fold_in derives a
+        total += jax.random.normal(k, ())     # fresh per-i stream
+    return total
+
+
+def loop_with_resplit(key, n):
+    total = 0.0
+    for _ in range(n):
+        key, k = jax.random.split(key)        # ok: rebound in the body
+        total += jax.random.normal(k, ())
+    return total
+
+
+def branch_exclusive_use(key, flag):
+    if flag:
+        return jax.random.normal(key, ())
+    else:
+        return jax.random.uniform(key, ())    # ok: mutually exclusive
+
+
+def numpy_random_is_not_tracked(loc):
+    a = np.random.normal(loc, 1.0)            # numpy: no key argument
+    b = np.random.normal(loc, 2.0)
+    return a + b
+
+
+def fresh_keys(seed):
+    k1 = jax.random.PRNGKey(seed)
+    x = jax.random.normal(k1, ())
+    k1 = jax.random.PRNGKey(seed + 1)         # rebound: new stream
+    y = jax.random.normal(k1, ())
+    return x + y

@@ -1,0 +1,23 @@
+"""Positive fixture: traced values into python-static flags."""
+import jax.numpy as jnp
+
+
+def solve(x, collect_stats=False, optimized=True):
+    return x
+
+
+def direct_jnp_expression(solver, x, mask):
+    return solver(x, collect_stats=jnp.any(mask))      # BAD: traced
+
+
+def jax_indexing(solver, x, flags):
+    return solver(x, optimized=jnp.asarray(flags)[0])  # BAD: traced
+
+
+def via_local_name(solver, x, mask):
+    use_opt = jnp.all(mask > 0)
+    return solver(x, fused=use_opt)                    # BAD: jax-derived
+
+
+def comparison_of_traced(solver, x, r):
+    return solver(x, collect_diag=(jnp.max(r) > 1.0))  # BAD: traced bool

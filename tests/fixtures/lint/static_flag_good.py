@@ -1,0 +1,25 @@
+"""Negative fixture: host-static flag values — zero findings."""
+import numpy as np
+
+
+def literal_flags(solver, x):
+    return solver(x, collect_stats=True, optimized=False)
+
+
+def host_config(solver, x, args, self_like):
+    a = solver(x, collect_diag=args.diag)          # argparse bool: host
+    b = solver(x, fused=self_like.fused)           # instance config: host
+    return a, b
+
+
+def helper_call(solver, x, args, diag_from_args):
+    return solver(x, collect_diag=diag_from_args(args))   # host helper
+
+
+def host_numpy_is_fine(solver, x, mask):
+    return solver(x, optimized=bool(np.any(mask)))  # numpy is host-side
+
+
+def plain_keyword_named_like_flag(x):
+    # a dict key is not a call keyword; never flagged
+    return {"optimized": x}

@@ -1,0 +1,12 @@
+"""Fixture: malformed suppressions (no reason / unknown rule)."""
+import jax
+
+
+def reasonless(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.normal(key, (2,))  # graftlint: disable=rng-key-reuse
+    return a + b
+
+
+def unknown_rule(key):  # graftlint: disable=no-such-rule -- typo'd name
+    return jax.random.normal(key, (2,))

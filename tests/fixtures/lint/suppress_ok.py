@@ -1,0 +1,10 @@
+"""Fixture: violations silenced by well-formed suppressions."""
+import jax
+
+# graftlint: disable-file=read-after-donation -- fixture demonstrates file-wide disable
+
+
+def silenced_reuse(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.normal(key, (2,))  # graftlint: disable=rng-key-reuse -- demo: intentional reuse
+    return a + b

@@ -1,0 +1,494 @@
+"""graftlint framework + rule tests (ISSUE 11).
+
+Three layers:
+
+* per-rule positive/negative fixtures under ``tests/fixtures/lint/``
+  (each rule must catch every planted bug and stay silent on the
+  disciplined twin);
+* framework behavior — suppression comments (reason mandatory),
+  baseline grandfathering/staleness, deterministic output, CLI exit
+  codes, ``--types`` audit;
+* THE GATE: the shipped tree must lint clean against the checked-in
+  baseline, fast enough to stay cheap relative to the tier-1 budget,
+  and a seeded violation must fail it.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+from smartcal_tpu import analysis
+from smartcal_tpu.analysis import baseline as bl
+from smartcal_tpu.analysis import typecheck
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(ROOT, "tests", "fixtures", "lint")
+LINT_CLI = os.path.join(ROOT, "tools", "lint.py")
+
+
+def fixture_findings(name, rule=None, options=None):
+    fs = analysis.lint_file(os.path.join(FIX, name), ROOT, options=options)
+    if rule is not None:
+        fs = [f for f in fs if f.rule == rule]
+    return fs
+
+
+def lines_of(findings):
+    return sorted({f.line for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures
+# ---------------------------------------------------------------------------
+
+def test_rng_rule_positive():
+    fs = fixture_findings("rng_bad.py", "rng-key-reuse")
+    assert lines_of(fs) == [7, 14, 20, 27, 35], fs
+
+
+def test_rng_rule_negative():
+    assert fixture_findings("rng_good.py", "rng-key-reuse") == []
+
+
+def test_donation_rule_positive():
+    fs = fixture_findings("donation_bad.py", "read-after-donation")
+    assert lines_of(fs) == [17, 22, 27, 34], fs
+
+
+def test_donation_rule_negative():
+    assert fixture_findings("donation_good.py", "read-after-donation") == []
+
+
+def test_jit_sync_rule_positive():
+    fs = fixture_findings("jit_sync_bad.py", "host-sync-in-jit")
+    assert lines_of(fs) == [12, 18, 23, 29, 35, 40, 46, 56], fs
+
+
+def test_jit_sync_rule_negative():
+    assert fixture_findings("jit_sync_good.py", "host-sync-in-jit") == []
+
+
+def test_static_flag_rule_positive():
+    fs = fixture_findings("static_flag_bad.py", "traced-static-flag")
+    assert lines_of(fs) == [10, 14, 19, 23], fs
+
+
+def test_static_flag_rule_negative():
+    assert fixture_findings("static_flag_good.py",
+                            "traced-static-flag") == []
+
+
+_LOCK_SPEC = {"class": "Fleet",
+              "fields": ["_weights", "_version", "_queue"],
+              "locks": ["_wlock"], "why": "fixture"}
+
+
+def test_locks_rule_positive():
+    opts = {"shared_specs": [dict(_LOCK_SPEC, path="locks_bad.py")]}
+    fs = fixture_findings("locks_bad.py", "unlocked-shared-write", opts)
+    assert lines_of(fs) == [17, 18, 21, 25, 28], fs
+
+
+def test_locks_rule_negative():
+    opts = {"shared_specs": [dict(_LOCK_SPEC, path="locks_good.py")]}
+    assert fixture_findings("locks_good.py", "unlocked-shared-write",
+                            opts) == []
+
+
+def _lint_as_package(tmp_path, *names):
+    """Copy fixtures under a fake smartcal_tpu/ so path-scoped rules
+    (pickle outside tests/, bare-print) see them as package code."""
+    pkg = tmp_path / "smartcal_tpu"
+    pkg.mkdir(exist_ok=True)
+    for n in names:
+        shutil.copy(os.path.join(FIX, n), pkg / n)
+    return analysis.lint_paths(["smartcal_tpu"], str(tmp_path))
+
+
+def test_pickle_rule_positive(tmp_path):
+    fs = [f for f in _lint_as_package(tmp_path, "pickle_bad.py")
+          if f.rule == "unguarded-pickle-load"]
+    assert lines_of(fs) == [7, 12, 13], fs
+
+
+def test_pickle_rule_negative(tmp_path):
+    fs = [f for f in _lint_as_package(tmp_path, "pickle_good.py")
+          if f.rule == "unguarded-pickle-load"]
+    assert fs == []
+
+
+def test_bare_print_rule_positive(tmp_path):
+    fs = [f for f in _lint_as_package(tmp_path, "print_bad.py")
+          if f.rule == "bare-print"]
+    assert lines_of(fs) == [5, 11], fs
+
+
+def test_bare_print_rule_negative(tmp_path):
+    fs = [f for f in _lint_as_package(tmp_path, "print_good.py")
+          if f.rule == "bare-print"]
+    assert fs == []
+
+
+def test_pickle_rule_exempts_test_code(tmp_path):
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    shutil.copy(os.path.join(FIX, "pickle_bad.py"),
+                tdir / "test_pickle_stuff.py")
+    fs = analysis.lint_paths(["tests"], str(tmp_path))
+    assert [f for f in fs if f.rule == "unguarded-pickle-load"] == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_suppression_with_reason_silences():
+    assert fixture_findings("suppress_ok.py") == []
+
+
+def test_suppression_without_reason_is_a_finding():
+    fs = fixture_findings("suppress_bad.py")
+    rules = sorted(f.rule for f in fs)
+    # the reasonless disable does NOT disable (the rng finding stays)
+    # and is itself reported; the unknown-rule disable is reported too
+    assert rules == ["bad-suppression", "bad-suppression",
+                     "rng-key-reuse"], fs
+
+
+def test_rules_subset_does_not_misflag_other_suppressions(tmp_path):
+    # a valid disable for rule B must not become "unknown rule" when
+    # only rule A is selected
+    rules = analysis.all_rules()
+    subset = {"read-after-donation": rules["read-after-donation"]}
+    fs = analysis.lint_file(os.path.join(FIX, "suppress_ok.py"), ROOT,
+                            rules=subset)
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def _some_findings():
+    return fixture_findings("rng_bad.py", "rng-key-reuse")
+
+
+def test_baseline_grandfathers_and_detects_stale(tmp_path):
+    fs = _some_findings()
+    path = str(tmp_path / "base.json")
+    bl.save(path, fs, default_reason="fixture corpus")
+    loaded = bl.load(path)
+    assert len(loaded) == len(fs)
+    new, old, stale = bl.split(fs, loaded)
+    assert new == [] and len(old) == len(fs) and stale == []
+    # drop one finding -> exactly one stale entry surfaces
+    new, old, stale = bl.split(fs[1:], loaded)
+    assert new == [] and len(stale) == 1
+
+
+def test_malformed_baseline_is_exit_2_not_findings(tmp_path):
+    mangled = tmp_path / "mangled.json"
+    mangled.write_text("{not json")
+    with pytest.raises(bl.BaselineError):
+        bl.load(str(mangled))
+    p = _cli("--baseline", str(mangled), "smartcal_tpu")
+    assert p.returncode == 2, p.stdout + p.stderr
+    assert "unreadable baseline" in p.stderr
+    # entry missing required keys is also a BaselineError, not KeyError
+    partial = tmp_path / "partial.json"
+    partial.write_text(json.dumps(
+        {"version": 1, "entries": [{"rule": "bare-print",
+                                    "reason": "x"}]}))
+    with pytest.raises(bl.BaselineError):
+        bl.load(str(partial))
+
+
+def test_baseline_requires_reason(tmp_path):
+    path = str(tmp_path / "base.json")
+    doc = {"version": 1, "entries": [
+        {"rule": "rng-key-reuse", "path": "x.py", "fingerprint": "ab#0",
+         "line": 1, "source": "s", "reason": "   "}]}
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    with pytest.raises(bl.BaselineError):
+        bl.load(path)
+
+
+def test_fingerprints_distinguish_duplicate_lines(tmp_path):
+    # two byte-identical violating lines must get distinct fingerprints,
+    # so baselining one does not cover a copy-pasted second
+    f = tmp_path / "dup.py"
+    f.write_text("import jax\n\n\ndef g(key):\n"
+                 "    a = jax.random.normal(key, (2,))\n"
+                 "    b = jax.random.normal(key, (2,))\n"
+                 "    b = jax.random.normal(key, (2,))\n"
+                 "    return a + b\n")
+    fs = analysis.lint_file(str(f), str(tmp_path))
+    fs = [x for x in fs if x.rule == "rng-key-reuse"]
+    assert len(fs) == 2
+    fps = bl.fingerprints(fs)
+    assert len(set(fps)) == 2 and all("#" in fp for fp in fps)
+
+
+# ---------------------------------------------------------------------------
+# determinism + the gate
+# ---------------------------------------------------------------------------
+
+def _gate_findings():
+    findings = analysis.lint_paths(["smartcal_tpu", "tools", "tests"],
+                                   ROOT)
+    baseline = bl.load(os.path.join(ROOT, bl.DEFAULT_BASELINE))
+    new, _old, _stale = bl.split(findings, baseline)
+    return new
+
+
+def test_determinism_two_runs_identical_json():
+    a = [f.as_dict() for f in analysis.lint_paths(["smartcal_tpu"], ROOT)]
+    b = [f.as_dict() for f in analysis.lint_paths(["smartcal_tpu"], ROOT)]
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_gate_repo_is_clean_and_fast():
+    """THE tier-1 gate: no non-baselined finding in the shipped tree,
+    in well under the 30 s budget the acceptance criteria set."""
+    t0 = time.monotonic()
+    new = _gate_findings()
+    elapsed = time.monotonic() - t0
+    assert new == [], "\n".join(f.render() for f in new)
+    assert elapsed < 30.0, f"lint gate took {elapsed:.1f}s (budget 30s)"
+
+
+def test_gate_catches_seeded_violation(tmp_path):
+    """The gate must FAIL when a violation lands in a scanned tree —
+    proven by seeding a copy with a known-bad fixture."""
+    pkg = tmp_path / "smartcal_tpu"
+    shutil.copytree(os.path.join(ROOT, "smartcal_tpu", "runtime"),
+                    pkg / "runtime")
+    shutil.copy(os.path.join(FIX, "rng_bad.py"),
+                pkg / "runtime" / "seeded_violation.py")
+    findings = analysis.lint_paths(["smartcal_tpu"], str(tmp_path))
+    baseline = bl.load(os.path.join(ROOT, bl.DEFAULT_BASELINE))
+    new, _old, _stale = bl.split(findings, baseline)
+    assert any(f.rule == "rng-key-reuse"
+               and f.path.endswith("seeded_violation.py") for f in new), new
+
+
+def test_fixture_corpus_is_excluded_from_directory_walks():
+    files = list(analysis.iter_python_files(["tests"], ROOT))
+    assert not any("fixtures" + os.sep + "lint" in f or
+                   "fixtures/lint" in f for f in files)
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+def _cli(*args, cwd=ROOT):
+    return subprocess.run([sys.executable, LINT_CLI, *args],
+                          capture_output=True, text=True, cwd=cwd)
+
+
+def test_cli_exit_codes_and_json():
+    bad = os.path.join("tests", "fixtures", "lint", "rng_bad.py")
+    good = os.path.join("tests", "fixtures", "lint", "rng_good.py")
+    p = _cli("--json", "--no-baseline", bad)
+    assert p.returncode == 1, p.stdout + p.stderr
+    doc = json.loads(p.stdout)
+    assert doc["new"] > 0
+    assert all(f["rule"] == "rng-key-reuse" for f in doc["findings"])
+    p = _cli("--json", "--no-baseline", good)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert json.loads(p.stdout)["new"] == 0
+
+
+def test_cli_list_rules_names_all_six_plus_meta():
+    p = _cli("--list-rules", "--json")
+    assert p.returncode == 0
+    names = {r["name"] for r in json.loads(p.stdout)["rules"]}
+    for want in ("rng-key-reuse", "read-after-donation",
+                 "host-sync-in-jit", "traced-static-flag",
+                 "unlocked-shared-write", "unguarded-pickle-load",
+                 "bare-print", "bad-suppression", "parse-error"):
+        assert want in names, names
+
+
+def test_cli_unknown_rule_is_usage_error():
+    p = _cli("--rules", "no-such-rule", "--no-baseline")
+    assert p.returncode == 2
+
+
+def test_cli_changed_mode(tmp_path):
+    """--changed lints only git-touched files, from a scratch repo."""
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    env = dict(os.environ,
+               GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+    subprocess.run(["git", "init", "-q"], cwd=repo, check=True, env=env)
+    clean = repo / "clean.py"
+    clean.write_text("x = 1\n")
+    subprocess.run(["git", "add", "clean.py"], cwd=repo, check=True,
+                   env=env)
+    subprocess.run(["git", "commit", "-qm", "seed"], cwd=repo, check=True,
+                   env=env)
+    # untracked file with a violation -> --changed must catch it
+    shutil.copy(os.path.join(FIX, "rng_bad.py"), repo / "touched.py")
+    p = subprocess.run([sys.executable, LINT_CLI, "--changed", "--json",
+                        "--root", str(repo)],
+                       capture_output=True, text=True, cwd=repo)
+    assert p.returncode == 1, p.stdout + p.stderr
+    doc = json.loads(p.stdout)
+    assert {f["path"] for f in doc["findings"]} == {"touched.py"}
+    # clean worktree -> exit 0, nothing checked
+    (repo / "touched.py").unlink()
+    p = subprocess.run([sys.executable, LINT_CLI, "--changed", "--json",
+                        "--root", str(repo)],
+                       capture_output=True, text=True, cwd=repo)
+    assert p.returncode == 0 and json.loads(p.stdout)["checked"] == 0
+
+
+def test_changed_mode_skips_fixture_corpus(tmp_path):
+    """--changed must apply the corpus exclusion: a touched
+    intentional-violation fixture never fails the pre-commit path."""
+    repo = tmp_path / "repo"
+    (repo / "tests" / "fixtures" / "lint").mkdir(parents=True)
+    env = dict(os.environ,
+               GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+    subprocess.run(["git", "init", "-q"], cwd=repo, check=True, env=env)
+    subprocess.run(["git", "commit", "-qm", "s", "--allow-empty"],
+                   cwd=repo, check=True, env=env)
+    shutil.copy(os.path.join(FIX, "rng_bad.py"),
+                repo / "tests" / "fixtures" / "lint" / "rng_bad.py")
+    p = subprocess.run([sys.executable, LINT_CLI, "--changed", "--json",
+                        "--root", str(repo)],
+                       capture_output=True, text=True, cwd=repo)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert json.loads(p.stdout)["checked"] == 0
+
+
+def test_suppression_inside_string_is_inert(tmp_path):
+    """A docstring QUOTING the disable syntax must not suppress."""
+    f = tmp_path / "doc.py"
+    f.write_text('"""Docs show: # graftlint: disable-file=rng-key-reuse'
+                 ' -- example only."""\nimport jax\n\n\ndef g(key):\n'
+                 "    a = jax.random.normal(key, (2,))\n"
+                 "    b = jax.random.normal(key, (2,))\n"
+                 "    return a + b\n")
+    fs = analysis.lint_file(str(f), str(tmp_path))
+    assert any(x.rule == "rng-key-reuse" for x in fs), fs
+
+
+def test_update_baseline_refuses_partial_scope():
+    p = _cli("--update-baseline", "smartcal_tpu")
+    assert p.returncode == 2 and "full default scope" in p.stderr
+    p = _cli("--update-baseline", "--changed")
+    assert p.returncode == 2
+
+
+def test_unreadable_file_is_parse_error_not_crash(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_bytes(b"\xff\xfe broken bytes \x00\x01")
+    fs = analysis.lint_file(str(bad), str(tmp_path))
+    assert [f.rule for f in fs] == ["parse-error"], fs
+
+
+def test_bad_suppression_cannot_be_baselined(tmp_path):
+    src = ("import jax\n\n\ndef g(key):\n"
+           "    a = jax.random.normal(key, (2,))"
+           "  # graftlint: disable=rng-key-reuse\n"
+           "    return a\n")
+    f = tmp_path / "mod.py"
+    f.write_text(src)
+    fs = analysis.lint_file(str(f), str(tmp_path))
+    assert any(x.rule == "bad-suppression" for x in fs)
+    path = str(tmp_path / "base.json")
+    bl.save(path, fs)                      # must drop the meta-finding
+    new, old, _stale = bl.split(fs, bl.load(path))
+    assert any(x.rule == "bad-suppression" for x in new)
+    assert not any(x.rule == "bad-suppression" for x in old)
+
+
+def test_stale_reporting_scoped_to_scanned_files():
+    fs = _some_findings()
+    base = {("rng-key-reuse", "other/file.py", "dead#0"): "out of scope"}
+    base.update({(f.rule, f.path, fp): "r"
+                 for f, fp in zip(fs, bl.fingerprints(fs))})
+    # subset run that never scanned other/file.py -> not stale
+    _new, _old, stale = bl.split(fs, base,
+                                 scanned_paths=[fs[0].path])
+    assert stale == []
+    # full-scope semantics (scanned includes it) -> stale
+    _new, _old, stale = bl.split(fs, base,
+                                 scanned_paths=[fs[0].path,
+                                                "other/file.py"])
+    assert len(stale) == 1
+
+
+def test_exclusion_respects_component_boundaries():
+    from smartcal_tpu.analysis.core import is_excluded
+    assert is_excluded(os.path.join(ROOT, "tests", "fixtures", "lint",
+                                    "rng_bad.py"))
+    assert not is_excluded(os.path.join(ROOT, "tests", "fixtures",
+                                        "linty.py"))
+    assert not is_excluded(os.path.join(ROOT, "tests", "fixtures",
+                                        "lint_utils", "helper.py"))
+
+
+def test_changed_mode_with_types_still_runs_types_gate(tmp_path):
+    """`--changed --types` on a clean worktree must still run the typed
+    core (exit 1 when the audit finds un-annotated strict-core defs)."""
+    repo = tmp_path / "repo"
+    (repo / "smartcal_tpu" / "obs").mkdir(parents=True)
+    (repo / "smartcal_tpu" / "obs" / "x.py").write_text(
+        "def public_fn(a):\n    return a\n")
+    env = dict(os.environ,
+               GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+    subprocess.run(["git", "init", "-q"], cwd=repo, check=True, env=env)
+    subprocess.run(["git", "add", "-A"], cwd=repo, check=True, env=env)
+    subprocess.run(["git", "commit", "-qm", "s"], cwd=repo, check=True,
+                   env=env)
+    p = subprocess.run([sys.executable, LINT_CLI, "--changed", "--types",
+                        "--json", "--root", str(repo)],
+                       capture_output=True, text=True, cwd=repo)
+    assert p.returncode == 1, p.stdout + p.stderr
+    doc = json.loads(p.stdout)
+    assert doc["types_mode"] in ("audit", "mypy")
+    assert doc["new"] > 0 and doc["checked"] == 0
+
+
+def test_stale_reporting_scoped_to_rules_run():
+    fs = _some_findings()
+    base = {("bare-print", fs[0].path, "dead#0"): "other rule's debt"}
+    # rng-only run: the bare-print entry's rule never executed -> not stale
+    _n, _o, stale = bl.split(fs, base, scanned_paths=[fs[0].path],
+                             rules_run=["rng-key-reuse"])
+    assert stale == []
+    _n, _o, stale = bl.split(fs, base, scanned_paths=[fs[0].path],
+                             rules_run=["rng-key-reuse", "bare-print"])
+    assert len(stale) == 1
+
+
+# ---------------------------------------------------------------------------
+# --types gate
+# ---------------------------------------------------------------------------
+
+def test_types_audit_strict_core_is_clean():
+    findings = typecheck.run_audit(ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_types_audit_catches_untyped_public_def(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text("def public_fn(a, b):\n    return a\n\n\n"
+                 "def _private(a):\n    return a\n")
+    fs = typecheck.audit_file(str(f), str(tmp_path))
+    assert {x.rule for x in fs} == {typecheck.UNTYPED_DEF}
+    # params a, b + missing return = 3 findings; _private exempt
+    assert len(fs) == 3, fs

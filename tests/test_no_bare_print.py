@@ -1,113 +1,55 @@
 """Static check: no new bare ``print(`` in smartcal_tpu/ or tools/.
 
-Diagnostics must flow through the obs layer (``obs.echo`` -> stderr +
-structured event, ``obs.emit_json`` -> the stdout machine interface) so
-logging stays structured and ``--quiet``-able.  ``smartcal_tpu/obs/
-console.py`` is the one sanctioned ``print`` site in the package; in
-``tools/`` an explicit stdout allowlist names the CLIs whose stdout IS
-their product (report/sweep/bench output that scripts parse or humans
-pipe) — a new tool must either route through ``smartcal_tpu.obs.console``
-or be added there deliberately.  Tokenizer-based so strings, comments,
-and ``.print(`` method calls never false-positive.
+THIN SHIM (kept one release so the tier-1 dot count doesn't regress):
+the policy now lives in the graftlint ``bare-print`` rule
+(:mod:`smartcal_tpu.analysis.rules.prints`, ISSUE 11) with the same
+allowlist semantics — ``obs.echo`` -> stderr + structured event,
+``obs.emit_json`` -> the stdout machine interface,
+``smartcal_tpu/obs/console.py`` the one sanctioned package ``print``
+site, and an explicit stdout allowlist for tools whose stdout IS their
+product.  These tests re-assert the rule through the framework; new
+code should run ``python tools/lint.py`` (which also enforces it via
+tests/test_graftlint.py's gate).
 """
 
-import io
 import os
-import tokenize
+
+from smartcal_tpu import analysis
+from smartcal_tpu.analysis.rules.prints import (PKG_ALLOWLIST,
+                                                TOOLS_STDOUT_ALLOWLIST)
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(_ROOT, "smartcal_tpu")
-TOOLS = os.path.join(_ROOT, "tools")
-
-# relative paths (to smartcal_tpu/) allowed to call print()
-ALLOWLIST = {
-    os.path.join("obs", "console.py"),
-}
-
-# tools/ files sanctioned to print to stdout directly: their stdout is
-# the tool's interface (obs_report/obs_tail render reports and must run
-# standalone without the package importable; the sweeps/benches emit the
-# JSON lines capture scripts parse).  Anything NOT listed here must
-# route output through smartcal_tpu.obs.console.
-TOOLS_STDOUT_ALLOWLIST = {
-    "bench_host_seg.py",
-    "bench_per.py",
-    "bench_solve_eval.py",
-    "capture_calib_episode.py",
-    "certify_batched.py",
-    "chip_checks.py",
-    "convert_ateam.py",
-    "eig_mode_parity.py",
-    "enet_hint_stats.py",
-    "measure_reference.py",
-    "obs_report.py",
-    "obs_tail.py",
-    "summarize_demix_curves.py",
-    "sweep_calib.py",
-    "sweep_demix.py",
-    "sweep_enet.py",
-}
-
-_SKIP_TYPES = (tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
-               tokenize.DEDENT, tokenize.COMMENT)
 
 
-def bare_print_lines(path):
-    """Line numbers of bare ``print(`` calls (NAME 'print' followed by
-    '(', not preceded by '.' or 'def')."""
-    with open(path, "rb") as fh:
-        src = fh.read().decode("utf-8")
-    toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
-    hits = []
-    for i, t in enumerate(toks):
-        if t.type != tokenize.NAME or t.string != "print":
-            continue
-        prev = next((p for p in reversed(toks[:i])
-                     if p.type not in _SKIP_TYPES), None)
-        if prev is not None and prev.string in (".", "def"):
-            continue
-        nxt = next((n for n in toks[i + 1:] if n.type not in _SKIP_TYPES),
-                   None)
-        if nxt is not None and nxt.string == "(":
-            hits.append(t.start[0])
-    return hits
+def _bare_print_offenders(paths):
+    rules = analysis.all_rules()
+    sub = {"bare-print": rules["bare-print"]}
+    return [f"{f.path}:{f.line}"
+            for f in analysis.lint_paths(paths, _ROOT, rules=sub)
+            if f.rule == "bare-print"]
 
 
 def test_no_bare_print_in_package():
-    offenders = []
-    for root, _, files in os.walk(PKG):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, PKG)
-            if rel in ALLOWLIST:
-                continue
-            for line in bare_print_lines(path):
-                offenders.append(f"smartcal_tpu/{rel}:{line}")
+    offenders = _bare_print_offenders(["smartcal_tpu"])
     assert not offenders, (
         "bare print() found — route human output through smartcal_tpu.obs."
         "echo (stderr + structured event) or obs.emit_json (stdout machine "
-        "payloads), or extend the allowlist deliberately:\n  "
-        + "\n  ".join(offenders))
+        "payloads), or extend the allowlist in smartcal_tpu/analysis/rules/"
+        "prints.py deliberately:\n  " + "\n  ".join(offenders))
 
 
 def test_no_bare_print_in_tools():
-    offenders = []
-    for fn in sorted(os.listdir(TOOLS)):
-        if not fn.endswith(".py") or fn in TOOLS_STDOUT_ALLOWLIST:
-            continue
-        for line in bare_print_lines(os.path.join(TOOLS, fn)):
-            offenders.append(f"tools/{fn}:{line}")
+    offenders = _bare_print_offenders(["tools"])
     assert not offenders, (
         "bare print() in an unlisted tool — route output through "
         "smartcal_tpu.obs.console (echo/emit_json) or add the file to "
-        "TOOLS_STDOUT_ALLOWLIST deliberately:\n  " + "\n  ".join(offenders))
+        "TOOLS_STDOUT_ALLOWLIST in smartcal_tpu/analysis/rules/prints.py "
+        "deliberately:\n  " + "\n  ".join(offenders))
 
 
 def test_allowlist_entries_exist():
     """A deleted/renamed sanctioned file must not linger in the lists."""
-    for rel in ALLOWLIST:
-        assert os.path.exists(os.path.join(PKG, rel)), rel
+    for rel in PKG_ALLOWLIST:
+        assert os.path.exists(os.path.join(_ROOT, "smartcal_tpu", rel)), rel
     for fn in TOOLS_STDOUT_ALLOWLIST:
-        assert os.path.exists(os.path.join(TOOLS, fn)), fn
+        assert os.path.exists(os.path.join(_ROOT, "tools", fn)), fn

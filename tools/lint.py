@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""graftlint CLI — the repo's JAX-aware static-analysis gate (ISSUE 11).
+
+Usage::
+
+    python tools/lint.py [paths ...]        # default: smartcal_tpu tools tests
+    python tools/lint.py --json             # machine output (stable order)
+    python tools/lint.py --changed          # only git-touched files (pre-commit)
+    python tools/lint.py --types            # typed-core gate (mypy or audit)
+    python tools/lint.py --list-rules       # rule table
+    python tools/lint.py --update-baseline  # re-grandfather current findings
+
+Exit codes: 0 clean (no NEW findings), 1 findings, 2 internal/usage error.
+Findings already recorded in ``graftlint.baseline.json`` (each with a
+mandatory reason) don't fail the gate; stale baseline entries are
+reported so the debt list shrinks instead of rotting.
+
+This file's stdout IS its product (text report or ``--json`` document) —
+it is on the bare-print allowlist deliberately.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from smartcal_tpu import analysis                      # noqa: E402
+from smartcal_tpu.analysis import baseline as bl       # noqa: E402
+from smartcal_tpu.analysis import typecheck            # noqa: E402
+
+DEFAULT_PATHS = ("smartcal_tpu", "tools", "tests")
+
+
+def changed_files(root):
+    """Python files touched per git (staged, unstaged, untracked)."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=all"],
+            capture_output=True, text=True, cwd=root, check=True).stdout
+    except (OSError, subprocess.CalledProcessError) as e:
+        # usage/environment error, not findings: honor the exit-2 contract
+        sys.stderr.write(f"lint: --changed needs git ({e})\n")
+        raise SystemExit(2)
+    from smartcal_tpu.analysis.core import is_excluded
+    files = []
+    for line in out.splitlines():
+        if len(line) < 4 or line[:2] == "D " or line[1] == "D":
+            continue
+        path = line[3:].split(" -> ")[-1].strip().strip('"')
+        ap = os.path.join(root, path)
+        if path.endswith(".py") and os.path.exists(ap) \
+                and not is_excluded(ap):
+            files.append(path)
+    return sorted(set(files))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="lint.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: "
+                         f"{' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output (deterministic)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: graftlint.baseline.json "
+                         "at the repo root)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write current findings as the new baseline "
+                         "(carries forward existing reasons)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only git-modified/untracked .py files")
+    ap.add_argument("--types", action="store_true",
+                    help="run the typed-core gate (mypy when available, "
+                         "else the built-in annotation audit)")
+    ap.add_argument("--root", default=_ROOT, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    root = os.path.abspath(args.root)
+
+    try:
+        rules = analysis.all_rules()
+    except Exception as e:  # registry import failure is an internal error
+        sys.stderr.write(f"lint: rule registry failed to load: {e!r}\n")
+        return 2
+
+    if args.list_rules:
+        rows = [(name, r.doc) for name, r in sorted(rules.items())]
+        rows.append((analysis.BAD_SUPPRESSION,
+                     "disable comment without a reason or naming an "
+                     "unknown rule (driver meta-rule)"))
+        rows.append((analysis.PARSE_ERROR,
+                     "file does not parse (driver meta-rule)"))
+        rows.append((typecheck.UNTYPED_DEF,
+                     "strict-core def missing annotations "
+                     "(--types audit mode)"))
+        rows.append((typecheck.MYPY_ERROR,
+                     "mypy error in the strict core (--types, mypy "
+                     "available)"))
+        if args.as_json:
+            print(json.dumps({"rules": [{"name": n, "doc": d}
+                                        for n, d in rows]}, indent=1))
+        else:
+            width = max(len(n) for n, _ in rows)
+            for n, d in rows:
+                print(f"{n:<{width}}  {d}")
+        return 0
+
+    if args.rules:
+        want = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = want - set(rules)
+        if unknown:
+            sys.stderr.write(
+                f"lint: unknown rule(s): {', '.join(sorted(unknown))} "
+                "(see --list-rules)\n")
+            return 2
+        rules = {k: v for k, v in rules.items() if k in want}
+
+    if args.changed:
+        paths = changed_files(root)
+        if not paths:
+            # nothing to lint — but --types is an independent gate and
+            # must still run (a pre-commit hook wired with both flags
+            # must never skip the typed core silently)
+            types_findings, types_mode = ([], None)
+            if args.types:
+                types_findings, types_mode = typecheck.run_types(root)
+            if args.as_json:
+                doc = {"findings": [f.as_dict() for f in types_findings],
+                       "new": len(types_findings), "checked": 0,
+                       "mode": "changed"}
+                if types_mode:
+                    doc["types_mode"] = types_mode
+                print(json.dumps(doc, indent=1))
+            else:
+                for f in types_findings:
+                    print(f.render())
+                tail = "graftlint: no changed python files"
+                if types_mode:
+                    tail += (f"; types gate via {types_mode}: "
+                             f"{len(types_findings)} finding(s)")
+                print(tail)
+            return 1 if types_findings else 0
+    else:
+        paths = list(args.paths) if args.paths else list(DEFAULT_PATHS)
+
+    try:
+        findings = analysis.lint_paths(paths, root, rules=rules)
+        scanned = [analysis.core.relpath(f, root) for f in
+                   analysis.iter_python_files(paths, root)]
+    except Exception as e:
+        sys.stderr.write(f"lint: internal error: {e!r}\n")
+        return 2
+
+    baseline_path = args.baseline or os.path.join(root,
+                                                  bl.DEFAULT_BASELINE)
+    if args.update_baseline:
+        # a partial run must never rewrite the whole-repo debt record:
+        # entries for files outside the subset would be dropped silently
+        full_scope = (not args.changed and not args.rules
+                      and sorted(paths) == sorted(DEFAULT_PATHS))
+        if not full_scope:
+            sys.stderr.write(
+                "lint: --update-baseline requires the full default scope "
+                f"({' '.join(DEFAULT_PATHS)}; no --changed/--rules) — a "
+                "subset rewrite would delete out-of-scope baseline "
+                "entries\n")
+            return 2
+        old = {}
+        try:
+            old = bl.load(baseline_path)
+        except bl.BaselineError:
+            pass  # rewriting anyway
+        bl.save(baseline_path, findings, reasons=old)
+        kept = [f for f in findings if f.rule not in bl.UNBASELINEABLE]
+        print(f"graftlint: baseline updated with {len(kept)} "
+              f"finding(s) -> {os.path.relpath(baseline_path, root)}")
+        return 0
+
+    baseline = {}
+    if not args.no_baseline:
+        try:
+            baseline = bl.load(baseline_path)
+        except bl.BaselineError as e:
+            sys.stderr.write(f"lint: {e}\n")
+            return 2
+    new, grandfathered, stale = bl.split(findings, baseline,
+                                         scanned_paths=scanned,
+                                         rules_run=list(rules))
+
+    types_findings, types_mode = [], None
+    if args.types:
+        types_findings, types_mode = typecheck.run_types(root)
+        new = sorted(new + types_findings)
+
+    n_files = len(scanned)
+    if args.as_json:
+        doc = {
+            "findings": [f.as_dict() for f in new],
+            "grandfathered": [f.as_dict() for f in grandfathered],
+            "stale_baseline": stale,
+            "new": len(new),
+            "checked": n_files,
+            "rules": sorted(rules),
+        }
+        if types_mode:
+            doc["types_mode"] = types_mode
+        print(json.dumps(doc, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"graftlint: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed debt — "
+                  "prune with --update-baseline):")
+            for s in stale:
+                print(f"  {s['rule']} {s['path']} [{s['fingerprint']}]")
+        tail = (f"graftlint: {len(new)} finding(s) "
+                f"({len(grandfathered)} grandfathered) over {n_files} "
+                f"file(s)")
+        if types_mode:
+            tail += f"; types gate via {types_mode}"
+        print(tail)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+#!/bin/bash
+# graftlint smoke (mirrors smoke_obs.sh/smoke_fleet.sh): prove the gate
+# is both GREEN and ALIVE in one run —
+#
+#   1. lint the shipped tree against the checked-in baseline -> clean;
+#   2. run the typed-core gate (--types: mypy when installed, else the
+#      built-in annotation audit) -> clean;
+#   3. inject a known-bad fixture into a scratch copy of a package
+#      subtree -> the gate must CATCH it (non-zero exit, the seeded rule
+#      in the --json findings);
+#   4. touch a file in a scratch git repo -> --changed must lint exactly
+#      the touched file (the pre-commit fast path).
+#
+#   bash tools/smoke_lint.sh [workdir]
+#
+# Exits non-zero on any broken link: a silently-green-on-bad-code linter
+# is worse than no linter.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+REPO="$PWD"
+WORK="${1:-$(mktemp -d /tmp/smoke_lint.XXXXXX)}"
+mkdir -p "$WORK"
+
+echo "[smoke_lint] 1/4 full gate over smartcal_tpu tools tests" >&2
+python tools/lint.py smartcal_tpu tools tests > "$WORK/gate.txt"
+echo "[smoke_lint] gate clean: $(tail -1 "$WORK/gate.txt")" >&2
+
+echo "[smoke_lint] 2/4 typed-core gate (--types)" >&2
+python tools/lint.py --types smartcal_tpu/analysis > "$WORK/types.txt"
+echo "[smoke_lint] types clean: $(tail -1 "$WORK/types.txt")" >&2
+
+echo "[smoke_lint] 3/4 seeded violation must be caught" >&2
+SEED="$WORK/seeded"
+rm -rf "$SEED"
+mkdir -p "$SEED/smartcal_tpu"
+cp smartcal_tpu/analysis/core.py "$SEED/smartcal_tpu/"   # innocent bystander
+cp tests/fixtures/lint/rng_bad.py "$SEED/smartcal_tpu/injected.py"
+set +e
+python tools/lint.py --json --root "$SEED" smartcal_tpu \
+    > "$WORK/seeded.json"
+rc=$?
+set -e
+[ "$rc" -eq 1 ] || {
+    echo "[smoke_lint] FAIL: seeded tree exited $rc (want 1)" >&2; exit 1; }
+python - "$WORK/seeded.json" <<'EOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+hits = [f for f in doc["findings"] if f["rule"] == "rng-key-reuse"
+        and f["path"].endswith("injected.py")]
+assert hits, f"seeded rng-key-reuse not caught: {doc['findings'][:3]}"
+print(f"[smoke_lint] caught {len(hits)} seeded finding(s)",
+      file=sys.stderr)
+EOF
+
+echo "[smoke_lint] 4/4 --changed lints exactly the touched file" >&2
+CH="$WORK/changed_repo"
+rm -rf "$CH"
+mkdir -p "$CH"
+(cd "$CH" \
+ && git init -q \
+ && git -c user.name=smoke -c user.email=s@s commit -q --allow-empty -m s)
+cp tests/fixtures/lint/donation_bad.py "$CH/touched.py"
+set +e
+python tools/lint.py --changed --json --root "$CH" > "$WORK/changed.json"
+rc=$?
+set -e
+[ "$rc" -eq 1 ] || {
+    echo "[smoke_lint] FAIL: --changed exited $rc (want 1)" >&2; exit 1; }
+python - "$WORK/changed.json" <<'EOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+paths = {f["path"] for f in doc["findings"]}
+assert paths == {"touched.py"}, paths
+assert any(f["rule"] == "read-after-donation" for f in doc["findings"])
+print("[smoke_lint] --changed scoped to the touched file", file=sys.stderr)
+EOF
+
+echo "[smoke_lint] OK: gate green, seeded violation caught, --changed scoped" >&2
