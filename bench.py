@@ -771,39 +771,82 @@ def bench_calib_batched(batch_sizes=(1, 4, 8), steps=2):
     return out
 
 
-def bench_actor_scaling(n_actors=(1, 2, 4), episodes=16, out_path=None):
+def bench_actor_scaling(arms=None, episodes=16, out_path=None,
+                        replay_shards=4):
     """Aggregate env-steps/s of the supervised async actor-learner fleet
-    vs actor count on ONE host (ISSUE 10 tentpole metric).
+    vs fleet shape (ISSUE 10 tentpole metric, extended past the thread
+    ceiling by ISSUE 12).
 
-    Each arm runs the full pipeline — N actor threads, each driving 2
-    batched env lanes off an episode-frozen snapshot, feeding the
+    Each arm runs the full pipeline — N actors, each driving 2 batched
+    env lanes off an episode-frozen snapshot, feeding the mesh-sharded
     device-resident learner's fused store->PER-sample->learn->priority
     step with IMPACT IS-clipping armed (is_clip=2) — and reports the
     STEADY-STATE aggregate throughput: continuous wall clock from the
     end of the warmup rounds through loop exit, counting ingest,
     telemetry and bookkeeping (run_supervised_loop's summary), so queue
-    pre-fill bursts cannot inflate the number.  CPU-safe scale (tiny
-    enet MLPs); ``out_path`` additionally writes the payload as a
-    results artifact.
+    pre-fill bursts cannot inflate the number.  The default sweep
+    continues results/actor_scaling_r10.json past the thread ceiling:
+    the r10 4-thread point for continuity, then actor PROCESSES at 1,
+    4, 8 on one host and an 8-process arm split over 2 SIMULATED hosts
+    (``sim_hosts=2`` — contiguous slot blocks tagged with host ids).
+    Every arm records the staleness the IS-clip absorbed
+    (``transition_staleness_mean``) and how hard the clip worked
+    (``is_clip_saturation``).  CPU-safe scale (tiny enet MLPs);
+    ``out_path`` additionally writes the payload as a results artifact.
     """
     from smartcal_tpu.parallel import learner as plearner
 
+    # thread arms keep the r10 configuration (FLAT buffer) so the old
+    # curve's points stay comparable; process arms run the new regime
+    # (mesh-sharded replay).  On one CPU the sharded sample/merge is
+    # pure overhead (every "shard" shares the same core budget) — its
+    # win is hardware-shaped; what this sweep shows is that actor
+    # PROCESSES keep scaling where threads flatten, with the sharded
+    # store/sample in the loop.
+    arms = arms or (
+        {"label": "thread-1", "mode": "thread", "n_actors": 1,
+         "shards": 0},
+        {"label": "thread-4", "mode": "thread", "n_actors": 4,
+         "shards": 0},
+        {"label": "process-1", "mode": "process", "n_actors": 1},
+        {"label": "process-4", "mode": "process", "n_actors": 4},
+        {"label": "process-8", "mode": "process", "n_actors": 8},
+        {"label": "process-8x2host", "mode": "process", "n_actors": 8,
+         "sim_hosts": 2},
+    )
+    if jax.devices()[0].platform == "cpu":
+        # spawned actor workers read the ENV, not this process's
+        # jax.config — pin them to the platform the parent actually
+        # measured on (a dead-tunnel env var must not wedge the fleet)
+        os.environ["JAX_PLATFORMS"] = "cpu"
     per_n = []
-    for n in n_actors:
+    for arm in arms:
+        shards = arm.get("shards", replay_shards)
         _, _, summary = plearner.train_supervised(
-            seed=0, episodes=episodes, n_actors=n,
+            seed=0, episodes=episodes, n_actors=arm["n_actors"],
             agent_kwargs={"batch_size": 32, "mem_size": 4096},
             rollout_epochs=2, rollout_steps=10, batch_envs=2,
-            is_clip=2.0, quiet=True)
+            is_clip=2.0, quiet=True, actor_mode=arm["mode"],
+            sim_hosts=arm.get("sim_hosts", 1),
+            replay_shards=shards)
         per_n.append({
-            "n_actors": n,
+            "label": arm["label"],
+            "actor_mode": arm["mode"],
+            "n_actors": arm["n_actors"],
+            "sim_hosts": arm.get("sim_hosts", 1),
+            "replay_shards": shards,
             "env_steps_per_s": summary["env_steps_per_s"],
             "transitions_steady": summary["transitions_steady"],
             "wall_steady_s": summary["wall_steady_s"],
             "rounds": summary["rounds"],
             "restarts": summary["restarts"],
+            "transition_staleness_mean":
+                summary.get("transition_staleness_mean"),
+            "is_clip_saturation": summary.get("is_clip_saturation"),
+            "critic_loss_mean": summary.get("critic_loss_mean"),
         })
-    base = per_n[0]["env_steps_per_s"]
+    base = next((r["env_steps_per_s"] for r in per_n
+                 if r["n_actors"] == 1 and r["env_steps_per_s"]), None)
     for row in per_n:
         # an arm that never reached steady state (too few non-empty
         # rounds) reports None — mark it failed rather than fabricating
@@ -821,14 +864,17 @@ def bench_actor_scaling(n_actors=(1, 2, 4), episodes=16, out_path=None):
         "unit": "env-steps/sec aggregate",
         "vs_baseline": None,
         "scale": "enet default env, 2 lanes/actor, rollout 2x10, "
-                 "is_clip=2.0 (CPU-safe)",
+                 f"is_clip=2.0, replay_shards={replay_shards} (CPU-safe)",
         "platform": jax.devices()[0].platform,
         "host_cores": os.cpu_count(),
         "episodes_per_arm": episodes,
         "results": per_n,
         "note": "steady-state continuous-wall aggregate env-steps/s of "
-                "the supervised fleet (actors + fused device-resident "
-                "learner); warmup rounds excluded",
+                "the supervised fleet (thread AND process actor modes, "
+                "mesh-sharded device-resident replay); warmup rounds "
+                "excluded.  process-8x2host = 8 worker processes split "
+                "over 2 simulated hosts on this machine — a topology "
+                "rehearsal, not a second physical host",
     }
     if out_path:
         with open(out_path, "w") as fh:

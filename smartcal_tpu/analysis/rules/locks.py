@@ -37,6 +37,26 @@ SHARED_FIELD_SPECS = [
                "per rollout (get_weights) while the learner publishes",
     },
     {
+        "path": "smartcal_tpu/runtime/supervisor.py",
+        "class": "Fleet",
+        "fields": ["_shard_qs", "_slot_shard"],
+        "locks": ["_wlock"],
+        "why": "cross-process ingest-shard directory + slot->shard map "
+               "read concurrently by every pump thread (shard_queue) "
+               "and the learner (collect/queue_depths); built once in "
+               "__init__ and immutable after — any later write must "
+               "take the lock",
+    },
+    {
+        "path": "smartcal_tpu/runtime/supervisor.py",
+        "class": "_ProcessActor",
+        "fields": ["_outbox"],
+        "locks": ["_outbox_lock"],
+        "why": "latest-wins weights outbox written by the learner "
+               "(publish) and drained by the slot's sender thread — "
+               "an unlocked write can ship a torn frame reference",
+    },
+    {
         "path": "smartcal_tpu/obs/runlog.py",
         "class": "RunLog",
         "fields": ["_buf", "_bytes", "_fh", "_rotations", "_last_flush"],

@@ -397,46 +397,35 @@ def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
     return st, scores
 
 
-def train_supervised_demix(seed=0, episodes=5, n_actors=2, K=4,
-                           backend=None, provide_influence=False,
-                           agent_kwargs=None, quiet=False,
-                           rollout_epochs=1, rollout_steps=3, metrics=None,
-                           diag=False, watchdog=False,
-                           heartbeat_timeout=300.0, max_restarts=3,
-                           queue_timeout=300.0, max_empty_rounds=10,
-                           restart_backoff=None, batch_envs=1,
-                           is_clip=0.0, ere_eta=1.0, publish_every=1,
-                           ckpt_dir=None, ckpt_every=0, keep_ckpts=3,
-                           resume=False):
-    """Supervised actor-thread fleet for the demixing workload (the
-    scale-out async sibling of :func:`train_distributed_demix`; see
-    parallel.learner.train_supervised for the architecture).
-
-    Each actor thread simulates ITS OWN workload lanes on the host
-    (``make_workloads`` with ``batch_envs`` lanes) and runs the jitted
-    per-actor rollout — vmapped over the lane axis into ONE batched
-    dispatch — against the latest weights snapshot; the supervisor
-    restarts dead/hung actors with backoff and a watchdog trip joins the
-    fleet cleanly.  ``is_clip``/``ere_eta``/``publish_every`` and the
-    checkpoint flags behave as in ``train_supervised``.
-    Returns ``((agent_state, buf), scores, fleet_summary)``.
-    """
-    from smartcal_tpu.runtime import Fleet
-    from smartcal_tpu.runtime import faults as rt_faults
-    from smartcal_tpu.train.blocks import TrainRuntime, train_obs
-
-    from .learner import run_supervised_loop
-
-    backend = backend or radio.RadioBackend()
+def _demix_agent_cfg(backend: radio.RadioBackend, K: int,
+                     provide_influence: bool, is_clip: float,
+                     ere_eta: float, agent_kwargs) -> dsac.DSACConfig:
     md_dim = 3 * K + 2
-    agent_cfg = dsac.DSACConfig(
+    return dsac.DSACConfig(
         obs_dim=backend.npix * backend.npix + md_dim,
         n_actions=2 ** (K - 1), img_shape=(backend.npix, backend.npix),
         use_image=provide_influence, is_clip=is_clip, ere_eta=ere_eta,
         **(agent_kwargs or {}))
-    n_trans = batch_envs * rollout_epochs * rollout_steps
+
+
+def _demix_fleet_work_fn(backend_kwargs=None, K=4, agent_kwargs=None,
+                         provide_influence=False, is_clip=0.0,
+                         ere_eta=1.0, batch_envs=1, rollout_epochs=1,
+                         rollout_steps=3, seed=0, _backend=None):
+    """Build the demix fleet actor's work function from PICKLABLE
+    primitives (the enet twin is
+    :func:`smartcal_tpu.parallel.learner._enet_fleet_work_fn`): shared
+    by actor threads (called in-process, optionally with an already-
+    built ``_backend``) and spawned actor processes (named as the
+    ``worker_spec`` factory; each worker reconstructs the backend from
+    ``backend_kwargs``).  Same per-(actor, iteration) key streams in
+    both modes."""
     from .learner import flatten_lanes, lane_keys
 
+    backend = _backend or radio.RadioBackend(**(backend_kwargs or {}))
+    agent_cfg = _demix_agent_cfg(backend, K, provide_influence, is_clip,
+                                 ere_eta, agent_kwargs)
+    n_trans = batch_envs * rollout_epochs * rollout_steps
     rollout_one = make_demix_actor_rollout(
         backend, K, agent_cfg, rollout_epochs, rollout_steps,
         provide_influence=provide_influence, record_logp=is_clip > 0)
@@ -453,8 +442,92 @@ def train_supervised_demix(seed=0, episodes=5, n_actors=2, K=4,
     else:
         rollout = jax.jit(rollout_one)
 
+    base_key = jax.random.PRNGKey(seed ^ 0x0AC7D32)
+
+    from smartcal_tpu.runtime import faults as rt_faults
+
+    def work_fn(actor_id, iteration, weights):
+        rt_faults.maybe_delay("actor_rollout", iteration)
+        if rt_faults.should_kill_actor(actor_id, iteration):
+            raise rt_faults.FaultInjected(
+                f"actor {actor_id} killed at iteration {iteration}")
+        k = jax.random.fold_in(jax.random.fold_in(base_key, actor_id),
+                               iteration)
+        k_wl, k_roll = jax.random.split(k)
+        # the actor simulates its own episode lanes (the host-side half
+        # the SPMD mode batches up front)
+        wl = make_workloads(backend, K, batch_envs, rollout_epochs, k_wl)
+        if batch_envs > 1:
+            return jax.device_get(rollout(weights, wl, k_roll))
+        wl_one = jax.tree_util.tree_map(lambda x: x[0], wl)
+        return jax.device_get(rollout(weights, wl_one, k_roll))
+
+    return work_fn
+
+
+def train_supervised_demix(seed=0, episodes=5, n_actors=2, K=4,
+                           backend=None, provide_influence=False,
+                           agent_kwargs=None, quiet=False,
+                           rollout_epochs=1, rollout_steps=3, metrics=None,
+                           diag=False, watchdog=False,
+                           heartbeat_timeout=300.0, max_restarts=3,
+                           queue_timeout=300.0, max_empty_rounds=10,
+                           restart_backoff=None, batch_envs=1,
+                           is_clip=0.0, ere_eta=1.0, publish_every=1,
+                           ckpt_dir=None, ckpt_every=0, keep_ckpts=3,
+                           resume=False, actor_mode="thread",
+                           replay_shards=0, sim_hosts=1,
+                           backend_kwargs=None):
+    """Supervised actor fleet for the demixing workload (the scale-out
+    async sibling of :func:`train_distributed_demix`; see
+    parallel.learner.train_supervised for the architecture).
+
+    Each actor simulates ITS OWN workload lanes on the host
+    (``make_workloads`` with ``batch_envs`` lanes) and runs the jitted
+    per-actor rollout — vmapped over the lane axis into ONE batched
+    dispatch — against the latest weights snapshot; the supervisor
+    restarts dead/hung actors with backoff and a watchdog trip joins the
+    fleet cleanly.  ``is_clip``/``ere_eta``/``publish_every`` and the
+    checkpoint flags behave as in ``train_supervised``; so do
+    ``actor_mode``/``replay_shards``/``sim_hosts`` — with the demixing
+    caveat that ``actor_mode="process"`` needs ``backend_kwargs`` (the
+    picklable RadioBackend constructor form; a pre-built ``backend``
+    object cannot cross the process boundary).
+    Returns ``((agent_state, buf), scores, fleet_summary)``.
+    """
+    from smartcal_tpu.runtime import Fleet
+    from smartcal_tpu.train.blocks import TrainRuntime, train_obs
+
+    from .learner import make_sharded_fleet_buffer, run_supervised_loop
+
+    if actor_mode == "process" and backend is not None \
+            and backend_kwargs is None:
+        raise ValueError(
+            "actor_mode='process' needs backend_kwargs (the picklable "
+            "RadioBackend constructor kwargs) — a pre-built backend "
+            "object cannot be shipped to worker processes")
+    backend = backend or radio.RadioBackend(**(backend_kwargs or {}))
+    agent_cfg = _demix_agent_cfg(backend, K, provide_influence, is_clip,
+                                 ere_eta, agent_kwargs)
+    n_trans = batch_envs * rollout_epochs * rollout_steps
+
+    factory_kwargs = dict(backend_kwargs=dict(backend_kwargs or {}), K=K,
+                          agent_kwargs=dict(agent_kwargs or {}),
+                          provide_influence=provide_influence,
+                          is_clip=is_clip, ere_eta=ere_eta,
+                          batch_envs=batch_envs,
+                          rollout_epochs=rollout_epochs,
+                          rollout_steps=rollout_steps, seed=seed)
+    work_fn = (None if actor_mode == "process"
+               else _demix_fleet_work_fn(_backend=backend,
+                                         **factory_kwargs))
+    worker_spec = {
+        "factory":
+            "smartcal_tpu.parallel.demix_learner:_demix_fleet_work_fn",
+        "kwargs": factory_kwargs}
+
     def _ingest(agent, buf, flat, key, learner_version):
-        buf = rp.replay_add_batch(buf, flat)
+        buf = rp.backend_for(buf).replay_add_batch(buf, flat)
         return dsac.learn(agent_cfg, agent, buf, key,
                           learner_version=learner_version)
 
@@ -475,37 +548,27 @@ def train_supervised_demix(seed=0, episodes=5, n_actors=2, K=4,
     spec = dsac.transition_spec(agent_cfg.obs_dim)
     if is_clip > 0:
         spec = rp.versioned_spec(spec)
-    buf = rp.replay_init(agent_cfg.mem_size, spec)
-
-    base_key = jax.random.PRNGKey(seed ^ 0x0AC7D32)
-
-    def work_fn(actor_id, iteration, weights):
-        rt_faults.maybe_delay("actor_rollout", iteration)
-        if rt_faults.should_kill_actor(actor_id, iteration):
-            raise rt_faults.FaultInjected(
-                f"actor {actor_id} killed at iteration {iteration}")
-        k = jax.random.fold_in(jax.random.fold_in(base_key, actor_id),
-                               iteration)
-        k_wl, k_roll = jax.random.split(k)
-        # the actor simulates its own episode lanes (the host-side half
-        # the SPMD mode batches up front)
-        wl = make_workloads(backend, K, batch_envs, rollout_epochs, k_wl)
-        if batch_envs > 1:
-            return jax.device_get(rollout(weights, wl, k_roll))
-        wl_one = jax.tree_util.tree_map(lambda x: x[0], wl)
-        return jax.device_get(rollout(weights, wl_one, k_roll))
+    if replay_shards:
+        buf = make_sharded_fleet_buffer(agent_cfg.mem_size, spec,
+                                        replay_shards)
+    else:
+        buf = rp.replay_init(agent_cfg.mem_size, spec)
 
     tob = train_obs("demix_learner_supervised", metrics=metrics,
                     quiet=quiet, diag=diag, watchdog=watchdog, seed=seed,
                     n_actors=n_actors, K=K, batch_envs=batch_envs,
-                    is_clip=is_clip, ere_eta=ere_eta)
+                    is_clip=is_clip, ere_eta=ere_eta,
+                    actor_mode=actor_mode, replay_shards=replay_shards,
+                    sim_hosts=sim_hosts)
     rt = TrainRuntime("demix_learner_supervised", ckpt_dir=ckpt_dir,
                       ckpt_every=ckpt_every, keep=keep_ckpts,
                       resume=resume, tob=tob)
     fleet = Fleet(n_actors, work_fn, name="demix-actor",
                   heartbeat_timeout=heartbeat_timeout,
                   max_restarts=max_restarts, backoff=restart_backoff,
-                  seed=seed)
+                  seed=seed, actor_mode=actor_mode,
+                  worker_spec=worker_spec if actor_mode == "process"
+                  else None, hosts=sim_hosts)
     return run_supervised_loop(fleet, ingest_batch, agent, buf, key,
                                episodes, n_trans, tob,
                                queue_timeout=queue_timeout,
@@ -562,16 +625,20 @@ def main(argv=None):
         obs.echo(f"multihost: {multihost.runtime_summary()}",
                  event="multihost")
     if args.small:
-        backend = radio.RadioBackend(n_stations=6, n_times=4, tdelta=2,
-                                     npix=16, admm_iters=2, lbfgs_iters=3,
-                                     init_iters=4)
+        backend_kwargs = dict(n_stations=6, n_times=4, tdelta=2,
+                              npix=16, admm_iters=2, lbfgs_iters=3,
+                              init_iters=4)
     else:
-        backend = radio.RadioBackend(n_stations=args.stations,
-                                     npix=args.npix)
+        backend_kwargs = dict(n_stations=args.stations, npix=args.npix)
+    backend = radio.RadioBackend(**backend_kwargs)
+    if args.actor_mode == "process" or args.replay_shards \
+            or args.sim_hosts > 1:
+        args.supervised = True
     if args.supervised:
         _, scores, _ = train_supervised_demix(
             seed=args.seed, episodes=args.episodes,
             n_actors=n_actors or 2, K=args.K, backend=backend,
+            backend_kwargs=backend_kwargs,
             provide_influence=args.provide_influence,
             rollout_epochs=args.rollout_epochs,
             rollout_steps=args.rollout_steps,
@@ -583,7 +650,9 @@ def main(argv=None):
             batch_envs=args.batch_envs, is_clip=args.is_clip,
             ere_eta=args.ere_eta, publish_every=args.publish_every,
             ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
-            keep_ckpts=args.keep_ckpts, resume=args.resume)
+            keep_ckpts=args.keep_ckpts, resume=args.resume,
+            actor_mode=args.actor_mode,
+            replay_shards=args.replay_shards, sim_hosts=args.sim_hosts)
         return scores
     _, scores = train_distributed_demix(
         seed=args.seed, episodes=args.episodes, n_actors=n_actors,

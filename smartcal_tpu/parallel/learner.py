@@ -143,6 +143,59 @@ def make_fleet_rollout(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
         lambda agent_state, key: single(agent_state, key))
 
 
+def _enet_fleet_work_fn(env_kwargs=None, agent_kwargs=None, use_hint=False,
+                        is_clip=0.0, ere_eta=1.0, batch_envs=1,
+                        rollout_epochs=2, rollout_steps=5, seed=0):
+    """Build the enet fleet actor's work function from PICKLABLE
+    primitives — the one definition shared by actor THREADS (called
+    in-process by ``train_supervised``) and actor PROCESSES (named as
+    the ``worker_spec`` factory and called inside each spawned worker
+    by :func:`smartcal_tpu.runtime.ipc.worker_main`).  Identical inputs
+    produce identical per-(actor, iteration) key streams in both modes,
+    so switching ``--actor-mode`` changes WHERE rollouts run, never
+    WHAT they compute."""
+    env_cfg = enet.EnetConfig(**(env_kwargs or {}))
+    agent_kwargs = dict(agent_kwargs or {})
+    agent_kwargs.setdefault("prioritized", True)
+    agent_cfg = sac.SACConfig(obs_dim=env_cfg.obs_dim, n_actions=2,
+                              use_hint=use_hint, is_clip=is_clip,
+                              ere_eta=ere_eta, **agent_kwargs)
+    rollout = jax.jit(make_fleet_rollout(
+        env_cfg, agent_cfg, batch_envs, rollout_epochs, rollout_steps,
+        use_hint=use_hint, record_logp=is_clip > 0))
+    # per-(actor, iteration) rollout keys: a restarted actor continues
+    # its predecessor's deterministic stream from the next iteration
+    base_key = jax.random.PRNGKey(seed ^ 0x0AC7035)
+
+    from smartcal_tpu.runtime import faults as rt_faults
+
+    def work_fn(actor_id, iteration, weights):
+        rt_faults.maybe_delay("actor_rollout", iteration)
+        if rt_faults.should_kill_actor(actor_id, iteration):
+            raise rt_faults.FaultInjected(
+                f"actor {actor_id} killed at iteration {iteration}")
+        k = jax.random.fold_in(jax.random.fold_in(base_key, actor_id),
+                               iteration)
+        return jax.device_get(rollout(weights, k))
+
+    return work_fn
+
+
+def make_sharded_fleet_buffer(mem_size: int, spec: dict,
+                              replay_shards: int):
+    """The fleet's mesh-sharded replay buffer, committed to the device
+    mesh (see :mod:`smartcal_tpu.rl.replay_sharded`); validates the
+    shard count against the ring size at config time."""
+    from ..rl import replay_sharded as rps
+
+    if mem_size % replay_shards != 0:
+        raise ValueError(
+            f"--replay-shards {replay_shards} must divide mem_size "
+            f"{mem_size} (equal round-robin ring shards)")
+    return rps.place_on_mesh(rps.replay_init(mem_size, spec,
+                                             replay_shards))
+
+
 def make_distributed_per_sac(env_cfg: enet.EnetConfig,
                              agent_cfg: sac.SACConfig, mesh: Mesh,
                              n_actors: int, rollout_epochs: int = 10,
@@ -334,24 +387,40 @@ def train_supervised(seed=0, episodes=50, n_actors=2, env_kwargs=None,
                      queue_timeout=30.0, max_empty_rounds=20,
                      restart_backoff=None, batch_envs=1, is_clip=0.0,
                      ere_eta=1.0, publish_every=1, ckpt_dir=None,
-                     ckpt_every=0, keep_ckpts=3, resume=False):
-    """Supervised actor-thread fleet: the scale-out async sibling of
+                     ckpt_every=0, keep_ckpts=3, resume=False,
+                     actor_mode="thread", replay_shards=0, sim_hosts=1):
+    """Supervised actor fleet: the scale-out async sibling of
     :func:`train_distributed`.
 
     Where the SPMD learner fuses all actors into one jitted program
-    (nothing can die independently), here each actor is a host THREAD
-    driving ``batch_envs`` env lanes as ONE batched jitted rollout
-    (:func:`make_fleet_rollout`, the PR 9 regime) against an
-    episode-frozen weights snapshot, queueing version-stamped host
-    transition blocks; the learner ingests whatever arrived through one
-    fused device-resident step (store -> PER/ERE sample -> learn ->
-    priority update, no host round-trip of the sampled batch), and a
+    (nothing can die independently), here each actor is an independent
+    host execution unit driving ``batch_envs`` env lanes as ONE batched
+    jitted rollout (:func:`make_fleet_rollout`, the PR 9 regime)
+    against an episode-frozen weights snapshot, shipping
+    version-stamped transition blocks; the learner ingests whatever
+    arrived through one fused device-resident step (store -> PER/ERE
+    sample -> learn -> priority update, no host round-trip of the
+    sampled batch), and a
     :class:`~smartcal_tpu.runtime.supervisor.Fleet` restarts dead/hung
     actors with exponential backoff + jitter.  Learning continues from
     the surviving fleet; a watchdog trip stops AND joins every actor
-    thread before the driver exits.  Deterministic faults (kill actor i
-    at iteration n, delay a rollout) come from
+    before the driver exits.  Deterministic faults (kill actor i at
+    iteration n, delay a rollout) come from
     :mod:`smartcal_tpu.runtime.faults`.
+
+    ``actor_mode`` picks the fleet backend: ``"thread"`` (default, the
+    PR 10 shape, bit-identical to it) runs each actor as a host thread
+    in this process; ``"process"`` spawns each actor as a WORKER
+    PROCESS (its own interpreter, its own GIL) exchanging framed
+    batches/heartbeats over IPC, with per-slot ingest shards instead of
+    one global queue — same work function, same key streams, so the
+    mode changes where rollouts run, never what they compute.
+    ``sim_hosts > 1`` (process mode only) tags contiguous slot blocks
+    with simulated host ids (the single-machine multi-host rehearsal).
+    ``replay_shards > 0`` swaps the learner's flat HBM buffer for the
+    mesh-sharded one (:mod:`smartcal_tpu.rl.replay_sharded`): stores
+    land shard-local, sampling merges per-shard draws via collectives,
+    priority updates scatter shard-local.
 
     ``is_clip`` arms the IMPACT staleness-clipped importance weighting
     (transitions carry the actor's snapshot version + behavior log-prob;
@@ -365,10 +434,11 @@ def train_supervised(seed=0, episodes=50, n_actors=2, env_kwargs=None,
 
     Returns ``((agent_state, buf), scores, fleet_summary)`` — the
     summary carries restart counts plus the steady-state aggregate
-    ``env_steps_per_s`` (measured after the warmup rounds).
+    ``env_steps_per_s`` (measured after the warmup rounds) and, when
+    the IS-clip is armed, the steady-state mean
+    ``transition_staleness_mean`` / ``is_clip_saturation``.
     """
     from smartcal_tpu.runtime import Fleet
-    from smartcal_tpu.runtime import faults as rt_faults
     from smartcal_tpu.train.blocks import TrainRuntime, train_obs
 
     env_cfg = enet.EnetConfig(**(env_kwargs or {}))
@@ -379,12 +449,22 @@ def train_supervised(seed=0, episodes=50, n_actors=2, env_kwargs=None,
                               ere_eta=ere_eta, **agent_kwargs)
     n_trans = batch_envs * rollout_epochs * rollout_steps
 
-    rollout = jax.jit(make_fleet_rollout(
-        env_cfg, agent_cfg, batch_envs, rollout_epochs, rollout_steps,
-        use_hint=use_hint, record_logp=is_clip > 0))
+    factory_kwargs = dict(env_kwargs=dict(env_kwargs or {}),
+                          agent_kwargs=agent_kwargs, use_hint=use_hint,
+                          is_clip=is_clip, ere_eta=ere_eta,
+                          batch_envs=batch_envs,
+                          rollout_epochs=rollout_epochs,
+                          rollout_steps=rollout_steps, seed=seed)
+    # thread mode calls the SAME factory in-process; process mode ships
+    # the picklable spec and each worker rebuilds the identical program
+    work_fn = (None if actor_mode == "process"
+               else _enet_fleet_work_fn(**factory_kwargs))
+    worker_spec = {"factory":
+                   "smartcal_tpu.parallel.learner:_enet_fleet_work_fn",
+                   "kwargs": factory_kwargs}
 
     def _ingest(agent, buf, flat, key, learner_version):
-        buf = rp.replay_add_batch(buf, flat)
+        buf = rp.backend_for(buf).replay_add_batch(buf, flat)
         return sac.learn(agent_cfg, agent, buf, key,
                          learner_version=learner_version)
 
@@ -396,20 +476,11 @@ def train_supervised(seed=0, episodes=50, n_actors=2, env_kwargs=None,
     spec = rp.transition_spec(env_cfg.obs_dim, agent_cfg.n_actions)
     if is_clip > 0:
         spec = rp.versioned_spec(spec)
-    buf = rp.replay_init(agent_cfg.mem_size, spec)
-
-    # per-(actor, iteration) rollout keys: a restarted actor continues
-    # its predecessor's deterministic stream from the next iteration
-    base_key = jax.random.PRNGKey(seed ^ 0x0AC7035)
-
-    def work_fn(actor_id, iteration, weights):
-        rt_faults.maybe_delay("actor_rollout", iteration)
-        if rt_faults.should_kill_actor(actor_id, iteration):
-            raise rt_faults.FaultInjected(
-                f"actor {actor_id} killed at iteration {iteration}")
-        k = jax.random.fold_in(jax.random.fold_in(base_key, actor_id),
-                               iteration)
-        return jax.device_get(rollout(weights, k))
+    if replay_shards:
+        buf = make_sharded_fleet_buffer(agent_cfg.mem_size, spec,
+                                        replay_shards)
+    else:
+        buf = rp.replay_init(agent_cfg.mem_size, spec)
 
     def ingest_batch(agent, buf, host_trs, kl, weights_version,
                      learner_version):
@@ -426,14 +497,18 @@ def train_supervised(seed=0, episodes=50, n_actors=2, env_kwargs=None,
     tob = train_obs("parallel_learner_supervised", metrics=metrics,
                     quiet=quiet, diag=diag, watchdog=watchdog, seed=seed,
                     n_actors=n_actors, batch_envs=batch_envs,
-                    is_clip=is_clip, ere_eta=ere_eta)
+                    is_clip=is_clip, ere_eta=ere_eta,
+                    actor_mode=actor_mode, replay_shards=replay_shards,
+                    sim_hosts=sim_hosts)
     rt = TrainRuntime("parallel_learner_supervised", ckpt_dir=ckpt_dir,
                       ckpt_every=ckpt_every, keep=keep_ckpts,
                       resume=resume, tob=tob)
     fleet = Fleet(n_actors, work_fn, name="enet-actor",
                   heartbeat_timeout=heartbeat_timeout,
                   max_restarts=max_restarts, backoff=restart_backoff,
-                  seed=seed)
+                  seed=seed, actor_mode=actor_mode,
+                  worker_spec=worker_spec if actor_mode == "process"
+                  else None, hosts=sim_hosts)
     return run_supervised_loop(fleet, ingest_batch, agent, buf, key,
                                episodes, n_trans, tob,
                                queue_timeout=queue_timeout,
@@ -464,11 +539,16 @@ def run_supervised_loop(fleet, ingest_batch, agent, buf, key, episodes,
     actor slot's next rollout iteration (``fleet.slot_iterations``).
 
     Telemetry per round: aggregate + per-actor ``transitions_per_s``
-    gauges, ``weight_staleness_versions`` (max) and, when the IS-clip is
-    armed, the ``staleness_mean``/``is_clip_saturation``/``is_clip_mean``
+    gauges, ``weight_staleness_versions`` (max), per-slot
+    ``ingest_queue_depth`` gauges (process fleets — the single-slow-
+    shard visibility the aggregate hid) plus the aggregate, per-shard
+    ``replay_shard_occupancy`` gauges (sharded buffers; derived from
+    the global counter, no array pull) and, when the IS-clip is armed,
+    the ``staleness_mean``/``is_clip_saturation``/``is_clip_mean``
     gauges off the fused step's metrics.  The summary reports the
     steady-state aggregate env-steps/s measured AFTER ``warmup_rounds``
-    (compile excluded — the actor-scaling bench's metric).
+    (compile excluded — the actor-scaling bench's metric) and the
+    steady-state means of the staleness/saturation gauges.
     """
     import time
 
@@ -507,6 +587,8 @@ def run_supervised_loop(fleet, ingest_batch, agent, buf, key, episodes,
     # aggregate env-steps/s is the sustained pipeline rate, not just the
     # queue-drain burst rate
     meas_trans, meas_t0, rounds = 0, None, 0
+    stale_means, clip_sats, critic_losses = [], [], []
+    sharded = hasattr(buf, "n_shards")
     try:
         fleet.start(agent, start_iterations=start_iters, version=version0)
         learner_version = fleet.version
@@ -562,6 +644,24 @@ def run_supervised_loop(fleet, ingest_batch, agent, buf, key, episodes,
                 obs.gauge_set("per_actor_transitions_per_s",
                               round(tr_n / max(wall, 1e-9), 2), actor=aid)
             obs.gauge_set("weight_staleness_versions", staleness)
+            # per-slot ingest depth: one gauge per shard (process
+            # fleets) so a single backed-up slot is visible, plus the
+            # aggregate every mode reports
+            depths = fleet.queue_depths()
+            obs.gauge_set("ingest_queue_depth", depths["aggregate"])
+            for slot, d in sorted(depths.get("per_slot", {}).items()):
+                obs.gauge_set("ingest_queue_depth", d, slot=slot)
+            if sharded:
+                # occupancy per replay shard, derived from the global
+                # store counter alone (round-robin keeps shards within
+                # one transition of each other — a skew here means the
+                # interleave broke)
+                from smartcal_tpu.rl import replay_sharded as rps
+
+                occ = rps.shard_occupancy(int(buf.cntr), buf.n_shards,
+                                          buf.local_size)
+                for sh_i, o in enumerate(occ):
+                    obs.gauge_set("replay_shard_occupancy", o, shard=sh_i)
             if "staleness_mean" in metrics_out:
                 # the fused step's IS-clip telemetry (batch-level means,
                 # already on device): the staleness distribution the
@@ -575,6 +675,13 @@ def run_supervised_loop(fleet, ingest_batch, agent, buf, key, episodes,
                                   metrics_out["is_clip_saturation"]), 4))
                 obs.gauge_set("is_clip_mean",
                               round(float(metrics_out["is_clip_mean"]), 4))
+                if rounds > warmup_rounds:
+                    stale_means.append(
+                        float(metrics_out["staleness_mean"]))
+                    clip_sats.append(
+                        float(metrics_out["is_clip_saturation"]))
+            if rounds > warmup_rounds and "critic_loss" in metrics_out:
+                critic_losses.append(float(metrics_out["critic_loss"]))
             tripped = False
             if tob.collect_diag:
                 tripped = tob.record_diag(
@@ -621,6 +728,17 @@ def run_supervised_loop(fleet, ingest_batch, agent, buf, key, episodes,
                "env_steps_per_s": (round(meas_trans / meas_wall, 2)
                                    if meas_wall > 0 and meas_trans
                                    else None)}
+    if stale_means:
+        # steady-state staleness the IS-clip absorbed (the curve the
+        # actor-scaling bench records at every point)
+        summary["transition_staleness_mean"] = round(
+            float(np.mean(stale_means)), 4)
+        summary["is_clip_saturation"] = round(
+            float(np.mean(clip_sats)), 4)
+    if critic_losses:
+        # next to the staleness: did the clipped TD loss stay bounded?
+        summary["critic_loss_mean"] = round(
+            float(np.mean(critic_losses)), 4)
     return (agent, buf), scores, summary
 
 
@@ -632,6 +750,7 @@ def main(argv=None):
 
     Usage: python -m smartcal_tpu.parallel.learner --episodes 100
         [--n-actors 8] [--batch-envs 4] [--is-clip 2.0] [--ere 0.98]
+        [--actor-mode process] [--replay-shards 4] [--sim-hosts 2]
         [--use_hint] [--learn_per_transition]
         [--coordinator host:port --num_processes N --process_id i]
     """
@@ -672,6 +791,11 @@ def main(argv=None):
     if multihost.initialize_from_args(args):
         obs.echo(f"multihost: {multihost.runtime_summary()}",
                  event="multihost")
+    if args.actor_mode == "process" or args.replay_shards \
+            or args.sim_hosts > 1:
+        # the process fleet / sharded replay are supervised-mode
+        # features; flip the switch rather than silently ignoring them
+        args.supervised = True
     if args.supervised:
         _, scores, _ = train_supervised(
             seed=args.seed, episodes=args.episodes,
@@ -684,7 +808,9 @@ def main(argv=None):
             batch_envs=args.batch_envs, is_clip=args.is_clip,
             ere_eta=args.ere_eta, publish_every=args.publish_every,
             ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
-            keep_ckpts=args.keep_ckpts, resume=args.resume)
+            keep_ckpts=args.keep_ckpts, resume=args.resume,
+            actor_mode=args.actor_mode,
+            replay_shards=args.replay_shards, sim_hosts=args.sim_hosts)
         return scores
     _, scores = train_distributed(
         seed=args.seed, episodes=args.episodes, n_actors=n_actors,

@@ -55,6 +55,56 @@ def initialize(coordinator: Optional[str] = None,
     return True
 
 
+# ---------------------------------------------------------------------------
+# Simulated multi-host attach (the process-fleet rehearsal mode)
+# ---------------------------------------------------------------------------
+
+# "<host_id>/<n_hosts>" marker a simulated-host actor process runs under
+SIM_HOST_ENV = "SMARTCAL_SIM_HOST"
+
+
+def simulated_host_env(host_id: int, n_hosts: int) -> dict:
+    """Env-var form of a simulated host assignment (what a spawner sets
+    for a worker when it cannot pass arguments directly)."""
+    return {SIM_HOST_ENV: f"{int(host_id)}/{int(n_hosts)}"}
+
+
+def attach_simulated(host_id: Optional[int] = None,
+                     n_hosts: Optional[int] = None) -> dict:
+    """Attach this process to the SIMULATED multi-host runtime.
+
+    The process-backed actor fleet rehearses the multi-host topology on
+    one machine: each spawned actor process calls this with its
+    assigned ``(host_id, n_hosts)`` (or inherits them from
+    ``SMARTCAL_SIM_HOST``), records the assignment in the environment
+    (so nested tooling and the RunLog header can see it) and returns a
+    summary.  It deliberately does NOT call
+    ``jax.distributed.initialize`` — there is only one real host; a
+    REAL multi-host job still goes through :func:`initialize`, and this
+    marker documents which rehearsal host the process was playing.
+    """
+    if host_id is None:
+        raw = os.environ.get(SIM_HOST_ENV, "").strip()
+        if raw:
+            try:
+                host_id, n_hosts = (int(x) for x in raw.split("/", 1))
+            except ValueError:
+                host_id = None
+    if host_id is None:
+        return {"simulated": False, "host_id": 0, "n_hosts": 1}
+    n_hosts = int(n_hosts or 1)
+    host_id = int(host_id)
+    os.environ.update(simulated_host_env(host_id, n_hosts))
+    return {"simulated": n_hosts > 1, "host_id": host_id,
+            "n_hosts": n_hosts}
+
+
+def simulated_summary() -> dict:
+    """The current process's simulated-host assignment (default: the
+    single real host)."""
+    return attach_simulated()
+
+
 def add_cli_args(parser) -> None:
     """Attach the multi-host flags every parallel CLI shares
     (the reference's --master_addr/--master_port/--world_size/--rank,

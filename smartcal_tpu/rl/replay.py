@@ -23,6 +23,7 @@ the same machinery with extra keys.
 from __future__ import annotations
 
 import math
+import types
 from typing import NamedTuple, Optional
 
 import jax
@@ -86,6 +87,23 @@ def versioned_spec(spec: dict) -> dict:
     and plain buffers share every other code path."""
     return {**spec, "version": ((), jnp.int32),
             "behavior_logp": ((), jnp.float32)}
+
+
+def backend_for(buf: object) -> "types.ModuleType":
+    """The replay module implementing ``buf``'s layout: this module for
+    the flat single-device :class:`ReplayState`, the mesh-sharded twin
+    (:mod:`smartcal_tpu.rl.replay_sharded`) for its
+    ``ShardedReplayState``.  Both expose the same store/sample/update
+    function names, so the agents' fused learn steps dispatch on buffer
+    type with one call (the choice is python-static under jit — the
+    buffer's pytree TYPE, not a traced value)."""
+    import sys
+
+    from . import replay_sharded as rps
+
+    if isinstance(buf, rps.ShardedReplayState):
+        return rps
+    return sys.modules[__name__]
 
 
 def replay_init(size: int, spec: dict) -> ReplayState:

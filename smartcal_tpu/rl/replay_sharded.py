@@ -1,0 +1,387 @@
+"""Mesh-sharded device-resident replay (the cluster form of
+:mod:`smartcal_tpu.rl.replay`).
+
+One HBM ring buffer bounds the async fleet long before the hardware
+does: every actor's transitions funnel into one device's memory and
+every sample reads it.  This module generalizes the PR 10 buffer to a
+buffer SHARDED over a mesh axis — the "In-Network Experience Sampling"
+direction (arXiv:2110.13506): the store and sample paths themselves
+move onto the mesh so no transition or sampled batch ever needs a
+single-owner hop.
+
+Layout: all arrays carry a leading shard axis — ``data[field]`` is
+``(S, local, ...)``, ``priority`` is ``(S, local)`` — sharded over the
+mesh (``place_on_mesh``), with a replicated GLOBAL store counter.  The
+global ring is interleaved round-robin across shards: store number
+``t`` lands at ring slot ``r = t % size``, which is shard ``r % S``,
+local slot ``r // S``.  Consequences:
+
+* **store is shard-local**: a batch scatter decomposes into S
+  independent local scatters (each shard takes exactly the rows whose
+  ring slot it owns — no cross-shard traffic);
+* **ring parity**: slot ``(s, j)`` holds exactly what ring slot
+  ``j*S + s`` of the equivalent single buffer holds, so ages, ERE
+  weights and fill state match the flat
+  :class:`~smartcal_tpu.rl.replay.ReplayState` EXACTLY, and the
+  round-robin interleave keeps every shard balanced to within one
+  transition;
+* **sampling draws per-shard then merges via collectives**: the
+  stratified PER draw runs against per-shard local cumsums plus an
+  S-scalar shard-total prefix (the only cross-shard reduction on the
+  hot path); each shard gathers its own rows and the batch materializes
+  as a masked sum over the shard axis — on a real mesh, a psum over
+  ICI/DCN, never a host hop;
+* **priority update is a shard-local scatter**: every shard writes the
+  sampled rows it owns and drops the rest.
+
+Sampling-distribution note: per-transition EXPECTED sample counts under
+the stratified draw are ``batch * p_i / total`` — identical to the flat
+buffer and the native sum tree — but the stratification ORDER is
+shard-concatenated rather than ring-ordered, so individual draws are
+not bitwise those of the flat buffer (distribution parity is what
+tests/test_sharded_replay.py certifies, against both oracles).
+
+The module mirrors :mod:`~smartcal_tpu.rl.replay`'s function names and
+signatures so the agents' fused learn steps dispatch between the two by
+buffer type (:func:`smartcal_tpu.rl.replay.backend_for`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import replay as rp
+
+
+class ShardedReplayState(NamedTuple):
+    """Pytree of the sharded buffer (leading shard axis everywhere)."""
+
+    data: dict                 # field -> (S, local, ...) arrays
+    cntr: jnp.ndarray          # () int32 GLOBAL store counter
+    priority: jnp.ndarray      # (S, local)
+    beta: jnp.ndarray          # () PER beta
+
+    @property
+    def n_shards(self) -> int:
+        return self.priority.shape[0]
+
+    @property
+    def local_size(self) -> int:
+        return self.priority.shape[1]
+
+    @property
+    def size(self) -> int:
+        return self.priority.shape[0] * self.priority.shape[1]
+
+    def health(self) -> dict:
+        """Replay-health summary in the flat buffer's vocabulary
+        (ring-slot order reconstructed from the interleave) plus the
+        per-shard occupancy profile."""
+        return replay_health(self)
+
+
+def replay_init(size: int, spec: dict, n_shards: int) -> ShardedReplayState:
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    if size % n_shards != 0:
+        raise ValueError(
+            f"buffer size {size} must be divisible by n_shards "
+            f"{n_shards} (the round-robin ring needs equal shards)")
+    local = size // n_shards
+    data = {k: jnp.zeros((n_shards, local) + tuple(shape), dtype)
+            for k, (shape, dtype) in spec.items()}
+    return ShardedReplayState(
+        data=data,
+        cntr=jnp.asarray(0, jnp.int32),
+        priority=jnp.zeros((n_shards, local), jnp.float32),
+        beta=jnp.asarray(rp.PER_BETA0, jnp.float32),
+    )
+
+
+def shardings(buf: ShardedReplayState, mesh, axis: str = "rp"):
+    """The buffer's sharding pytree: leading-axis sharded data +
+    priority, replicated counters."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    return ShardedReplayState(
+        data={k: shard for k in buf.data},
+        cntr=repl, priority=shard, beta=repl)
+
+
+def place_on_mesh(buf: ShardedReplayState, mesh=None, axis: str = "rp"):
+    """Commit the buffer to the device mesh, shard axis leading.
+
+    Default mesh: the largest divisor of ``n_shards`` that the local
+    device count supports, over all devices — so an S=4 buffer on the
+    8-device virtual test mesh occupies 4 devices, and on a single-CPU
+    host degenerates (gracefully) to one device still carrying the
+    sharded LAYOUT the cluster run uses.
+    """
+    if mesh is None:
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        n = math.gcd(buf.n_shards, len(devs))
+        mesh = Mesh(np.asarray(devs[:n]), (axis,))
+    return jax.device_put(buf, shardings(buf, mesh, axis))
+
+
+# ---------------------------------------------------------------------------
+# store (shard-local scatter)
+# ---------------------------------------------------------------------------
+
+def replay_add_batch(buf: ShardedReplayState, transitions: dict,
+                     priority: Optional[jnp.ndarray] = None,
+                     errors: Optional[jnp.ndarray] = None,
+                     error_clip: float = 100.0) -> ShardedReplayState:
+    """Store a leading-axis batch at consecutive GLOBAL ring slots.
+
+    Row ``b`` is store number ``cntr + b`` -> ring slot ``(cntr + b) %
+    size`` -> shard ``(cntr + b) % S``.  Each shard independently
+    gathers the (at most ``ceil(B/S)``) rows it owns and scatters them
+    into its local ring — transitions land shard-local, no collective.
+    Priority defaults follow :func:`~smartcal_tpu.rl.replay
+    .replay_add_batch` (explicit > per-row errors > global max/clip).
+    """
+    S, L = buf.priority.shape
+    B = next(iter(transitions.values())).shape[0]
+    nmax = -(-B // S)                     # rows per shard, padded
+    C = buf.cntr
+    if priority is None:
+        if errors is None:
+            pmax = jnp.max(buf.priority)
+            priority = jnp.full((B,), jnp.where(pmax == 0.0, error_clip,
+                                                pmax))
+        else:
+            priority = rp.priority_from_errors(errors, error_clip)
+    else:
+        priority = jnp.broadcast_to(jnp.asarray(priority, jnp.float32),
+                                    (B,))
+
+    def upd_shard(s, data_s, prio_s):
+        # rows this shard owns: b with (C + b) % S == s
+        b = (s - C) % S + S * jnp.arange(nmax)
+        valid = b < B
+        bg = jnp.minimum(b, B - 1)
+        j = ((C + b) // S) % L
+        idx = jnp.where(valid, j, L)      # L is out of range -> dropped
+        new_data = {
+            k: v.at[idx].set(
+                jnp.asarray(transitions[k], v.dtype)[bg], mode="drop")
+            for k, v in data_s.items()}
+        return new_data, prio_s.at[idx].set(priority[bg], mode="drop")
+
+    data, prio = jax.vmap(upd_shard)(jnp.arange(S), buf.data, buf.priority)
+    return ShardedReplayState(data=data, cntr=C + B, priority=prio,
+                              beta=buf.beta)
+
+
+def replay_add(buf: ShardedReplayState, transition: dict,
+               priority: Optional[jnp.ndarray] = None,
+               error: Optional[jnp.ndarray] = None,
+               error_clip: float = 100.0) -> ShardedReplayState:
+    """Single-transition store (the batch path with B=1)."""
+    one = {k: jnp.asarray(v)[None] for k, v in transition.items()}
+    err = None if error is None else jnp.asarray(error)[None]
+    pri = None if priority is None else priority
+    return replay_add_batch(buf, one, priority=pri, errors=err,
+                            error_clip=error_clip)
+
+
+# ---------------------------------------------------------------------------
+# ages / ERE / fill
+# ---------------------------------------------------------------------------
+
+def _filled(buf: ShardedReplayState):
+    return jnp.minimum(buf.cntr, buf.size)
+
+
+def _global_slots(buf: ShardedReplayState) -> jnp.ndarray:
+    """(S, local) map of each cell to its global ring-slot id
+    ``g = j*S + s`` — the interleave that makes ages/ERE/fill match the
+    flat buffer exactly."""
+    S, L = buf.priority.shape
+    s = jnp.arange(S)[:, None]
+    j = jnp.arange(L)[None, :]
+    return j * S + s
+
+
+def ere_weights(buf: ShardedReplayState, eta: float) -> jnp.ndarray:
+    """(S, local) emphasizing-recent-experience weights — numerically
+    identical to :func:`~smartcal_tpu.rl.replay.ere_weights` on the
+    equivalent flat ring (slot ``(s, j)`` == flat slot ``j*S + s``)."""
+    size = buf.size
+    filled = _filled(buf)
+    g = _global_slots(buf)
+    ages = jnp.mod(buf.cntr - 1 - g, jnp.maximum(size, 1))
+    x = ages.astype(jnp.float32) / jnp.maximum(filled - 1, 1)
+    w = jnp.asarray(eta, jnp.float32) ** (rp.ERE_SPAN * x)
+    return jnp.where(g < filled, w, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# sampling (per-shard draw, collective merge)
+# ---------------------------------------------------------------------------
+
+def _stratified_gather(buf: ShardedReplayState, weights: jnp.ndarray,
+                       key: jnp.ndarray, batch_size: int):
+    """Stratified draw of ``batch_size`` rows from the ``weights``
+    distribution ((S, local), zero on unfilled slots).
+
+    Per-shard local cumsums + an S-scalar shard-total prefix route each
+    stratified value to (shard, local slot); every shard gathers the
+    rows it owns and the batch merges as a masked sum over the shard
+    axis (the collective).  Returns ``(batch, gidx, p_sel, total)``
+    with ``gidx`` the GLOBAL ring-slot ids (priority-update currency).
+    """
+    S, L = weights.shape
+    csum = jnp.cumsum(weights, axis=1)        # (S, L) shard-local
+    totals = csum[:, -1]                      # (S,)
+    t_csum = jnp.cumsum(totals)
+    total = t_csum[-1]
+    off = t_csum - totals                     # exclusive shard offsets
+
+    seg = total / batch_size
+    u = jax.random.uniform(key, (batch_size,))
+    values = (jnp.arange(batch_size) + u) * seg
+    shard_of = jnp.clip(jnp.searchsorted(t_csum, values, side="left"),
+                        0, S - 1)
+    local_v = values - off[shard_of]
+
+    def shard_gather(s, csum_s, data_s, w_s):
+        li = jnp.clip(jnp.searchsorted(csum_s, local_v, side="left"),
+                      0, L - 1)
+        mine = shard_of == s
+
+        def sel(v):
+            g = v[li]
+            m = mine.reshape((batch_size,) + (1,) * (g.ndim - 1))
+            return jnp.where(m, g, jnp.zeros_like(g))
+
+        rows = {k: sel(v) for k, v in data_s.items()}
+        p = jnp.where(mine, w_s[li], 0.0)
+        gidx = jnp.where(mine, li * S + s, 0)
+        return rows, p, gidx
+
+    rows, p, gidx = jax.vmap(shard_gather)(
+        jnp.arange(S), csum, buf.data, weights)
+    batch = {k: jnp.sum(v, axis=0).astype(buf.data[k].dtype)
+             for k, v in rows.items()}
+    return batch, jnp.sum(gidx, axis=0), jnp.sum(p, axis=0), total
+
+
+def replay_sample_per(
+        buf: ShardedReplayState, key: jnp.ndarray, batch_size: int,
+        recency_eta: Optional[float] = None,
+) -> "tuple[dict, jnp.ndarray, jnp.ndarray, ShardedReplayState]":
+    """Sharded stratified PER (+ optional ERE modulation) with IS
+    weights computed against the distribution actually sampled from —
+    the flat :func:`~smartcal_tpu.rl.replay.replay_sample_per` contract
+    on the mesh.  Returns ``(batch, gidx, is_weights, new_buf)``."""
+    weights = buf.priority
+    if recency_eta is not None and recency_eta < 1.0:
+        weights = weights * ere_weights(buf, recency_eta)
+    beta = jnp.minimum(1.0, buf.beta + rp.PER_BETA_INCREMENT)
+    batch, gidx, p_sel, total = _stratified_gather(buf, weights, key,
+                                                   batch_size)
+    probs = p_sel / total
+    is_w = (batch_size * probs) ** (-beta)
+    is_w = is_w / jnp.max(is_w)
+    return batch, gidx, is_w.astype(jnp.float32), buf._replace(beta=beta)
+
+
+def replay_sample_ere(buf: ShardedReplayState, key: jnp.ndarray,
+                      batch_size: int,
+                      eta: float) -> "tuple[dict, jnp.ndarray]":
+    """Recency-weighted sampling for UNIFORM sharded buffers (no IS
+    correction, per the ERE paper — the flat contract)."""
+    w = ere_weights(buf, eta)
+    batch, gidx, _, _ = _stratified_gather(buf, w, key, batch_size)
+    return batch, gidx
+
+
+def replay_sample_uniform(buf: ShardedReplayState, key: jnp.ndarray,
+                          batch_size: int) -> "tuple[dict, jnp.ndarray]":
+    """Uniform sample w/o replacement over the filled prefix: the flat
+    path's Gumbel-top-k, scored shard-local, ranked globally (top-k
+    over the S*local score vector is the one collective)."""
+    S, L = buf.priority.shape
+    filled = _filled(buf)
+    g = _global_slots(buf)
+    gumb = jax.random.gumbel(key, (S, L))
+    score = jnp.where(g < filled, gumb, -jnp.inf)
+    _, flat_idx = jax.lax.top_k(score.reshape(-1), batch_size)
+    shard_of = flat_idx // L
+    li = flat_idx % L
+
+    def shard_gather(s, data_s):
+        mine = shard_of == s
+
+        def sel(v):
+            rows = v[jnp.clip(li, 0, L - 1)]
+            m = mine.reshape((batch_size,) + (1,) * (rows.ndim - 1))
+            return jnp.where(m, rows, jnp.zeros_like(rows))
+
+        return {k: sel(v) for k, v in data_s.items()}
+
+    rows = jax.vmap(shard_gather)(jnp.arange(S), buf.data)
+    batch = {k: jnp.sum(v, axis=0).astype(buf.data[k].dtype)
+             for k, v in rows.items()}
+    return batch, li * S + shard_of
+
+
+def replay_update_priorities(buf: ShardedReplayState, gidx: jnp.ndarray,
+                             errors: jnp.ndarray,
+                             error_clip: float = 100.0
+                             ) -> ShardedReplayState:
+    """Shard-local scatter of the re-computed priorities: each shard
+    writes the sampled rows it owns (``gidx % S == s``) and drops the
+    rest — same clip-then-exponent rule as the flat buffer."""
+    S, L = buf.priority.shape
+    clipped = jnp.minimum(jnp.abs(errors) + rp.PER_EPSILON, error_clip)
+    newp = clipped ** rp.PER_ALPHA
+
+    def upd(s, prio_s):
+        mine = (gidx % S) == s
+        li = jnp.where(mine, gidx // S, L)   # L -> dropped
+        return prio_s.at[li].set(newp, mode="drop")
+
+    return buf._replace(
+        priority=jax.vmap(upd)(jnp.arange(S), buf.priority))
+
+
+# ---------------------------------------------------------------------------
+# telemetry / persistence
+# ---------------------------------------------------------------------------
+
+def shard_occupancy(cntr: int, n_shards: int, local_size: int) -> list:
+    """Filled slots per shard from the GLOBAL counter alone (host ints;
+    one cheap scalar pull per telemetry round, no array transfer).
+    Round-robin keeps shards balanced to within one transition."""
+    filled = min(int(cntr), n_shards * local_size)
+    return [max(0, (filled - s + n_shards - 1) // n_shards)
+            for s in range(n_shards)]
+
+
+def replay_health(buf: ShardedReplayState) -> dict:
+    """Host-side health summary — the flat ring reconstructed from the
+    interleave (slot ``g = j*S + s``), run through the shared
+    :func:`~smartcal_tpu.rl.replay._health_from_arrays` math, plus the
+    per-shard occupancy profile."""
+    S, L = buf.priority.shape
+    prio = np.asarray(jax.device_get(buf.priority))
+    # (S, L) -> ring order g = j*S + s  ==  transpose then flatten
+    flat = prio.T.reshape(-1)
+    cntr = int(jax.device_get(buf.cntr))
+    out = rp._health_from_arrays(flat, cntr, S * L,
+                                 float(jax.device_get(buf.beta)))
+    out["n_shards"] = S
+    out["shard_occupancy"] = shard_occupancy(cntr, S, L)
+    return out

@@ -421,31 +421,37 @@ def learn(cfg: SACConfig, st: SACState, buf: rp.ReplayState,
     ``cfg.ere_eta < 1`` switches the sample distribution to (or, with
     PER, modulates it by) the emphasizing-recent-experience weights;
     ``learner_version`` (traced int) is required when ``cfg.is_clip``
-    arms the IMPACT staleness weighting.
+    arms the IMPACT staleness weighting.  ``buf`` may be the flat
+    :class:`~smartcal_tpu.rl.replay.ReplayState` or the mesh-sharded
+    :class:`~smartcal_tpu.rl.replay_sharded.ShardedReplayState` — the
+    sample/priority-update calls dispatch on the buffer type and the
+    whole step stays device-resident either way.
     """
     ere = cfg.ere_eta if cfg.ere_eta < 1.0 else None
+    rpb = rp.backend_for(buf)
 
     def do_learn(args):
         st, buf, key = args
         k_samp, k_core = jax.random.split(key)
 
         if cfg.prioritized:
-            batch, idx, is_w, buf2 = rp.replay_sample_per(
+            batch, idx, is_w, buf2 = rpb.replay_sample_per(
                 buf, k_samp, cfg.batch_size, recency_eta=ere)
         elif ere is not None:
-            batch, idx = rp.replay_sample_ere(buf, k_samp, cfg.batch_size,
-                                              ere)
+            batch, idx = rpb.replay_sample_ere(buf, k_samp, cfg.batch_size,
+                                               ere)
             is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
         else:
-            batch, idx = rp.replay_sample_uniform(buf, k_samp, cfg.batch_size)
+            batch, idx = rpb.replay_sample_uniform(buf, k_samp,
+                                                   cfg.batch_size)
             is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
 
         st_new, metrics = learn_from_batch(cfg, st, batch, is_w, k_core,
                                            collect_diag=collect_diag,
                                            learner_version=learner_version)
         if cfg.prioritized:
-            buf2 = rp.replay_update_priorities(buf2, idx, metrics["td"],
-                                               cfg.error_clip)
+            buf2 = rpb.replay_update_priorities(buf2, idx, metrics["td"],
+                                                cfg.error_clip)
         return st_new, buf2, {k: v for k, v in metrics.items() if k != "td"}
 
     def no_learn(args):

@@ -169,20 +169,21 @@ def learn(cfg: DSACConfig, st: DSACState, buf: rp.ReplayState,
     actor, critic = _nets(cfg)
     opt_a, opt_c = optax.adam(cfg.lr_a), optax.adam(cfg.lr_c)
     ere = cfg.ere_eta if cfg.ere_eta < 1.0 else None
+    rpb = rp.backend_for(buf)              # flat vs mesh-sharded buffer
 
     def do_learn(args):
         st, buf, key = args
         k_samp, _ = jax.random.split(key)
         if cfg.prioritized:
-            batch, idx, is_w, buf2 = rp.replay_sample_per(
+            batch, idx, is_w, buf2 = rpb.replay_sample_per(
                 buf, k_samp, cfg.batch_size, recency_eta=ere)
         elif ere is not None:
-            batch, idx = rp.replay_sample_ere(buf, k_samp, cfg.batch_size,
-                                              ere)
+            batch, idx = rpb.replay_sample_ere(buf, k_samp, cfg.batch_size,
+                                               ere)
             is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
         else:
-            batch, idx = rp.replay_sample_uniform(buf, k_samp,
-                                                  cfg.batch_size)
+            batch, idx = rpb.replay_sample_uniform(buf, k_samp,
+                                                   cfg.batch_size)
             is_w, buf2 = jnp.ones((cfg.batch_size,), jnp.float32), buf
 
         clip_aux = {}
@@ -253,7 +254,8 @@ def learn(cfg: DSACConfig, st: DSACState, buf: rp.ReplayState,
 
         if cfg.prioritized:
             td = jnp.abs(q1v - y)
-            buf2 = rp.replay_update_priorities(buf2, idx, td, cfg.error_clip)
+            buf2 = rpb.replay_update_priorities(buf2, idx, td,
+                                                cfg.error_clip)
 
         lerp = lambda t, o: jax.tree_util.tree_map(
             lambda a_, b_: cfg.tau * a_ + (1.0 - cfg.tau) * b_, o, t)

@@ -1,7 +1,7 @@
 """Fault-tolerant training runtime shared by the train drivers and the
 parallel learners.
 
-Five small, composable pieces:
+Seven small, composable pieces:
 
 * :mod:`~smartcal_tpu.runtime.atomic` — crash-safe file writes
   (tmp + ``os.replace``) and corruption-tolerant pickle loads.  Every
@@ -23,8 +23,12 @@ Five small, composable pieces:
   policy: roll back to the last good checkpoint, apply a mitigation
   (LR shrink / exploration reseed), retry within a bounded budget.
 * :mod:`~smartcal_tpu.runtime.supervisor` — heartbeat-monitored actor
-  threads with restart-on-death (exponential backoff + jitter) for the
-  parallel learners.
+  slots (threads or spawned worker processes) with restart-on-death
+  (exponential backoff + jitter) for the parallel learners.
+* :mod:`~smartcal_tpu.runtime.ipc` — framed, CRC-validated pickle
+  transport for the process-backed fleet (truncated mid-send payloads
+  surface as droppable :class:`CorruptPayloadError`, never a poisoned
+  learner iteration).
 
 Import cost: stdlib only at package import; jax is read lazily inside
 the functions that move device arrays.
@@ -40,6 +44,8 @@ from .checkpoint import (Checkpointer, load_latest,          # noqa: F401
 from .faults import (FaultInjected, FaultPlan,               # noqa: F401
                      clear as clear_faults, install as install_faults,
                      plan_from_env)
+from .ipc import (CorruptPayloadError, frame_payload,        # noqa: F401
+                  unframe_payload)
 from .recovery import (RecoveryAction, RecoveryManager,      # noqa: F401
                        RecoveryPolicy)
 from .supervisor import Fleet                                # noqa: F401

@@ -185,7 +185,10 @@ def pack_replay(buf: object) -> dict:
     import jax
 
     from smartcal_tpu.rl import replay as rp
+    from smartcal_tpu.rl import replay_sharded as rps
 
+    if isinstance(buf, rps.ShardedReplayState):
+        return {"kind": "hbm_sharded", "state": jax.device_get(buf)}
     if isinstance(buf, rp.ReplayState):
         return {"kind": "hbm", "state": jax.device_get(buf)}
     if hasattr(buf, "state_dict"):                 # NativePER
@@ -200,6 +203,14 @@ def unpack_replay(obj: dict) -> object:
     kind = obj.get("kind")
     if kind == "hbm":
         return jax.tree_util.tree_map(jnp.asarray, obj["state"])
+    if kind == "hbm_sharded":
+        # the NamedTuple type survives device_get/pickle, so the
+        # restored tree IS a ShardedReplayState; mesh placement is the
+        # resuming learner's business (place_on_mesh)
+        from smartcal_tpu.rl import replay_sharded as rps
+
+        return rps.place_on_mesh(
+            jax.tree_util.tree_map(jnp.asarray, obj["state"]))
     if kind == "native":
         from smartcal_tpu.rl.replay_native import NativePER
 

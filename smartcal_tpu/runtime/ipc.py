@@ -1,0 +1,205 @@
+"""Framed, integrity-checked IPC for the cross-process actor fleet.
+
+The process-backed fleet (:mod:`smartcal_tpu.runtime.supervisor`,
+``actor_mode="process"``) moves versioned transition batches, weight
+snapshots and heartbeats between the learner process and spawned actor
+worker processes over ``multiprocessing.Pipe`` connections.  A worker
+can die at ANY byte of a send (SIGKILL, OOM, preemption), so every
+payload travels as a self-validating frame::
+
+    MAGIC(4) | payload_len(4, BE) | crc32(4, BE) | pickle(payload)
+
+and the receiving side treats a bad magic, a length mismatch, a CRC
+mismatch or an unpicklable body as :class:`CorruptPayloadError` — a
+subclass of :class:`~smartcal_tpu.runtime.atomic.CorruptStateError`, so
+it rides the same drop-and-log discipline as a torn checkpoint file:
+the learner drops the one broken batch and keeps training, instead of
+letting a half-serialized pytree poison the ingest iteration.
+
+Message vocabulary (tuples, first element is the kind):
+
+* parent -> worker: ``("weights", version, host_pytree)``, ``("stop",)``
+* worker -> parent: ``("beat", iteration)``,
+  ``("result", iteration, weights_version, host_transitions)``,
+  ``("error", iteration, repr_str)``
+
+Stdlib only — workers exchange plain host pytrees; device placement is
+the learner's business.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import pickle
+import struct
+import zlib
+from typing import Any, Callable, Optional
+
+from .atomic import CorruptStateError
+
+MAGIC = b"SCF1"
+_HEADER = struct.Struct("!4sII")
+
+
+class CorruptPayloadError(CorruptStateError):
+    """An IPC frame failed validation (bad magic / length / CRC /
+    unpicklable body) — the mid-send-death signature of a worker
+    process, surfaced as droppable corruption instead of a crash."""
+
+
+def frame_payload(obj: Any) -> bytes:
+    """Serialize ``obj`` into one self-validating frame."""
+    body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    return _HEADER.pack(MAGIC, len(body), zlib.crc32(body)) + body
+
+
+def unframe_payload(data: bytes) -> Any:
+    """Validate + deserialize one frame; raises
+    :class:`CorruptPayloadError` on any integrity failure."""
+    if len(data) < _HEADER.size:
+        raise CorruptPayloadError(
+            f"IPC frame truncated: {len(data)} bytes < "
+            f"{_HEADER.size}-byte header")
+    magic, length, crc = _HEADER.unpack_from(data)
+    body = data[_HEADER.size:]
+    if magic != MAGIC:
+        raise CorruptPayloadError(f"IPC frame bad magic {magic!r}")
+    if len(body) != length:
+        raise CorruptPayloadError(
+            f"IPC frame length mismatch: header says {length}, "
+            f"got {len(body)} payload bytes (mid-send death?)")
+    if zlib.crc32(body) != crc:
+        raise CorruptPayloadError("IPC frame CRC mismatch")
+    try:
+        return pickle.loads(body)
+    except Exception as e:
+        raise CorruptPayloadError(
+            f"IPC frame body unpicklable ({e!r})") from e
+
+
+def send_msg(conn, obj: Any) -> None:
+    """Frame + send one message on a Connection."""
+    conn.send_bytes(frame_payload(obj))
+
+
+def send_blob(conn, blob: bytes) -> None:
+    """Send an already-framed payload (one serialization, N workers)."""
+    conn.send_bytes(blob)
+
+
+def recv_msg(conn) -> Any:
+    """Receive + validate one message.  Raises ``EOFError``/``OSError``
+    when the peer is gone, :class:`CorruptPayloadError` on a bad frame."""
+    return unframe_payload(conn.recv_bytes())
+
+
+def resolve_factory(spec: str) -> Callable:
+    """``"pkg.module:callable"`` -> the callable (the picklable form a
+    spawned worker uses to rebuild its work function)."""
+    mod_name, _, fn_name = spec.partition(":")
+    if not mod_name or not fn_name:
+        raise ValueError(
+            f"worker factory spec {spec!r} must be 'module:callable'")
+    mod = importlib.import_module(mod_name)
+    fn = getattr(mod, fn_name, None)
+    if fn is None:
+        raise ValueError(f"worker factory {fn_name!r} not found in "
+                         f"{mod_name!r}")
+    return fn
+
+
+def worker_main(conn, actor_id: int, start_iteration: int,
+                factory: str, factory_kwargs: dict,
+                host_id: int = 0, n_hosts: int = 1,
+                platform: Optional[str] = "cpu") -> None:
+    """Entry point of a spawned actor worker process.
+
+    Pins the worker's jax platform (default ``"cpu"``: workers are
+    host-side rollout engines feeding a device-resident learner, and an
+    accelerator like a TPU is a SINGLE-client device the learner
+    already holds — a worker initializing the same backend would crash
+    or wedge it; pass ``platform=None`` via ``worker_spec["platform"]``
+    to inherit the environment instead), attaches to the (simulated)
+    multi-host runtime, re-arms the deterministic fault plan from
+    ``SMARTCAL_FAULTS`` (inherited env), rebuilds the work function
+    from its picklable factory spec, then loops: drain control frames
+    (keep the NEWEST weights), beat, run one rollout iteration, ship
+    the versioned result.  Any work-fn exception is reported as an
+    ``error`` frame naming the killing iteration (the supervisor's
+    poison-pill skip currency) before the process exits.
+    """
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+
+    from smartcal_tpu.parallel import multihost
+    from smartcal_tpu.runtime import faults as rt_faults
+
+    if platform:
+        # a sitecustomize may pin jax_platforms at interpreter start,
+        # overriding the env var — repeat the pin on the config once
+        # the jax module is in (backends have not initialized yet:
+        # nothing above touches devices)
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", platform)
+        except Exception:
+            pass
+    multihost.attach_simulated(host_id, n_hosts)
+    rt_faults.install_from_env()
+    work_fn = resolve_factory(factory)(**(factory_kwargs or {}))
+
+    iteration = int(start_iteration)
+    weights: Any = None
+    version = 0
+    have_weights = False
+    test_corrupt = _test_corrupt_plan()
+    try:
+        while True:
+            # drain the control inbox; the newest weights frame wins.
+            # Block (short ticks) until the FIRST weights arrive so the
+            # initial rollout never runs against nothing.
+            while conn.poll(0 if have_weights else 0.2):
+                try:
+                    msg = recv_msg(conn)
+                except CorruptPayloadError:
+                    continue            # parent->worker corruption: skip
+                if msg[0] == "stop":
+                    return
+                if msg[0] == "weights":
+                    version, weights = int(msg[1]), msg[2]
+                    have_weights = True
+            if not have_weights:
+                send_msg(conn, ("beat", iteration))
+                continue
+            send_msg(conn, ("beat", iteration))
+            try:
+                out = work_fn(actor_id, iteration, weights)
+            except BaseException as e:  # noqa: BLE001 — death IS the signal
+                send_msg(conn, ("error", iteration, repr(e)))
+                return
+            if test_corrupt is not None and iteration == test_corrupt:
+                # test hook (SMARTCAL_IPC_TEST_CORRUPT=<iteration>):
+                # emulate a death mid-send — ship a deliberately
+                # corrupted frame instead of the result, then die, so
+                # the drop-and-log path is exercisable end to end
+                blob = bytearray(frame_payload(
+                    ("result", iteration, version, out)))
+                blob[-1] ^= 0xFF
+                send_blob(conn, bytes(blob))
+                return
+            send_msg(conn, ("result", iteration, version, out))
+            iteration += 1
+    except (EOFError, OSError, BrokenPipeError):
+        return                          # parent gone: nothing to report
+
+
+def _test_corrupt_plan() -> Optional[int]:
+    raw = os.environ.get("SMARTCAL_IPC_TEST_CORRUPT", "").strip()
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None

@@ -1,11 +1,13 @@
-"""Heartbeat-supervised actor-thread fleet for the parallel learners.
+"""Heartbeat-supervised actor fleet (threads OR processes) for the
+parallel learners.
 
 The SPMD learners (:mod:`smartcal_tpu.parallel.learner`,
 ``demix_learner``) fuse actors into one jitted program — nothing there
 can die independently.  The supervised mode instead runs each actor as
-a host thread (the IMPACT-shaped split: actors roll out against a
-possibly-stale weights snapshot, the learner consumes whatever arrives)
-and THIS module is the part that survives faults:
+an independent host execution unit (the IMPACT-shaped split: actors
+roll out against a possibly-stale weights snapshot, the learner
+consumes whatever arrives) and THIS module is the part that survives
+faults:
 
 * each actor thread beats a heartbeat before every rollout and pushes
   its result onto the shared queue;
@@ -23,18 +25,43 @@ and THIS module is the part that survives faults:
   alive; ``Fleet.stop(join=True)`` is the one call a tripping watchdog
   needs to leave no actor running against a dead learner.
 
-Telemetry: ``actor_down`` / ``actor_restart`` / ``actor_failed`` RunLog
-events, an ``actors_alive`` gauge and an ``actor_restarts`` counter via
-the existing obs registry.
+Two actor backends share the whole supervision contract
+(``actor_mode``):
+
+* ``"thread"`` (default, the PR 10 shape, bit-identical to it): each
+  slot is a :class:`_Actor` host thread calling ``work_fn`` in-process
+  and pushing onto ONE bounded global ingest queue;
+* ``"process"``: each slot is a :class:`_ProcessActor` — a spawned
+  worker process (``multiprocessing`` spawn context, so jax state is
+  never forked) running :func:`smartcal_tpu.runtime.ipc.worker_main`
+  with a picklable ``worker_spec`` factory, exchanging versioned
+  transition batches / weight snapshots / heartbeats over a framed,
+  CRC-checked duplex pipe, plus a parent-side pump thread that relays
+  worker frames into the slot's OWN bounded ingest shard (per-slot
+  queues instead of one global queue — ``collect`` drains them
+  round-robin so a single hot slot cannot starve the rest, and
+  ``queue_depths()`` exposes per-slot depth for the obs gauges).  A
+  frame that fails validation (a worker died mid-send) is DROPPED and
+  logged (``ipc_corrupt_payload``), never handed to the learner.  A
+  ``hosts > 1`` fleet tags contiguous slot blocks with simulated host
+  ids (``multihost.attach_simulated`` in each worker) — the
+  single-machine rehearsal of a real multi-host fleet.
+
+Telemetry: ``actor_down`` / ``actor_restart`` / ``actor_failed`` /
+``ipc_corrupt_payload`` RunLog events, ``actors_alive`` gauges and
+``actor_restarts`` / ``ipc_corrupt_payloads`` counters via the
+existing obs registry.
 """
 
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 import time
 from typing import Any, Callable, Optional
 
+from . import ipc
 from .backoff import BackoffPolicy
 from .faults import FaultInjected  # noqa: F401  (re-export for callers)
 
@@ -80,28 +107,262 @@ class _Actor(threading.Thread):
             self.iteration += 1
 
 
-class Fleet:
-    """A supervised set of ``n_actors`` worker threads (see module doc)."""
+def _to_host(weights: Any) -> Any:
+    """Pull device arrays to host before pickling for a worker process.
+    Identity when jax was never imported (stdlib-only callers)."""
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return weights
+    try:
+        return jax_mod.device_get(weights)
+    except Exception:
+        return weights
 
-    def __init__(self, n_actors: int, work_fn: WorkFn, *,
+
+class _ProcessActor(threading.Thread):
+    """A process-backed actor slot: a spawned worker process plus this
+    parent-side pump thread relaying the worker's framed messages into
+    the slot's ingest shard.  Duck-types :class:`_Actor`'s supervision
+    surface (``iteration`` / ``last_beat`` / ``stop_event`` / ``error``
+    / ``is_alive``) so :class:`Fleet` supervises both backends through
+    one contract."""
+
+    def __init__(self, fleet: "Fleet", actor_id: int, start_iteration: int):
+        super().__init__(name=f"{fleet.name}-{actor_id}-pump", daemon=True)
+        self.fleet = fleet
+        self.actor_id = actor_id
+        self.iteration = start_iteration
+        self.last_beat = time.monotonic()
+        self.stop_event = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.proc = None
+        self.conn = None
+        # latest-wins outbox: the learner's publish() NEVER blocks on
+        # the pipe (a full pipe toward a busy worker must not stall the
+        # learner — that closes a learner->worker->pump->learner
+        # deadlock cycle); a dedicated sender thread drains it
+        self._outbox: Optional[bytes] = None
+        self._outbox_lock = threading.Lock()
+        self._outbox_ev = threading.Event()
+        self._sender: Optional[threading.Thread] = None
+
+    def _launch(self) -> None:
+        """Spawn the worker process + duplex channel (spawn context:
+        never fork a process that may hold jax runtime threads)."""
+        import multiprocessing as mp
+
+        f = self.fleet
+        ctx = mp.get_context("spawn")
+        self.conn, child = ctx.Pipe(duplex=True)
+        self.proc = ctx.Process(
+            target=ipc.worker_main,
+            args=(child, self.actor_id, self.iteration,
+                  f.worker_spec["factory"],
+                  f.worker_spec.get("kwargs", {}),
+                  f.slot_host(self.actor_id), f.hosts,
+                  f.worker_spec.get("platform", "cpu")),
+            name=f"{f.name}-{self.actor_id}", daemon=True)
+        self.proc.start()
+        child.close()                    # parent keeps one end only
+        # stage the current snapshot for the fresh worker so a
+        # restarted slot never rolls out against nothing (the sender
+        # thread ships it once the worker starts draining)
+        weights, version = f.get_weights()
+        self.publish(ipc.frame_payload(("weights", version,
+                                        _to_host(weights))))
+
+    def start(self) -> None:
+        self._launch()
+        self._sender = threading.Thread(
+            target=self._send_loop,
+            name=f"{self.fleet.name}-{self.actor_id}-send", daemon=True)
+        self._sender.start()
+        super().start()
+
+    def publish(self, blob: bytes) -> None:
+        """Stage an already-framed message for the worker — latest
+        wins, never blocks (only the NEWEST weights snapshot matters)."""
+        with self._outbox_lock:
+            self._outbox = blob
+        self._outbox_ev.set()
+
+    def _take_outbox(self) -> Optional[bytes]:
+        with self._outbox_lock:
+            blob, self._outbox = self._outbox, None
+            self._outbox_ev.clear()
+        return blob
+
+    def _send_loop(self):
+        """Sole WRITER of the parent-side connection (the pump is the
+        sole reader, so the duplex pipe never sees two concurrent users
+        of one direction)."""
+        while not self.stop_event.is_set():
+            if not self._outbox_ev.wait(timeout=0.2):
+                continue
+            blob = self._take_outbox()
+            if blob is None:
+                continue
+            try:
+                ipc.send_blob(self.conn, blob)
+            except (OSError, BrokenPipeError, ValueError):
+                return
+        blob = self._take_outbox()       # final frame (the stop message)
+        if blob is not None:
+            try:
+                ipc.send_blob(self.conn, blob)
+            except (OSError, BrokenPipeError, ValueError):
+                pass
+
+    def request_stop(self) -> None:
+        self.publish(ipc.frame_payload(("stop",)))
+        self.stop_event.set()
+
+    def hard_kill(self) -> None:
+        """Unlike a hung thread, a hung PROCESS can be killed."""
+        try:
+            if self.proc is not None and self.proc.is_alive():
+                self.proc.terminate()
+        except Exception:
+            pass
+
+    def finalize(self, timeout: float = 2.0) -> None:
+        """Reap the worker process after the pump thread is done."""
+        if self.proc is None:
+            return
+        try:
+            self.proc.join(timeout=timeout)
+            if self.proc.is_alive():
+                self.proc.terminate()
+                self.proc.join(timeout=1.0)
+        except Exception:
+            pass
+
+    def run(self):
+        f = self.fleet
+        shard = f.shard_queue(self.actor_id)
+        while not self.stop_event.is_set():
+            try:
+                if not self.conn.poll(0.2):
+                    if self.proc is not None and not self.proc.is_alive() \
+                            and not self.conn.poll(0):
+                        # silently-dead worker (SIGKILL'd mid-rollout):
+                        # nothing buffered, channel will never speak —
+                        # the last beat frame named the killing iteration
+                        if self.error is None:
+                            self.error = RuntimeError(
+                                f"actor process exited (code "
+                                f"{self.proc.exitcode})")
+                        return
+                    continue
+                msg = ipc.recv_msg(self.conn)
+            except ipc.CorruptPayloadError as e:
+                # a worker died mid-send (or shipped garbage): drop the
+                # one broken frame, log it, keep pumping — the learner
+                # iteration is never poisoned by a truncated payload
+                f._log("ipc_corrupt_payload", actor=self.actor_id,
+                       error=repr(e))
+                f._counter("ipc_corrupt_payloads")
+                continue
+            except (EOFError, OSError):
+                if not self.stop_event.is_set() and self.error is None:
+                    code = (self.proc.exitcode if self.proc is not None
+                            else None)
+                    self.error = RuntimeError(
+                        f"actor process channel closed (exit code {code})")
+                return
+            kind = msg[0]
+            if kind == "beat":
+                self.iteration = int(msg[1])
+                self.last_beat = time.monotonic()
+            elif kind == "result":
+                it, version, out = int(msg[1]), int(msg[2]), msg[3]
+                self.last_beat = time.monotonic()
+                item = (self.actor_id, it, version, out)
+                while not self.stop_event.is_set():
+                    try:
+                        # bounded shard: back-pressure blocks HERE (and
+                        # transitively the worker, once the pipe buffer
+                        # fills); re-beat so back-pressure is never
+                        # mistaken for a hung worker
+                        shard.put(item, timeout=0.2)
+                        break
+                    except queue.Full:
+                        self.last_beat = time.monotonic()
+                self.iteration = it + 1
+            elif kind == "error":
+                self.iteration = int(msg[1])
+                self.error = RuntimeError(msg[2])
+                return
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self.ident is not None:       # pump thread actually started
+            super().join(timeout=timeout)
+        if not self.is_alive():
+            self.finalize()
+
+
+class Fleet:
+    """A supervised set of ``n_actors`` worker threads or processes
+    (see module doc).
+
+    ``actor_mode="process"`` requires ``worker_spec`` — a picklable
+    ``{"factory": "module:callable", "kwargs": {...}}`` description
+    that each spawned worker resolves into its work function (closures
+    cannot cross a process boundary); ``work_fn`` is then unused in the
+    workers and may be None.  An optional ``worker_spec["platform"]``
+    pins each worker's jax platform (default ``"cpu"`` — a worker must
+    never contend for the single-client accelerator the learner holds;
+    ``None`` inherits the environment).  ``hosts > 1`` splits the slots
+    into contiguous simulated-host blocks (``slot_host``)."""
+
+    def __init__(self, n_actors: int, work_fn: Optional[WorkFn], *,
                  name: str = "actor", heartbeat_timeout: float = 60.0,
                  max_restarts: int = 3,
                  backoff: Optional[BackoffPolicy] = None, seed: int = 0,
-                 queue_depth: int = 2):
+                 queue_depth: int = 2, actor_mode: str = "thread",
+                 worker_spec: Optional[dict] = None, hosts: int = 1):
+        if actor_mode not in ("thread", "process"):
+            raise ValueError(f"actor_mode must be 'thread' or 'process', "
+                             f"got {actor_mode!r}")
+        if actor_mode == "process" and not worker_spec:
+            raise ValueError("actor_mode='process' requires worker_spec "
+                             "({'factory': 'module:callable', 'kwargs': "
+                             "{...}}) — closures cannot cross a process "
+                             "boundary")
+        if actor_mode == "thread" and hosts != 1:
+            raise ValueError("multi-host (simulated) fleets require "
+                             "actor_mode='process'")
         self.n_actors = int(n_actors)
         self.work_fn = work_fn
         self.name = name
+        self.actor_mode = actor_mode
+        self.worker_spec = worker_spec
+        self.hosts = max(1, int(hosts))
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.max_restarts = int(max_restarts)
         self.backoff = backoff or BackoffPolicy(base_s=0.25, factor=2.0,
                                                 max_s=30.0, jitter=0.25)
         self._seed = seed
-        # bounded to queue_depth results per actor slot: actors block
-        # (with heartbeat) when the learner lags — staleness stays
-        # bounded by the queue depth plus the publication cadence
-        # instead of growing with every learner hiccup
-        self._q: "queue.Queue" = queue.Queue(
-            maxsize=max(1, int(queue_depth)) * self.n_actors)
+        if actor_mode == "process":
+            # per-slot ingest shards: each slot owns a bounded queue, so
+            # one hot producer cannot occupy the whole ingest budget and
+            # per-slot depth is observable (the obs gauges); the shard
+            # directory and slot->shard map are built once here and
+            # never rewritten (graftlint SHARED_FIELD_SPECS covers them)
+            self._q = None
+            self._shard_qs = [queue.Queue(maxsize=max(1, int(queue_depth)))
+                              for _ in range(self.n_actors)]
+            self._slot_shard = {i: i for i in range(self.n_actors)}
+        else:
+            # bounded to queue_depth results per actor slot: actors
+            # block (with heartbeat) when the learner lags — staleness
+            # stays bounded by the queue depth plus the publication
+            # cadence instead of growing with every learner hiccup
+            self._q = queue.Queue(
+                maxsize=max(1, int(queue_depth)) * self.n_actors)
+            self._shard_qs = None
+            self._slot_shard = None
+        self._rr = 0                         # collect()'s round-robin cursor
         self._weights: Any = None
         self._version = 0
         self._wlock = threading.Lock()
@@ -112,6 +373,28 @@ class Fleet:
         self._stopped = False
         import random
         self._rng = random.Random(seed)
+
+    # -- sharded ingest ----------------------------------------------------
+    def slot_host(self, slot: int) -> int:
+        """Simulated host id of ``slot`` — contiguous blocks, so a
+        2-host 8-actor fleet is slots 0-3 on host 0, 4-7 on host 1."""
+        return (slot * self.hosts) // self.n_actors
+
+    def shard_queue(self, slot: int) -> "queue.Queue":
+        """The bounded ingest queue slot ``slot`` produces into (the
+        global queue in thread mode)."""
+        if self._shard_qs is None:
+            return self._q
+        return self._shard_qs[self._slot_shard[slot]]
+
+    def queue_depths(self) -> dict:
+        """Current ingest depth per shard plus the aggregate — the
+        single-slow-shard visibility the global-queue gauge lacked.
+        Thread mode reports only the aggregate (one global queue)."""
+        if self._shard_qs is None:
+            return {"aggregate": self._q.qsize()}
+        depths = {i: q.qsize() for i, q in enumerate(self._shard_qs)}
+        return {"aggregate": sum(depths.values()), "per_slot": depths}
 
     # -- weights snapshot --------------------------------------------------
     def set_weights(self, weights: Any, version: Optional[int] = None
@@ -127,7 +410,16 @@ class Fleet:
                 self._version = int(version)
             else:
                 self._version += 1
-            return self._version
+            v = self._version
+        if self.actor_mode == "process":
+            # serialize ONCE, fan the framed snapshot out to every live
+            # worker (a dead worker's publish is a no-op; its
+            # replacement receives the current snapshot at spawn)
+            blob = ipc.frame_payload(("weights", v, _to_host(weights)))
+            for a in self._actors.values():
+                if isinstance(a, _ProcessActor) and a.is_alive():
+                    a.publish(blob)
+        return v
 
     def get_weights(self):
         with self._wlock:
@@ -175,7 +467,8 @@ class Fleet:
         return out
 
     def _spawn(self, slot: int, start_iteration: int) -> None:
-        a = _Actor(self, slot, start_iteration)
+        cls = _ProcessActor if self.actor_mode == "process" else _Actor
+        a = cls(self, slot, start_iteration)
         self._actors[slot] = a
         a.start()
 
@@ -188,7 +481,10 @@ class Fleet:
             return 0
         self._stopped = True
         for a in self._actors.values():
-            a.stop_event.set()
+            if isinstance(a, _ProcessActor):
+                a.request_stop()
+            else:
+                a.stop_event.set()
         joined = 0
         if join:
             deadline = time.monotonic() + timeout
@@ -205,18 +501,45 @@ class Fleet:
         """Up to ``max_items`` queued results, waiting at most ``timeout``
         seconds TOTAL for the first one (later ones are taken only if
         already queued).  Returns [(actor_id, iteration, weights_version,
-        result), ...] — possibly empty when the whole fleet is down."""
-        out = []
+        result), ...] — possibly empty when the whole fleet is down.
+
+        Process mode drains the per-slot ingest shards round-robin
+        (rotating the starting shard every call) so one hot slot can
+        never monopolize a collection round while another shard backs
+        up unseen."""
         deadline = time.monotonic() + timeout
+        if self._shard_qs is None:
+            out = []
+            while len(out) < max_items:
+                remaining = deadline - time.monotonic()
+                try:
+                    if not out and remaining > 0:
+                        out.append(self._q.get(timeout=remaining))
+                    else:
+                        out.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            return out
+        out: list = []
+        n = len(self._shard_qs)
+        start = self._rr
+        self._rr = (self._rr + 1) % n
         while len(out) < max_items:
-            remaining = deadline - time.monotonic()
-            try:
-                if not out and remaining > 0:
-                    out.append(self._q.get(timeout=remaining))
-                else:
-                    out.append(self._q.get_nowait())
-            except queue.Empty:
+            got = False
+            for k in range(n):
+                if len(out) >= max_items:
+                    break
+                try:
+                    out.append(
+                        self._shard_qs[(start + k) % n].get_nowait())
+                    got = True
+                except queue.Empty:
+                    continue
+            if got:
+                continue
+            if out or time.monotonic() >= deadline:
                 break
+            time.sleep(0.01)
         return out
 
     # -- supervision -------------------------------------------------------
@@ -252,8 +575,17 @@ class Fleet:
                 continue
             if hung:
                 # can't kill a python thread: abandon it (daemon) and
-                # make sure it exits if it ever wakes up
+                # make sure it exits if it ever wakes up.  A hung
+                # PROCESS, unlike a thread, can actually be killed.
                 a.stop_event.set()
+                if isinstance(a, _ProcessActor):
+                    a.hard_kill()
+            if isinstance(a, _ProcessActor):
+                # reap the dead/killed worker NOW — _spawn() replaces
+                # the slot entry, and a slot past max_restarts never
+                # respawns, so without this the zombie (and its pipe
+                # fds) would linger until interpreter exit
+                a.finalize(timeout=1.0)
             reason = (f"error:{a.error!r}" if dead and a.error is not None
                       else ("exited" if dead else "hung"))
             n = self._restarts[slot]

@@ -540,6 +540,28 @@ def add_fleet_args(p):
                    help="supervised fleet: publish learner weights every "
                         "N learner rounds (N > 1 forces actor staleness "
                         "— the IS-clip ablation knob)")
+    p.add_argument("--actor-mode", dest="actor_mode",
+                   choices=("thread", "process"), default="thread",
+                   help="supervised fleet backend: 'thread' (default; "
+                        "actors share this process and its GIL — the "
+                        "PR 10 shape, bit-identical to it) or 'process' "
+                        "(each actor is a spawned worker process "
+                        "shipping framed transition batches over IPC "
+                        "into per-slot ingest shards — scales past the "
+                        "GIL)")
+    p.add_argument("--replay-shards", dest="replay_shards", type=int,
+                   default=0,
+                   help="shard the learner's device-resident replay "
+                        "ring over N mesh shards (0 = the flat "
+                        "single-buffer layout): stores land "
+                        "shard-local, sampling merges per-shard draws "
+                        "via collectives, priority updates scatter "
+                        "shard-local")
+    p.add_argument("--sim-hosts", dest="sim_hosts", type=int, default=1,
+                   help="process fleet: rehearse a multi-host topology "
+                        "by tagging contiguous actor-slot blocks with N "
+                        "simulated host ids (single machine; real "
+                        "multi-host runs use --coordinator)")
     return p
 
 

@@ -1,0 +1,35 @@
+"""Positive fixture: unlocked writes to the CROSS-PROCESS fleet fields
+(the ISSUE 12 shard directory / slot->shard map / weights outbox).
+
+The test registers this file with two specs mirroring the shipped
+SHARED_FIELD_SPECS rows: class Fleet, fields {_shard_qs, _slot_shard},
+lock {_wlock}; class ProcessActor, fields {_outbox}, lock
+{_outbox_lock}.
+"""
+import threading
+
+
+class Fleet:
+    def __init__(self):
+        self._wlock = threading.Lock()
+        self._shard_qs = []            # ok: __init__ runs pre-sharing
+        self._slot_shard = {}
+
+    def grow(self, q):
+        self._shard_qs.append(q)       # BAD: mutator without the lock
+
+    def remap(self, slot, shard):
+        self._slot_shard[slot] = shard  # BAD: subscript store, no lock
+
+    def rebuild(self, n):
+        self._shard_qs = [None] * n    # BAD: rebind without the lock
+        self._slot_shard = {}          # BAD: rebind without the lock
+
+
+class ProcessActor:
+    def __init__(self):
+        self._outbox_lock = threading.Lock()
+        self._outbox = None
+
+    def publish(self, blob):
+        self._outbox = blob            # BAD: learner-side write, no lock

@@ -1,0 +1,41 @@
+"""Negative fixture: lock-disciplined cross-process fleet fields —
+zero findings.  Registered with the same specs as locks_shard_bad.py.
+"""
+import threading
+
+
+class Fleet:
+    def __init__(self):
+        self._wlock = threading.Lock()
+        self._shard_qs = []
+        self._slot_shard = {}
+
+    def grow(self, q):
+        with self._wlock:
+            self._shard_qs.append(q)   # ok: under the annotated lock
+
+    def remap(self, slot, shard):
+        with self._wlock:
+            self._slot_shard[slot] = shard
+
+    def shard_queue(self, slot):
+        return self._shard_qs[self._slot_shard[slot]]  # reads unchecked
+
+    def _rebuild_locked(self, n):
+        self._shard_qs = [None] * n    # ok: *_locked caller-holds-lock
+        self._slot_shard = {}
+
+
+class ProcessActor:
+    def __init__(self):
+        self._outbox_lock = threading.Lock()
+        self._outbox = None
+
+    def publish(self, blob):
+        with self._outbox_lock:
+            self._outbox = blob        # ok: under the annotated lock
+
+    def take(self):
+        with self._outbox_lock:
+            blob, self._outbox = self._outbox, None
+        return blob

@@ -1,0 +1,31 @@
+"""Picklable worker factories for tests/test_fleet_process.py.
+
+A spawned actor worker rebuilds its work function from a
+``"module:callable"`` spec (:func:`smartcal_tpu.runtime.ipc
+.resolve_factory`) because a closure defined inside a test function
+cannot cross the process boundary.  Kept stdlib-only so a worker spawn
+never pays a jax import for the factory itself.
+"""
+
+import os
+import time
+
+
+def make_echo(scale=1, fail_actor=None, fail_at=None, sleep_s=0.0):
+    """Echo work function: returns a dict naming the (actor, iteration,
+    weights) it saw plus the worker's simulated-host assignment;
+    optionally raises at one (actor, iteration) to exercise the
+    worker-death -> restart -> poison-skip path."""
+
+    def work_fn(actor_id, iteration, weights):
+        if sleep_s:
+            time.sleep(sleep_s)
+        if fail_at is not None and int(iteration) == int(fail_at) and (
+                fail_actor is None or int(actor_id) == int(fail_actor)):
+            raise RuntimeError(f"echo poison at iteration {iteration}")
+        w = weights.get("w") if isinstance(weights, dict) else weights
+        return {"actor": actor_id, "iteration": iteration, "w": w,
+                "scaled": None if w is None else w * scale,
+                "sim_host": os.environ.get("SMARTCAL_SIM_HOST", "")}
+
+    return work_fn

@@ -1,0 +1,308 @@
+"""Cross-process actor fleet (ISSUE 12): framed-IPC integrity units,
+process-mode Fleet config/topology contracts, the pump's corrupt-frame
+drop-and-log (a truncated mid-send payload never reaches the learner),
+and spawn e2e — echo collect/publish/stop, corrupt-mid-send recovery,
+simulated 2-host attach, plus (slow) worker-death restart with poison
+skip and the process-mode + sharded-replay training loop."""
+
+import multiprocessing as mp
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from smartcal_tpu.runtime import BackoffPolicy, Fleet, clear_faults
+from smartcal_tpu.runtime import ipc
+from smartcal_tpu.runtime import supervisor as sup
+from smartcal_tpu.runtime.atomic import CorruptStateError
+
+ECHO = {"factory": "fleet_proc_worker:make_echo", "kwargs": {"scale": 3}}
+ENV_KW = {"M": 5, "N": 5}
+AGENT_KW = {"batch_size": 8, "mem_size": 64}
+
+
+@pytest.fixture(autouse=True)
+def in_tmp(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    yield
+    clear_faults()
+
+
+def _fast_backoff():
+    return BackoffPolicy(base_s=0.01, factor=2.0, max_s=0.05, jitter=0.0)
+
+
+def _collect_until(fleet, want, deadline_s=45.0, max_items=8):
+    """Poll + collect until ``want`` items arrived (spawn e2e helper:
+    the first result waits out the worker's interpreter start)."""
+    out, deadline = [], time.monotonic() + deadline_s
+    while len(out) < want and time.monotonic() < deadline:
+        fleet.poll()
+        out.extend(fleet.collect(max_items, timeout=0.5))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# IPC frame integrity
+# ---------------------------------------------------------------------------
+
+def test_frame_roundtrip_and_corruption_detection():
+    """Every mid-send-death signature — truncated header, truncated
+    body, bad magic, flipped payload byte, CRC-valid non-pickle — is a
+    CorruptPayloadError (and a CorruptStateError, the drop-and-log
+    currency); an intact frame round-trips."""
+    msg = ("result", 3, 7, {"x": [1.0, 2.0], "y": "z"})
+    blob = ipc.frame_payload(msg)
+    assert ipc.unframe_payload(blob) == msg
+    assert issubclass(ipc.CorruptPayloadError, CorruptStateError)
+
+    with pytest.raises(ipc.CorruptPayloadError, match="truncated"):
+        ipc.unframe_payload(blob[:6])                 # inside the header
+    with pytest.raises(ipc.CorruptPayloadError, match="length mismatch"):
+        ipc.unframe_payload(blob[:-3])                # body cut mid-send
+    with pytest.raises(ipc.CorruptPayloadError, match="bad magic"):
+        ipc.unframe_payload(b"XXXX" + blob[4:])
+    flipped = bytearray(blob)
+    flipped[-1] ^= 0xFF
+    with pytest.raises(ipc.CorruptPayloadError, match="CRC"):
+        ipc.unframe_payload(bytes(flipped))
+    body = b"not a pickle at all"
+    bad = ipc._HEADER.pack(ipc.MAGIC, len(body),
+                           __import__("zlib").crc32(body)) + body
+    with pytest.raises(ipc.CorruptPayloadError, match="unpicklable"):
+        ipc.unframe_payload(bad)
+
+
+def test_resolve_factory_contract():
+    fn = ipc.resolve_factory("fleet_proc_worker:make_echo")
+    work = fn(scale=2)
+    assert work(0, 1, {"w": 4})["scaled"] == 8
+    with pytest.raises(ValueError, match="module:callable"):
+        ipc.resolve_factory("no_colon_here")
+    with pytest.raises(ValueError, match="not found"):
+        ipc.resolve_factory("fleet_proc_worker:nope")
+
+
+# ---------------------------------------------------------------------------
+# process-mode Fleet config / topology (no spawn)
+# ---------------------------------------------------------------------------
+
+def test_process_mode_config_contracts():
+    with pytest.raises(ValueError, match="worker_spec"):
+        Fleet(2, None, actor_mode="process")
+    with pytest.raises(ValueError, match="actor_mode"):
+        Fleet(2, None, actor_mode="banana")
+    with pytest.raises(ValueError, match="process"):
+        Fleet(2, lambda *a: None, actor_mode="thread", hosts=2)
+
+
+def test_slot_host_blocks_and_queue_depths():
+    """hosts=2 over 8 slots -> contiguous 4+4 simulated-host blocks;
+    process mode exposes per-slot ingest depth, thread mode only the
+    aggregate (one global queue)."""
+    f = Fleet(8, None, actor_mode="process", worker_spec=ECHO, hosts=2)
+    assert [f.slot_host(i) for i in range(8)] == [0] * 4 + [1] * 4
+    d = f.queue_depths()
+    assert d["aggregate"] == 0
+    assert sorted(d["per_slot"]) == list(range(8))
+    ft = Fleet(2, lambda *a: None)
+    assert ft.queue_depths() == {"aggregate": 0}
+
+
+def test_collect_round_robin_never_starves_a_shard():
+    """One hot slot cannot monopolize a collection round: the drain
+    rotates shards, so a backed-up shard 0 still yields shard 2's item
+    within the first pass."""
+    f = Fleet(3, None, actor_mode="process", worker_spec=ECHO,
+              queue_depth=4)
+    for i in range(3):
+        f._shard_qs[0].put((0, i, 0, f"hot{i}"))
+    f._shard_qs[2].put((2, 0, 0, "cold"))
+    out = f.collect(2, timeout=0.5)
+    assert len(out) == 2
+    assert {o[0] for o in out} == {0, 2}       # one from each, not 2x hot
+    rest = f.collect(8, timeout=0.5)
+    assert len(rest) == 2                       # nothing lost
+    assert f.queue_depths()["aggregate"] == 0
+
+
+def test_pump_drops_corrupt_frame_and_delivers_good():
+    """The parent-side pump: a corrupt frame (worker died mid-send) is
+    dropped and the NEXT good frame still lands in the slot's ingest
+    shard — the learner iteration is never poisoned; EOF afterwards
+    surfaces as the slot error for the supervisor."""
+    f = Fleet(1, None, actor_mode="process", worker_spec=ECHO,
+              queue_depth=4)
+    a = sup._ProcessActor(f, 0, 0)
+    parent, child = mp.Pipe(duplex=True)
+    a.conn = parent
+    threading.Thread.start(a)                  # pump only, no spawn
+    try:
+        bad = bytearray(ipc.frame_payload(("result", 0, 1, {"t": 1})))
+        bad[-1] ^= 0xFF
+        child.send_bytes(bytes(bad))           # dropped
+        child.send_bytes(ipc.frame_payload(("result", 0, 1, {"t": 2})))
+        child.send_bytes(ipc.frame_payload(("beat", 1)))
+        got = f.collect(2, timeout=10.0)
+        assert got == [(0, 0, 1, {"t": 2})]    # ONLY the intact frame
+        assert a.error is None                 # corruption != slot death
+        assert a.iteration == 1                # result advanced the slot
+        child.close()                          # peer gone -> slot error
+        a.join(timeout=10.0)
+        assert not a.is_alive()
+        assert isinstance(a.error, RuntimeError)
+    finally:
+        a.stop_event.set()
+        try:
+            child.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# spawn e2e
+# ---------------------------------------------------------------------------
+
+def test_process_fleet_echo_collect_publish_stop():
+    """Real spawned workers: results arrive version-stamped through the
+    per-slot shards, a set_weights publish reaches running workers, the
+    slot iterations advance, and stop(join=True) reaps every worker
+    process."""
+    f = Fleet(2, None, actor_mode="process", worker_spec=ECHO,
+              queue_depth=2, backoff=_fast_backoff())
+    try:
+        f.start({"w": 2.0})
+        v0 = f.version
+        out = _collect_until(f, 2)
+        assert len(out) >= 2
+        for aid, it, ver, res in out:
+            assert res["actor"] == aid and res["iteration"] == it
+            assert ver == v0 and res["scaled"] == 6.0
+        v1 = f.set_weights({"w": 5.0})
+        deadline = time.monotonic() + 45.0
+        seen_new = False
+        while not seen_new and time.monotonic() < deadline:
+            for _, _, ver, res in f.collect(8, timeout=0.5):
+                if ver == v1:
+                    assert res["w"] == 5.0 and res["scaled"] == 15.0
+                    seen_new = True
+        assert seen_new, "published weights never reached the workers"
+        iters = f.slot_iterations()
+        assert set(iters) == {0, 1} and all(v >= 1 for v in iters.values())
+    finally:
+        f.stop(join=True)
+    assert f.alive_count == 0
+    for a in f._actors.values():
+        assert a.proc is not None and not a.proc.is_alive()
+
+
+def test_corrupt_mid_send_dropped_then_slot_restarts(monkeypatch):
+    """The satellite fix end to end: a worker ships a deliberately
+    corrupted result frame at iteration 1 and dies (the mid-send death
+    rehearsal, SMARTCAL_IPC_TEST_CORRUPT) — the frame is dropped, the
+    supervisor restarts the slot, the replacement resumes PAST the
+    corrupted iteration, and no iteration-1 batch ever reaches
+    collect."""
+    monkeypatch.setenv("SMARTCAL_IPC_TEST_CORRUPT", "1")
+    f = Fleet(1, None, actor_mode="process", worker_spec=ECHO,
+              queue_depth=4, backoff=_fast_backoff(), max_restarts=3)
+    try:
+        f.start({"w": 1.0})
+        out = _collect_until(f, 1)
+        assert [o[1] for o in out] == [0]      # the intact iteration 0
+        # worker dies after the corrupt send; wait for restart + resume
+        deadline = time.monotonic() + 60.0
+        later = []
+        while not later and time.monotonic() < deadline:
+            f.poll()
+            later = f.collect(8, timeout=0.5)
+        assert later, "slot never recovered after the corrupt send"
+        assert f.restarts_total() >= 1
+        assert all(o[1] >= 2 for o in later), later   # 1 skipped, dropped
+        assert f.slot_iterations()[0] >= 2
+    finally:
+        f.stop(join=True)
+
+
+def test_simulated_two_host_attach():
+    """hosts=2: each worker process attaches to its simulated host
+    (multihost.attach_simulated) — both host ids are represented in the
+    results, per the contiguous slot->host blocks."""
+    f = Fleet(2, None, actor_mode="process", worker_spec=ECHO,
+              queue_depth=2, hosts=2, backoff=_fast_backoff())
+    try:
+        f.start({"w": 1.0})
+        out = _collect_until(f, 4)
+        hosts = {(aid, res["sim_host"]) for aid, _, _, res in out}
+        assert {a for a, _ in hosts} == {0, 1}
+        assert dict(hosts) == {0: "0/2", 1: "1/2"}
+    finally:
+        f.stop(join=True)
+
+
+@pytest.mark.slow
+def test_process_worker_death_restart_poison_skip():
+    """A worker that raises at iteration 1 dies with an error frame;
+    the supervisor restarts the slot after backoff and the replacement
+    resumes at iteration 2 — the poison-pill skip surviving a process
+    boundary."""
+    spec = {"factory": "fleet_proc_worker:make_echo",
+            "kwargs": {"scale": 1, "fail_actor": 0, "fail_at": 1}}
+    f = Fleet(1, None, actor_mode="process", worker_spec=spec,
+              queue_depth=4, backoff=_fast_backoff(), max_restarts=3)
+    try:
+        f.start({"w": 1.0})
+        out = _collect_until(f, 1)
+        assert [o[1] for o in out] == [0]
+        deadline = time.monotonic() + 60.0
+        later, events = [], []
+        while not later and time.monotonic() < deadline:
+            events.extend(f.poll())
+            later = f.collect(8, timeout=0.5)
+        kinds = [e["event"] for e in events]
+        assert "actor_down" in kinds and "actor_restart" in kinds
+        down = next(e for e in events if e["event"] == "actor_down")
+        assert "echo poison" in down["reason"]
+        restart = next(e for e in events if e["event"] == "actor_restart")
+        assert restart["iteration"] == 2       # the poison skip
+        assert later and all(o[1] >= 2 for o in later)
+    finally:
+        f.stop(join=True)
+
+
+@pytest.mark.slow
+def test_train_supervised_process_mode_sharded_replay(tmp_path):
+    """The whole ISSUE 12 chain in one driver call: --actor-mode
+    process + --replay-shards + --sim-hosts on the enet fleet — scores
+    stay finite, the learner's buffer is the mesh-sharded one and
+    filled, the summary carries the staleness/saturation means, and the
+    per-slot depth + shard-occupancy gauges hit the RunLog."""
+    import json
+
+    from smartcal_tpu.parallel import learner
+    from smartcal_tpu.rl import replay_sharded as rps
+
+    run = str(tmp_path / "proc_fleet.jsonl")
+    (st, buf), scores, summary = learner.train_supervised(
+        seed=0, episodes=6, n_actors=2, env_kwargs=ENV_KW,
+        agent_kwargs=AGENT_KW, rollout_epochs=1, rollout_steps=4,
+        batch_envs=2, is_clip=2.0, ere_eta=0.98, quiet=True,
+        metrics=run, restart_backoff=_fast_backoff(),
+        actor_mode="process", replay_shards=4, sim_hosts=2)
+    assert len(scores) == 6 and np.all(np.isfinite(scores))
+    assert isinstance(buf, rps.ShardedReplayState)
+    assert buf.n_shards == 4 and int(buf.cntr) > 0
+    assert int(st.learn_counter) > 0
+    assert summary["transition_staleness_mean"] >= 0.0
+    assert 0.0 <= summary["is_clip_saturation"] <= 1.0
+    events = [json.loads(ln) for ln in open(run) if ln.strip()]
+    gauges = {e["name"] for e in events if e.get("event") == "gauge"}
+    assert {"ingest_queue_depth", "replay_shard_occupancy",
+            "weight_staleness_versions"} <= gauges
+    slots = {e.get("slot") for e in events if e.get("event") == "gauge"
+             and e["name"] == "ingest_queue_depth" and "slot" in e}
+    assert {0, 1} <= slots
+    shards = {e.get("shard") for e in events if e.get("event") == "gauge"
+              and e["name"] == "replay_shard_occupancy"}
+    assert shards == {0, 1, 2, 3}

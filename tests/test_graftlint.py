@@ -100,6 +100,45 @@ def test_locks_rule_negative():
                             opts) == []
 
 
+# the ISSUE 12 cross-process fields: shard directory + slot->shard map
+# (Fleet) and the latest-wins weights outbox (ProcessActor) — mirrors
+# the shipped SHARED_FIELD_SPECS rows
+def _shard_specs(path):
+    return [
+        {"path": path, "class": "Fleet",
+         "fields": ["_shard_qs", "_slot_shard"], "locks": ["_wlock"],
+         "why": "fixture"},
+        {"path": path, "class": "ProcessActor",
+         "fields": ["_outbox"], "locks": ["_outbox_lock"],
+         "why": "fixture"},
+    ]
+
+
+def test_locks_shard_rule_positive():
+    opts = {"shared_specs": _shard_specs("locks_shard_bad.py")}
+    fs = fixture_findings("locks_shard_bad.py", "unlocked-shared-write",
+                          opts)
+    assert lines_of(fs) == [19, 22, 25, 26, 35], fs
+
+
+def test_locks_shard_rule_negative():
+    opts = {"shared_specs": _shard_specs("locks_shard_good.py")}
+    assert fixture_findings("locks_shard_good.py",
+                            "unlocked-shared-write", opts) == []
+
+
+def test_shipped_shared_specs_cover_cross_process_fields():
+    """The SHIPPED spec table must keep the ISSUE 12 rows: the shard
+    directory / slot->shard map and the process-actor outbox — dropping
+    a row silently un-guards the concurrency surface."""
+    from smartcal_tpu.analysis.rules.locks import SHARED_FIELD_SPECS
+
+    fields = {f for s in SHARED_FIELD_SPECS
+              if s["path"].endswith("supervisor.py")
+              for f in s["fields"]}
+    assert {"_shard_qs", "_slot_shard", "_outbox"} <= fields
+
+
 def _lint_as_package(tmp_path, *names):
     """Copy fixtures under a fake smartcal_tpu/ so path-scoped rules
     (pickle outside tests/, bare-print) see them as package code."""

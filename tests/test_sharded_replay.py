@@ -1,0 +1,346 @@
+"""Mesh-sharded device-resident replay (ISSUE 12): ring parity of the
+store path vs the flat buffer, sampling DISTRIBUTION parity vs both
+single-buffer oracles (HBM stratified + NativePER sum tree), ERE/PER
+composition at eta != 1, shard-local priority updates, the
+transfer-guard proof of the fused sharded
+store->sample->learn->priority-update step on the virtual mesh, and
+checkpoint round-trips."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from smartcal_tpu.rl import replay as rp
+from smartcal_tpu.rl import replay_sharded as rps
+from smartcal_tpu.rl import sac
+
+S, SIZE = 4, 32
+SPEC = {"x": ((), jnp.float32)}
+AGENT_KW = {"batch_size": 8, "mem_size": 64}
+
+
+def _paired_buffers(n=40, block=5):
+    """The SAME store sequence (blocks of ``block``, wrapping the ring,
+    block size NOT divisible by the shard count) into a flat and a
+    sharded buffer."""
+    flat = rp.replay_init(SIZE, SPEC)
+    sh = rps.replay_init(SIZE, SPEC, S)
+    for blk in range(n // block):
+        vals = jnp.arange(block, dtype=jnp.float32) + block * blk
+        pri = 1.0 + 0.1 * vals
+        flat = rp.replay_add_batch(flat, {"x": vals}, priority=pri)
+        sh = rps.replay_add_batch(sh, {"x": vals}, priority=pri)
+    return flat, sh
+
+
+def _interleave(arr2d):
+    """(S, L) -> the flat ring order g = j*S + s."""
+    return np.asarray(arr2d).T.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# store / layout parity
+# ---------------------------------------------------------------------------
+
+def test_store_ring_parity_vs_flat():
+    """Slot (s, j) of the sharded ring holds EXACTLY what ring slot
+    j*S+s of the flat buffer holds — data, priority and counter — even
+    with wrap-around and block sizes not divisible by S."""
+    flat, sh = _paired_buffers()
+    assert int(sh.cntr) == int(flat.cntr) == 40
+    np.testing.assert_array_equal(_interleave(sh.data["x"]),
+                                  np.asarray(flat.data["x"]))
+    np.testing.assert_array_equal(_interleave(sh.priority),
+                                  np.asarray(flat.priority))
+
+
+def test_store_default_priorities_match_flat():
+    """pmax-fallback and error-based store priorities follow the flat
+    rules (global max, not per-shard max)."""
+    flat = rp.replay_init(SIZE, SPEC)
+    sh = rps.replay_init(SIZE, SPEC, S)
+    trs = {"x": jnp.arange(6, dtype=jnp.float32)}
+    # untouched buffer -> clip everywhere
+    flat = rp.replay_add_batch(flat, trs)
+    sh = rps.replay_add_batch(sh, trs)
+    np.testing.assert_array_equal(_interleave(sh.priority),
+                                  np.asarray(flat.priority))
+    # error-based store
+    errs = jnp.linspace(0.0, 3.0, 6)
+    flat = rp.replay_add_batch(flat, trs, errors=errs)
+    sh = rps.replay_add_batch(sh, trs, errors=errs)
+    np.testing.assert_array_equal(_interleave(sh.priority),
+                                  np.asarray(flat.priority))
+
+
+def test_init_validation():
+    with pytest.raises(ValueError, match="divisible"):
+        rps.replay_init(30, SPEC, 4)
+    with pytest.raises(ValueError, match="n_shards"):
+        rps.replay_init(32, SPEC, 0)
+
+
+# ---------------------------------------------------------------------------
+# ages / ERE parity
+# ---------------------------------------------------------------------------
+
+def test_ere_weights_exact_parity_vs_flat():
+    flat, sh = _paired_buffers()
+    wf = np.asarray(rp.ere_weights(flat, 0.9))
+    ws = _interleave(rps.ere_weights(sh, 0.9))
+    np.testing.assert_allclose(ws, wf, rtol=1e-6)
+
+
+def test_ere_per_composition_at_eta_below_one():
+    """PER x ERE on the sharded buffer: a high-priority OLD slot is
+    sampled less under recency_eta < 1 than under plain PER (the flat
+    buffer's composition contract)."""
+    _, sh = _paired_buffers(n=32, block=4)   # exactly full, no wrap
+    # oldest ring slot (g=0 -> shard 0, local 0) gets a huge priority
+    sh = sh._replace(priority=sh.priority.at[0, 0].set(50.0))
+    plain = jax.jit(lambda b, k: rps.replay_sample_per(b, k, 16))
+    ere = jax.jit(
+        lambda b, k: rps.replay_sample_per(b, k, 16, recency_eta=0.9))
+    hits_plain = hits_ere = 0
+    for i in range(100):
+        _, gidx, _, _ = plain(sh, jax.random.PRNGKey(i))
+        hits_plain += int(np.sum(np.asarray(gidx) == 0))
+        _, gidx2, _, _ = ere(sh, jax.random.PRNGKey(i))
+        hits_ere += int(np.sum(np.asarray(gidx2) == 0))
+    assert hits_ere < hits_plain, (hits_ere, hits_plain)
+
+
+# ---------------------------------------------------------------------------
+# sampling distribution parity vs both oracles
+# ---------------------------------------------------------------------------
+
+def _empirical_freq(sample_fn, buf, draws=400, batch=16):
+    counts = np.zeros(SIZE)
+    for i in range(draws):
+        gidx = sample_fn(buf, jax.random.PRNGKey(i))
+        np.add.at(counts, np.asarray(gidx), 1)
+    return counts / counts.sum()
+
+
+def test_sample_per_distribution_parity_vs_flat_and_theory():
+    """Per-transition sampled frequency matches p_i/total (the shared
+    theoretical marginal) AND the flat HBM oracle's empirical
+    distribution; the returned batch rows are the rows the indices
+    name; IS weights agree with the flat formula at equal priorities."""
+    flat, sh = _paired_buffers()
+    theo = np.asarray(flat.priority) / float(np.sum(flat.priority))
+
+    samp_sh = jax.jit(lambda b, k: rps.replay_sample_per(b, k, 16))
+    samp_fl = jax.jit(lambda b, k: rp.replay_sample_per(b, k, 16))
+    emp_sh = _empirical_freq(lambda b, k: samp_sh(b, k)[1], sh)
+    emp_fl = _empirical_freq(lambda b, k: samp_fl(b, k)[1], flat)
+    assert np.abs(emp_sh - theo).max() < 0.012, \
+        np.abs(emp_sh - theo).max()
+    assert np.abs(emp_sh - emp_fl).max() < 0.012, \
+        np.abs(emp_sh - emp_fl).max()
+
+    batch, gidx, is_w, _ = samp_sh(sh, jax.random.PRNGKey(123))
+    fx = np.asarray(flat.data["x"])
+    np.testing.assert_allclose(np.asarray(batch["x"]),
+                               fx[np.asarray(gidx)])
+    assert np.asarray(is_w).max() == pytest.approx(1.0)
+    assert np.all(np.asarray(is_w) > 0)
+
+
+def test_sample_per_distribution_parity_vs_native_sum_tree():
+    """The sharded draw and the reference-shaped NativePER sum tree
+    sample from the same distribution (both stratified over the same
+    priorities)."""
+    from smartcal_tpu.rl.replay_native import NativePER
+
+    flat, sh = _paired_buffers()
+    native = NativePER(SIZE, {"x": ((), np.float32)})
+    # replay the same store order with the same explicit priorities
+    fx = np.asarray(flat.data["x"])
+    fp = np.asarray(flat.priority)
+    for g in range(SIZE):
+        native.store({"x": fx[g]})
+    native.tree.update_batch(np.arange(SIZE), fp)
+
+    rng = np.random.default_rng(0)
+    counts_nat = np.zeros(SIZE)
+    for _ in range(400):
+        _, idx, _ = native.sample(16, rng)
+        np.add.at(counts_nat, np.asarray(idx), 1)
+    emp_nat = counts_nat / counts_nat.sum()
+
+    samp_sh = jax.jit(lambda b, k: rps.replay_sample_per(b, k, 16))
+    emp_sh = _empirical_freq(lambda b, k: samp_sh(b, k)[1], sh)
+    assert np.abs(emp_sh - emp_nat).max() < 0.015, \
+        np.abs(emp_sh - emp_nat).max()
+
+
+def test_uniform_sample_no_replacement_and_values():
+    _, sh = _paired_buffers()
+    flat, _ = _paired_buffers()
+    samp = jax.jit(lambda b, k: rps.replay_sample_uniform(b, k, 8))
+    batch, gidx = samp(sh, jax.random.PRNGKey(0))
+    gi = np.asarray(gidx)
+    assert len(set(gi.tolist())) == 8        # without replacement
+    np.testing.assert_allclose(np.asarray(batch["x"]),
+                               np.asarray(flat.data["x"])[gi])
+
+
+def test_uniform_sample_respects_fill_boundary():
+    sh = rps.replay_init(SIZE, SPEC, S)
+    sh = rps.replay_add_batch(
+        sh, {"x": jnp.arange(10, dtype=jnp.float32)}, priority=1.0)
+    _, gidx = jax.jit(
+        lambda b, k: rps.replay_sample_uniform(b, k, 8))(
+        sh, jax.random.PRNGKey(1))
+    assert np.all(np.asarray(gidx) < 10)
+
+
+# ---------------------------------------------------------------------------
+# priority update
+# ---------------------------------------------------------------------------
+
+def test_priority_update_shard_local_parity():
+    flat, sh = _paired_buffers()
+    gidx = jnp.asarray([0, 5, 13, 31, 2, 2, 17, 8])
+    errs = jnp.linspace(0.0, 5.0, 8)
+    flat2 = rp.replay_update_priorities(flat, gidx, errs)
+    sh2 = rps.replay_update_priorities(sh, gidx, errs)
+    np.testing.assert_allclose(_interleave(sh2.priority),
+                               np.asarray(flat2.priority), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fused step on the virtual mesh: transfer guard + placement
+# ---------------------------------------------------------------------------
+
+def _versioned_sharded(cfg, key, n, version, mesh):
+    spec = rp.versioned_spec(rp.transition_spec(cfg.obs_dim,
+                                                cfg.n_actions))
+    buf = rps.place_on_mesh(rps.replay_init(cfg.mem_size, spec, S), mesh)
+    st = sac.sac_init(jax.random.PRNGKey(7), cfg)
+    k_obs, k_act = jax.random.split(key)
+    obs = jax.random.normal(k_obs, (n, cfg.obs_dim))
+    a, lp = sac.choose_action_logp(cfg, st, obs, k_act)
+    flat = {"state": obs, "new_state": obs + 0.1, "action": a,
+            "reward": (jnp.arange(n) % 3).astype(jnp.float32) - 1.0,
+            "done": jnp.zeros((n,), jnp.bool_),
+            "hint": jnp.zeros((n, cfg.n_actions)),
+            "version": jnp.full((n,), version, jnp.int32),
+            "behavior_logp": lp}
+    return buf, st, flat
+
+
+def test_fused_sharded_store_sample_learn_update_zero_host_transfers():
+    """The WHOLE sharded chain — store -> PER/ERE sample -> IS-clipped
+    learn -> shard-local priority update — runs as one jitted step on a
+    4-shard mesh with transfers DISALLOWED: no transition and no
+    sampled batch touches the host, and the buffer stays
+    shard-distributed."""
+    cfg = sac.SACConfig(obs_dim=6, n_actions=2, prioritized=True,
+                        is_clip=2.0, ere_eta=0.99, **AGENT_KW)
+    mesh = Mesh(np.asarray(jax.devices()[:S]), ("rp",))
+    repl = NamedSharding(mesh, P())
+    buf, st, flat = _versioned_sharded(cfg, jax.random.PRNGKey(0), 32,
+                                       1, mesh)
+
+    def fused(st, buf, flat, key, ver):
+        buf = rps.replay_add_batch(buf, flat)
+        return sac.learn(cfg, st, buf, key, learner_version=ver)
+
+    fused = jax.jit(fused)
+    st, flat, k0, ver = jax.device_put(
+        (st, flat, jax.random.PRNGKey(3), jnp.asarray(2, jnp.int32)),
+        repl)
+    out = fused(st, buf, flat, k0, ver)      # warm the compile
+    jax.block_until_ready(out)
+    k2 = jax.device_put(jax.random.PRNGKey(4), repl)
+    with jax.transfer_guard("disallow"):
+        st2, buf2, metrics = fused(st, buf, flat, k2, ver)
+        jax.block_until_ready((st2, buf2))
+    assert int(st2.learn_counter) == 1
+    assert not np.array_equal(np.asarray(buf2.priority),
+                              np.asarray(buf.priority))
+    # staleness telemetry flowed out of the fused step
+    assert float(metrics["staleness_mean"]) == 1.0
+    # the buffer never collapsed to one device
+    assert buf2.priority.sharding.spec == P("rp")
+
+
+def test_place_on_mesh_shards_leading_axis():
+    buf = rps.place_on_mesh(rps.replay_init(SIZE, SPEC, S))
+    assert buf.priority.sharding.spec == P("rp")
+    assert buf.data["x"].sharding.spec == P("rp")
+    # replicated scalars
+    assert buf.cntr.sharding.spec == P()
+    assert len(buf.priority.sharding.mesh.devices.ravel()) == S
+
+
+def test_dsac_learn_accepts_sharded_buffer():
+    """The discrete-SAC fused step dispatches on buffer type too (the
+    demix fleet's path)."""
+    from smartcal_tpu.rl import sac_discrete as dsac
+
+    npix, K = 2, 3
+    cfg = dsac.DSACConfig(obs_dim=npix * npix + 3 * K + 2,
+                          n_actions=2 ** (K - 1), img_shape=(npix, npix),
+                          use_image=True, prioritized=True,
+                          batch_size=8, mem_size=64)
+    spec = dsac.transition_spec(cfg.obs_dim)
+    buf = rps.replay_init(cfg.mem_size, spec, S)
+    st = dsac.dsac_init(jax.random.PRNGKey(0), cfg)
+    n = 16
+    trs = {"state": jax.random.normal(jax.random.PRNGKey(1),
+                                      (n, cfg.obs_dim)),
+           "new_state": jax.random.normal(jax.random.PRNGKey(2),
+                                          (n, cfg.obs_dim)),
+           "action": jnp.zeros((n,), jnp.int32),
+           "reward": jnp.ones((n,)),
+           "done": jnp.zeros((n,), jnp.bool_)}
+    trs = {k: jnp.asarray(v, buf.data[k].dtype) if k in buf.data else v
+           for k, v in trs.items()}
+    buf = rps.replay_add_batch(buf, trs)
+    st2, buf2, m = jax.jit(
+        lambda s, b, k: dsac.learn(cfg, s, b, k))(
+        st, buf, jax.random.PRNGKey(3))
+    assert int(st2.learn_counter) == 1
+    assert np.isfinite(float(m["critic_loss"]))
+
+
+# ---------------------------------------------------------------------------
+# health / occupancy / checkpoint
+# ---------------------------------------------------------------------------
+
+def test_health_matches_flat_and_reports_occupancy():
+    flat, sh = _paired_buffers()
+    hf = rp.replay_health(flat)
+    hs = sh.health()
+    for k in ("filled", "cntr", "size", "priority_total",
+              "priority_entropy", "max_mean_priority_ratio"):
+        assert hs[k] == pytest.approx(hf[k], rel=1e-6), k
+    assert hs["n_shards"] == S
+    assert hs["shard_occupancy"] == [SIZE // S] * S
+    # partially filled: round-robin keeps shards within one transition
+    sh2 = rps.replay_init(SIZE, SPEC, S)
+    sh2 = rps.replay_add_batch(
+        sh2, {"x": jnp.arange(6, dtype=jnp.float32)}, priority=1.0)
+    occ = rps.shard_occupancy(int(sh2.cntr), S, SIZE // S)
+    assert occ == [2, 2, 1, 1]
+    assert max(occ) - min(occ) <= 1
+
+
+def test_checkpoint_roundtrip_sharded(tmp_path):
+    from smartcal_tpu.runtime import pack_replay, unpack_replay
+
+    _, sh = _paired_buffers()
+    packed = pack_replay(sh)
+    assert packed["kind"] == "hbm_sharded"
+    back = unpack_replay(packed)
+    assert isinstance(back, rps.ShardedReplayState)
+    np.testing.assert_array_equal(np.asarray(back.priority),
+                                  np.asarray(sh.priority))
+    np.testing.assert_array_equal(np.asarray(back.data["x"]),
+                                  np.asarray(sh.data["x"]))
+    assert int(back.cntr) == int(sh.cntr)

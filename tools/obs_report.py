@@ -20,6 +20,11 @@ given.  Output sections:
   (CI < 0), or NO TREND.  This is the "the sweep cannot detect learning"
   gap: a flat curve and an improving one get different verdicts with
   quantified confidence.
+* **Fleet** (supervised actor fleets) — actors alive / restarts /
+  dropped-corrupt-IPC counts, aggregate AND per-actor transitions/s,
+  per-slot ingest-queue depth (the aggregate hides a single slow
+  shard), per-shard replay occupancy, and the staleness / IS-clip
+  gauge trajectories.
 * **Training health** (``--diag`` runs) — grad-norm trajectory over the
   learning updates (quarter means, so a ramp or a blowup is visible at a
   glance), non-finite counts, watchdog trips with their reasons, and the
@@ -236,6 +241,126 @@ def solver_summary(events):
         if fr:
             d["final_consensus_resid_mean"] = round(float(np.mean(fr)), 6)
     return by_route
+
+
+# ---------------------------------------------------------------------------
+# Fleet telemetry (supervised actor fleets: gauges + supervision events)
+# ---------------------------------------------------------------------------
+
+def _gauge_series(events, name):
+    """[(tags, value)] for every gauge event called ``name``."""
+    out = []
+    for e in events:
+        if e.get("event") == "gauge" and e.get("name") == name:
+            tags = {k: v for k, v in e.items()
+                    if k not in ("event", "name", "value", "t")}
+            out.append((tags, e.get("value")))
+    return out
+
+
+def _series_stats(vals):
+    v = np.asarray([x for x in vals if x is not None], np.float64)
+    if not v.size:
+        return None
+    return {"last": round(float(v[-1]), 4), "mean": round(float(v.mean()), 4),
+            "max": round(float(v.max()), 4)}
+
+
+def fleet_summary(events):
+    """Aggregate the supervised-fleet gauge/event streams, or None for
+    a run with no fleet signals.
+
+    The per-slot ``ingest_queue_depth`` and per-shard
+    ``replay_shard_occupancy`` gauges are reported INDIVIDUALLY — the
+    aggregate alone hides a single slow shard (one backed-up slot looks
+    like mild global pressure), which is exactly the failure mode the
+    per-slot gauges exist to expose."""
+    alive = _series_stats([v for _, v in
+                           _gauge_series(events, "actors_alive")])
+    if alive is None:
+        return None
+    out = {"actors_alive": alive}
+    out["restarts"] = sum(1 for e in events
+                          if e.get("event") == "actor_restart")
+    out["downs"] = sum(1 for e in events
+                       if e.get("event") == "actor_down")
+    out["failed_slots"] = sorted({e.get("actor") for e in events
+                                  if e.get("event") == "actor_failed"})
+    out["ipc_corrupt_payloads"] = sum(
+        1 for e in events if e.get("event") == "ipc_corrupt_payload")
+    # throughput
+    agg = _series_stats([v for _, v in
+                         _gauge_series(events, "actor_transitions_per_s")])
+    if agg:
+        out["transitions_per_s"] = agg
+    per_actor = {}
+    for tags, v in _gauge_series(events, "per_actor_transitions_per_s"):
+        per_actor.setdefault(tags.get("actor"), []).append(v)
+    if per_actor:
+        out["per_actor_transitions_per_s"] = {
+            a: _series_stats(vs) for a, vs in sorted(per_actor.items())}
+    # ingest queue depth: aggregate (untagged) vs per-slot
+    depth_all, depth_slot = [], {}
+    for tags, v in _gauge_series(events, "ingest_queue_depth"):
+        if "slot" in tags:
+            depth_slot.setdefault(tags["slot"], []).append(v)
+        else:
+            depth_all.append(v)
+    if depth_all:
+        out["ingest_queue_depth"] = _series_stats(depth_all)
+    if depth_slot:
+        out["ingest_queue_depth_per_slot"] = {
+            s: _series_stats(vs) for s, vs in sorted(depth_slot.items())}
+    # replay shard occupancy (sharded buffers)
+    occ = {}
+    for tags, v in _gauge_series(events, "replay_shard_occupancy"):
+        occ.setdefault(tags.get("shard"), []).append(v)
+    if occ:
+        out["replay_shard_occupancy"] = {
+            s: (vs[-1] if vs else None) for s, vs in sorted(occ.items())}
+    # staleness / IS-clip trajectory
+    for g in ("weight_staleness_versions", "transition_staleness_mean",
+              "is_clip_saturation", "is_clip_mean"):
+        vals = [v for _, v in _gauge_series(events, g)]
+        if vals:
+            st = _series_stats(vals)
+            st["quarters"] = _quarter_means(vals)
+            out[g] = st
+    return out
+
+
+def render_fleet(fs, out):
+    out.append("  " + "  ".join(
+        f"{k}={v}" for k, v in (("alive_last", fs["actors_alive"]["last"]),
+                                ("restarts", fs["restarts"]),
+                                ("downs", fs["downs"]),
+                                ("corrupt_ipc",
+                                 fs["ipc_corrupt_payloads"]))))
+    if fs.get("failed_slots"):
+        out.append(f"  failed slots: {fs['failed_slots']}")
+    if "transitions_per_s" in fs:
+        t = fs["transitions_per_s"]
+        out.append(f"  aggregate transitions/s: mean={t['mean']} "
+                   f"max={t['max']}")
+    for a, st in (fs.get("per_actor_transitions_per_s") or {}).items():
+        out.append(f"    actor {a}: mean={st['mean']} max={st['max']}")
+    if "ingest_queue_depth" in fs:
+        d = fs["ingest_queue_depth"]
+        out.append(f"  ingest queue depth (aggregate): mean={d['mean']} "
+                   f"max={d['max']}")
+    for s, st in (fs.get("ingest_queue_depth_per_slot") or {}).items():
+        out.append(f"    slot {s}: mean={st['mean']} max={st['max']} "
+                   f"last={st['last']}")
+    if "replay_shard_occupancy" in fs:
+        occ = fs["replay_shard_occupancy"]
+        out.append("  replay shard occupancy (last): " + "  ".join(
+            f"shard{s}={v}" for s, v in occ.items()))
+    for g in ("weight_staleness_versions", "transition_staleness_mean",
+              "is_clip_saturation", "is_clip_mean"):
+        if g in fs:
+            st = fs[g]
+            out.append(f"  {g}: mean={st['mean']} max={st['max']} "
+                       f"quarters={st['quarters']}")
 
 
 # ---------------------------------------------------------------------------
@@ -469,6 +594,7 @@ def build_report(runs, n_boot=1000, seed=0):
              "learning": learning_verdict(eps, scores, n_boot, seed),
              "probes": probe_summary(ev),
              "solver": solver_summary(ev),
+             "fleet": fleet_summary(ev),
              "training_health": training_health(ev),
              "roofline": roofline(ev, spans),
              "compile_events": len(compiles),
@@ -513,6 +639,9 @@ def render(report):
             for route, d in sorted(r["solver"].items()):
                 out.append(f"  route={route}  " + "  ".join(
                     f"{k}={v}" for k, v in d.items()))
+        if r.get("fleet"):
+            out.append("-- fleet")
+            render_fleet(r["fleet"], out)
         if r["compile_events"]:
             out.append(f"-- jax compile: {r['compile_events']} events, "
                        f"{r['compile_secs']} s")

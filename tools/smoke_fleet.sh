@@ -1,7 +1,10 @@
 #!/bin/bash
-# Async actor-learner fleet smoke: record a short supervised fleet run
-# with the IS-clip armed, kill actor 1 mid-run through the deterministic
-# fault plan (SMARTCAL_FAULTS), and assert from the RunLog that
+# Async actor-learner fleet smoke, two phases:
+#
+# Phase 1 (threads, the PR 10 chain): record a short supervised fleet
+# run with the IS-clip armed, kill actor 1 mid-run through the
+# deterministic fault plan (SMARTCAL_FAULTS), and assert from the
+# RunLog that
 #
 #   * the fault fired and the supervisor restarted the slot
 #     (fault_injected -> actor_down -> actor_restart),
@@ -9,7 +12,16 @@
 #   * the learner kept making progress (non-empty episode stream with
 #     finite scores after the kill).
 #
-# The CI companion of smoke_obs.sh / smoke_ckpt.sh; ~1 min on CPU.
+# Phase 2 (PROCESSES, the ISSUE 12 chain): the same kill against
+# --actor-mode process with the mesh-sharded replay armed — the fault
+# fires inside a spawned WORKER PROCESS, the worker dies, the
+# supervisor restarts the slot skipping the poison iteration, and the
+# per-slot ingest-depth + shard-occupancy gauges are present.  (The
+# fault_injected event is logged in the worker's process, which has no
+# RunLog — actor_down's recorded reason carries the FaultInjected
+# signature instead.)
+#
+# The CI companion of smoke_obs.sh / smoke_ckpt.sh; ~3 min on CPU.
 #
 #   bash tools/smoke_fleet.sh [workdir]
 #
@@ -64,6 +76,55 @@ assert episodes[-1]["episode"] >= 5, "learner stalled after the kill"
 print("[smoke_fleet] OK:", len(episodes), "episodes,",
       len(restarts), "restart(s), gauges:",
       sorted(g for g in gauges if "staleness" in g or "clip" in g))
+EOF
+
+RUN2="$WORK/smoke_fleet_proc.jsonl"
+echo "[smoke_fleet] phase 2: PROCESS fleet (kill actor-1 worker at" \
+     "iteration 1, sharded replay) -> $RUN2" >&2
+(cd "$WORK" && PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+    JAX_PLATFORMS=cpu \
+    SMARTCAL_FAULTS='{"kill_actor": 1, "kill_at": 1}' \
+    python -m smartcal_tpu.parallel.learner \
+    --supervised --actor-mode process --replay-shards 4 \
+    --episodes 10 --n-actors 2 --batch-envs 2 \
+    --is-clip 2.0 --metrics "$RUN2" --diag --quiet)
+
+python - "$RUN2" <<'EOF'
+import json
+import math
+import sys
+
+events = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+kinds = [e.get("event") for e in events]
+
+# 1. the worker-process death was detected and the slot recovered.
+# The fault fires INSIDE the worker process (no RunLog there): the
+# supervisor's actor_down reason carries the FaultInjected signature.
+downs = [e for e in events if e.get("event") == "actor_down"]
+assert downs and downs[0]["actor"] == 1, f"no actor_down for actor 1: {downs}"
+assert "FaultInjected" in downs[0]["reason"], downs[0]
+restarts = [e for e in events if e.get("event") == "actor_restart"]
+assert restarts, "supervisor never restarted the killed worker process"
+assert restarts[0]["iteration"] == 2, \
+    f"poison iteration not skipped: {restarts[0]}"
+
+# 2. the process-fleet gauge surface: per-slot ingest depth + shard
+# occupancy + the staleness pair
+gauges = {e["name"] for e in events if e.get("event") == "gauge"}
+for need in ("ingest_queue_depth", "replay_shard_occupancy",
+             "weight_staleness_versions", "is_clip_saturation"):
+    assert need in gauges, f"missing gauge {need}: {sorted(gauges)}"
+slots = {e.get("slot") for e in events if e.get("event") == "gauge"
+         and e["name"] == "ingest_queue_depth" and "slot" in e}
+assert {0, 1} <= slots, f"per-slot depth gauges missing: {slots}"
+
+# 3. the learner kept making progress past the worker kill
+episodes = [e for e in events if e.get("event") == "episode"]
+assert len(episodes) >= 5, f"too few learner episodes: {len(episodes)}"
+assert all(math.isfinite(e["score"]) for e in episodes), "non-finite scores"
+
+print("[smoke_fleet] phase 2 OK:", len(episodes), "episodes,",
+      len(restarts), "process restart(s), per-slot gauges:", sorted(slots))
 EOF
 
 echo "[smoke_fleet] PASS (workdir $WORK)" >&2
