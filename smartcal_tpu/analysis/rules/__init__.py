@@ -4,6 +4,7 @@ new rule by creating a module here that defines a ``Rule`` subclass
 decorated with ``@register`` and importing it below."""
 
 from . import donation     # noqa: F401
+from . import dtype_discipline  # noqa: F401
 from . import jit_sync     # noqa: F401
 from . import locks        # noqa: F401
 from . import pickle_io    # noqa: F401

@@ -1,0 +1,87 @@
+"""dtype-discipline: bare float dtype literals in precision-policied
+kernel modules.
+
+PR 13's mixed-precision contract (cal/precision.py): the kernel modules
+that take a static ``precision=`` decide their contraction dtypes
+through the ONE policy table — ``contraction_dtype(kernel, precision)``
+for policy-controlled sites, ``precision.F32`` for pinned ones — so
+"where is bf16 allowed" has a single auditable answer backed by parity
+tests.  A bare ``jnp.float32``/``jnp.float64`` literal inside a policied
+module is a dtype decision the policy can't see: it silently pins a
+site f32 (or worse, f64 on a platform that demotes it) with no recorded
+reason and no oracle coverage.
+
+Pinned-f32 sites that genuinely must stay literal (e.g. a Pallas
+kernel's ``preferred_element_type``) carry a
+``# graftlint: disable=dtype-discipline -- <pinning reason>`` — the
+reason requirement is the point: every f32 pin in a policied module is
+either the policy helper or a stated decision.
+
+Scope: the policied module list (``POLICIED_PATHS``); the policy module
+itself (cal/precision.py) is exempt — it is where the literals are
+supposed to live.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from ..core import FileContext, Finding, Rule, register
+
+#: modules whose kernels take the static ``precision=`` policy argument
+POLICIED_PATHS = (
+    "smartcal_tpu/cal/imager.py",
+    "smartcal_tpu/cal/influence.py",
+    "smartcal_tpu/cal/kernels.py",
+    "smartcal_tpu/ops/pallas_imager.py",
+)
+
+#: the policy helper module — dtype literals are its job
+EXEMPT_PATHS = ("smartcal_tpu/cal/precision.py",)
+
+_BARE = {"float32", "float64"}
+_ROOTS = {"jnp", "jax"}
+
+
+def _dtype_literal(node: ast.AST) -> str | None:
+    """'jnp.float32' for a bare dtype attribute (jnp.float32 or
+    jax.numpy.float32), else None."""
+    if not isinstance(node, ast.Attribute) or node.attr not in _BARE:
+        return None
+    base = node.value
+    if isinstance(base, ast.Name) and base.id in _ROOTS:
+        return f"{base.id}.{node.attr}"
+    if isinstance(base, ast.Attribute) and base.attr == "numpy" and \
+            isinstance(base.value, ast.Name) and base.value.id == "jax":
+        return f"jax.numpy.{node.attr}"
+    return None
+
+
+@register
+class DtypeDiscipline(Rule):
+    name = "dtype-discipline"
+    doc = ("bare jnp.float32/float64 literal in a precision=-policied "
+           "kernel module — route through cal/precision.py or pin with "
+           "a reasoned disable")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        policied = ctx.options.get("dtype_policied_paths", POLICIED_PATHS)
+        exempt = ctx.options.get("dtype_exempt_paths", EXEMPT_PATHS)
+        if any(ctx.rel.endswith(p) for p in exempt):
+            return iter(())
+        if not any(ctx.rel.endswith(p) for p in policied):
+            return iter(())
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            lit = _dtype_literal(node)
+            if lit is not None:
+                findings.append(ctx.finding(
+                    self.name, node,
+                    f"bare {lit} in a precision=-policied kernel module "
+                    "— use cal/precision.py (contraction_dtype for "
+                    "policy-controlled sites, precision.F32 for pinned "
+                    "ones) or add a reasoned "
+                    "'# graftlint: disable=dtype-discipline' so the pin "
+                    "is a recorded decision"))
+        return iter(sorted(set(findings)))

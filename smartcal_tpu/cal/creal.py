@@ -49,18 +49,33 @@ def abs2(a):
     return a[..., 0] ** 2 + a[..., 1] ** 2
 
 
-def einsum(spec, a, b):
+def einsum(spec, a, b, compute_dtype=None):
     """Complex einsum over split operands: four real einsums.
 
     ``spec`` is a two-operand einsum spec over the NON-pair axes; the pair
     axis rides along implicitly.
+
+    ``compute_dtype`` (cal/precision.py policy): when given, the OPERANDS
+    are narrowed to it (e.g. bf16) while the contraction still
+    accumulates in float32 (``preferred_element_type``) — the mixed-
+    precision shape the MXU natively executes.  None = untouched f32
+    (bit-identical to the pre-policy behavior).
     """
     ar, ai = a[..., 0], a[..., 1]
     br, bi = b[..., 0], b[..., 1]
-    rr = jnp.einsum(spec, ar, br)
-    ii = jnp.einsum(spec, ai, bi)
-    ri = jnp.einsum(spec, ar, bi)
-    ir = jnp.einsum(spec, ai, br)
+    kw = {}
+    if compute_dtype is not None:
+        # the accumulation pin applies whenever a compute dtype is
+        # requested — including operands that ALREADY arrive narrowed
+        # (otherwise they would accumulate in their own dtype)
+        kw["preferred_element_type"] = jnp.float32
+        if compute_dtype != ar.dtype:
+            ar, ai = ar.astype(compute_dtype), ai.astype(compute_dtype)
+            br, bi = br.astype(compute_dtype), bi.astype(compute_dtype)
+    rr = jnp.einsum(spec, ar, br, **kw)
+    ii = jnp.einsum(spec, ai, bi, **kw)
+    ri = jnp.einsum(spec, ar, bi, **kw)
+    ir = jnp.einsum(spec, ai, br, **kw)
     return jnp.stack([rr - ii, ri + ir], axis=-1)
 
 

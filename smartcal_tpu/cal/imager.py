@@ -21,6 +21,9 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
+
+from smartcal_tpu.cal import precision as prec
 
 C_LIGHT = 2.99792458e8
 
@@ -29,7 +32,7 @@ def pixel_grid(npix, cell):
     """(npix^2, 2) direction cosines (l, m) of the image pixels; row-major
     with m varying fastest; centered, north up (m increasing)."""
     half = npix // 2
-    idx = (jnp.arange(npix) - half).astype(jnp.float32) * cell
+    idx = (jnp.arange(npix) - half).astype(prec.F32) * cell
     ll, mm = jnp.meshgrid(idx, idx, indexing="ij")
     return jnp.stack([ll.ravel(), mm.ravel()], axis=-1)
 
@@ -63,8 +66,43 @@ def dirty_image_sr(uvw, vis, freq, cell, npix=128):
     return dirty_image_sr_xla(uvw, vis, freq, cell, npix=npix)
 
 
-@partial(jax.jit, static_argnames=("npix",))
-def dirty_image_factored_sr(uvw, vis, freq, cell, npix=128):
+def _factored_planes(uvw, vis, freq, cell, npix):
+    """Shared (p1, p2, cb, sb) plane build of the factored DFT imager
+    (see :func:`dirty_image_factored_sr`); phase/trig stays f32 — the
+    range-reduction-sensitive part of the formulation."""
+    scale = 2.0 * jnp.pi * freq / C_LIGHT
+    u = uvw[:, 0] * scale
+    v = uvw[:, 1] * scale
+    half = npix // 2
+    idx = (jnp.arange(npix) - half).astype(prec.F32) * cell
+    a = idx[:, None] * u[None, :]                          # (npix, R) l u
+    b = idx[:, None] * v[None, :]                          # (npix, R) m v
+    ca, sa = jnp.cos(a), jnp.sin(a)
+    cb, sb = jnp.cos(b), jnp.sin(b)
+    vr, vi = vis[:, 0], vis[:, 1]
+    p1 = ca * vr[None, :] + sa * vi[None, :]
+    p2 = ca * vi[None, :] - sa * vr[None, :]
+    return p1, p2, cb, sb
+
+
+def _factored_contract(p1, p2, cb, sb, dt):
+    """The two (npix, R) @ (R, npix) matmuls, with operands narrowed to
+    the policy dtype ``dt`` and f32 accumulation (the mixed-precision
+    MXU shape; dt == f32 is bit-identical to the plain matmuls)."""
+    kw = {}
+    if dt != prec.F32:
+        # pin f32 accumulation even if the operands already arrive in
+        # the compute dtype — same contract as creal.einsum
+        kw["preferred_element_type"] = prec.F32
+        if dt != p1.dtype:
+            p1, p2 = p1.astype(dt), p2.astype(dt)
+            cb, sb = cb.astype(dt), sb.astype(dt)
+    return jnp.matmul(p1, cb.T, **kw) + jnp.matmul(p2, sb.T, **kw)
+
+
+@partial(jax.jit, static_argnames=("npix", "precision"))
+def dirty_image_factored_sr(uvw, vis, freq, cell, npix=128,
+                            precision="f32"):
     """Rank-factored DFT image — the influence-path production imager.
 
     The pixel grid is separable (l indexes rows, m columns), so the DFT
@@ -81,21 +119,78 @@ def dirty_image_factored_sr(uvw, vis, freq, cell, npix=128):
     ~17 s per sub-band on the host core — to (npix, R): same math to
     float round-off (the identity reassociates the phase evaluation).
     Pure matmuls + elementwise: safe inside GSPMD/shard_map programs.
+
+    ``precision`` (static, cal/precision.py): "bf16" narrows the matmul
+    OPERANDS under the ``imager_matmul`` policy row (f32 accumulation;
+    phase/trig untouched) — measured image parity within the documented
+    bf16 tolerance in tests/test_nscale_kernels.py; "f32" (default) is
+    bit-identical to the pre-policy kernel.
     """
-    scale = 2.0 * jnp.pi * freq / C_LIGHT
-    u = uvw[:, 0] * scale
-    v = uvw[:, 1] * scale
-    half = npix // 2
-    idx = (jnp.arange(npix) - half).astype(jnp.float32) * cell
-    a = idx[:, None] * u[None, :]                          # (npix, R) l u
-    b = idx[:, None] * v[None, :]                          # (npix, R) m v
-    ca, sa = jnp.cos(a), jnp.sin(a)
-    cb, sb = jnp.cos(b), jnp.sin(b)
-    vr, vi = vis[:, 0], vis[:, 1]
-    p1 = ca * vr[None, :] + sa * vi[None, :]
-    p2 = ca * vi[None, :] - sa * vr[None, :]
-    img = p1 @ cb.T + p2 @ sb.T                            # (l, m)
+    dt = prec.contraction_dtype("imager_matmul", precision)
+    p1, p2, cb, sb = _factored_planes(uvw, vis, freq, cell, npix)
+    return _factored_contract(p1, p2, cb, sb, dt) / vis.shape[0]
+
+
+@partial(jax.jit, static_argnames=("npix", "block_r", "precision"))
+def dirty_image_factored_blocked_sr(uvw, vis, freq, cell, npix=1024,
+                                    block_r=4096, precision="f32"):
+    """BLOCKED rank-factored DFT image — the npix>=1024 / B~N^2 tier.
+
+    At SKA scale the factored imager's (npix, R) planes stop being
+    small: npix=1024 x R = T*B(N=256) ~ 6.5e5 is ~2.7 GB PER PLANE (six
+    live at once).  Here the visibility axis is tiled: a ``lax.scan``
+    over R-blocks accumulates the (npix, npix) image, so the largest
+    live buffer is a (npix, block_r) plane (~16 MB at the default
+    block) plus the f32 image accumulator — the blocked-kernel memory
+    contract.  Transcendental count and math are IDENTICAL to
+    :func:`dirty_image_factored_sr` (the R-axis sum is reassociated
+    across blocks; parity tested to float round-off), so this is the
+    ``lax`` fallback of the tiled Pallas kernel
+    (ops/pallas_imager.dirty_image_factored_pallas) on CPU/GPU and
+    inside GSPMD programs.
+
+    R is zero-padded to the block size (padded vis rows are 0, so any
+    phase value contributes nothing — the pallas_imager convention).
+    """
+    dt = prec.contraction_dtype("imager_matmul", precision)
+    R = uvw.shape[0]
+    nblk = -(-R // block_r)
+    padr = nblk * block_r - R
+    uv = jnp.pad(uvw[:, :2], ((0, padr), (0, 0)))
+    vp = jnp.pad(vis, ((0, padr), (0, 0)))
+    uvb = uv.reshape(nblk, block_r, 2)
+    vb = vp.reshape(nblk, block_r, 2)
+
+    def body(acc, operand):
+        uvw_b, vis_b = operand
+        uvw3 = jnp.pad(uvw_b, ((0, 0), (0, 1)))   # w unused by the planes
+        p1, p2, cb, sb = _factored_planes(uvw3, vis_b, freq, cell, npix)
+        return acc + _factored_contract(p1, p2, cb, sb, dt), None
+
+    img0 = jnp.zeros((npix, npix), prec.F32)
+    img, _ = lax.scan(body, img0, (uvb, vb))
     return img / vis.shape[0]
+
+
+def dirty_image_factored_large_sr(uvw, vis, freq, cell, npix=1024,
+                                  block_r=4096, precision="f32",
+                                  allow_pallas=True):
+    """Dispatcher for the npix >= 512 factored-imager tier: the tiled
+    Pallas kernel on TPU for aligned image sizes (the (TILE_L, TILE_M,
+    TILE_R) VMEM-tile twin — ops/pallas_imager.dirty_image_factored_
+    pallas), the R-blocked lax kernel otherwise — the same
+    dispatch-upgrades-every-caller contract as :func:`dirty_image_sr`.
+    Callers INSIDE a GSPMD/shard_map program pass
+    ``allow_pallas=False`` (pallas_call has no partitioning rule)."""
+    from smartcal_tpu.ops import pallas_imager  # lazy: ops is above cal
+
+    if (allow_pallas and npix % pallas_imager.TILE_L == 0
+            and pallas_imager.pallas_available()):
+        return pallas_imager.dirty_image_factored_pallas(
+            uvw, vis, freq, cell, npix=npix, precision=precision)
+    return dirty_image_factored_blocked_sr(uvw, vis, freq, cell,
+                                           npix=npix, block_r=block_r,
+                                           precision=precision)
 
 
 @partial(jax.jit, static_argnames=("npix",))

@@ -42,6 +42,7 @@ import numpy as np
 from jax import lax
 
 from smartcal_tpu.cal import consensus, kernels
+from smartcal_tpu.cal import precision as prec
 
 
 def consensus_hadd_scalars(rho_spectral, rho_spatial, freqs, f0, fidx,
@@ -60,9 +61,9 @@ def consensus_hadd_scalars(rho_spectral, rho_spatial, freqs, f0, fidx,
       alpha == 0:
         h = rho/2 * fs^2 * (1 + fs^2 / (1 - fs^2))
     """
-    freqs = jnp.asarray(freqs, jnp.float32)
-    rho = jnp.asarray(rho_spectral, jnp.float32)
-    alpha = jnp.asarray(rho_spatial, jnp.float32)
+    freqs = jnp.asarray(freqs, prec.F32)
+    rho = jnp.asarray(rho_spectral, prec.F32)
+    alpha = jnp.asarray(rho_spatial, prec.F32)
 
     def per_dir(r, a):
         bfull, bi, fscale = consensus.consensus_cores(
@@ -129,30 +130,46 @@ def _chunk_post(pol_means, fullpol):
 
 
 def _chunk_influence_opt(R3, C5, Jp, Jq, lhs, hadd, n_stations, fullpol,
-                         perdir):
+                         perdir, block_baselines=0, precision="f32"):
     """One calibration interval, OPTIMIZED formulation, on hoisted
     operands: the split-real block forms (R3, C5), the station-gathered
     Jones blocks (Jp, Jq) and the shared Dsolutions/Dresiduals lhs are
     built ONCE for all chunks by the caller (the oracle chain rebuilds
     each of them per chunk per kernel).  Hessian is the scatter-free
     formulation; the Dsolutions -> Dresiduals chain is the adjoint
-    4-RHS transpose solve (kernels._colmeans_adjoint_core_sr)."""
+    4-RHS transpose solve (kernels._colmeans_adjoint_core_sr).
+
+    ``block_baselines`` (static) > 0 selects the BLOCKED Hessian core
+    (kernels._hessian_res_core_blocked_sr — a lax.scan over baseline
+    blocks bounding the einsum temporaries to the block, the B ~ N^2
+    memory tier); ``precision`` (static, cal/precision.py) narrows the
+    colmeans contraction under the ``colmeans_contract`` policy row —
+    the Hessian build and the transpose solve stay pinned f32."""
     Td = C5.shape[1]
     p_idx, _ = kernels.baseline_indices(n_stations)
-    H = kernels._hessian_res_core_sr(R3, C5, Jp, Jq, n_stations)
+    if block_baselines:
+        H = kernels._hessian_res_core_blocked_sr(R3, C5, Jp, Jq,
+                                                 n_stations,
+                                                 block_baselines)
+    else:
+        H = kernels._hessian_res_core_sr(R3, C5, Jp, Jq, n_stations)
     N4 = H.shape[1]
     H = H.at[:, jnp.arange(N4), jnp.arange(N4), 0].add(hadd[:, None])
+    dt = prec.contraction_dtype("colmeans_contract", precision)
     pol_means = kernels._colmeans_adjoint_core_sr(
-        lhs, H, p_idx, n_stations, Td, addself=False, perdir=perdir)
+        lhs, H, p_idx, n_stations, Td, addself=False, perdir=perdir,
+        contract_dtype=None if dt == prec.F32 else dt)
     return _chunk_post(pol_means, fullpol), \
         kernels._llr_core_sr(R3, C5, Jp, Jq)
 
 
 @partial(jax.jit, static_argnames=("n_stations", "n_chunks", "fullpol",
-                                   "perdir", "optimized"))
+                                   "perdir", "optimized",
+                                   "block_baselines", "precision"))
 def influence_visibilities(R, C, J, hadd, n_stations, n_chunks,
                            fullpol=False, perdir=False,
-                           optimized=True) -> InfluenceResult:
+                           optimized=True, block_baselines=0,
+                           precision="f32") -> InfluenceResult:
     """Influence visibilities over all calibration intervals.
 
     R : (2*B*T, 2, 2) kernel-convention residuals for one sub-band
@@ -171,6 +188,12 @@ def influence_visibilities(R, C, J, hadd, n_stations, n_chunks,
     sum) hoisted out of the ``lax.map`` into one fused pass each.
     ``optimized=False`` is the retained oracle chain — same results to
     float round-off (tested), O(10x) slower at the N=62 episode scale.
+
+    ``block_baselines`` (static, optimized chain only) > 0 runs the
+    blocked Hessian core — at N >= 256 the unblocked per-chunk einsum
+    temporaries are the memory wall; ``precision`` (static,
+    cal/precision.py) selects the mixed bf16 policy for the colmeans
+    contraction (documented tolerance; solve/Hessian pinned f32).
     """
     B = n_stations * (n_stations - 1) // 2
     T = C.shape[1] // B
@@ -192,7 +215,9 @@ def influence_visibilities(R, C, J, hadd, n_stations, n_chunks,
         def one(args):
             r3, c5, jp, jq, lh = args
             return _chunk_influence_opt(r3, c5, jp, jq, lh, hadd,
-                                        n_stations, fullpol, perdir)
+                                        n_stations, fullpol, perdir,
+                                        block_baselines=block_baselines,
+                                        precision=precision)
 
         vis_b, llr = lax.map(one, (R3, C5, Jp, Jq, lhs))
     else:
@@ -216,11 +241,90 @@ def influence_visibilities(R, C, J, hadd, n_stations, n_chunks,
     return InfluenceResult(vis=vis, llr=llr)
 
 
+def _chunk_influence_bshard(r3l, c5l, jpl, jql, lhs_l, p_idx_l, q_idx_l,
+                            b_offset, hadd, n_stations, b_total, fullpol,
+                            perdir, axis_name, precision):
+    """One calibration interval with the BASELINE axis sharded over
+    ``axis_name`` — every operand is this shard's local baseline slice.
+    Collectives happen only at the per-direction reductions: ONE psum of
+    the assembled partial Hessian and ONE psum of the adjoint chain's
+    per-station G sum (plus the scalar LLR norms); the returned column
+    means cover the local baselines."""
+    Td = c5l.shape[1]
+    K = c5l.shape[0]
+    off_l, dsum_l = kernels._hessian_block_sums(
+        r3l, c5l, jpl, jql, p_idx_l, q_idx_l, n_stations)
+    # place the local off-diagonal blocks at their global slots; the
+    # assembled partials live on disjoint (p, q) slots across shards, so
+    # the psum IS the global Hessian
+    off_tab = jnp.zeros((K, b_total, 4, 4, 2), off_l.dtype)
+    off_tab = lax.dynamic_update_slice(off_tab, off_l,
+                                       (0, b_offset, 0, 0, 0))
+    H = kernels._hessian_assemble(off_tab, dsum_l, n_stations, b_total,
+                                  Td)
+    H = lax.psum(H, axis_name)
+    N4 = H.shape[1]
+    H = H.at[:, jnp.arange(N4), jnp.arange(N4), 0].add(hadd[:, None])
+    dt = prec.contraction_dtype("colmeans_contract", precision)
+    pol_means = kernels._colmeans_adjoint_bshard_sr(
+        lhs_l, H, p_idx_l, n_stations, Td, b_total, addself=False,
+        perdir=perdir, axis_name=axis_name,
+        contract_dtype=None if dt == prec.F32 else dt)
+    return _chunk_post(pol_means, fullpol), \
+        kernels._llr_bshard_sr(r3l, c5l, jpl, jql, axis_name)
+
+
+def influence_visibilities_blocal(R3, C5, J, p_idx_l, q_idx_l, hadd,
+                                  n_stations, b_total,
+                                  fullpol=False, perdir=False,
+                                  axis_name="bp", precision="f32"):
+    """Shard-LOCAL body of the baseline-sharded influence engine (called
+    inside ``shard_map`` by parallel/sharded_cal.influence_baseline_
+    sharded; per-shard shapes).
+
+    R3 (Ts, Td, Bl, 2, 2, 2); C5 (Ts, K, Td, Bl, 2, 2, 2); J (Ts, K,
+    2N, 2, 2) replicated; p_idx_l/q_idx_l (Bl,) this shard's station
+    indices.  Returns (vis (T, Bl, 4, 2) — (K, T, Bl, 4, 2) when
+    ``perdir`` — and llr (Ts, K) replicated); the caller's out_specs
+    concatenate the baseline axis back into global time-major order."""
+    from smartcal_tpu.cal import creal  # local: kernels owns the math
+
+    Ts, Td = R3.shape[0], R3.shape[1]
+    Bl = R3.shape[2]
+    K = C5.shape[1]
+    b_offset = lax.axis_index(axis_name) * Bl
+
+    J4 = J.reshape(Ts, K, n_stations, 2, 2, 2)
+    Jp, Jq = J4[:, :, p_idx_l], J4[:, :, q_idx_l]   # (Ts, K, Bl, 2, 2, 2)
+    Csum = jnp.sum(C5, axis=2)                      # (Ts, K, Bl, 2, 2, 2)
+    lhs = creal.einsum("skbuv,skbwv->skbuw", Jq, creal.conj(Csum))
+
+    def one(args):
+        r3, c5, jp, jq, lh = args
+        return _chunk_influence_bshard(
+            r3, c5, jp, jq, lh, p_idx_l, q_idx_l, b_offset, hadd,
+            n_stations, b_total, fullpol, perdir, axis_name, precision)
+
+    vis_b, llr = lax.map(one, (R3, C5, Jp, Jq, lhs))
+    scale = 8.0 * b_total * Td
+    if perdir:
+        # (Ts, K, Bl, 4, 2) -> (K, Ts*Td, Bl, 4, 2) replicated over Td
+        v = jnp.repeat(vis_b[:, :, None, :, :, :], Td, axis=2)
+        vis = jnp.moveaxis(v, 0, 1).reshape(K, Ts * Td, Bl, 4, 2) * scale
+    else:
+        v = jnp.repeat(vis_b[:, None, :, :, :], Td, axis=1)
+        vis = v.reshape(Ts * Td, Bl, 4, 2) * scale
+    return InfluenceResult(vis=vis, llr=llr)
+
+
 @partial(jax.jit, static_argnames=("n_stations", "n_chunks", "npix",
-                                   "use_pallas", "optimized"))
+                                   "use_pallas", "optimized",
+                                   "block_baselines", "imager_block_r",
+                                   "precision"))
 def influence_images_multi(residual, C, J, hadd_all, freqs, uvw, cell,
                            n_stations, n_chunks, npix, use_pallas=True,
-                           optimized=True):
+                           optimized=True, block_baselines=0,
+                           imager_block_r=0, precision="f32"):
     """Per-sub-band Stokes-I influence dirty images in ONE device dispatch.
 
     The envs' host loop over sub-bands (residual_to_kernel ->
@@ -242,6 +346,12 @@ def influence_images_multi(residual, C, J, hadd_all, freqs, uvw, cell,
     matmul-only, so it is also the path used inside sharded programs).
     ``optimized=False`` keeps the oracle chain, where ``use_pallas=False``
     forces the XLA imager (required inside GSPMD/shard_map programs).
+
+    SKA-tier statics (optimized chain only): ``block_baselines`` > 0
+    runs the blocked Hessian core; ``imager_block_r`` > 0 swaps in the
+    blocked factored imager (``dirty_image_factored_blocked_sr``, the
+    npix >= 1024 tier where the (npix, R) planes stop being small);
+    ``precision`` selects the bf16 policy rows (cal/precision.py).
     """
     from smartcal_tpu.cal import imager, solver  # lazy: solver is a consumer
 
@@ -255,10 +365,20 @@ def influence_images_multi(residual, C, J, hadd_all, freqs, uvw, cell,
         def one(args):
             rk, c, j, hadd, f = args
             inf = influence_visibilities(rk, c, j, hadd, n_stations,
-                                         n_chunks, optimized=True)
+                                         n_chunks, optimized=True,
+                                         block_baselines=block_baselines,
+                                         precision=precision)
             ivis = stokes_i_influence(inf.vis)
+            if imager_block_r:
+                # use_pallas doubles as the GSPMD guard here, exactly as
+                # on the oracle chain: sharded callers pass False
+                return imager.dirty_image_factored_large_sr(
+                    uvw, ivis, f, cell, npix=npix,
+                    block_r=imager_block_r, precision=precision,
+                    allow_pallas=use_pallas)
             return imager.dirty_image_factored_sr(uvw, ivis, f, cell,
-                                                  npix=npix)
+                                                  npix=npix,
+                                                  precision=precision)
 
         return lax.map(one, (Rk_all, C, J, hadd_all, jnp.asarray(freqs)))
 
@@ -275,23 +395,39 @@ def influence_images_multi(residual, C, J, hadd_all, freqs, uvw, cell,
     return lax.map(one, (residual, C, J, hadd_all, jnp.asarray(freqs)))
 
 
-@partial(jax.jit, static_argnames=("n_stations", "n_chunks", "npix"))
+@partial(jax.jit, static_argnames=("n_stations", "n_chunks", "npix",
+                                   "block_baselines", "imager_block_r",
+                                   "precision"))
 def influence_image_single_sr(residual_f, C_f, J_f, hadd_f, freq, uvw,
-                              cell, n_stations, n_chunks, npix):
+                              cell, n_stations, n_chunks, npix,
+                              block_baselines=0, imager_block_r=0,
+                              precision="f32"):
     """ONE sub-band's influence dirty image with the optimized kernels —
     the bounded per-dispatch unit of the host-segmented influence route
     (envs/radio.RadioBackend): at the N=62 episode scale the fused
     all-band program runs minutes on a chip (device-watchdog territory,
     same story as the segmented ADMM driver), while this program is
     1/Nf-th the size and the host loop double-buffers it — band f+1's
-    dispatch is enqueued while band f executes."""
+    dispatch is enqueued while band f executes.  The SKA-tier statics
+    (``block_baselines``/``imager_block_r``/``precision``) mirror
+    :func:`influence_images_multi` — this is the route big-N episodes
+    take on one device, so the blocked kernels must be reachable here."""
     from smartcal_tpu.cal import imager, solver
 
     Rk = solver.residual_to_kernel(residual_f)
     inf = influence_visibilities(Rk, C_f, J_f, hadd_f, n_stations,
-                                 n_chunks, optimized=True)
+                                 n_chunks, optimized=True,
+                                 block_baselines=block_baselines,
+                                 precision=precision)
     ivis = stokes_i_influence(inf.vis)
-    return imager.dirty_image_factored_sr(uvw, ivis, freq, cell, npix=npix)
+    if imager_block_r:
+        # single-band host-segmented unit — never inside a GSPMD
+        # program, so the TPU dispatch may pick the Pallas tile kernel
+        return imager.dirty_image_factored_large_sr(
+            uvw, ivis, freq, cell, npix=npix, block_r=imager_block_r,
+            precision=precision)
+    return imager.dirty_image_factored_sr(uvw, ivis, freq, cell,
+                                          npix=npix, precision=precision)
 
 
 class PerdirSummary(NamedTuple):

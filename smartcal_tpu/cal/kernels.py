@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from smartcal_tpu.cal import creal
+from smartcal_tpu.cal import precision as _precision
 
 EPS_SINGULAR = 1e-12   # reference: EPS in Dsolutions (calibration_tools.py:696)
 EPS_DIV = 1e-12        # reference: EPS in log_likelihood_ratio (:1203)
@@ -53,7 +54,7 @@ def baseline_indices(n_stations):
     return jnp.asarray(p), jnp.asarray(q)
 
 
-def baseline_onehots(n_stations, dtype=jnp.float32):
+def baseline_onehots(n_stations, dtype=_precision.F32):
     """One-hot (N, B) selection matrices for the p and q station of each
     baseline — the scatter-free station<->baseline expansion shared by the
     solver's inner evaluation (cal/solver._cost_fn_onehot) and the
@@ -180,37 +181,17 @@ def _hessian_res_core_sr(R3, C5, Jp, Jq, n_stations):
     Taking ``R3/C5/Jp/Jq`` directly lets the influence engine hoist the
     split-real rebuilds out of its chunk loop (they are recomputed per
     chunk per kernel in the oracle chain).
+
+    ONE copy of the math: this is ``_hessian_block_sums`` over the full
+    baseline set followed by the shared ``_hessian_assemble`` placement
+    tail — the same pieces the blocked (lax.scan) and baseline-sharded
+    paths run per subset, so a formula fix lands in every path at once.
     """
     K, T, B = C5.shape[0], C5.shape[1], C5.shape[2]
-
-    off = -creal.einsum("ktbij,tbuv->kbiujv", creal.conj(C5), R3)
-    off = off.reshape(K, B, 4, 4, 2)
-
-    A1 = creal.einsum("ktbuv,kbwv->ktbuw", C5, creal.conj(Jq))
-    Sp = creal.einsum("ktbuw,ktbvw->kbuv", A1, creal.conj(A1))
-    A2 = creal.einsum("kbuv,ktbvw->ktbuw", Jp, C5)
-    Sq = creal.einsum("ktbuv,ktbuw->kbvw", creal.conj(A2), A2)
-
-    onehot_p, onehot_q = baseline_onehots(n_stations, R3.dtype)
-    Dsum = (jnp.einsum("nb,kbuvz->knuvz", jnp.asarray(onehot_p), Sp)
-            + jnp.einsum("nb,kbuvz->knuvz", jnp.asarray(onehot_q), Sq))
-    eye2 = jnp.eye(2, dtype=R3.dtype)
-    diag_blocks = jnp.einsum("knjiz,uv->kniujvz", Dsum, eye2).reshape(
-        K, n_stations, 4, 4, 2)
-
-    idx = jnp.asarray(offdiag_index_map(n_stations))
-    off_pad = jnp.concatenate(
-        [off, jnp.zeros((K, 1, 4, 4, 2), off.dtype)], axis=1)
-    herm_pad = creal.conj(jnp.swapaxes(off_pad, -3, -2))
-    Hup = off_pad[:, idx]                 # (K, p, q, 4, 4, 2)
-    Hlow = herm_pad[:, idx.T]             # (K, q, p, 4, 4, 2)
-    eyeN = jnp.eye(n_stations, dtype=R3.dtype)
-    Hd = jnp.einsum("nm,knijz->knmijz", eyeN, diag_blocks)
-    # the three terms live on disjoint (n, m) slots (p < q strictly), so
-    # the sum is placement, not accumulation
-    H = jnp.swapaxes(Hup + Hlow + Hd, 2, 3)     # (K, N, 4, N, 4, 2)
-    N4 = 4 * n_stations
-    return H.reshape(K, N4, N4, 2) / (B * T)
+    p_idx, q_idx = baseline_indices(n_stations)
+    off, Dsum = _hessian_block_sums(R3, C5, Jp, Jq, p_idx, q_idx,
+                                    n_stations)
+    return _hessian_assemble(off, Dsum, n_stations, B, T)
 
 
 @partial(jax.jit, static_argnames=("n_stations",))
@@ -224,6 +205,132 @@ def hessian_res_opt_sr(Rs, Cs, Js, n_stations):
     p_idx, q_idx = baseline_indices(n_stations)
     return _hessian_res_core_sr(R3, C5, J4[:, p_idx], J4[:, q_idx],
                                 n_stations)
+
+
+# ---------------------------------------------------------------------------
+# Blocked / baseline-sharded Hessian (the B ~ N^2 memory tier)
+# ---------------------------------------------------------------------------
+#
+# At N >= 256 stations (B = 32640 baselines) the unblocked Hessian core's
+# per-chunk einsum temporaries — A1/A2/Sp/Sq and their conjugates, each
+# (K, Td, B, 2, 2, 2) — dominate peak memory (the inputs themselves are a
+# fraction of the live set).  The pieces below compute the SAME math from
+# an arbitrary SUBSET of baselines (a scan block, or a mesh shard's local
+# slice), so the temporaries scale with the block/shard size while the
+# output stays the full (K, 4N, 4N, 2) per-direction Hessian:
+#
+# * ``_hessian_block_sums``   — per-subset off-diagonal blocks + one-hot
+#   station sums (one-hots built by equality against the subset's OWN
+#   p/q indices, so zero-padding/sentinel indices contribute nothing);
+# * ``_hessian_assemble``     — the placement tail of the core (padded
+#   gather of the off-diagonal table + diag kron), shared verbatim;
+# * ``hessian_res_core_blocked_sr`` — lax.scan over baseline blocks on
+#   the hoisted per-chunk operands (the blocked twin of
+#   ``_hessian_res_core_sr``, selected by the influence engine's static
+#   ``block_baselines``);
+# * shard callers (cal/influence._chunk_influence_bshard) place a local
+#   subset at its global offset and psum the assembled partial — the ONE
+#   collective of the baseline-sharded Hessian.
+
+
+def _block_onehot(idx, n_stations, dtype):
+    """(N, nb) one-hot from a station-index vector (device-built, traced
+    indices allowed — shard-local p/q slices are operands, not
+    constants).  Sentinel indices >= N (zero-pad slots) produce all-zero
+    columns, so padded baselines contribute nothing."""
+    return (idx[None, :] == jnp.arange(n_stations)[:, None]).astype(dtype)
+
+
+def _hessian_block_sums(R3, C5, Jp, Jq, p_idx, q_idx, n_stations):
+    """Off-diagonal blocks + station-summed diagonal contributions from
+    ONE baseline subset: R3 (T, nb, 2, 2, 2); C5 (K, T, nb, 2, 2, 2);
+    Jp/Jq (K, nb, 2, 2, 2); p_idx/q_idx (nb,).  Returns
+    (off (K, nb, 4, 4, 2), Dsum (K, N, 2, 2, 2)), UNNORMALIZED."""
+    K, nb = C5.shape[0], C5.shape[2]
+
+    off = -creal.einsum("ktbij,tbuv->kbiujv", creal.conj(C5), R3)
+    off = off.reshape(K, nb, 4, 4, 2)
+
+    A1 = creal.einsum("ktbuv,kbwv->ktbuw", C5, creal.conj(Jq))
+    Sp = creal.einsum("ktbuw,ktbvw->kbuv", A1, creal.conj(A1))
+    A2 = creal.einsum("kbuv,ktbvw->ktbuw", Jp, C5)
+    Sq = creal.einsum("ktbuv,ktbuw->kbvw", creal.conj(A2), A2)
+
+    ohp = _block_onehot(p_idx, n_stations, R3.dtype)
+    ohq = _block_onehot(q_idx, n_stations, R3.dtype)
+    Dsum = (jnp.einsum("nb,kbuvz->knuvz", ohp, Sp)
+            + jnp.einsum("nb,kbuvz->knuvz", ohq, Sq))
+    return off, Dsum
+
+
+def _hessian_assemble(off, Dsum, n_stations, B, T):
+    """Placement tail shared by the blocked and sharded Hessian paths:
+    off (K, B, 4, 4, 2) global off-diagonal block table (zero rows where
+    this caller holds no baseline), Dsum (K, N, 2, 2, 2) station sums.
+    Returns (K, 4N, 4N, 2) normalized by the GLOBAL B*T."""
+    K = off.shape[0]
+    eye2 = jnp.eye(2, dtype=off.dtype)
+    diag_blocks = jnp.einsum("knjiz,uv->kniujvz", Dsum, eye2).reshape(
+        K, n_stations, 4, 4, 2)
+
+    idx = jnp.asarray(offdiag_index_map(n_stations))
+    off_pad = jnp.concatenate(
+        [off, jnp.zeros((K, 1, 4, 4, 2), off.dtype)], axis=1)
+    herm_pad = creal.conj(jnp.swapaxes(off_pad, -3, -2))
+    Hup = off_pad[:, idx]
+    Hlow = herm_pad[:, idx.T]
+    eyeN = jnp.eye(n_stations, dtype=off.dtype)
+    Hd = jnp.einsum("nm,knijz->knmijz", eyeN, diag_blocks)
+    H = jnp.swapaxes(Hup + Hlow + Hd, 2, 3)
+    N4 = 4 * n_stations
+    return H.reshape(K, N4, N4, 2) / (B * T)
+
+
+def _hessian_res_core_blocked_sr(R3, C5, Jp, Jq, n_stations,
+                                 block_baselines):
+    """Blocked :func:`_hessian_res_core_sr` on the same hoisted per-chunk
+    operands: a ``lax.scan`` over baseline blocks bounds the big einsum
+    temporaries to the block size.  Same math to float round-off (the
+    block scan reassociates the station sums; parity tested)."""
+    from jax import lax
+
+    K, T, B = C5.shape[0], C5.shape[1], C5.shape[2]
+    p_idx, q_idx = baseline_indices(n_stations)
+    blk = min(int(block_baselines), B)
+    nblk = -(-B // blk)
+    padb = nblk * blk - B
+
+    def pad_b(x, axis):
+        pw = [(0, 0)] * x.ndim
+        pw[axis] = (0, padb)
+        return jnp.pad(x, pw)
+
+    # sentinel station index for pad slots -> all-zero one-hot columns;
+    # the zero-padded C5/Jones blocks make every other pad contribution 0
+    pi = jnp.concatenate([p_idx, jnp.full((padb,), n_stations,
+                                          p_idx.dtype)])
+    qi = jnp.concatenate([q_idx, jnp.full((padb,), n_stations,
+                                          q_idx.dtype)])
+    R3b = jnp.moveaxis(pad_b(R3, 1).reshape(T, nblk, blk, 2, 2, 2), 1, 0)
+    C5b = jnp.moveaxis(pad_b(C5, 2).reshape(K, T, nblk, blk, 2, 2, 2),
+                       2, 0)
+    Jpb = jnp.moveaxis(pad_b(Jp, 1).reshape(K, nblk, blk, 2, 2, 2), 1, 0)
+    Jqb = jnp.moveaxis(pad_b(Jq, 1).reshape(K, nblk, blk, 2, 2, 2), 1, 0)
+    pib = pi.reshape(nblk, blk)
+    qib = qi.reshape(nblk, blk)
+
+    def body(dsum, xs):
+        r3, c5, jp, jq, pidx, qidx = xs
+        off_b, dsum_b = _hessian_block_sums(r3, c5, jp, jq, pidx, qidx,
+                                            n_stations)
+        return dsum + dsum_b, off_b
+
+    dsum0 = jnp.zeros((K, n_stations, 2, 2, 2), R3.dtype)
+    Dsum, off_blocks = lax.scan(body, dsum0,
+                                (R3b, C5b, Jpb, Jqb, pib, qib))
+    off = jnp.moveaxis(off_blocks, 0, 1).reshape(
+        K, nblk * blk, 4, 4, 2)[:, :B]
+    return _hessian_assemble(off, Dsum, n_stations, B, T)
 
 
 # ---------------------------------------------------------------------------
@@ -394,25 +501,28 @@ def dresiduals_colmeans_sr(Cs, Js, n_stations, dJs, addself=True,
     G = jnp.swapaxes(G, 0, 1)                           # (K, N, i, j, 2)
 
     dJ6 = dJs.reshape(8, K, 2, n_stations, 2, B, 2)     # (r,k,j,n,u,c,2)
+    # float normalizers: int B^2 T overflows int32 at N >= 256
+    bbt = float(B) * B * T
+    bb = float(B) * B
     if perdir:
         out = creal.einsum("knij,rkjnuc->rkiuc", G, dJ6)
-        out = out.reshape(8, K, 4, B, 2) / (B * B * T)
+        out = out.reshape(8, K, 4, B, 2) / bbt
         if addself:
             # dense path: dR[r, k, 4b + r//2, b, r%2] += T (then /(B*T));
             # each column has exactly one contributing row -> mean adds 1/B^2
-            sel = _selfterm() / (B * B)                 # (8, 4, 2)
+            sel = _selfterm() / bb                      # (8, 4, 2)
             out = out + sel[:, None, :, None, :]
     else:
         out = creal.einsum("knij,rkjnuc->riuc", G, dJ6)
-        out = out.reshape(8, 4, B, 2) / (B * B * T)
+        out = out.reshape(8, 4, B, 2) / bbt
         if addself:
-            sel = _selfterm() * K / (B * B)
+            sel = _selfterm() * K / bb
             out = out + sel[:, :, None, :]
     return out
 
 
 def _colmeans_adjoint_core_sr(lhs, Dgs, p_idx, n_stations, T,
-                              addself, perdir):
+                              addself, perdir, contract_dtype=None):
     """Adjoint-form Dsolutions -> Dresiduals column means on the PRE-BUILT
     shared lhs blocks (``lhs = Jq Csum^H``, (K, B, 2, 2, 2)).
 
@@ -436,16 +546,35 @@ def _colmeans_adjoint_core_sr(lhs, Dgs, p_idx, n_stations, T,
 
     The Dresiduals lhs shares the Dsolutions lhs: ``-(Csum Jq^H)^T =
     -conj(Jq Csum^H)`` — one einsum where the oracle chain computes two.
+
+    ``contract_dtype`` (cal/precision.py ``colmeans_contract`` row):
+    narrows the OPERANDS of the final Yr x Lr gather-einsum — the one
+    big per-baseline contraction, linear in both operands and
+    downstream of the (always-f32) transpose solve — with f32
+    accumulation.  None/f32 is bit-identical to the pre-policy kernel.
     """
     N = n_stations
     B = lhs.shape[1]
-    K = lhs.shape[0]
-    dtype = lhs.dtype
-    onehot_p = jnp.asarray(baseline_onehots(N, dtype)[0])
+    onehot_p = jnp.asarray(baseline_onehots(N, lhs.dtype)[0])
 
     # G[k, n, i, j] = sum over baselines b with p(b) = n of the
     # Dresiduals lhs -conj(lhs)[k, b, i, j]  (one-hot matmul, no scatter)
     G = jnp.einsum("nb,kbijz->knijz", onehot_p, -creal.conj(lhs))
+    return _colmeans_from_g(G, lhs, Dgs, p_idx, N, T, B, addself, perdir,
+                            contract_dtype)
+
+
+def _colmeans_from_g(G, lhs, Dgs, p_idx, n_stations, T, B, addself,
+                     perdir, contract_dtype):
+    """G -> column means: the ONE copy of the W build, the
+    eps-regularized 4-RHS transpose solve, and the Yr x Lr gather tail,
+    shared by the single-device core and the baseline-sharded path
+    (which differ only in how the per-station sum G was formed —
+    locally vs psummed).  ``lhs``/``p_idx`` may cover a SUBSET of
+    baselines; ``B`` is always the GLOBAL count."""
+    N = n_stations
+    K = lhs.shape[0]
+    dtype = lhs.dtype
     # W[k, row(j, n, u'), (i, u)] = G[k, n, i, j] delta_{u, u'}
     eye2 = jnp.eye(2, dtype=dtype)
     W = jnp.einsum("knijz,vu->kjnviuz", G, eye2)
@@ -458,30 +587,86 @@ def _colmeans_adjoint_core_sr(lhs, Dgs, p_idx, n_stations, T,
         return creal.solve(jnp.swapaxes(A, 0, 1), w_k)   # A^T y = w
 
     Y = jax.vmap(solve_k)(Dgs, W)                        # (K, 4N, 4, 2)
+    return _colmeans_from_y(Y, lhs, p_idx, N, T, B, K, addself, perdir,
+                            contract_dtype)
+
+
+def _colmeans_from_y(Y, lhs, p_idx, n_stations, T, B, K, addself, perdir,
+                     contract_dtype=None):
+    """Post-solve tail of the adjoint column means: gather the transpose
+    solutions at the (possibly shard-local) baseline stations and
+    contract against the lhs blocks.  ``lhs``/``p_idx`` may cover a
+    SUBSET of baselines (the baseline-sharded path); ``B`` is always the
+    GLOBAL baseline count (the normalization and addself factors)."""
+    N = n_stations
+    # float normalizers: the int products overflow int32 at SKA scale
+    # (B^2 T ~ 1.1e10 at N=256) before the weak-typed f32 conversion —
+    # same f32 value as the int path at every pre-r13 scale (exact in
+    # f64, then rounded identically)
+    bbt = float(B) * B * T
+    bb = float(B) * B
     Y6 = Y.reshape(K, 2, N, 2, 4, 2)                     # (k,j,n,u',c,2)
-    Yr = Y6[:, :, p_idx][:, :, :, _V_OF_R]               # (k,j,B,r,c,2)
-    Lr = lhs[:, :, _J_OF_R]                              # (k,B,r,j,2)
+    Yr = Y6[:, :, p_idx][:, :, :, _V_OF_R]               # (k,j,b,r,c,2)
+    Lr = lhs[:, :, _J_OF_R]                              # (k,b,r,j,2)
     if perdir:
-        out = creal.einsum("kjbrc,kbrj->krcb", Yr, Lr)
-        out = jnp.moveaxis(out, 0, 1)                    # (8, K, 4, B, 2)
+        out = creal.einsum("kjbrc,kbrj->krcb", Yr, Lr,
+                           compute_dtype=contract_dtype)
+        out = jnp.moveaxis(out, 0, 1)                    # (8, K, 4, b, 2)
         out = jnp.where(_ODD_R[:, None, None, None, None],
-                        creal.mul_i(out), out) / (B * B * T)
+                        creal.mul_i(out), out) / bbt
         if addself:
-            sel = _selfterm() / (B * B)
+            sel = _selfterm() / bb
             out = out + sel[:, None, :, None, :]
     else:
-        out = creal.einsum("kjbrc,kbrj->rcb", Yr, Lr)    # (8, 4, B, 2)
+        out = creal.einsum("kjbrc,kbrj->rcb", Yr, Lr,    # (8, 4, b, 2)
+                           compute_dtype=contract_dtype)
         out = jnp.where(_ODD_R[:, None, None, None],
-                        creal.mul_i(out), out) / (B * B * T)
+                        creal.mul_i(out), out) / bbt
         if addself:
-            sel = _selfterm() * K / (B * B)
+            sel = _selfterm() * K / bb
             out = out + sel[:, :, None, :]
     return out
 
 
-@partial(jax.jit, static_argnames=("n_stations", "addself", "perdir"))
+def _colmeans_adjoint_bshard_sr(lhs_l, Dgs, p_idx_l, n_stations, T,
+                                b_total, addself, perdir, axis_name,
+                                contract_dtype=None):
+    """Baseline-SHARDED adjoint column means: ``lhs_l``/``p_idx_l`` are
+    this shard's local baseline slice, ``Dgs`` the (already psummed,
+    replicated) consensus-augmented Hessian.  The per-station sum G is
+    the ONE collective (the per-direction reduction); the small 4-RHS
+    transpose solve runs replicated on every shard; the final gather-
+    einsum is shard-local and the returned column means cover only the
+    local baselines (the caller's out_spec concatenates them back into
+    global baseline order)."""
+    N = n_stations
+    onehot_p = _block_onehot(p_idx_l, N, lhs_l.dtype)
+
+    G = jnp.einsum("nb,kbijz->knijz", onehot_p, -creal.conj(lhs_l))
+    G = jax.lax.psum(G, axis_name)       # per-direction station reduction
+    return _colmeans_from_g(G, lhs_l, Dgs, p_idx_l, N, T, b_total,
+                            addself, perdir, contract_dtype)
+
+
+def _llr_bshard_sr(R3l, C5l, Jpl, Jql, axis_name):
+    """Baseline-sharded :func:`_llr_core_sr`: the three norms are local
+    partial sums psummed over the shard axis — same math as the local
+    core on the concatenated operands (addition reassociated)."""
+    tmp = creal.einsum("kbuv,ktbvw->ktbuw", Jpl, C5l)
+    mu = creal.einsum("ktbuw,kbxw->ktbux", tmp, creal.conj(Jql))
+
+    sV = 0.5 * (R3l[..., 0, 1, :] - R3l[..., 1, 0, :])
+    sigma2 = jax.lax.psum(jnp.sum(creal.abs2(sV)), axis_name)
+    rn2 = jax.lax.psum(jnp.sum(creal.abs2(R3l)), axis_name)
+    rpmu2 = jax.lax.psum(
+        jnp.sum(creal.abs2(R3l[None] + mu), axis=(1, 2, 3, 4)), axis_name)
+    return (rpmu2 - rn2) / (sigma2 + EPS_DIV)
+
+
+@partial(jax.jit, static_argnames=("n_stations", "addself", "perdir",
+                                   "precision"))
 def influence_colmeans_opt_sr(Cs, Js, n_stations, Dgs, addself=False,
-                              perdir=False):
+                              perdir=False, precision="f32"):
     """Fused Dsolutions -> Dresiduals column means (8, 4, B, 2) — or
     (8, K, 4, B, 2) when ``perdir`` — straight from the coherencies,
     Jones solutions, and the (consensus-augmented) Hessian ``Dgs``.
@@ -490,7 +675,11 @@ def influence_colmeans_opt_sr(Cs, Js, n_stations, Dgs, addself=False,
     :func:`_colmeans_adjoint_core_sr`) replaces the oracle chain's
     8B-column solve with a 4-column transpose solve and drops both the
     AdV RHS and the dJ tensor.  ``dsolutions_all_sr`` +
-    ``dresiduals_colmeans_sr`` are retained as the parity oracles."""
+    ``dresiduals_colmeans_sr`` are retained as the parity oracles.
+
+    ``precision`` (static, cal/precision.py): "bf16" narrows the final
+    gather-einsum operands under the ``colmeans_contract`` policy row
+    (the transpose solve stays pinned f32 under every policy)."""
     B = n_stations * (n_stations - 1) // 2
     K = Cs.shape[0]
     T = Cs.shape[1] // B
@@ -499,8 +688,10 @@ def influence_colmeans_opt_sr(Cs, Js, n_stations, Dgs, addself=False,
     J4 = _jones_blocks_sr(Js, n_stations)
     p_idx, q_idx = baseline_indices(n_stations)
     lhs = creal.einsum("kbuv,kbwv->kbuw", J4[:, q_idx], creal.conj(Csum))
-    return _colmeans_adjoint_core_sr(lhs, Dgs, p_idx, n_stations, T,
-                                     addself, perdir)
+    dt = _precision.contraction_dtype("colmeans_contract", precision)
+    return _colmeans_adjoint_core_sr(
+        lhs, Dgs, p_idx, n_stations, T, addself, perdir,
+        contract_dtype=None if dt == _precision.F32 else dt)
 
 
 @partial(jax.jit, static_argnames=("n_stations", "addself"))

@@ -1,0 +1,97 @@
+"""bf16/f32 mixed-precision policy for the physics kernels.
+
+SKA-scale episodes (N>=256 stations, B~N^2 baselines, npix>=1024) are
+bandwidth-bound on the big contractions; bf16 operands halve the HBM
+traffic and double the MXU peak on every validated TPU generation.  But
+the calibration chain is NOT uniformly bf16-safe: the ADMM/L-BFGS solve
+and the 4Nx4N per-direction Hessian factorizations carry conditioning
+constants (EPS_SINGULAR = 1e-12, the quartic line-search cancellation
+fix of PR 1) that sit far below bf16's ~3e-3 relative resolution, while
+the post-solve LINEAR contractions (the adjoint column-means gather, the
+DFT imager matmuls) degrade gracefully — an O(eps_bf16) relative error
+on quantities the envs only consume through image statistics.
+
+So precision is a PER-KERNEL policy, not a global switch, and the policy
+is decided by the retained parity oracles, not by assumption: every
+kernel listed bf16-capable below has a tier-1 test measuring it against
+its f32/XLA oracle within the documented tolerance, and every pinned
+kernel has a bit-exactness test proving ``precision="bf16"`` does not
+touch it (tests/test_nscale_kernels.py).  The measured outcomes that set
+this table:
+
+* ``imager_matmul`` — the factored DFT image is a mean over R>=1e4
+  visibilities; bf16 operand rounding is zero-mean and the accumulation
+  stays f32 (``preferred_element_type``), so image parity holds to ~1e-2
+  relative of the image DYNAMIC RANGE (tested) and sigma(img), the env
+  observation, to ~1e-2 relative.
+* ``colmeans_contract`` — the final Yr x Lr gather-einsum of the adjoint
+  influence chain is linear in both operands, downstream of the pinned
+  f32 solve; per-element relative error is O(3e-3) (tested vs the f32
+  chain).
+* ``hessian`` / ``solve_4n`` / ``admm`` — PINNED f32.  Measured: a bf16
+  Hessian perturbs the (Dgrad + 1e-12 I)^{-1} factorization at the
+  percent level and the ADMM consensus path amplifies it across
+  iterations; sigma_res parity vs the host-loop oracle fails the 1e-3
+  band the solver tests hold today.  These stay f32 under every policy.
+
+``precision`` is python-STATIC everywhere (same contract as
+``optimized=``/``fused=``; enforced by graftlint's traced-static-flag
+rule): each value selects a trace, so it must be a host string decided
+before tracing.
+
+This module is the ONE place dtype literals are chosen for the policied
+kernel modules (cal/imager.py, cal/influence.py, cal/kernels.py,
+ops/pallas_imager.py) — graftlint's ``dtype-discipline`` rule flags bare
+``jnp.float32``/``jnp.float64`` literals there, so pinned sites either
+route through these helpers or carry a ``# graftlint: disable=`` with
+the pinning reason.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: valid values of the static ``precision=`` argument
+POLICIES = ("f32", "bf16")
+
+#: the f32 dtype object the policied modules use for pinned sites
+#: (index/coordinate arrays, accumulators, solve operands)
+F32 = jnp.float32
+
+#: per-kernel dtype class under the mixed ("bf16") policy; "f32" rows
+#: are pinned — the policy never downgrades them (see module docstring
+#: for the measured reasons)
+KERNEL_DTYPES = {
+    "imager_matmul": "bf16",
+    "colmeans_contract": "bf16",
+    "hessian": "f32",
+    "solve_4n": "f32",
+    "admm": "f32",
+}
+
+
+def check(precision: str) -> str:
+    """Validate a ``precision=`` value (static; raises on unknowns so a
+    typo fails at the call site, not as a silent f32 run)."""
+    if precision not in POLICIES:
+        raise ValueError(
+            f"precision={precision!r}: expected one of {POLICIES}")
+    return precision
+
+
+def contraction_dtype(kernel: str, precision: str = "f32"):
+    """The OPERAND dtype for ``kernel``'s big contraction under
+    ``precision``.  Accumulation stays f32 at every call site
+    (``preferred_element_type=F32``); only the operand storage narrows.
+    Unknown kernel names are an error — a new kernel must take an
+    explicit policy row, not inherit one by accident."""
+    check(precision)
+    pinned = KERNEL_DTYPES[kernel]
+    if precision == "bf16" and pinned == "bf16":
+        return jnp.bfloat16
+    return F32
+
+
+def dtype_name(dtype) -> str:
+    """Short name for telemetry tags ("bf16"/"f32")."""
+    return "bf16" if dtype == jnp.bfloat16 else "f32"

@@ -54,6 +54,18 @@ from smartcal_tpu.cal import (coherency, imager, influence, observation,
 _WATCHDOG_WORK = 1e7
 _SHARD_MIN_WORK = 1e6
 
+# SKA-tier thresholds (ISSUE 13): above _BLOCK_MIN_B baselines (N=128 ->
+# B=8128) the influence chain's per-chunk (K, Td, B)-scale einsum
+# temporaries become the memory wall, so the blocked Hessian core and
+# (with a mesh) the baseline shard axis take over; npix >= _IMAGER_BLOCK
+# _MIN_NPIX swaps the factored imager for its R-blocked twin (the
+# (npix, R) planes are ~2.7 GB each at npix=1024 x N=256).  Block sizes
+# keep the per-block live set in the tens-of-MB band on every backend.
+_BLOCK_MIN_B = 8128
+_BLOCK_BASELINES = 2048
+_IMAGER_BLOCK_MIN_NPIX = 512
+_IMAGER_BLOCK_R = 4096
+
 # donated-carry image accumulator for the host-segmented influence route:
 # band f's running sum is donated into band f+1's add, so the per-band
 # loop holds ONE image buffer on the device (no-op on CPU, where buffer
@@ -124,13 +136,24 @@ class RadioBackend:
         enough to amortize the collectives (_SHARD_MIN_WORK); True
         forces sharding whenever a divisible mesh exists; False never
         shards.  SMARTCAL_SHARD=0/1 overrides.
+    precision : "f32" | "bf16" (static) — the cal/precision.py policy
+        for the influence/imaging chain; the solve is policy-pinned f32
+        either way.  Parity-gated: every bf16-capable kernel is tested
+        against its f32 oracle within a documented tolerance.
+    block_baselines / imager_block_r : blocked-kernel block sizes
+        (None = auto by threshold — blocked Hessian at B >= 8128,
+        R-blocked imager at npix >= 512; 0 = force-unblocked).  With a
+        mesh and B >= the same threshold, ``influence_image`` routes
+        baseline-SHARDED first (the axis that makes SKA-scale episodes
+        fit).
     """
 
     def __init__(self, n_stations=14, n_freqs=3, n_times=20, tdelta=10,
                  n_poly=2, admm_iters=10, lbfgs_iters=8, init_iters=30,
                  polytype=0, npix=128, hint_batch=8, vectorized=True,
                  shard="auto", robust_solver=True, solver_max_retries=2,
-                 solver_rho_boost=10.0):
+                 solver_rho_boost=10.0, precision="f32",
+                 block_baselines=None, imager_block_r=None):
         if n_times <= 0 or n_times % tdelta != 0:
             raise ValueError(
                 f"n_times={n_times} must be a positive multiple of "
@@ -163,6 +186,18 @@ class RadioBackend:
         self.robust_solver = robust_solver
         self.solver_max_retries = solver_max_retries
         self.solver_rho_boost = solver_rho_boost
+        # SKA-tier knobs (python-STATIC — each value selects a trace):
+        # precision in {"f32", "bf16"} picks the mixed-precision policy
+        # (cal/precision.py; the policy itself pins the solve/Hessian to
+        # f32, so "bf16" narrows only the oracle-validated contractions);
+        # block_baselines / imager_block_r override the blocked-kernel
+        # block sizes (None = auto by the _BLOCK_* / _IMAGER_BLOCK_*
+        # thresholds, 0 = force-unblocked).
+        from smartcal_tpu.cal import precision as _prec
+
+        self.precision = _prec.check(precision)
+        self.block_baselines = block_baselines
+        self.imager_block_r = imager_block_r
         self._sweep_fns = {}     # (n_dirs, n_masks, batch) -> jitted sweep
         self._batched_fns = {}   # (kind, shape sig) -> jitted batched prog
         self._meshes = {}        # axis size -> cached 1D mesh
@@ -423,6 +458,12 @@ class RadioBackend:
         watchdogs — solver.solve_admm_host).  Under a jax trace (the
         vmapped hint sweep) the fused path is the only legal one and is
         kept.
+
+        Precision: the solve runs f32 under EVERY backend ``precision``
+        — the ``admm``/``hessian``/``solve_4n`` policy rows are pinned
+        (cal/precision.py; measured — bf16 there fails the sigma_res
+        parity band), so ``precision="bf16"`` affects only the
+        influence/imaging chain.
         """
         C = ep.Ccal
         if mask is not None:
@@ -614,6 +655,25 @@ class RadioBackend:
                   jnp.asarray(rho, jnp.float32), masks,
                   jnp.asarray(iters))
 
+    @property
+    def n_baselines(self):
+        return self.n_stations * (self.n_stations - 1) // 2
+
+    def _influence_statics(self, npix):
+        """The SKA-tier static kwargs of the influence chain, decided on
+        the HOST from the episode geometry (python-static by contract):
+        blocked Hessian above the baseline threshold, blocked imager
+        above the npix threshold, and the backend's precision policy."""
+        bb = self.block_baselines
+        if bb is None:
+            bb = _BLOCK_BASELINES if self.n_baselines >= _BLOCK_MIN_B \
+                else 0
+        ibr = self.imager_block_r
+        if ibr is None:
+            ibr = _IMAGER_BLOCK_R if npix >= _IMAGER_BLOCK_MIN_NPIX else 0
+        return {"block_baselines": bb, "imager_block_r": ibr,
+                "precision": self.precision}
+
     def influence_image(self, ep: Episode, result: solver.SolveResult,
                         rho, rho_spatial, npix=None):
         """Mean influence dirty image over sub-bands (doinfluence.sh role).
@@ -657,6 +717,21 @@ class RadioBackend:
         # scale, and a backend big enough to shard the ADMM is big enough
         # to shard the influence fan-out
         work = self._fused_work()
+        statics = self._influence_statics(npix)
+        # baseline shard axis first at SKA scale: above the blocked
+        # threshold the per-baseline tensors are the memory wall, and
+        # partitioning B is what makes an N >= 256 episode FIT — the
+        # frequency fan-out merely speeds it up
+        if self.n_baselines >= _BLOCK_MIN_B:
+            nbp = self._shard_size(self.n_baselines, work)
+            if nbp:
+                sp.tag(route="baseline_sharded", shards=nbp)
+                out = self._influence_image_baseline_sharded(
+                    ep, result, hadd_all, uvw, cell, npix, nbp, statics)
+                self._record_influence_cost(result, ep, hadd_all, uvw,
+                                            cell, npix, statics,
+                                            shards=nbp)
+                return out
         nfp = self._shard_size(self.n_freqs, work)
         if nfp:
             from smartcal_tpu.parallel import sharded_cal
@@ -665,17 +740,17 @@ class RadioBackend:
             out = sharded_cal.influence_images_sharded(
                 self._mesh(nfp), result.residual, ep.Ccal, result.J,
                 hadd_all, ep.obs.freqs, uvw, cell, self.n_stations,
-                self.n_chunks, npix)
+                self.n_chunks, npix, **statics)
             self._record_influence_cost(result, ep, hadd_all, uvw, cell,
-                                        npix)
+                                        npix, statics, shards=nfp)
             return out
         nsp = self._shard_size(self.n_chunks, work)
         if nsp:
             sp.tag(route="chunk_sharded", shards=nsp)
             out = self._influence_image_chunk_sharded(
-                ep, result, hadd_all, uvw, cell, npix, nsp)
+                ep, result, hadd_all, uvw, cell, npix, nsp, statics)
             self._record_influence_cost(result, ep, hadd_all, uvw, cell,
-                                        npix)
+                                        npix, statics, shards=nsp)
             return out
         if self._use_host_solver():
             # single device at watchdog scale: same proxy as the solve —
@@ -684,31 +759,43 @@ class RadioBackend:
             # host-loop double-buffered)
             sp.tag(route="host_segmented", bands=self.n_freqs)
             return self._influence_image_host_segmented(
-                ep, result, hadd_all, uvw, cell, npix)
+                ep, result, hadd_all, uvw, cell, npix, statics)
         sp.tag(route="vectorized")
         imgs = influence.influence_images_multi(
             result.residual, ep.Ccal, result.J, hadd_all, ep.obs.freqs,
-            uvw, cell, self.n_stations, self.n_chunks, npix)
-        self._record_influence_cost(result, ep, hadd_all, uvw, cell, npix)
+            uvw, cell, self.n_stations, self.n_chunks, npix, **statics)
+        self._record_influence_cost(result, ep, hadd_all, uvw, cell, npix,
+                                    statics)
         return jnp.mean(imgs, axis=0)
 
-    def _record_influence_cost(self, result, ep, hadd_all, uvw, cell, npix):
+    def _record_influence_cost(self, result, ep, hadd_all, uvw, cell, npix,
+                               statics=None, shards=1):
         """Deferred cost-analysis event for the influence stage, shared by
-        the vectorized and BOTH sharded routes: shard_map programs don't
+        the vectorized and ALL sharded routes: shard_map programs don't
         AOT-lower through record_stage_cost's plain-args contract, so the
         sharded routes account the fused single-device equivalent — the
-        same math (the shard only adds the mean's psum), hence the right
-        TOTAL stage flops for the roofline table."""
+        same math (the shard only adds the reductions' psums), hence the
+        right TOTAL stage flops for the roofline table.  ``shards``
+        divides the footprint fields (obs/costs.py): per-device peak
+        live bytes under the sharded routes."""
+        statics = statics or {}
+        from smartcal_tpu.cal import precision as _prec
+
         obs_costs.record_stage_cost(
             "influence", influence.influence_images_multi,
             result.residual, ep.Ccal, result.J, hadd_all, ep.obs.freqs,
-            uvw, static_argnames=("cell", "n_stations", "n_chunks", "npix"),
+            uvw, static_argnames=("cell", "n_stations", "n_chunks", "npix",
+                                  "block_baselines", "imager_block_r",
+                                  "precision"),
             defer=True,              # inside the influence span
+            shards=shards,
+            compute_dtype=_prec.dtype_name(_prec.contraction_dtype(
+                "imager_matmul", statics.get("precision", "f32"))),
             cell=cell, n_stations=self.n_stations, n_chunks=self.n_chunks,
-            npix=npix)
+            npix=npix, **statics)
 
     def _influence_image_host_segmented(self, ep, result, hadd_all, uvw,
-                                        cell, npix):
+                                        cell, npix, statics=None):
         """Per-sub-band influence images as bounded device dispatches
         (cal/influence.influence_image_single_sr), double-buffered by
         JAX's async dispatch: band f+1's program is enqueued while band
@@ -716,29 +803,38 @@ class RadioBackend:
         image sum is a DONATED carry (``_img_acc``), so on accelerators
         each band's accumulation reuses the previous buffer instead of
         allocating Nf images."""
-        freqs_arr = jnp.asarray(np.asarray(ep.obs.freqs), jnp.float32)
+        from smartcal_tpu.cal import precision as _prec
+
+        statics = statics if statics is not None \
+            else self._influence_statics(npix)
+        freqs_arr = jnp.asarray(np.asarray(ep.obs.freqs), _prec.F32)
         acc = None
         for fi in range(self.n_freqs):
             img = influence.influence_image_single_sr(
                 result.residual[fi], ep.Ccal[fi], result.J[fi],
                 hadd_all[fi], freqs_arr[fi], uvw, cell,
                 n_stations=self.n_stations, n_chunks=self.n_chunks,
-                npix=npix)
+                npix=npix, **statics)
             acc = img if acc is None else _img_acc(acc, img)
         obs_costs.record_stage_cost(
             "influence", influence.influence_image_single_sr,
             result.residual[0], ep.Ccal[0], result.J[0], hadd_all[0],
             freqs_arr[0], uvw, cell, defer=True,  # inside the span
-            n_stations=self.n_stations, n_chunks=self.n_chunks, npix=npix)
+            compute_dtype=_prec.dtype_name(_prec.contraction_dtype(
+                "imager_matmul", statics.get("precision", "f32"))),
+            n_stations=self.n_stations, n_chunks=self.n_chunks, npix=npix,
+            **statics)
         return acc / self.n_freqs
 
     def _influence_image_chunk_sharded(self, ep, result, hadd_all, uvw,
-                                       cell, npix, nsp):
+                                       cell, npix, nsp, statics=None):
         """Per-band influence with the calibration-interval axis sharded
         (sharded_cal.influence_sharded); used when Nf has no usable
         divisor but n_chunks does."""
         from smartcal_tpu.parallel import sharded_cal
 
+        statics = statics if statics is not None \
+            else self._influence_statics(npix)
         mesh = self._mesh(nsp)
         freqs = np.asarray(ep.obs.freqs)
         imgs = []
@@ -746,11 +842,55 @@ class RadioBackend:
             Rk = solver.residual_to_kernel(result.residual[fi])
             inf = sharded_cal.influence_sharded(
                 mesh, Rk, ep.Ccal[fi], result.J[fi], hadd_all[fi],
-                self.n_stations, self.n_chunks, axis="fp")
+                self.n_stations, self.n_chunks, axis="fp",
+                block_baselines=statics["block_baselines"],
+                precision=statics.get("precision", "f32"))
             ivis = influence.stokes_i_influence(inf.vis)
-            imgs.append(imager.dirty_image_factored_sr(uvw, ivis,
-                                                       float(freqs[fi]),
-                                                       cell, npix=npix))
+            imgs.append(self._image_ivis(uvw, ivis, float(freqs[fi]),
+                                         cell, npix, statics))
+        return jnp.mean(jnp.stack(imgs), axis=0)
+
+    def _image_ivis(self, uvw, ivis, freq, cell, npix, statics):
+        """Factored DFT image of one band's influence visibilities with
+        the SKA-tier statics applied (blocked imager above the npix
+        threshold, precision policy).  Runs OUTSIDE the shard_map (the
+        vis are already gathered), so the large-tier dispatch may pick
+        the Pallas tile kernel on TPU."""
+        if statics.get("imager_block_r"):
+            return imager.dirty_image_factored_large_sr(
+                uvw, ivis, freq, cell, npix=npix,
+                block_r=statics["imager_block_r"],
+                precision=statics.get("precision", "f32"))
+        return imager.dirty_image_factored_sr(
+            uvw, ivis, freq, cell, npix=npix,
+            precision=statics.get("precision", "f32"))
+
+    def _influence_image_baseline_sharded(self, ep, result, hadd_all, uvw,
+                                          cell, npix, nbp, statics):
+        """Per-band influence with the BASELINE axis sharded
+        (sharded_cal.influence_baseline_sharded) — the SKA-scale route:
+        the (B, ...) residual/coherency/lhs tensors and every
+        per-baseline einsum temporary partition across the mesh, so an
+        N >= 256 episode's influence chain fits where the unsharded
+        chain is footprint-bounded.  The mesh is the backend's generic
+        1D mesh, whose single axis is NAMED "fp" (the historical
+        routing name) — here it plays the baseline-partition ROLE; the
+        "bp" default of influence_baseline_sharded is just the name
+        tests/standalone callers use for their own meshes."""
+        from smartcal_tpu.parallel import sharded_cal
+
+        mesh = self._mesh(nbp)
+        freqs = np.asarray(ep.obs.freqs)
+        imgs = []
+        for fi in range(self.n_freqs):
+            Rk = solver.residual_to_kernel(result.residual[fi])
+            inf = sharded_cal.influence_baseline_sharded(
+                mesh, Rk, ep.Ccal[fi], result.J[fi], hadd_all[fi],
+                self.n_stations, self.n_chunks, axis="fp",
+                precision=statics.get("precision", "f32"))
+            ivis = influence.stokes_i_influence(inf.vis)
+            imgs.append(self._image_ivis(uvw, ivis, float(freqs[fi]),
+                                         cell, npix, statics))
         return jnp.mean(jnp.stack(imgs), axis=0)
 
     def _influence_image_loop(self, ep, result, rho, rho_spatial, npix):
@@ -923,7 +1063,9 @@ class RadioBackend:
                       jnp.asarray(bep.f0, jnp.float32), rho, masks, iters)
 
     def _batched_influence_fn(self, n_dirs, n_lanes, npix):
-        key = ("influence", n_dirs, n_lanes, npix)
+        statics = self._influence_statics(npix)
+        key = ("influence", n_dirs, n_lanes, npix,
+               tuple(sorted(statics.items())))
         fn = self._batched_fns.get(key)
         if fn is not None:
             return fn
@@ -934,7 +1076,8 @@ class RadioBackend:
             hadd = influence.consensus_hadd_all(
                 r, a, f, f0_, n_poly=n_poly, polytype=polytype)
             imgs = influence.influence_images_multi(
-                res, c, j, hadd, f, u, cl, n_stations, n_chunks, npix)
+                res, c, j, hadd, f, u, cl, n_stations, n_chunks, npix,
+                **statics)
             return jnp.mean(imgs, axis=0)
 
         fn = jax.jit(jax.vmap(one))

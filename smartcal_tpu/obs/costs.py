@@ -104,17 +104,51 @@ def stage_cost(fn: Callable, *args: object,
     if isinstance(ca, (list, tuple)):        # older jax returns [dict]
         ca = ca[0] if ca else {}
     ca = ca or {}
-    return {"flops": float(ca.get("flops", 0.0) or 0.0),
-            "bytes_accessed": float(ca.get("bytes accessed", 0.0) or 0.0)}
+    out = {"flops": float(ca.get("flops", 0.0) or 0.0),
+           "bytes_accessed": float(ca.get("bytes accessed", 0.0) or 0.0)}
+    out.update(_memory_footprint(compiled))
+    return out
 
 
-def _compute_and_log(stage, fn, args, static_argnames, kwargs) -> dict:
+def _memory_footprint(compiled) -> dict:
+    """Peak-live-bytes accounting from the compiled executable's
+    ``memory_analysis()``: argument + output + XLA temp (minus aliased
+    donation reuse) is the executable's peak live set — the quantity the
+    N-scaling report bounds per device.  Degrades to {} on backends/
+    versions without the API (the cost event then simply has no
+    footprint fields; tools/obs_report.py prints dashes)."""
+    try:
+        ma = compiled.memory_analysis()
+        arg = float(ma.argument_size_in_bytes)
+        out_b = float(ma.output_size_in_bytes)
+        tmp = float(ma.temp_size_in_bytes)
+        alias = float(getattr(ma, "alias_size_in_bytes", 0.0) or 0.0)
+    except Exception:  # noqa: BLE001 — footprint is best-effort extra
+        return {}
+    return {"arg_bytes": arg, "out_bytes": out_b, "temp_bytes": tmp,
+            "peak_bytes": arg + out_b + tmp - alias}
+
+
+def _compute_and_log(stage, fn, args, static_argnames, kwargs,
+                     shards=1, compute_dtype=None) -> dict:
     rl = active()
     try:
         cost = stage_cost(fn, *args, static_argnames=static_argnames,
                           **kwargs)
     except Exception as e:  # noqa: BLE001 — never kill the observed run
         cost = {"error": f"{type(e).__name__}: {e}"}
+    if shards and shards > 1 and "peak_bytes" in cost:
+        # sharding-aware division: the lowered program is the fused
+        # single-device equivalent (shard_map programs don't AOT-lower
+        # through the plain-args contract), so the per-DEVICE peak under
+        # an n-way shard is the fused peak / n — the big (B, ...)/(Nf,
+        # ...) operands and temporaries partition, and the replicated
+        # leftovers (4N x 4N solves, images) are a rounding error at the
+        # scales where sharding is on.  Both numbers are logged.
+        cost = dict(cost, shards=int(shards),
+                    peak_bytes_per_shard=cost["peak_bytes"] / shards)
+    if compute_dtype is not None:
+        cost = dict(cost, compute_dtype=str(compute_dtype))
     if rl is not None:
         rl.log("cost", stage=stage, **cost)
     return cost
@@ -122,7 +156,8 @@ def _compute_and_log(stage, fn, args, static_argnames, kwargs) -> dict:
 
 def record_stage_cost(stage: str, fn: Callable, *args: object,
                       static_argnames: Sequence[str] = (),
-                      defer: bool = False,
+                      defer: bool = False, shards: int = 1,
+                      compute_dtype: Optional[str] = None,
                       **kwargs: object) -> Optional[dict]:
     """Log the ``cost`` event for ``stage`` once per abstract signature.
 
@@ -133,6 +168,12 @@ def record_stage_cost(stage: str, fn: Callable, *args: object,
     timed span) queues the lower+compile for ``flush_pending()`` instead
     of paying it here.  Returns the cached cost dict or None (always
     None for a just-deferred signature).
+
+    ``shards``/``compute_dtype`` are ACCOUNTING metadata, never passed
+    to ``fn``: ``shards`` > 1 adds the sharding-aware footprint division
+    (``peak_bytes_per_shard``); ``compute_dtype`` tags the event with
+    the kernel's policy dtype ("bf16"/"f32") so the roofline report can
+    pick the matching device peak instead of assuming f32.
     """
     rl = active()
     if rl is None or not _enabled:
@@ -147,9 +188,10 @@ def record_stage_cost(stage: str, fn: Callable, *args: object,
         _cache[sig] = None               # claim: concurrent callers skip
         if defer:
             _pending.append((sig, stage, fn, args, static_argnames,
-                             kwargs))
+                             kwargs, shards, compute_dtype))
             return None
-    cost = _compute_and_log(stage, fn, args, static_argnames, kwargs)
+    cost = _compute_and_log(stage, fn, args, static_argnames, kwargs,
+                            shards, compute_dtype)
     with _lock:
         _cache[sig] = cost
     return cost
@@ -164,8 +206,10 @@ def flush_pending() -> int:
         with _lock:
             if not _pending:
                 return n
-            sig, stage, fn, args, statics, kwargs = _pending.pop(0)
-        cost = _compute_and_log(stage, fn, args, statics, kwargs)
+            (sig, stage, fn, args, statics, kwargs, shards,
+             compute_dtype) = _pending.pop(0)
+        cost = _compute_and_log(stage, fn, args, statics, kwargs, shards,
+                                compute_dtype)
         with _lock:
             _cache[sig] = cost
         n += 1

@@ -57,19 +57,19 @@ TILE_R = 256     # uv samples per tile; phase tile = 1024x256x4B = 1 MB
 
 def _imager_kernel(lm_ref, uvt_ref, vre_ref, vim_ref, out_ref):
     j = pl.program_id(1)
+    f32 = jnp.float32  # graftlint: disable=dtype-discipline -- direct-DFT kernel accumulates f32 by construction (pre-policy oracle tier); ops layers below cal so the policy helper can't be imported at kernel scope
     # (TILE_P, 2) @ (2, TILE_R) -> phase tile, never leaves VMEM
-    phase = jnp.dot(lm_ref[:], uvt_ref[:],
-                    preferred_element_type=jnp.float32)
+    phase = jnp.dot(lm_ref[:], uvt_ref[:], preferred_element_type=f32)
     # explicit range reduction: |phase| reaches ~1e3 rad at LOFAR uv
     # scales, where raw f32 trig approximations diverge visibly between
     # implementations (0.3% pallas-vs-XLA observed on a v5e); one mod-2pi
     # keeps the trig argument small at the cost of two VPU ops
-    two_pi = jnp.float32(2.0 * jnp.pi)
+    two_pi = f32(2.0 * jnp.pi)
     phase = phase - two_pi * jnp.round(phase / two_pi)
     acc = (jnp.dot(jnp.cos(phase), vre_ref[:],
-                   preferred_element_type=jnp.float32)
+                   preferred_element_type=f32)
            + jnp.dot(jnp.sin(phase), vim_ref[:],
-                     preferred_element_type=jnp.float32))   # (TILE_P, 1)
+                     preferred_element_type=f32))            # (TILE_P, 1)
     acc = acc.reshape(TILE_P // 128, 128)
 
     @pl.when(j == 0)
@@ -91,6 +91,7 @@ def dirty_image_pallas(uvw, vis, freq, cell, npix=128, interpret=False):
     phase value contributes nothing).
     """
     from smartcal_tpu.cal.imager import C_LIGHT, pixel_grid
+    from smartcal_tpu.cal import precision as prec
 
     P = npix * npix
     if P % TILE_P != 0:
@@ -99,13 +100,13 @@ def dirty_image_pallas(uvw, vis, freq, cell, npix=128, interpret=False):
                          "to the XLA path for unaligned sizes")
     R = uvw.shape[0]
     scale = 2.0 * jnp.pi * freq / C_LIGHT
-    uv = (uvw[:, :2] * scale).astype(jnp.float32)
-    lm = pixel_grid(npix, cell).astype(jnp.float32)          # (P, 2)
+    uv = (uvw[:, :2] * scale).astype(prec.F32)
+    lm = pixel_grid(npix, cell).astype(prec.F32)             # (P, 2)
 
     Rp = pl.cdiv(R, TILE_R) * TILE_R
-    uvt = jnp.zeros((2, Rp), jnp.float32).at[:, :R].set(uv.T)
-    vre = jnp.zeros((Rp, 1), jnp.float32).at[:R, 0].set(vis[:, 0])
-    vim = jnp.zeros((Rp, 1), jnp.float32).at[:R, 0].set(vis[:, 1])
+    uvt = jnp.zeros((2, Rp), prec.F32).at[:, :R].set(uv.T)
+    vre = jnp.zeros((Rp, 1), prec.F32).at[:R, 0].set(vis[:, 0])
+    vim = jnp.zeros((Rp, 1), prec.F32).at[:R, 0].set(vis[:, 1])
 
     grid = (P // TILE_P, Rp // TILE_R)
     out = pl.pallas_call(
@@ -123,10 +124,129 @@ def dirty_image_pallas(uvw, vis, freq, cell, npix=128, interpret=False):
         ],
         out_specs=pl.BlockSpec((TILE_P // 128, 128),
                                lambda i, j: (i, 0), memory_space=_VMEM),
-        out_shape=jax.ShapeDtypeStruct((P // 128, 128), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((P // 128, 128), prec.F32),
         interpret=interpret,
     )(lm, uvt, vre, vim)
     return out.reshape(npix, npix) / R
+
+
+# --------------------------------------------------------------------------
+# Tiled FACTORED imager: the npix >= 1024 / B ~ N^2 (SKA-scale) tier
+# --------------------------------------------------------------------------
+#
+# The rank-factored formulation (cal/imager.dirty_image_factored_sr) is
+# already transcendental-cheap, but its (npix, R) axis planes grow to
+# GB scale at npix=1024 x R~6.5e5 (N=256).  This kernel tiles BOTH the
+# pixel axes and the visibility (reduction) axis: each grid step builds
+# one (TILE_L, TILE_R) "a" tile and one (TILE_M, TILE_R) "b" tile in
+# VMEM, takes cos/sin in place, and reduces into a (TILE_L, TILE_M)
+# output tile on the MXU — the largest live buffer is a tile, never a
+# plane.  The R axis is the reduction: the output block index map
+# ignores the innermost grid coordinate (init at k == 0, accumulate
+# after — the same pattern as _imager_kernel above).
+#
+# The lax fallback with the identical blocking contract is
+# cal/imager.dirty_image_factored_blocked_sr (CPU/GPU and inside GSPMD
+# programs, where pallas_call has no partitioning rule); interpret=True
+# runs this kernel through the Pallas interpreter on CPU for the tier-1
+# parity tests.
+
+TILE_L = 128     # output rows per tile  -> (128, 128) output block
+TILE_M = 128     # output cols per tile
+TILE_FR = 256    # uv samples per tile: a/b tiles are 128x256x4B = 128 kB
+
+
+def _factored_kernel(dt, li_ref, mi_ref, u_ref, v_ref, vre_ref, vim_ref,
+                     out_ref):
+    k = pl.program_id(2)
+    f32 = jnp.float32  # graftlint: disable=dtype-discipline -- kernel accumulator dtype is pinned f32 by the imager_matmul policy row
+    # (TILE_L, 1) @ (1, TILE_R) phase-plane tiles, VMEM-resident
+    a = jnp.dot(li_ref[:], u_ref[:], preferred_element_type=f32)
+    b = jnp.dot(mi_ref[:], v_ref[:], preferred_element_type=f32)
+    # same explicit mod-2pi range reduction as _imager_kernel: |phase|
+    # reaches ~1e3 rad at LOFAR uv scales where raw f32 trig diverges
+    two_pi = f32(2.0 * jnp.pi)
+    a = a - two_pi * jnp.round(a / two_pi)
+    b = b - two_pi * jnp.round(b / two_pi)
+    ca, sa = jnp.cos(a), jnp.sin(a)
+    cb, sb = jnp.cos(b), jnp.sin(b)
+    vr, vi = vre_ref[:], vim_ref[:]            # (1, TILE_R)
+    p1 = ca * vr + sa * vi                     # (TILE_L, TILE_R)
+    p2 = ca * vi - sa * vr
+    if dt != f32:                              # mixed-precision operands,
+        p1, p2 = p1.astype(dt), p2.astype(dt)  # f32 accumulation (policy
+        cb, sb = cb.astype(dt), sb.astype(dt)  # row: imager_matmul)
+    # contract the shared TILE_R axis (rhs transposed in the dimension
+    # numbers — no explicit VMEM transpose)
+    dn = (((1,), (1,)), ((), ()))
+    acc = (jax.lax.dot_general(p1, cb, dn, preferred_element_type=f32)
+           + jax.lax.dot_general(p2, sb, dn, preferred_element_type=f32))
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[:] = acc
+
+    @pl.when(k != 0)
+    def _accum():
+        out_ref[:] += acc
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("npix", "precision", "interpret"))
+def dirty_image_factored_pallas(uvw, vis, freq, cell, npix=1024,
+                                precision="f32", interpret=False):
+    """Tiled Pallas version of
+    :func:`cal.imager.dirty_image_factored_blocked_sr` (same math, same
+    blocking contract; parity tested in interpret mode against the XLA
+    oracles).  Requires npix a multiple of TILE_L (128); R is zero-padded
+    to TILE_FR (padded vis rows are 0, so any phase contributes nothing).
+
+    ``precision`` (static, cal/precision.py ``imager_matmul`` row):
+    "bf16" narrows the reduction matmul operands with f32 accumulation.
+    """
+    from smartcal_tpu.cal import precision as prec
+    from smartcal_tpu.cal.imager import C_LIGHT
+
+    if npix % TILE_L != 0:
+        raise ValueError(
+            f"npix={npix}: must be a multiple of {TILE_L}; "
+            "cal.imager.dirty_image_factored_blocked_sr is the unaligned "
+            "fallback")
+    dt = prec.contraction_dtype("imager_matmul", precision)
+    R = uvw.shape[0]
+    scale = 2.0 * jnp.pi * freq / C_LIGHT
+    half = npix // 2
+    idx = ((jnp.arange(npix) - half).astype(prec.F32) * cell)[:, None]
+    Rp = pl.cdiv(R, TILE_FR) * TILE_FR
+    u = jnp.zeros((1, Rp), prec.F32).at[0, :R].set(uvw[:, 0] * scale)
+    v = jnp.zeros((1, Rp), prec.F32).at[0, :R].set(uvw[:, 1] * scale)
+    vre = jnp.zeros((1, Rp), prec.F32).at[0, :R].set(vis[:, 0])
+    vim = jnp.zeros((1, Rp), prec.F32).at[0, :R].set(vis[:, 1])
+
+    grid = (npix // TILE_L, npix // TILE_M, Rp // TILE_FR)
+    out = pl.pallas_call(
+        functools.partial(_factored_kernel, dt),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE_L, 1), lambda i, j, k: (i, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((TILE_M, 1), lambda i, j, k: (j, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, TILE_FR), lambda i, j, k: (0, k),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, TILE_FR), lambda i, j, k: (0, k),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, TILE_FR), lambda i, j, k: (0, k),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, TILE_FR), lambda i, j, k: (0, k),
+                         memory_space=_VMEM),
+        ],
+        out_specs=pl.BlockSpec((TILE_L, TILE_M), lambda i, j, k: (i, j),
+                               memory_space=_VMEM),
+        out_shape=jax.ShapeDtypeStruct((npix, npix), prec.F32),
+        interpret=interpret,
+    )(idx, idx, u, v, vre, vim)
+    return out / R
 
 
 def pallas_available() -> bool:

@@ -32,6 +32,10 @@ except ImportError:                   # older pins: experimental module
 from ..cal import influence as influence_mod
 from ..cal import solver
 
+# jitted baseline-sharded influence programs, keyed on (mesh, statics) —
+# see influence_baseline_sharded
+_BSHARD_CACHE: dict = {}
+
 
 def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
     """Version-tolerant ``shard_map``: newer jax renamed the replication
@@ -168,14 +172,17 @@ def solve_admm_sharded2d(mesh: Mesh, Vb, Cb, freqs_b, f0_b, rho,
 
 def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
                       n_chunks: int, axis: str = "sp", fullpol=False,
-                      perdir=False, optimized=True):
+                      perdir=False, optimized=True, block_baselines=0,
+                      precision: str = "f32"):
     """Influence visibilities with the calibration-interval (chunk) axis
     sharded over ``axis`` (the reference's process pool as a mesh axis).
 
     Same signature/semantics as cal/influence.influence_visibilities,
     including the ``optimized`` formulation switch (default: the
-    scatter-free/adjoint chain; False = the retained oracle kernels);
-    ``n_chunks`` must divide by the axis size.
+    scatter-free/adjoint chain; False = the retained oracle kernels) and
+    the SKA-tier statics (``block_baselines``/``precision`` — the
+    chunk-sharded route must run the SAME kernels the accounting layer
+    records); ``n_chunks`` must divide by the axis size.
     """
     nsp = mesh.shape[axis]
     if n_chunks % nsp != 0:
@@ -196,7 +203,8 @@ def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
         c = jnp.moveaxis(c4, 0, 1).reshape(K, local_chunks * B * Td, 4, 2)
         return influence_mod.influence_visibilities(
             r, c, j, hadd, n_stations, local_chunks, fullpol=fullpol,
-            perdir=perdir, optimized=optimized)
+            perdir=perdir, optimized=optimized,
+            block_baselines=block_baselines, precision=precision)
 
     out_specs = influence_mod.InfluenceResult(
         vis=P(None, axis) if perdir else P(axis), llr=P(axis))
@@ -209,9 +217,91 @@ def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
     return res
 
 
+def influence_baseline_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
+                               n_chunks: int, axis: str = "bp",
+                               fullpol=False, perdir=False,
+                               precision: str = "f32"):
+    """Influence visibilities with the BASELINE axis sharded over
+    ``axis`` — the B ~ N^2 (SKA-scale) partition: the (B, ...)
+    coherency/residual/lhs tensors and every per-baseline einsum
+    temporary live 1/n-th per device, while the per-direction 4N x 4N
+    solves run replicated.  Collectives happen ONLY at the per-direction
+    reductions (one psum of the assembled partial Hessian, one of the
+    adjoint chain's per-station G sum, scalar LLR norms) — verified
+    host-transfer-free under ``jax.transfer_guard`` in
+    tests/test_nscale_kernels.py, the PR 12 sharded-replay pattern.
+
+    Same signature/semantics as cal/influence.influence_visibilities on
+    the optimized chain (``precision`` selects the bf16 policy rows);
+    B = N(N-1)/2 must divide by the axis size.  Equal to the
+    single-device optimized chain to float round-off (the shard psum
+    reassociates the station/Hessian sums).
+    """
+    import numpy as np
+
+    nbp = mesh.shape[axis]
+    B = n_stations * (n_stations - 1) // 2
+    if B % nbp != 0:
+        raise ValueError(f"B={B} not divisible by {axis}={nbp}")
+    T = C.shape[1] // B
+    Td = T // n_chunks
+    K = C.shape[0]
+
+    # pre-chunk with the baseline axis exposed for sharding
+    R3 = R.reshape(n_chunks, Td, B, 2, 2, 2)
+    C5 = jnp.moveaxis(jnp.swapaxes(
+        C.reshape(K, n_chunks, Td, B, 2, 2, 2), -3, -2), 1, 0)
+    # host numpy here; the indices reach the device only through the
+    # explicit device_put below (legal under transfer_guard "disallow")
+    p_np, q_np = np.triu_indices(n_stations, 1)
+    p_idx = np.asarray(p_np, np.int32)
+    q_idx = np.asarray(q_np, np.int32)
+
+    in_specs = (P(None, None, axis), P(None, None, None, axis), P(), P(),
+                P(axis), P(axis))
+    # one JITTED program per (mesh, statics): a fresh shard_map closure
+    # per call would retrace every time — paying trace cost per episode
+    # AND pulling trace-time constants through the transfer guard the
+    # steady state is tested under
+    cache_key = (mesh, axis, n_stations, fullpol, perdir, precision)
+    sharded = _BSHARD_CACHE.get(cache_key)
+    if sharded is None:
+        def local(r3, c5, j, h, pi, qi):
+            return influence_mod.influence_visibilities_blocal(
+                r3, c5, j, pi, qi, h, n_stations, B, fullpol=fullpol,
+                perdir=perdir, axis_name=axis, precision=precision)
+
+        out_specs = influence_mod.InfluenceResult(
+            vis=P(None, None, axis) if perdir else P(None, axis),
+            llr=P())
+        sharded = jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
+                                    out_specs=out_specs,
+                                    check_vma=False))
+        _BSHARD_CACHE[cache_key] = sharded
+    # explicit placement onto THIS mesh: upstream operands may arrive
+    # committed to a different mesh (e.g. a frequency-sharded solve's
+    # residual), which jit refuses to mix implicitly — and the explicit
+    # device_put keeps the steady-state call legal under
+    # jax.transfer_guard("disallow") (tested)
+    operands = [
+        jax.device_put(x, NamedSharding(mesh, spec)) for x, spec in
+        zip((R3, C5, jnp.asarray(J), jnp.asarray(hadd), p_idx, q_idx),
+            in_specs)]
+    res = sharded(*operands)
+    # the concatenated baseline axis restores the global time-major
+    # (ck = t*B + b) sample order
+    if perdir:
+        vis = res.vis.reshape(K, T * B, 4, 2)
+    else:
+        vis = res.vis.reshape(T * B, 4, 2)
+    return influence_mod.InfluenceResult(vis=vis, llr=res.llr)
+
+
 def influence_images_sharded(mesh: Mesh, residual, C, J, hadd_all, freqs,
                              uvw, cell, n_stations: int, n_chunks: int,
-                             npix: int, axis: str = "fp", optimized=True):
+                             npix: int, axis: str = "fp", optimized=True,
+                             block_baselines=0, imager_block_r=0,
+                             precision: str = "f32"):
     """Mean influence dirty image with the FREQUENCY axis sharded over
     ``axis``: each shard runs :func:`cal.influence.influence_images_multi`
     on its local sub-bands and the mean is one psum.
@@ -234,7 +324,8 @@ def influence_images_sharded(mesh: Mesh, residual, C, J, hadd_all, freqs,
         imgs = influence_mod.influence_images_multi(
             r, c, j, h, f, uvw_, cell, n_stations, n_chunks, npix,
             use_pallas=False,           # pallas_call has no partitioning rule
-            optimized=optimized)
+            optimized=optimized, block_baselines=block_baselines,
+            imager_block_r=imager_block_r, precision=precision)
         return jax.lax.psum(jnp.sum(imgs, axis=0), axis)
 
     sharded = shard_map(local, mesh=mesh,

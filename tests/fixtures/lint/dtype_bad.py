@@ -1,0 +1,16 @@
+"""Positive fixture: bare dtype literals in a precision-policied kernel
+module (linted with this file's name in dtype_policied_paths)."""
+import jax
+import jax.numpy as jnp
+
+
+def pixel_axis(npix, cell):
+    return (jnp.arange(npix)).astype(jnp.float32) * cell   # BAD: bare pin
+
+
+def contract(a, b):
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32)  # BAD
+
+
+def accum(x):
+    return x.astype(jax.numpy.float64)                     # BAD: f64

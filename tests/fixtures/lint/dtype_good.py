@@ -1,0 +1,25 @@
+"""Negative fixture: policy-routed and reasoned-pin dtype choices in a
+precision-policied kernel module."""
+import jax.numpy as jnp
+
+from smartcal_tpu.cal import precision as prec
+
+
+def pixel_axis(npix, cell):
+    return (jnp.arange(npix)).astype(prec.F32) * cell      # policy helper
+
+
+def contract(a, b, precision="f32"):
+    dt = prec.contraction_dtype("imager_matmul", precision)
+    return jnp.matmul(a.astype(dt), b.astype(dt))
+
+
+def kernel_accumulator(x):
+    f32 = jnp.float32  # graftlint: disable=dtype-discipline -- pallas accumulator dtype pinned f32 by policy
+    return x.astype(f32)
+
+
+def host_side(x):
+    import numpy as np
+
+    return np.asarray(x, np.float32)     # numpy literals are host-side

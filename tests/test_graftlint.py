@@ -83,6 +83,36 @@ def test_static_flag_rule_negative():
                             "traced-static-flag") == []
 
 
+_DTYPE_OPTS_BAD = {"dtype_policied_paths": ("dtype_bad.py",)}
+_DTYPE_OPTS_GOOD = {"dtype_policied_paths": ("dtype_good.py",)}
+
+
+def test_dtype_rule_positive():
+    fs = fixture_findings("dtype_bad.py", "dtype-discipline",
+                          _DTYPE_OPTS_BAD)
+    assert lines_of(fs) == [8, 12, 16], fs
+
+
+def test_dtype_rule_negative():
+    assert fixture_findings("dtype_good.py", "dtype-discipline",
+                            _DTYPE_OPTS_GOOD) == []
+
+
+def test_dtype_rule_scoped_to_policied_modules():
+    """The same bad literals OUTSIDE the policied module list are not
+    findings — dtype choices elsewhere are not the policy's business."""
+    assert fixture_findings("dtype_bad.py", "dtype-discipline",
+                            {"dtype_policied_paths":
+                             ("smartcal_tpu/cal/imager.py",)}) == []
+
+
+def test_dtype_rule_policy_module_exempt():
+    assert fixture_findings("dtype_bad.py", "dtype-discipline",
+                            {"dtype_policied_paths": ("dtype_bad.py",),
+                             "dtype_exempt_paths": ("dtype_bad.py",)}) \
+        == []
+
+
 _LOCK_SPEC = {"class": "Fleet",
               "fields": ["_weights", "_version", "_queue"],
               "locks": ["_wlock"], "why": "fixture"}

@@ -455,7 +455,17 @@ def roofline(events, spans):
     """Per-stage flops/bytes/achieved-FLOPs/s table, or None without
     ``cost`` events.  Achieved rate = flops-per-call x span count / span
     wall; absent span match or peak reference leaves those fields unset
-    (the renderer prints dashes)."""
+    (the renderer prints dashes).
+
+    Each row carries the stage's recorded ``compute_dtype`` (the
+    precision-policy tag on the cost event; untagged stages are f32) and
+    fraction-of-peak is quoted against the MATCHING device peak — a bf16
+    kernel against the bf16 systolic peak, an f32 kernel against the
+    fp32 estimate.  Before the dtype tag existed every stage divided by
+    fp32_est, which reads ~half under bf16 (or >1 if the fp32 estimate
+    is beaten).  Footprint fields (peak live bytes per compile, and the
+    per-shard division under sharded routes) ride along when the run
+    recorded them."""
     costs = [e for e in events if e.get("event") == "cost"]
     if not costs:
         return None
@@ -464,22 +474,41 @@ def roofline(events, spans):
     by_stage = {}
     for e in costs:
         d = by_stage.setdefault(e.get("stage", "?"),
-                                {"flops": [], "bytes": [], "errors": 0})
+                                {"flops": [], "bytes": [], "errors": 0,
+                                 "peak_bytes": [], "shard_bytes": [],
+                                 "shards": [], "dtypes": set()})
         if e.get("error"):
             d["errors"] += 1
         else:
             d["flops"].append(float(e.get("flops") or 0.0))
             d["bytes"].append(float(e.get("bytes_accessed") or 0.0))
+            if e.get("peak_bytes") is not None:
+                d["peak_bytes"].append(float(e["peak_bytes"]))
+            if e.get("peak_bytes_per_shard") is not None:
+                d["shard_bytes"].append(float(e["peak_bytes_per_shard"]))
+                d["shards"].append(int(e.get("shards") or 1))
+            d["dtypes"].add(str(e.get("compute_dtype") or "f32"))
     stages = {}
     for stage, d in sorted(by_stage.items()):
         row = {"signatures": len(d["flops"]) + d["errors"],
                "errors": d["errors"]}
+        dtypes = d["dtypes"] or {"f32"}
+        # a stage that recorded both policies reports the widest claim
+        # honestly: mixed -> quoted against the bf16 peak would overstate
+        # the f32 share, so flag it and quote fp32
+        row["compute_dtype"] = ("mixed" if len(dtypes) > 1
+                                else next(iter(dtypes)))
         if d["flops"]:
             row["flops_per_call"] = float(np.mean(d["flops"]))
             row["bytes_per_call"] = float(np.mean(d["bytes"]))
             if row["bytes_per_call"] > 0:
                 row["arith_intensity"] = round(
                     row["flops_per_call"] / row["bytes_per_call"], 3)
+        if d["peak_bytes"]:
+            row["peak_bytes_max"] = float(np.max(d["peak_bytes"]))
+        if d["shard_bytes"]:
+            row["peak_bytes_per_shard_max"] = float(np.max(d["shard_bytes"]))
+            row["shards"] = int(max(d["shards"]))
         leaf = _STAGE_SPAN_ALIASES.get(stage, stage)
         matches = [p for p in spans if p.rsplit("/", 1)[-1] == leaf]
         if matches and "flops_per_call" in row:
@@ -490,7 +519,16 @@ def roofline(events, spans):
             if tot > 0 and n > 0:
                 row["achieved_flops_per_s"] = \
                     row["flops_per_call"] * n / tot
-                if peak and peak.get("fp32_est"):
+                peak_key = ("bf16" if row["compute_dtype"] == "bf16"
+                            else "fp32_est")
+                if peak and peak.get(peak_key):
+                    row["peak_dtype"] = peak_key
+                    row["fraction_of_peak"] = round(
+                        row["achieved_flops_per_s"]
+                        / float(peak[peak_key]), 6)
+                # legacy field, kept for pre-r13 report consumers
+                if peak and peak.get("fp32_est") \
+                        and row["compute_dtype"] != "bf16":
                     row["fraction_of_peak_fp32"] = round(
                         row["achieved_flops_per_s"]
                         / float(peak["fp32_est"]), 6)
@@ -520,17 +558,23 @@ def render_roofline(rl, out):
     else:
         out.append("  (no roofline_peak reference — fraction-of-peak "
                    "unavailable on this platform)")
-    out.append(f"  {'stage':24s} {'flops/call':>11s} {'bytes/call':>11s} "
-               f"{'AI':>7s} {'calls':>6s} {'span_s':>8s} "
+    out.append(f"  {'stage':24s} {'dtype':>6s} {'flops/call':>11s} "
+               f"{'bytes/call':>11s} {'AI':>7s} {'peakMB':>8s} "
+               f"{'MB/shard':>9s} {'calls':>6s} {'span_s':>8s} "
                f"{'FLOP/s':>9s} {'%peak':>7s}")
     for stage, row in rl["stages"].items():
         ai = row.get("arith_intensity")
         span_s = row.get("span_total_s")
-        frac = row.get("fraction_of_peak_fp32")
+        frac = row.get("fraction_of_peak")
+        pk = row.get("peak_bytes_max")
+        pks = row.get("peak_bytes_per_shard_max")
         out.append(
-            f"  {stage:24s} {_fmt_si(row.get('flops_per_call')):>11s} "
+            f"  {stage:24s} {row.get('compute_dtype', 'f32'):>6s} "
+            f"{_fmt_si(row.get('flops_per_call')):>11s} "
             f"{_fmt_si(row.get('bytes_per_call')):>11s} "
             f"{(f'{ai:.2f}' if ai is not None else '-'):>7s} "
+            f"{(f'{pk / 1e6:.1f}' if pk is not None else '-'):>8s} "
+            f"{(f'{pks / 1e6:.1f}' if pks is not None else '-'):>9s} "
             f"{(str(row['calls']) if 'calls' in row else '-'):>6s} "
             f"{(f'{span_s:.2f}' if span_s is not None else '-'):>8s} "
             f"{_fmt_si(row.get('achieved_flops_per_s')):>9s} "

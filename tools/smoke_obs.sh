@@ -57,8 +57,37 @@ assert inf_costs, ("no successful influence cost-analysis event under "
                    f"--diag: {sorted({e.get('stage') for e in costs})} "
                    "— the roofline table would silently lose the "
                    "influence kernels")
+# ISSUE 13: the memory-footprint accounting must ride on the cost
+# events (peak live bytes per compile) and carry the precision-policy
+# dtype tag, or the N-scaling report loses its memory column and the
+# roofline quotes the wrong peak under bf16
+fp = [e for e in inf_costs if e.get("peak_bytes")]
+assert fp, f"influence cost events missing peak_bytes: {inf_costs[:2]}"
+assert all(e.get("compute_dtype") in ("f32", "bf16") for e in fp), \
+    f"influence cost events missing compute_dtype tag: {fp[:2]}"
 print("[smoke_obs] influence OK:", len(inf_spans), "span(s), route",
-      inf_spans[0].get("route") + ",", len(inf_costs), "cost event(s)")
+      inf_spans[0].get("route") + ",", len(inf_costs), "cost event(s),",
+      "peak_bytes", int(fp[0]["peak_bytes"]), "dtype",
+      fp[0]["compute_dtype"])
+EOF
+
+echo "[smoke_obs] checking dtype-tagged roofline rows in the calib report" >&2
+python tools/obs_report.py "$CALIB" --json --bootstrap 50 \
+    > "$WORK/calib_report.json"
+python - "$WORK/calib_report.json" <<'EOF'
+import json
+import sys
+
+report = json.load(open(sys.argv[1]))
+rl = (report["runs"][0] or {}).get("roofline") or {}
+stages = rl.get("stages") or {}
+assert "influence" in stages, f"roofline lost influence: {list(stages)}"
+row = stages["influence"]
+assert row.get("compute_dtype") in ("f32", "bf16", "mixed"), row
+assert row.get("peak_bytes_max", 0) > 0, \
+    f"roofline influence row missing footprint: {row}"
+print("[smoke_obs] roofline OK: influence dtype", row["compute_dtype"],
+      "peakMB", round(row["peak_bytes_max"] / 1e6, 1))
 EOF
 
 echo "[smoke_obs] recording 1-vector-episode batched calib_sac run -> " \
