@@ -1110,11 +1110,25 @@ def main():
     from smartcal_tpu import obs
 
     obs_path = os.environ.get("SMARTCAL_OBS", "").strip()
+    # --compile-cache <dir> (or SMARTCAL_COMPILE_CACHE): persistent XLA
+    # compilation cache — a repeat bench on the same host skips every
+    # first-compile, and the hit/miss counters land in the obs stream
+    cache_dir = os.environ.get("SMARTCAL_COMPILE_CACHE", "").strip()
+    if "--compile-cache" in sys.argv:
+        i = sys.argv.index("--compile-cache")
+        if i + 1 >= len(sys.argv):
+            raise SystemExit("--compile-cache requires a directory")
+        cache_dir = sys.argv[i + 1]
+    if cache_dir:
+        from smartcal_tpu.serve.export import enable_compile_cache
+        enable_compile_cache(cache_dir)
     runlog = None
     if obs_path:
         runlog = obs.RunLog(obs_path, meta={"entry": "bench"})
         obs.activate(runlog)
         obs.install_compile_listener()
+        if cache_dir:
+            obs.install_cache_listener()
     stopped, insurance = _pause_competitors()
     try:
         _measured_main()

@@ -64,6 +64,25 @@ SHARED_FIELD_SPECS = [
         "why": "every thread (actors, prefetch worker, watchdog) logs "
                "through the active RunLog's shared buffer",
     },
+    {
+        "path": "smartcal_tpu/serve/server.py",
+        "class": "CalibServer",
+        "fields": ["_programs", "_circuit_open", "_stats"],
+        "locks": ["_lock"],
+        "why": "latest-executable table swapped by warmup while the "
+               "batch worker reads it per batch; breaker flag written "
+               "by the supervisor thread and read on every submit; "
+               "stats written by worker + breaker, read by stats()",
+    },
+    {
+        "path": "smartcal_tpu/serve/router.py",
+        "class": "MicroBatcher",
+        "fields": ["_accepted", "_shed", "_service_est_s"],
+        "locks": ["_lock"],
+        "why": "admission counters written by every client thread and "
+               "the service-time EWMA written by the batch worker while "
+               "next_batch reads it for the deadline pull",
+    },
 ]
 
 _MUTATORS = {"append", "add", "extend", "update", "insert", "pop",

@@ -41,6 +41,7 @@ TOOLS_STDOUT_ALLOWLIST = frozenset({
     "measure_reference.py",
     "obs_report.py",
     "obs_tail.py",
+    "serve_calib.py",
     "summarize_demix_curves.py",
     "sweep_calib.py",
     "sweep_demix.py",

@@ -1001,11 +1001,13 @@ class RadioBackend:
         one fused program's size."""
         return self._shard_size(n_lanes, self._fused_work() * n_lanes)
 
-    def _batched_solve_fn(self, n_dirs, n_lanes, nbp):
-        key = ("solve", n_dirs, n_lanes, nbp)
-        fn = self._batched_fns.get(key)
-        if fn is not None:
-            return fn
+    def batched_solve_callable(self, n_dirs):
+        """The UNJITTED vmapped masked-ADMM solve over a leading lane
+        axis — positional operands as built by
+        :meth:`batched_solve_operands`.  Public so the serving layer
+        (serve/export.py) can AOT-export EXACTLY the program
+        :meth:`calibrate_batched` jits: one definition, two compilation
+        paths, no parity gap."""
         cfg = self._solver_cfg(n_dirs)
         n_chunks = self.n_chunks
 
@@ -1014,6 +1016,14 @@ class RadioBackend:
             return solver.solve_admm(v, cm, f, f0_, r, cfg,
                                      n_chunks=n_chunks, admm_iters=it)
 
+        return jax.vmap(one)
+
+    def _batched_solve_fn(self, n_dirs, n_lanes, nbp):
+        key = ("solve", n_dirs, n_lanes, nbp)
+        fn = self._batched_fns.get(key)
+        if fn is not None:
+            return fn
+        inner = self.batched_solve_callable(n_dirs)
         if nbp:
             from jax.sharding import PartitionSpec as P
 
@@ -1025,13 +1035,28 @@ class RadioBackend:
                 J=P(ax), Z=P(ax), residual=P(ax), sigma_res=P(ax),
                 sigma_data=P(ax), final_cost=P(ax), stats=None)
             inner = sharded_cal.shard_map(
-                jax.vmap(one), mesh=mesh, in_specs=(P(ax),) * 7,
+                inner, mesh=mesh, in_specs=(P(ax),) * 7,
                 out_specs=out_specs)
-            fn = jax.jit(inner)
-        else:
-            fn = jax.jit(jax.vmap(one))
+        fn = jax.jit(inner)
         self._batched_fns[key] = fn
         return fn
+
+    def batched_solve_operands(self, bep: BatchedEpisode, rho, mask=None,
+                               admm_iters=None) -> tuple:
+        """The positional operand tuple of the batched solve program
+        (shared by :meth:`calibrate_batched` and the serving layer's
+        exported call — the operand layout IS the export ABI)."""
+        E = int(bep.V.shape[0])
+        rho = jnp.asarray(rho, jnp.float32).reshape(E, bep.n_dirs)
+        masks = (jnp.ones((E, bep.n_dirs), jnp.float32) if mask is None
+                 else jnp.asarray(mask, jnp.float32).reshape(E, bep.n_dirs))
+        if admm_iters is None:
+            iters = jnp.full((E,), self.admm_iters, jnp.int32)
+        else:
+            iters = jnp.broadcast_to(
+                jnp.asarray(admm_iters, jnp.int32).reshape(-1), (E,))
+        return (bep.V, bep.Ccal, jnp.asarray(bep.freqs),
+                jnp.asarray(bep.f0, jnp.float32), rho, masks, iters)
 
     def calibrate_batched(self, bep: BatchedEpisode, rho, mask=None,
                           admm_iters=None) -> solver.SolveResult:
@@ -1045,30 +1070,21 @@ class RadioBackend:
         route (the batched program's output tree stays the fused-solve
         shape, same rule as the traced hint sweep)."""
         E = int(bep.V.shape[0])
-        rho = jnp.asarray(rho, jnp.float32).reshape(E, bep.n_dirs)
-        masks = (jnp.ones((E, bep.n_dirs), jnp.float32) if mask is None
-                 else jnp.asarray(mask, jnp.float32).reshape(E, bep.n_dirs))
-        if admm_iters is None:
-            iters = jnp.full((E,), self.admm_iters, jnp.int32)
-        else:
-            iters = jnp.broadcast_to(
-                jnp.asarray(admm_iters, jnp.int32).reshape(-1), (E,))
         nbp = self._batch_shard_size(E)
         route = "batched_sharded" if nbp else "batched_vmap"
         fn = self._batched_solve_fn(bep.n_dirs, E, nbp)
+        ops = self.batched_solve_operands(bep, rho, mask, admm_iters)
         with obs.span("solve", route=route, lanes=E,
                       **({"shards": nbp} if nbp else {})):
             obs.gauge_set("batched_lanes", E)
-            return fn(bep.V, bep.Ccal, jnp.asarray(bep.freqs),
-                      jnp.asarray(bep.f0, jnp.float32), rho, masks, iters)
+            return fn(*ops)
 
-    def _batched_influence_fn(self, n_dirs, n_lanes, npix):
+    def batched_influence_callable(self, n_dirs, npix):
+        """The UNJITTED vmapped influence chain (consensus Hessian-add ->
+        multi-frequency influence images -> frequency mean) — positional
+        operands as built by :meth:`batched_influence_operands`.  Public
+        for the same reason as :meth:`batched_solve_callable`."""
         statics = self._influence_statics(npix)
-        key = ("influence", n_dirs, n_lanes, npix,
-               tuple(sorted(statics.items())))
-        fn = self._batched_fns.get(key)
-        if fn is not None:
-            return fn
         n_stations, n_chunks = self.n_stations, self.n_chunks
         n_poly, polytype = self.n_poly, self.polytype
 
@@ -1080,9 +1096,31 @@ class RadioBackend:
                 **statics)
             return jnp.mean(imgs, axis=0)
 
-        fn = jax.jit(jax.vmap(one))
+        return jax.vmap(one)
+
+    def _batched_influence_fn(self, n_dirs, n_lanes, npix):
+        statics = self._influence_statics(npix)
+        key = ("influence", n_dirs, n_lanes, npix,
+               tuple(sorted(statics.items())))
+        fn = self._batched_fns.get(key)
+        if fn is not None:
+            return fn
+        fn = jax.jit(self.batched_influence_callable(n_dirs, npix))
         self._batched_fns[key] = fn
         return fn
+
+    def batched_influence_operands(self, bep: BatchedEpisode,
+                                   result: solver.SolveResult, rho,
+                                   rho_spatial) -> tuple:
+        """Positional operand tuple of the batched influence program
+        (the serving export ABI, mirrored by
+        :meth:`influence_images_batched`)."""
+        E = int(bep.V.shape[0])
+        rho = jnp.asarray(rho, jnp.float32).reshape(E, bep.n_dirs)
+        alpha = jnp.asarray(rho_spatial, jnp.float32).reshape(E, bep.n_dirs)
+        return (result.residual, bep.Ccal, result.J, rho, alpha,
+                jnp.asarray(bep.freqs), jnp.asarray(bep.f0, jnp.float32),
+                jnp.asarray(bep.uvw), jnp.asarray(bep.cell))
 
     def influence_images_batched(self, bep: BatchedEpisode,
                                  result: solver.SolveResult, rho,
@@ -1094,15 +1132,11 @@ class RadioBackend:
         one dispatch.  ``rho``/``rho_spatial`` are (E, K) per lane."""
         E = int(bep.V.shape[0])
         npix = npix or self.npix
-        rho = jnp.asarray(rho, jnp.float32).reshape(E, bep.n_dirs)
-        alpha = jnp.asarray(rho_spatial, jnp.float32).reshape(E, bep.n_dirs)
         fn = self._batched_influence_fn(bep.n_dirs, E, npix)
+        ops = self.batched_influence_operands(bep, result, rho, rho_spatial)
         with obs.span("influence") as sp:
             sp.tag(route="batched_vmap", lanes=E)
-            return fn(result.residual, bep.Ccal, result.J, rho, alpha,
-                      jnp.asarray(bep.freqs),
-                      jnp.asarray(bep.f0, jnp.float32),
-                      jnp.asarray(bep.uvw), jnp.asarray(bep.cell))
+            return fn(*ops)
 
     def _batched_sigma_fn(self, n_lanes, npix):
         key = ("sigmas", n_lanes, npix)
@@ -1149,3 +1183,21 @@ class RadioBackend:
             fn = jax.jit(jax.vmap(one))
             self._batched_fns[key] = fn
         return fn(V)
+
+    def serve_signature(self, n_dirs, n_lanes, npix=None) -> dict:
+        """The STATIC trace signature of the batched solve/influence
+        programs: every constructor knob that selects a different trace
+        (and therefore a different executable), plus the lane/direction/
+        image geometry.  The serving layer keys its AOT-export cache on
+        this dict — two backends with equal signatures compile (and can
+        reuse) the identical program."""
+        return {
+            "n_stations": self.n_stations, "n_freqs": self.n_freqs,
+            "n_times": self.n_times, "tdelta": self.tdelta,
+            "n_poly": self.n_poly, "polytype": self.polytype,
+            "lbfgs_iters": self.lbfgs_iters, "init_iters": self.init_iters,
+            "K": int(n_dirs), "lanes": int(n_lanes),
+            "npix": int(npix or self.npix), "precision": self.precision,
+            "block_baselines": self.block_baselines,
+            "imager_block_r": self.imager_block_r,
+        }

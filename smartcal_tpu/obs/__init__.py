@@ -23,8 +23,9 @@ from .costs import (device_peak, log_roofline_peak,        # noqa: F401
 from .diagnostics import (UpdateDiag, diag_steps,          # noqa: F401
                           diag_to_host, make_diag, zero_diag)
 from .registry import (counter_add, counters_snapshot,     # noqa: F401
-                       flush_counters, gauge_set, install_compile_listener,
-                       log_memory_gauges, reset_counters)
+                       flush_counters, gauge_set, install_cache_listener,
+                       install_compile_listener, log_memory_gauges,
+                       reset_counters)
 from .runlog import (SCHEMA_VERSION, RunLog, activate,     # noqa: F401
                      active, deactivate, recording, sanitize)
 from .spans import span                                    # noqa: F401

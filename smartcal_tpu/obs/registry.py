@@ -121,6 +121,52 @@ def install_compile_listener() -> bool:
     return True
 
 
+# ---------------------------------------------------------------------------
+# jax.monitoring persistent-compilation-cache listener
+# ---------------------------------------------------------------------------
+
+_cache_listener_installed = False
+
+# plain (no-duration) monitoring events the persistent XLA compilation
+# cache emits per compile request -> the obs counter each feeds
+_CACHE_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "persistent_cache_hits",
+    "/jax/compilation_cache/cache_misses": "persistent_cache_misses",
+    "/jax/compilation_cache/compile_requests_use_cache":
+        "persistent_cache_requests",
+}
+
+
+def _on_event(event, **kw):
+    if active() is None:
+        return
+    name = _CACHE_EVENT_COUNTERS.get(str(event))
+    if name is None:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0.0) + 1.0
+
+
+def install_cache_listener() -> bool:
+    """Idempotently register the persistent-compilation-cache hit/miss
+    listener (plain events, not durations — the cache emits
+    ``/jax/compilation_cache/cache_{hits,misses}`` per compile request).
+    Returns False when jax (or the monitoring API) is unavailable."""
+    global _cache_listener_installed
+    if _cache_listener_installed:
+        return True
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return False
+    try:
+        from jax import monitoring
+        monitoring.register_event_listener(_on_event)
+    except Exception:
+        return False
+    _cache_listener_installed = True
+    return True
+
+
 def log_memory_gauges() -> int:
     """Per-device memory_stats() gauges into the active RunLog; returns
     the number of devices that reported stats (0 when inactive, when jax

@@ -182,6 +182,15 @@ def choose_action(cfg: SACConfig, st: SACState, obs, key,
     return a
 
 
+def policy_apply(cfg: SACConfig, actor_params, obs):
+    """Deterministic policy head only — ``tanh(mu)`` from the actor
+    params (the SERVING forward: no sampling key, no critic/optimizer
+    state, so the AOT export closes over nothing but the net shape)."""
+    actor, _ = _nets(cfg)
+    mu, _ = actor.apply({"params": actor_params}, obs)
+    return jnp.tanh(mu)
+
+
 def choose_action_logp(cfg: SACConfig, st: SACState, obs, key):
     """:func:`choose_action` that ALSO returns ``log pi(a|s)`` (shape
     ``obs.shape[:-1]``) — the behavior log-prob the fleet actors store

@@ -1,0 +1,23 @@
+"""Calibration-as-a-service: AOT-exported serving over the batched
+substrate.
+
+* :mod:`~smartcal_tpu.serve.export` — jax.export program cache keyed on
+  trace signature + the persistent XLA compilation cache hookup (a warm
+  server restart neither re-traces nor re-compiles);
+* :mod:`~smartcal_tpu.serve.router` — bounded admission + deadline-aware
+  micro-batching of heterogeneous jobs into ``BatchedEpisode`` lanes;
+* :mod:`~smartcal_tpu.serve.server` — the supervised ``CalibServer``
+  driver (Fleet-backed circuit breaker, ``solve_admm_safe`` degradation,
+  SLO telemetry through the obs stack);
+* :mod:`~smartcal_tpu.serve.loadgen` — synthetic open-loop (Poisson)
+  load generator for the jobs/s-vs-offered-load curve.
+
+Entry point: ``tools/serve_calib.py``; smoke: ``tools/smoke_serve.sh``.
+"""
+
+from .export import (ExportCache, ServeProgram,            # noqa: F401
+                     abstract_like, enable_compile_cache,
+                     prime_backend_kernels, sig_digest)
+from .router import (Job, JobResult, MicroBatcher,         # noqa: F401
+                     ShedError)
+from .server import CalibServer                            # noqa: F401

@@ -1,0 +1,187 @@
+"""AOT export cache: serialized jax.export programs keyed on trace
+signature.
+
+Cold start of the calibration service pays twice: tracing (python) and
+XLA compilation (minutes at scale — ``first_episode_incl_compile_s:
+255.6`` in the r6 results).  This module removes both for a RESTARTED
+server:
+
+* the traced+lowered program is exported once per trace signature
+  (``jax.export``), serialized, and persisted under the cache dir —
+  a restart deserializes the StableHLO instead of re-tracing;
+* :func:`enable_compile_cache` arms JAX's persistent compilation cache
+  in the same directory tree, so the XLA compile of the deserialized
+  module is a disk hit too (including the ``jit_call_exported``
+  executable) — a warm restart compiles NOTHING.
+
+The signature (see ``RadioBackend.serve_signature``) carries every
+static program selector: geometry (N, T, Nf), K/lanes, npix, precision,
+blocking knobs.  Per-request values (rho, masks, maxiter) are TRACED
+operands since PR 9, so one cached program serves every request mix.
+
+Obs counters: ``export_cache_hit`` / ``export_cache_miss`` /
+``export_cache_store`` (plus ``persistent_cache_hits/misses`` from the
+registry listener) — the smoke asserts a warm restart is all hits.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+from jax import export as jax_export
+
+from smartcal_tpu import obs
+from smartcal_tpu.cal import solver as _solver
+from smartcal_tpu.runtime import atomic
+
+# jax.export refuses unregistered pytree node types in program
+# signatures; the solve program returns a SolveResult (stats=None on the
+# batched route, but register both).  Idempotent across re-imports.
+for _nt in (_solver.SolveResult, _solver.SolverStats):
+    try:
+        jax_export.register_namedtuple_serialization(
+            _nt, serialized_name=f"smartcal_tpu.cal.solver.{_nt.__name__}")
+    except ValueError:
+        pass
+
+
+_lapack_primed = False
+
+
+def prime_backend_kernels() -> None:
+    """Run one tiny ``eigh`` before any deserialized program executes.
+
+    jaxlib registers its CPU LAPACK custom-call kernels lazily, as a
+    side effect of the first NORMAL lowering of a linalg primitive in
+    the process.  A deserialized exported module never goes through
+    that lowering — its StableHLO already names the custom-call
+    targets — so in a fresh process (exactly the warm-restart case this
+    cache exists for) the call segfaults inside XLA on the unresolved
+    target.  One 2x2 ``eigh`` registers the whole LAPACK family
+    (eigh/svd/qr/solve all resolve afterwards); idempotent and ~ms."""
+    global _lapack_primed
+    if _lapack_primed:
+        return
+    import jax.numpy as jnp
+    jax.block_until_ready(jnp.linalg.eigh(jnp.eye(2, dtype=jnp.float32)))
+    _lapack_primed = True
+
+
+def sig_digest(sig: dict) -> str:
+    """Stable short digest of a signature dict (sorted-key JSON)."""
+    blob = json.dumps(sig, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def abstract_like(tree: Any):
+    """Pytree of ``ShapeDtypeStruct`` mirroring ``tree``'s arrays — the
+    export-time stand-ins for the runtime operands."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jax.numpy.shape(x),
+                                       jax.numpy.result_type(x)), tree)
+
+
+class ServeProgram:
+    """A deserialized/freshly-exported program: call it like the jitted
+    original.  ``source`` records where it came from ("export" = traced
+    this process, "cache" = deserialized from disk)."""
+
+    def __init__(self, exported, sig: dict, source: str):
+        self.exported = exported
+        self.sig = dict(sig)
+        self.source = source
+
+    def __call__(self, *args):
+        return self.exported.call(*args)
+
+
+class ExportCache:
+    """Persist/load serialized ``jax.export`` programs keyed on a
+    signature dict.  Layout: ``<dir>/<kind>-<digest>.jaxexp`` (the
+    serialized bytes) + ``.json`` sidecar (the human-readable signature,
+    for cache forensics).  Writes are atomic (tmp + rename), so a killed
+    server never leaves a torn blob for the next boot to trip on."""
+
+    def __init__(self, cache_dir: str):
+        self.dir = cache_dir
+        os.makedirs(cache_dir, exist_ok=True)
+
+    def _base(self, sig: dict) -> str:
+        kind = sig.get("kind", "program")
+        return os.path.join(self.dir, f"{kind}-{sig_digest(sig)}")
+
+    def load(self, sig: dict) -> Optional[ServeProgram]:
+        """Deserialize the persisted program for ``sig``, or None (and
+        count a miss).  A corrupt blob counts as a miss — the caller
+        rebuilds and overwrites it."""
+        path = self._base(sig) + ".jaxexp"
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+            exported = jax_export.deserialize(blob)
+        except FileNotFoundError:
+            obs.counter_add("export_cache_miss")
+            self._log("miss", sig, path)
+            return None
+        except Exception as e:     # torn/incompatible blob: rebuild
+            obs.counter_add("export_cache_miss")
+            self._log("corrupt", sig, path, error=repr(e))
+            return None
+        prime_backend_kernels()
+        obs.counter_add("export_cache_hit")
+        self._log("hit", sig, path, bytes=len(blob))
+        return ServeProgram(exported, sig, source="cache")
+
+    def store(self, sig: dict, exported) -> str:
+        path = self._base(sig) + ".jaxexp"
+        blob = exported.serialize()
+        atomic.atomic_write_bytes(path, bytes(blob))
+        atomic.atomic_write_text(
+            self._base(sig) + ".json",
+            json.dumps(sig, sort_keys=True, default=str, indent=1))
+        obs.counter_add("export_cache_store")
+        self._log("store", sig, path, bytes=len(blob))
+        return path
+
+    def build(self, sig: dict, fn: Callable,
+              abstract_args: Sequence[Any]) -> ServeProgram:
+        """Trace+lower ``fn`` at the abstract operands, persist, return."""
+        with obs.span("serve_export", kind=sig.get("kind")):
+            exported = jax_export.export(jax.jit(fn))(*abstract_args)
+            self.store(sig, exported)
+        return ServeProgram(exported, sig, source="export")
+
+    def get_or_build(self, sig: dict, fn: Callable,
+                     abstract_args: Sequence[Any]) -> ServeProgram:
+        prog = self.load(sig)
+        if prog is None:
+            prog = self.build(sig, fn, abstract_args)
+        return prog
+
+    def _log(self, action: str, sig: dict, path: str, **extra) -> None:
+        rl = obs.active()
+        if rl is not None:
+            rl.log("export_cache", action=action,
+                   kind=sig.get("kind"), digest=sig_digest(sig),
+                   path=os.path.basename(path), **extra)
+
+
+def enable_compile_cache(cache_dir: str) -> bool:
+    """Arm JAX's persistent compilation cache at ``cache_dir`` (and the
+    obs hit/miss listener).  Thresholds are zeroed so even the small
+    CPU-tier programs of the tests/smokes are cached — at TPU scale the
+    defaults would admit everything anyway.  Safe to call repeatedly;
+    returns False when the running jax lacks the config knobs."""
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        return False
+    obs.install_cache_listener()
+    return True

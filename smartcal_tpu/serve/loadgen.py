@@ -1,0 +1,113 @@
+"""Synthetic open-loop load generator for the calibration service.
+
+OPEN loop: arrivals are a Poisson process at the offered rate,
+independent of service progress — the generator never waits for a
+response before submitting the next job, so queueing/shedding behavior
+under overload is actually exercised (a closed loop self-throttles and
+can never drive the server past saturation).
+
+Episodes are pre-built (host-side sky draws are not the thing under
+test) and cycled with a mixed direction-count/maxiter/rho profile, so
+every batch the router packs is heterogeneous — the one-compile-serves-
+every-mix property is load-tested, not just unit-tested.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .router import Job, ShedError
+
+
+def build_job_pool(backend, M: int, n: int, seed: int = 0,
+                   key0=None) -> List[Tuple[int, object]]:
+    """``n`` pre-built (k, episode) pairs with K cycling over [2, M]
+    (episodes padded to M directions — the server's contract)."""
+    import jax
+
+    key = jax.random.PRNGKey(seed) if key0 is None else key0
+    pool = []
+    for i in range(n):
+        key, k = jax.random.split(key)
+        kdirs = 2 + i % max(1, M - 1)
+        ep, _ = backend.new_calib_episode(k, kdirs, M)
+        pool.append((kdirs, ep))
+    return pool
+
+
+class OpenLoopLoadGen:
+    """Submit Poisson arrivals at ``rate`` jobs/s for ``duration_s``,
+    then wait for the tail and summarize.  Shed jobs count against the
+    offered rate (they are the overload signal, not an error)."""
+
+    def __init__(self, server, pool, rate: float, duration_s: float,
+                 seed: int = 0, deadline_s: Optional[float] = None,
+                 maxiter_choices=(None,)):
+        self.server = server
+        self.pool = pool
+        self.rate = float(rate)
+        self.duration_s = float(duration_s)
+        self.deadline_s = deadline_s
+        self.maxiter_choices = tuple(maxiter_choices)
+        self._rng = np.random.default_rng(seed)
+
+    def run(self, drain_timeout_s: float = 120.0) -> dict:
+        rng = self._rng
+        t_end = time.monotonic() + self.duration_s
+        futures, shed, submitted = [], 0, 0
+        i = 0
+        next_t = time.monotonic()
+        while True:
+            next_t += rng.exponential(1.0 / self.rate)
+            if next_t > t_end:
+                break
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            kdirs, ep = self.pool[i % len(self.pool)]
+            mi = self.maxiter_choices[i % len(self.maxiter_choices)]
+            rho = None
+            if rng.random() < 0.5:       # half pinned-rho, half default/policy
+                rho = np.exp(rng.uniform(np.log(0.1), np.log(10.0),
+                                         kdirs)).astype(np.float32)
+            job = Job(episode=ep, k=kdirs, rho=rho, maxiter=mi,
+                      deadline_s=self.deadline_s)
+            submitted += 1
+            i += 1
+            try:
+                futures.append(self.server.submit(job))
+            except ShedError:
+                shed += 1
+        t0_wall = time.monotonic()
+        results = []
+        for fut in futures:
+            remaining = drain_timeout_s - (time.monotonic() - t0_wall)
+            try:
+                results.append(fut.result(timeout=max(0.1, remaining)))
+            except Exception:            # failed/timed-out job: counted only
+                pass
+        return self.summarize(submitted, shed, results)
+
+    def summarize(self, submitted: int, shed: int, results) -> dict:
+        out = {"offered_rate": self.rate, "duration_s": self.duration_s,
+               "submitted": submitted, "shed": shed,
+               "completed": len(results),
+               "shed_rate": round(shed / max(1, submitted), 4)}
+        if results:
+            totals = np.asarray([r.total_s for r in results])
+            waits = np.asarray([r.queue_wait_s for r in results])
+            span = self.duration_s + float(totals.max())
+            out.update({
+                "achieved_jobs_s": round(len(results) / span, 3),
+                "latency_p50_s": round(float(np.percentile(totals, 50)), 4),
+                "latency_p99_s": round(float(np.percentile(totals, 99)), 4),
+                "queue_wait_p50_s": round(float(np.percentile(waits, 50)),
+                                          4),
+                "queue_wait_p99_s": round(float(np.percentile(waits, 99)),
+                                          4),
+                "degraded": int(sum(1 for r in results if r.degraded)),
+            })
+        return out

@@ -1,0 +1,451 @@
+"""CalibServer: calibration-as-a-service over the batched substrate.
+
+One persistent ``BatchedEpisode`` of ``lanes`` lanes is the serving
+buffer: each micro-batch splices its jobs' episodes into lanes (the
+donated ``_lane_splice``, in place on accelerators), then runs the
+AOT-exported (policy ->) solve -> influence triple — per-request K/rho/
+maxiter are traced operands, so EVERY request mix rides the programs
+exported once at warmup (zero per-request compiles; the smoke asserts
+it).
+
+Supervision reuses the PR 6/10 Fleet machinery as the circuit breaker:
+
+* the batch worker runs as a 1-slot supervised Fleet — a crash (beyond
+  the solver's own ``solve_admm_safe`` degradation ladder) fails the
+  in-flight jobs' futures with a structured ``serve_batch_failed``
+  event and restarts the worker with backoff;
+* a slot past ``max_restarts`` OPENS the circuit: ``submit`` sheds with
+  ``ShedError("circuit_open")`` instead of queueing work nobody will
+  drain;
+* overload sheds at the bounded admission queue (router.MicroBatcher).
+
+Solver degradation inside a batch is handled per LANE: a non-finite
+batched solve result re-routes that job through the sequential robust
+``calibrate`` (rho-boost retries -> host-segmented fallback — the
+``solve_admm_safe`` path), marking the job ``degraded`` instead of
+failing the batch.
+
+Telemetry is the obs stack verbatim: spans ``serve_batch`` /
+``serve_pack`` / ``serve_policy`` / ``serve_solve`` /
+``serve_influence`` (per-stage p50/p99 in tools/obs_report.py), a
+``serve_request`` event per job (queue wait / service / total), queue-
+depth + batch-fill gauges, shed/admit/compile counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from smartcal_tpu import obs
+from smartcal_tpu.envs import calib as calib_env
+from smartcal_tpu.runtime import supervisor
+
+from .export import ExportCache, abstract_like, enable_compile_cache
+from .router import Job, JobResult, MicroBatcher, ShedError
+
+
+def _event(name: str, **fields) -> None:
+    rl = obs.active()
+    if rl is not None:
+        rl.log(name, **fields)
+
+
+class CalibServer:
+    """See module doc.  Lifecycle::
+
+        srv = CalibServer(backend, M=5, lanes=8, cache_dir=...)
+        srv.warmup(seed=0)      # AOT export (or cache load) + first batch
+        srv.start()             # supervised batch worker + breaker loop
+        fut = srv.submit(Job(episode=ep, k=3, maxiter=12))
+        res = fut.result(timeout=...)   # JobResult
+        srv.stop()
+
+    ``policy`` (optional) is ``(SACConfig, actor_params)`` — jobs with
+    ``rho=None`` get their regularization from the exported
+    deterministic actor forward on their ``obs_vec``.
+    """
+
+    def __init__(self, backend, M: int, lanes: int, cache_dir: str,
+                 policy: Optional[tuple] = None, npix: Optional[int] = None,
+                 max_wait_s: float = 0.05, max_queue: int = 64,
+                 heartbeat_timeout: float = 300.0, max_restarts: int = 3,
+                 backoff: Optional[supervisor.BackoffPolicy] = None,
+                 poll_s: float = 0.05, idle_tick_s: float = 0.2,
+                 compile_cache: bool = True):
+        self.backend = backend
+        self.M = int(M)
+        self.lanes = int(lanes)
+        self.npix = int(npix or backend.npix)
+        self.cache_dir = cache_dir
+        self.cache = ExportCache(f"{cache_dir}/programs")
+        if compile_cache:
+            # the XLA half of the zero-recompile restart: the exported
+            # modules' backend compiles become disk hits too
+            enable_compile_cache(f"{cache_dir}/xla")
+        self.batcher = MicroBatcher(lanes, max_wait_s=max_wait_s,
+                                    max_queue=max_queue)
+        self._policy = policy
+        self._lock = threading.Lock()
+        self._programs: dict = {}       # latest-executable table
+        self._circuit_open = False
+        self._stats = {"batches": 0, "served": 0, "degraded": 0,
+                       "failed": 0, "deadline_miss": 0}
+        self._bep = None                # worker-owned serving buffer
+        self._batch_id = 0
+        self._fleet: Optional[supervisor.Fleet] = None
+        self._sup: Optional[threading.Thread] = None
+        self._stop_ev = threading.Event()
+        self._hb = float(heartbeat_timeout)
+        self._max_restarts = int(max_restarts)
+        self._backoff = backoff
+        self._poll_s = float(poll_s)
+        self._idle_tick_s = float(idle_tick_s)
+
+    # -- warmup / AOT ------------------------------------------------------
+    def warmup(self, seed: int = 0) -> dict:
+        """Build (or load) the exported program triple and run one full
+        warmup batch through it — after this returns, steady state
+        compiles nothing.  Returns the timing/counter summary that the
+        restart measurement compares cold vs warm."""
+        t0 = time.time()
+        c0 = obs.counters_snapshot()
+        with obs.span("serve_warmup", lanes=self.lanes):
+            key = jax.random.PRNGKey(seed)
+            eps = []
+            for _ in range(self.lanes):
+                key, k = jax.random.split(key)
+                ep, _ = self.backend.new_calib_episode(k, self.M, self.M)
+                eps.append(ep)
+            self._bep = self.backend.stack_episodes(eps)
+            E, M = self.lanes, self.M
+            rho = np.ones((E, M), np.float32)
+            alpha = np.zeros((E, M), np.float32)
+            base = self.backend.serve_signature(M, E, self.npix)
+
+            ops = self.backend.batched_solve_operands(self._bep, rho)
+            solve = self.cache.get_or_build(
+                dict(base, kind="solve"),
+                self.backend.batched_solve_callable(M), abstract_like(ops))
+            res = solve(*ops)
+
+            iops = self.backend.batched_influence_operands(
+                self._bep, res, rho, alpha)
+            influence = self.cache.get_or_build(
+                dict(base, kind="influence"),
+                self.backend.batched_influence_callable(M, self.npix),
+                abstract_like(iops))
+            imgs = influence(*iops)
+
+            progs = {"solve": solve, "influence": influence}
+            if self._policy is not None:
+                progs["policy"] = self._export_policy(base)
+            jax.block_until_ready((res.sigma_res, imgs))
+            with self._lock:
+                self._programs = progs
+            # one full batch through the REQUEST path (splice, lane
+            # params, sigmas, all the jnp glue) so steady state compiles
+            # nothing — the warm jobs are tagged out of the SLO stats
+            warm_jobs = [
+                Job(episode=ep, k=self.M,
+                    rho=np.ones(self.M, np.float32),
+                    maxiter=int(self.backend.admm_iters), warm=True)
+                for ep in eps]
+            self._process_batch(warm_jobs)
+            for job in warm_jobs:
+                job.future.result()
+        c1 = obs.counters_snapshot()
+        summary = {
+            "wall_s": round(time.time() - t0, 3),
+            "sources": {k: p.source for k, p in progs.items()},
+            **{k: c1.get(k, 0.0) - c0.get(k, 0.0)
+               for k in ("export_cache_hit", "export_cache_miss",
+                         "jax_compile_events", "jax_compile_secs",
+                         "persistent_cache_hits",
+                         "persistent_cache_misses")},
+        }
+        _event("serve_warmup", **summary)
+        return summary
+
+    def _export_policy(self, base_sig: dict):
+        import hashlib
+
+        from smartcal_tpu.rl import sac
+
+        cfg, actor_params = self._policy
+        obs_dim = self.npix * self.npix + (self.M + 1) * 7
+        sig = dict(base_sig, kind="policy", obs_dim=obs_dim,
+                   act_dim=2 * self.M,
+                   cfg_digest=hashlib.sha256(
+                       repr(cfg).encode()).hexdigest()[:12])
+        aargs = (abstract_like(actor_params),
+                 jax.ShapeDtypeStruct((self.lanes, obs_dim), np.float32))
+        prog = self.cache.get_or_build(
+            sig, lambda ap, o: sac.policy_apply(cfg, ap, o), aargs)
+        # warm the backend compile of the deserialized module
+        zeros = np.zeros((self.lanes, obs_dim), np.float32)
+        jax.block_until_ready(prog(actor_params, zeros))
+        return prog
+
+    def _program(self, kind: str):
+        with self._lock:
+            prog = self._programs.get(kind)
+        if prog is None:
+            raise RuntimeError(f"no {kind!r} program — call warmup() first")
+        return prog
+
+    # -- request path ------------------------------------------------------
+    @property
+    def circuit_open(self) -> bool:
+        with self._lock:
+            return self._circuit_open
+
+    def submit(self, job: Job):
+        """Admit a job (returns its future) or shed: circuit open /
+        stopped server / queue full raise :class:`ShedError` with a
+        structured event."""
+        if self._stop_ev.is_set() and self._fleet is None:
+            # a stopped server has no worker: admitting would strand
+            # the job in the batcher forever (start() re-opens)
+            obs.counter_add("serve_shed")
+            _event("serve_shed", job_id=job.job_id, reason="shutdown")
+            raise ShedError("shutdown")
+        if self.circuit_open:
+            obs.counter_add("serve_shed")
+            _event("serve_shed", job_id=job.job_id, reason="circuit_open")
+            raise ShedError("circuit_open")
+        if job.episode.n_dirs != self.M:
+            raise ValueError(f"job episode padded to {job.episode.n_dirs} "
+                             f"directions, server expects M={self.M}")
+        if not 1 <= job.k <= self.M:
+            raise ValueError(f"job.k={job.k} outside [1, M={self.M}]")
+        return self.batcher.submit(job)
+
+    # -- batch execution ---------------------------------------------------
+    def _lane_params(self, batch):
+        """(rho, mask, alpha, iters) lane arrays for this batch.  Idle
+        lanes re-run their stale (valid) episode under the default rho —
+        the program shape is fixed at ``lanes``.  Jobs with rho=None and
+        an armed policy get theirs from the exported actor forward."""
+        E, M = self.lanes, self.M
+        rho = np.ones((E, M), np.float32)
+        mask = np.zeros((E, M), np.float32)
+        alpha = np.zeros((E, M), np.float32)
+        iters = np.full((E,), self.backend.admm_iters, np.int32)
+        mask[:, :2] = 1.0               # idle lanes: 2 live dirs, rho=1
+        want_policy = []
+        for lane, job in enumerate(batch):
+            mask[lane] = 0.0
+            mask[lane, :job.k] = 1.0
+            if job.maxiter is not None:
+                iters[lane] = int(job.maxiter)
+            if job.rho is not None:
+                rho[lane, :job.k] = np.asarray(job.rho,
+                                               np.float32)[:job.k]
+                if job.rho_spatial is not None:
+                    alpha[lane, :job.k] = np.asarray(job.rho_spatial,
+                                                     np.float32)[:job.k]
+            elif self._policy is not None:
+                want_policy.append(lane)
+        if want_policy:
+            with obs.span("serve_policy", lanes=len(want_policy)):
+                obs_dim = self.npix * self.npix + (self.M + 1) * 7
+                ovec = np.zeros((E, obs_dim), np.float32)
+                for lane in want_policy:
+                    if batch[lane].obs_vec is not None:
+                        ovec[lane] = np.asarray(batch[lane].obs_vec,
+                                                np.float32)
+                _, actor_params = self._policy
+                act = np.asarray(self._program("policy")(
+                    actor_params, ovec))
+                lo, hi = calib_env.LOW, calib_env.HIGH
+                mapped = act * (hi - lo) / 2 + (hi + lo) / 2
+                for lane in want_policy:
+                    k = batch[lane].k
+                    rho[lane, :k] = np.clip(mapped[lane, :k], lo, hi)
+                    alpha[lane, :k] = np.clip(
+                        mapped[lane, M:M + k], lo, hi)
+        return rho, mask, alpha, iters
+
+    def _degraded_result(self, job, rho_row, mask_row, alpha_row, it):
+        """Sequential robust re-solve for one non-finite lane: the
+        ``solve_admm_safe`` ladder (rho-boost retries -> host-segmented
+        fallback) behind the per-episode ``calibrate`` route."""
+        r = self.backend.calibrate(job.episode, rho_row, mask=mask_row,
+                                   admm_iters=int(it))
+        img = np.asarray(self.backend.influence_image(
+            job.episode, r, rho_row, alpha_row, npix=self.npix))
+        sig_d = float(np.std(np.asarray(self.backend.data_image(
+            job.episode, npix=self.npix))))
+        sig_r = float(np.std(np.asarray(self.backend.residual_image(
+            job.episode, r, npix=self.npix))))
+        return (float(np.asarray(r.sigma_res)), sig_d, sig_r,
+                float(np.std(img)))
+
+    def _process_batch(self, batch) -> int:
+        t_start = time.monotonic()
+        E = self.lanes
+        with self._lock:
+            self._batch_id += 1
+            batch_id = self._batch_id
+        with obs.span("serve_batch", jobs=len(batch), batch=batch_id):
+            with obs.span("serve_pack", jobs=len(batch)):
+                for lane, job in enumerate(batch):
+                    self._bep = self.backend.splice_episode(
+                        self._bep, lane, job.episode)
+                rho, mask, alpha, iters = self._lane_params(batch)
+            ops = self.backend.batched_solve_operands(
+                self._bep, rho, mask, iters)
+            with obs.span("serve_solve", lanes=E):
+                res = self._program("solve")(*ops)
+                sig = np.asarray(res.sigma_res)
+            with obs.span("serve_influence", lanes=E):
+                imgs = np.asarray(self._program("influence")(
+                    *self.backend.batched_influence_operands(
+                        self._bep, res, rho, alpha)))
+            with obs.span("serve_sigma"):
+                sig_d, sig_r = (np.asarray(a) for a in
+                                self.backend.image_sigmas_batched(
+                                    self._bep, res, npix=self.npix))
+        t_done = time.monotonic()
+        service = t_done - t_start
+        self.batcher.note_service_time(service)
+        obs.gauge_set("serve_batch_fill", len(batch) / E)
+        n_degraded = 0
+        for lane, job in enumerate(batch):
+            degraded = not np.isfinite(sig[lane])
+            if degraded:
+                n_degraded += 1
+                obs.counter_add("serve_degraded")
+                _event("serve_degraded", job_id=job.job_id, lane=lane,
+                       batch=batch_id)
+                vals = self._degraded_result(job, rho[lane], mask[lane],
+                                             alpha[lane], iters[lane])
+            else:
+                vals = (float(sig[lane]), float(sig_d[lane]),
+                        float(sig_r[lane]), float(np.std(imgs[lane])))
+            total = time.monotonic() - job.t_submit
+            missed = (job.deadline_s is not None and total > job.deadline_s)
+            if missed:
+                obs.counter_add("serve_deadline_miss")
+            result = JobResult(
+                job_id=job.job_id, lane=lane, batch_id=batch_id,
+                sigma_res=vals[0], sigma_data_img=vals[1],
+                sigma_res_img=vals[2], img_std=vals[3], degraded=degraded,
+                queue_wait_s=round(t_start - job.t_submit, 6),
+                service_s=round(service, 6), total_s=round(total, 6))
+            _event("serve_request", job_id=job.job_id, lane=lane,
+                   batch=batch_id, k=job.k, maxiter=job.maxiter,
+                   degraded=degraded, deadline_miss=missed,
+                   queue_wait_s=result.queue_wait_s,
+                   service_s=result.service_s, total_s=result.total_s,
+                   sigma_res=vals[0],
+                   **({"warm": True} if job.warm else {}))
+            obs.counter_add("serve_jobs_warm" if job.warm
+                            else "serve_jobs")
+            job.future.set_result(result)
+        with self._lock:
+            self._stats["batches"] += 1
+            self._stats["served"] += len(batch)
+            self._stats["degraded"] += n_degraded
+        return len(batch)
+
+    def process_once(self, jobs, timeout: float = 0.0) -> int:
+        """Synchronously pack+serve up to ``lanes`` queued/given jobs on
+        the CALLER's thread (tests, warmup probes).  Only valid while
+        the supervised worker is NOT running."""
+        if self._fleet is not None:
+            raise RuntimeError("process_once with a running fleet would "
+                               "race the batch worker")
+        for job in jobs:
+            self.batcher.submit(job)
+        batch = self.batcher.next_batch(timeout=max(timeout, 0.001))
+        return self._process_batch(batch) if batch else 0
+
+    # -- supervised worker + breaker loop ----------------------------------
+    def _work(self, actor_id, iteration, weights):
+        batch = self.batcher.next_batch(timeout=self._idle_tick_s)
+        if not batch:
+            return {"served": 0}
+        try:
+            n = self._process_batch(batch)
+        except BaseException as e:    # noqa: BLE001 — death IS the signal
+            _event("serve_batch_failed", jobs=[j.job_id for j in batch],
+                   error=repr(e))
+            with self._lock:
+                self._stats["failed"] += len(batch)
+            for job in batch:
+                if not job.future.done():
+                    job.future.set_exception(e)
+            raise
+        return {"served": n}
+
+    def start(self) -> None:
+        """Start the supervised batch worker and the breaker loop."""
+        if self._fleet is not None:
+            raise RuntimeError("server already started")
+        self._stop_ev.clear()
+        kw = {"name": "serve", "heartbeat_timeout": self._hb,
+              "max_restarts": self._max_restarts, "queue_depth": 4}
+        if self._backoff is not None:
+            kw["backoff"] = self._backoff
+        fleet = supervisor.Fleet(1, self._work, **kw)
+        fleet.start(None)
+        sup = threading.Thread(target=self._supervise, name="serve-breaker",
+                               daemon=True)
+        with self._lock:
+            self._fleet = fleet
+            self._sup = sup
+        sup.start()
+
+    def _supervise(self) -> None:
+        """The breaker loop: poll the fleet (death detection + backoff
+        restarts), drain its summary queue, open/close the circuit on
+        slot failure, and emit the queue-depth gauge stream."""
+        while not self._stop_ev.wait(self._poll_s):
+            fleet = self._fleet
+            if fleet is None:
+                return
+            try:
+                fleet.poll()
+                # drain the worker's summary queue: an undrained bounded
+                # queue back-pressures the batch worker to a HALT (the
+                # cold-run postmortem that added this try/except)
+                fleet.collect(max_items=64, timeout=0.0)
+                open_now = bool(fleet.failed_slots)
+                with self._lock:
+                    changed = open_now != self._circuit_open
+                    self._circuit_open = open_now
+                if changed:
+                    obs.counter_add("serve_circuit_transitions")
+                    _event("serve_circuit", open=open_now,
+                           restarts=fleet.restarts_total())
+                obs.gauge_set("serve_queue_depth", self.batcher.depth())
+            except Exception as e:   # breaker must outlive a bad pass
+                obs.counter_add("serve_breaker_errors")
+                _event("serve_breaker_error", error=repr(e))
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+        out.update(self.batcher.stats())
+        out["circuit_open"] = self.circuit_open
+        return out
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop the worker, fail any stranded queued jobs explicitly."""
+        self._stop_ev.set()
+        with self._lock:
+            fleet, sup = self._fleet, self._sup
+            self._fleet, self._sup = None, None
+        if sup is not None:
+            sup.join(timeout=timeout)
+        if fleet is not None:
+            fleet.stop(join=True, timeout=timeout)
+        for job in self.batcher.drain():
+            if not job.future.done():
+                job.future.set_exception(ShedError("shutdown"))

@@ -80,6 +80,12 @@ def add_obs_args(p):
                         "stream (implies --diag): on NaN losses, exploding "
                         "grad norms or Q blowup, emit watchdog_trip and "
                         "halt the run gracefully")
+    p.add_argument("--compile-cache", dest="compile_cache", type=str,
+                   default=os.environ.get("SMARTCAL_COMPILE_CACHE") or None,
+                   help="persistent XLA compilation cache dir (env "
+                        "SMARTCAL_COMPILE_CACHE): repeat runs skip the "
+                        "first-episode compile; hit/miss counters land in "
+                        "the metrics stream")
     return p
 
 
@@ -109,9 +115,15 @@ class TrainObs:
 
     def __init__(self, entry, metrics=None, run_id=None, trace=None,
                  quiet=False, diag=False, watchdog=False,
-                 watchdog_cfg=None, **meta):
+                 watchdog_cfg=None, compile_cache=None, **meta):
         self.entry = entry
         self.quiet = quiet
+        if compile_cache:
+            # persistent XLA compilation cache (+ the obs hit/miss
+            # listener): repeat runs stop paying the first compile
+            from smartcal_tpu.serve.export import enable_compile_cache
+            if not enable_compile_cache(compile_cache):
+                self.echo(f"compile cache unavailable at {compile_cache}")
         self._t0 = time.time()
         self._episodes = 0
         self._tracing = False
@@ -299,6 +311,7 @@ def train_obs_from_args(args, entry, **meta) -> TrainObs:
                     # without the detector would never fire
                     watchdog=(getattr(args, "watchdog", False)
                               or getattr(args, "max_recoveries", 0) > 0),
+                    compile_cache=getattr(args, "compile_cache", None),
                     seed=getattr(args, "seed", None), **meta)
 
 

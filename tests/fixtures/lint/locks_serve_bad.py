@@ -1,0 +1,42 @@
+"""Positive fixture: unlocked writes to the SERVING shared state
+(the ISSUE 15 latest-executable table / breaker flag / admission
+counters).
+
+The test registers this file with two specs mirroring the shipped
+SHARED_FIELD_SPECS rows: class CalibServer, fields {_programs,
+_circuit_open, _stats}, lock {_lock}; class MicroBatcher, fields
+{_accepted, _shed, _service_est_s}, lock {_lock}.
+"""
+import threading
+
+
+class CalibServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs = {}            # ok: __init__ runs pre-sharing
+        self._circuit_open = False
+        self._stats = {"served": 0}
+
+    def warmup(self, progs):
+        self._programs = progs         # BAD: swap without the lock
+
+    def trip(self):
+        self._circuit_open = True      # BAD: breaker write, no lock
+
+    def account(self, n):
+        self._stats["served"] += n     # BAD: subscript store, no lock
+        self._programs.clear()         # BAD: mutator without the lock
+
+
+class MicroBatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._accepted = 0
+        self._shed = 0
+        self._service_est_s = 0.5
+
+    def submit(self):
+        self._accepted += 1            # BAD: aug-assign without the lock
+
+    def note_service_time(self, s):
+        self._service_est_s = s        # BAD: EWMA write, no lock

@@ -1,0 +1,47 @@
+"""Negative fixture: lock-disciplined serving shared state — zero
+findings.  Registered with the same specs as locks_serve_bad.py.
+"""
+import threading
+
+
+class CalibServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs = {}
+        self._circuit_open = False
+        self._stats = {"served": 0}
+
+    def warmup(self, progs):
+        with self._lock:
+            self._programs = progs     # ok: under the annotated lock
+
+    def trip(self):
+        with self._lock:
+            self._circuit_open = True
+
+    def account(self, n):
+        with self._lock:
+            self._stats["served"] += n
+
+    def stats(self):
+        with self._lock:
+            return dict(self._stats)   # reads unchecked
+
+    def _swap_locked(self, progs):
+        self._programs = progs         # ok: *_locked caller-holds-lock
+
+
+class MicroBatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._accepted = 0
+        self._shed = 0
+        self._service_est_s = 0.5
+
+    def submit(self):
+        with self._lock:
+            self._accepted += 1        # ok: under the annotated lock
+
+    def note_service_time(self, s):
+        with self._lock:
+            self._service_est_s += 0.3 * (s - self._service_est_s)

@@ -157,6 +157,33 @@ def test_locks_shard_rule_negative():
                             "unlocked-shared-write", opts) == []
 
 
+# the ISSUE 15 serving fields: latest-executable table + breaker flag
+# + stats (CalibServer) and admission counters + service-time EWMA
+# (MicroBatcher) — mirrors the shipped SHARED_FIELD_SPECS rows
+def _serve_specs(path):
+    return [
+        {"path": path, "class": "CalibServer",
+         "fields": ["_programs", "_circuit_open", "_stats"],
+         "locks": ["_lock"], "why": "fixture"},
+        {"path": path, "class": "MicroBatcher",
+         "fields": ["_accepted", "_shed", "_service_est_s"],
+         "locks": ["_lock"], "why": "fixture"},
+    ]
+
+
+def test_locks_serve_rule_positive():
+    opts = {"shared_specs": _serve_specs("locks_serve_bad.py")}
+    fs = fixture_findings("locks_serve_bad.py", "unlocked-shared-write",
+                          opts)
+    assert lines_of(fs) == [21, 24, 27, 28, 39, 42], fs
+
+
+def test_locks_serve_rule_negative():
+    opts = {"shared_specs": _serve_specs("locks_serve_good.py")}
+    assert fixture_findings("locks_serve_good.py",
+                            "unlocked-shared-write", opts) == []
+
+
 def test_shipped_shared_specs_cover_cross_process_fields():
     """The SHIPPED spec table must keep the ISSUE 12 rows: the shard
     directory / slot->shard map and the process-actor outbox — dropping
@@ -167,6 +194,19 @@ def test_shipped_shared_specs_cover_cross_process_fields():
               if s["path"].endswith("supervisor.py")
               for f in s["fields"]}
     assert {"_shard_qs", "_slot_shard", "_outbox"} <= fields
+
+
+def test_shipped_shared_specs_cover_serving_fields():
+    """The SHIPPED spec table must keep the ISSUE 15 rows: the server's
+    latest-executable table / breaker flag / stats and the batcher's
+    admission counters + service-time EWMA."""
+    from smartcal_tpu.analysis.rules.locks import SHARED_FIELD_SPECS
+
+    fields = {f for s in SHARED_FIELD_SPECS
+              if "smartcal_tpu/serve/" in s["path"]
+              for f in s["fields"]}
+    assert {"_programs", "_circuit_open", "_stats",
+            "_accepted", "_shed", "_service_est_s"} <= fields
 
 
 def _lint_as_package(tmp_path, *names):

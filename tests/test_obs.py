@@ -431,6 +431,51 @@ def test_obs_report_folds_rotated_segments(tmp_path):
     assert len(scores) == 4
 
 
+def test_obs_report_serving_section(tmp_path):
+    """Serving SLO aggregation: warm probes excluded from percentiles,
+    shed rate over offered (live + shed) jobs, and the per-request
+    compile check scoped to the live serving window — a compile logged
+    while the load generator built episodes (before the first
+    submission) must not count."""
+    path = str(tmp_path / "serve.jsonl")
+    evs = [{"event": "run_header", "run_id": "s", "schema": 1},
+           {"event": "serve_warmup", "t": 100.0, "wall_s": 9.5,
+            "sources": {"solve": "cache", "influence": "cache"},
+            "export_cache_hit": 2.0, "export_cache_miss": 0.0},
+           # pool building compiles AFTER warmup, BEFORE serving: legit
+           {"event": "jax_event", "t": 101.0, "key": "compile",
+            "dur_s": 0.5},
+           {"event": "serve_request", "t": 110.0, "warm": True,
+            "total_s": 9.0, "queue_wait_s": 0.0, "service_s": 9.0},
+           {"event": "serve_shed", "t": 111.0, "job_id": 9,
+            "reason": "queue_full", "depth": 4}]
+    for i in range(4):
+        evs.append({"event": "serve_request", "t": 112.0 + i,
+                    "total_s": 0.2, "queue_wait_s": 0.05,
+                    "service_s": 0.15, "degraded": i == 0,
+                    "deadline_miss": False})
+        evs.append({"event": "span", "name": "serve_solve",
+                    "path": "serve_batch/serve_solve", "t": 112.0 + i,
+                    "dur_s": 0.1})
+    with open(path, "w") as fh:
+        for e in evs:
+            fh.write(json.dumps(e) + "\n")
+    rep = obs_report.build_report([obs_report.load_run(path)], n_boot=50)
+    sv = rep["runs"][0]["serving"]
+    assert sv["requests"] == 4 and sv["warm_probes"] == 1
+    assert sv["shed"] == 1 and sv["shed_rate"] == 0.2
+    assert sv["degraded"] == 1 and sv["deadline_miss"] == 0
+    # the 9 s warm probe must not smear the live percentiles
+    assert sv["total_s"]["p99"] <= 0.2
+    assert sv["stages"]["serve_solve"]["n"] == 4
+    # pool-building compile (t=101) is outside the serving window
+    assert sv["compiles_in_serving"] == 0
+    assert sv["warmup"]["sources"]["solve"] == "cache"
+    text = obs_report.render(rep)
+    assert "serving SLO" in text
+    assert "compiles in serving window: 0" in text
+
+
 # ---------------------------------------------------------------------------
 # Driver integration (cheap enet run)
 # ---------------------------------------------------------------------------

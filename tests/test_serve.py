@@ -1,0 +1,295 @@
+"""Serving subsystem: AOT export cache, heterogeneous-lane micro-
+batching, supervision-as-circuit-breaker, SLO telemetry.
+
+The load-bearing claims, each pinned here:
+
+* HETEROGENEITY RIDES ONE COMPILE — jobs with different K / rho /
+  maxiter splice into one ``BatchedEpisode`` and run the programs
+  exported at warmup; after warmup the compile-listener counter must
+  not move, and every lane must match the sequential per-episode
+  ``calibrate`` oracle (EXACTLY: serving and training jit the identical
+  callable).
+* WARM RESTART — a second server on the same cache dir deserializes
+  every program (``source == "cache"``, zero export-cache misses)
+  instead of re-tracing.
+* DEGRADATION — a non-finite batched lane re-routes through the
+  sequential robust solve and marks the job ``degraded`` rather than
+  failing the batch.
+* BREAKER — a crashing batch worker fails the in-flight futures, and a
+  slot past ``max_restarts`` opens the circuit: ``submit`` sheds with
+  ``ShedError("circuit_open")``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from smartcal_tpu import obs
+from smartcal_tpu.envs.radio import RadioBackend
+from smartcal_tpu.runtime.backoff import BackoffPolicy
+from smartcal_tpu.serve import (CalibServer, Job, MicroBatcher, ShedError)
+
+M = 3
+LANES = 3
+SEED = 7
+
+
+def tiny_backend(**kw):
+    args = dict(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                admm_iters=2, lbfgs_iters=3, init_iters=5, npix=32)
+    args.update(kw)
+    return RadioBackend(**args)
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """One warmed (never started) server + an active RunLog for the
+    whole module: the export build runs ONCE.  ``compile_cache=False``
+    keeps the process-global XLA cache config untouched for the rest of
+    the suite."""
+    obs.install_compile_listener()
+    path = tmp_path_factory.mktemp("serve") / "run.jsonl"
+    rl = obs.RunLog(str(path), run_id="serve-test", flush_lines=1)
+    obs.activate(rl)
+    be = tiny_backend()
+    cache = str(tmp_path_factory.mktemp("serve_cache"))
+    srv = CalibServer(be, M=M, lanes=LANES, cache_dir=cache,
+                      compile_cache=False, max_wait_s=0.02)
+    warm = srv.warmup(seed=SEED)
+    yield be, srv, warm, cache, str(path)
+    while obs.active() is not None:
+        obs.deactivate()
+
+
+def _jobs(be, specs, seed=SEED + 1):
+    """(k, maxiter) specs -> jobs with distinct pinned rho per job."""
+    import jax
+
+    key = jax.random.PRNGKey(seed)
+    jobs = []
+    for i, (k, maxiter) in enumerate(specs):
+        key, sub = jax.random.split(key)
+        ep, _ = be.new_calib_episode(sub, k, M)
+        rho = np.linspace(0.5 + i, 1.5 + i, k).astype(np.float32)
+        jobs.append(Job(episode=ep, k=k, rho=rho, maxiter=maxiter))
+    return jobs
+
+
+class TestHeterogeneousBatch:
+    SPECS = [(2, 2), (3, 3), (2, 4)]     # (k, maxiter) per lane — all mixed
+
+    @pytest.fixture(scope="class")
+    def batch_run(self, served):
+        be, srv, _, _, _ = served
+        jobs = _jobs(be, self.SPECS)
+        c0 = obs.counters_snapshot().get("jax_compile_events", 0.0)
+        n = srv.process_once(jobs, timeout=0.01)
+        c1 = obs.counters_snapshot().get("jax_compile_events", 0.0)
+        return jobs, n, c1 - c0
+
+    def test_mixed_k_rho_maxiter_share_one_warm_program(self, batch_run):
+        jobs, n, compile_delta = batch_run
+        assert n == len(self.SPECS)
+        assert compile_delta == 0, (
+            f"{compile_delta} compile events for a heterogeneous batch "
+            "after warmup — per-request K/rho/maxiter must be traced "
+            "operands of the exported program")
+        lanes = {j.future.result(timeout=1).lane for j in jobs}
+        assert lanes == set(range(len(self.SPECS)))
+
+    def test_each_lane_matches_sequential_oracle(self, batch_run, served):
+        be = served[0]
+        for j in batch_run[0]:
+            got = j.future.result(timeout=1)
+            rho = np.ones(M, np.float32)
+            rho[:j.k] = j.rho
+            mask = np.zeros(M, np.float32)
+            mask[:j.k] = 1.0
+            want = be.calibrate(j.episode, rho, mask=mask,
+                                admm_iters=j.maxiter)
+            # identical callable, two compilation paths -> exact match
+            np.testing.assert_array_equal(
+                got.sigma_res, np.asarray(want.sigma_res))
+            assert not got.degraded
+
+    def test_request_events_carry_slo_fields(self, batch_run, served):
+        path = served[4]
+        import json
+        evs = [json.loads(ln) for ln in open(path).read().splitlines()]
+        reqs = [e for e in evs if e.get("event") == "serve_request"
+                and not e.get("warm")]
+        assert len(reqs) >= len(self.SPECS)
+        for e in reqs:
+            assert e["queue_wait_s"] >= 0
+            assert e["service_s"] > 0
+            assert e["total_s"] >= e["service_s"]
+        # warmup probes are tagged OUT of the SLO population
+        warm = [e for e in evs if e.get("event") == "serve_request"
+                and e.get("warm")]
+        assert len(warm) == LANES
+
+
+def test_warm_restart_deserializes_every_program(served, tmp_path):
+    """Second server, same cache dir: every program comes back
+    ``source == "cache"`` with zero export-cache misses — the restart
+    never re-traces (and with the persistent XLA cache armed in prod,
+    never re-compiles: tools/smoke_serve.sh measures that half)."""
+    be, _, warm0, cache, _ = served
+    assert warm0["sources"] == {"solve": "export", "influence": "export"}
+    c0 = obs.counters_snapshot()
+    srv2 = CalibServer(tiny_backend(), M=M, lanes=LANES, cache_dir=cache,
+                       compile_cache=False)
+    warm = srv2.warmup(seed=SEED)
+    assert warm["sources"] == {"solve": "cache", "influence": "cache"}
+    assert warm["export_cache_miss"] == 0
+    c1 = obs.counters_snapshot()
+    assert c1.get("export_cache_hit", 0) - c0.get("export_cache_hit", 0) == 2
+    # and the restarted server actually serves
+    jobs = _jobs(be, [(2, 2), (3, 2), (2, 3)])
+    assert srv2.process_once(jobs, timeout=0.01) == 3
+    for j in jobs:
+        assert np.isfinite(j.future.result(timeout=1).sigma_res)
+
+
+def test_degraded_lane_reroutes_through_sequential_solve(served):
+    """A non-finite batched lane result must come back ``degraded`` via
+    the sequential ``solve_admm_safe`` route, not fail the batch."""
+    be, srv, _, _, _ = served
+    real = srv._program("solve")
+
+    class NaNLane0:
+        source = "test"
+
+        def __call__(self, *args):
+            res = real(*args)
+            sig = np.asarray(res.sigma_res).copy()
+            sig[0] = np.nan
+            return res._replace(sigma_res=sig)
+
+    with srv._lock:
+        srv._programs = dict(srv._programs, solve=NaNLane0())
+    try:
+        jobs = _jobs(be, [(2, 2), (2, 2)])
+        assert srv.process_once(jobs, timeout=0.01) == 2
+        r0 = jobs[0].future.result(timeout=1)
+        r1 = jobs[1].future.result(timeout=1)
+    finally:
+        with srv._lock:
+            srv._programs = dict(srv._programs, solve=real)
+    assert r0.degraded and np.isfinite(r0.sigma_res)
+    assert not r1.degraded
+    assert srv.stats()["degraded"] >= 1
+
+
+def test_submit_validates_job_shape(served):
+    import jax
+
+    be, srv, _, _, _ = served
+    ep, _ = be.new_calib_episode(jax.random.PRNGKey(0), 2, M)
+    with pytest.raises(ValueError, match="outside"):
+        srv.submit(Job(episode=ep, k=M + 1))
+    ep2, _ = be.new_calib_episode(jax.random.PRNGKey(0), 2, 2)
+    with pytest.raises(ValueError, match="padded"):
+        srv.submit(Job(episode=ep2, k=2))
+
+
+# ---------------------------------------------------------------------------
+# MicroBatcher (no jax, no backend)
+# ---------------------------------------------------------------------------
+
+def _stub_job(deadline_s=None):
+    return Job(episode=None, k=1, deadline_s=deadline_s)
+
+
+class TestMicroBatcher:
+    def test_full_lanes_flush_immediately(self):
+        b = MicroBatcher(lanes=3, max_wait_s=5.0)
+        for _ in range(3):
+            b.submit(_stub_job())
+        t0 = time.monotonic()
+        batch = b.next_batch(timeout=0.1)
+        assert len(batch) == 3
+        assert time.monotonic() - t0 < 1.0      # never waited max_wait_s
+
+    def test_max_wait_flushes_partial_batch(self):
+        b = MicroBatcher(lanes=4, max_wait_s=0.05)
+        b.submit(_stub_job())
+        t0 = time.monotonic()
+        batch = b.next_batch(timeout=0.1)
+        dt = time.monotonic() - t0
+        assert len(batch) == 1
+        assert 0.03 <= dt < 1.0                 # held ~max_wait_s, not more
+
+    def test_deadline_pulls_flush_earlier_than_max_wait(self):
+        b = MicroBatcher(lanes=4, max_wait_s=10.0, service_est_s=1.0)
+        b.submit(_stub_job(deadline_s=1.0))     # slack = 1.0 - 1.0 = now
+        t0 = time.monotonic()
+        batch = b.next_batch(timeout=0.1)
+        assert len(batch) == 1
+        assert time.monotonic() - t0 < 1.0
+        # EWMA feedback moves the estimate the deadline pull reads
+        b.note_service_time(2.0)
+        assert b.service_estimate_s() > 1.0
+
+    def test_bounded_queue_sheds_structured(self):
+        b = MicroBatcher(lanes=2, max_queue=2)
+        b.submit(_stub_job())
+        b.submit(_stub_job())
+        with pytest.raises(ShedError) as ei:
+            b.submit(_stub_job())
+        assert ei.value.reason == "queue_full"
+        assert b.stats() == {"accepted": 2, "shed": 1,
+                             "service_est_s": 0.5}
+        assert len(b.drain()) == 2 and b.depth() == 0
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker (stubbed batch execution — no programs, no warmup)
+# ---------------------------------------------------------------------------
+
+def test_stopped_server_sheds_submits(tmp_path):
+    """A stopped server has no worker: admitting would strand the job
+    in the batcher forever, so submit sheds ``ShedError("shutdown")``
+    (found by the post-stop drive, not a test)."""
+    srv = CalibServer(object(), M=M, lanes=2, cache_dir=str(tmp_path),
+                      npix=32, compile_cache=False,
+                      poll_s=0.01, idle_tick_s=0.02)
+    srv.start()
+    srv.stop()
+    with pytest.raises(ShedError) as ei:
+        srv.submit(Job(episode=None, k=1))
+    assert ei.value.reason == "shutdown"
+
+
+def test_worker_crash_fails_futures_then_opens_circuit(monkeypatch,
+                                                       tmp_path):
+    srv = CalibServer(object(), M=M, lanes=2, cache_dir=str(tmp_path),
+                      npix=32, compile_cache=False, max_restarts=1,
+                      backoff=BackoffPolicy(base_s=0.01, factor=1.0,
+                                            max_s=0.01, jitter=0.0),
+                      poll_s=0.01, idle_tick_s=0.02, heartbeat_timeout=5.0)
+    monkeypatch.setattr(
+        srv, "_process_batch",
+        lambda batch: (_ for _ in ()).throw(RuntimeError("poison")))
+    srv.start()
+    try:
+        job = Job(episode=None, k=1)
+        fut = srv.batcher.submit(job)       # bypass n_dirs validation
+        with pytest.raises(RuntimeError, match="poison"):
+            fut.result(timeout=10)
+        deadline = time.monotonic() + 10
+        while not srv.circuit_open and time.monotonic() < deadline:
+            # keep the worker crashing until the slot exhausts restarts
+            try:
+                srv.batcher.submit(Job(episode=None, k=1))
+            except ShedError:
+                pass
+            time.sleep(0.05)
+        assert srv.circuit_open, "slot past max_restarts must open circuit"
+        with pytest.raises(ShedError) as ei:
+            srv.submit(Job(episode=None, k=1))
+        assert ei.value.reason == "circuit_open"
+        assert srv.stats()["failed"] >= 1
+    finally:
+        srv.stop()

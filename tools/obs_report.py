@@ -364,6 +364,157 @@ def render_fleet(fs, out):
 
 
 # ---------------------------------------------------------------------------
+# Serving SLO (CalibServer: serve_* spans, serve_request events, gauges)
+# ---------------------------------------------------------------------------
+
+_SERVE_STAGES = ("serve_batch", "serve_pack", "serve_policy", "serve_solve",
+                 "serve_influence", "serve_sigma")
+
+
+def _pctiles(vals):
+    v = np.asarray([x for x in vals if x is not None], np.float64)
+    if not v.size:
+        return None
+    return {"n": int(v.size),
+            "p50": round(float(np.percentile(v, 50)), 4),
+            "p99": round(float(np.percentile(v, 99)), 4),
+            "mean": round(float(v.mean()), 4),
+            "max": round(float(v.max()), 4)}
+
+
+def serving_summary(events):
+    """Aggregate the CalibServer telemetry streams, or None for a run
+    with no serving signals.
+
+    Warmup probes (``serve_request`` events tagged ``warm``) are counted
+    but EXCLUDED from every latency percentile — the probe rides the
+    cold glue-compile path by design, and folding it in would smear the
+    steady-state p99 the SLO actually promises.  ``compiles_in_serving``
+    counts ``jax_event`` records inside the live-request window (first
+    submission -> last completion): the zero-per-request-compile claim,
+    checked from the stream alone."""
+    reqs = [e for e in events if e.get("event") == "serve_request"]
+    spans = [e for e in events if e.get("event") == "span"
+             and e.get("name") in _SERVE_STAGES]
+    if not (reqs or spans):
+        return None
+    live = [e for e in reqs if not e.get("warm")]
+    out = {"requests": len(live), "warm_probes": len(reqs) - len(live)}
+    for k in ("total_s", "queue_wait_s", "service_s"):
+        d = _pctiles([e.get(k) for e in live])
+        if d:
+            out[k] = d
+    stages = {}
+    for name in _SERVE_STAGES:
+        d = _pctiles([e.get("dur_s") for e in spans
+                      if e.get("name") == name])
+        if d:
+            stages[name] = d
+    if stages:
+        out["stages"] = stages
+    shed = sum(1 for e in events if e.get("event") == "serve_shed")
+    offered = len(live) + shed
+    out["shed"] = shed
+    out["shed_rate"] = round(shed / offered, 4) if offered else 0.0
+    out["degraded"] = sum(1 for e in live if e.get("degraded"))
+    out["deadline_miss"] = sum(1 for e in live if e.get("deadline_miss"))
+    out["batch_failures"] = sum(1 for e in events
+                                if e.get("event") == "serve_batch_failed")
+    circuits = [e for e in events if e.get("event") == "serve_circuit"]
+    if circuits:
+        out["circuit_transitions"] = len(circuits)
+        out["circuit_open_last"] = bool(circuits[-1].get("open"))
+    depth = _pctiles([v for _, v in _gauge_series(events,
+                                                  "serve_queue_depth")])
+    if depth:
+        out["queue_depth"] = depth
+    fill = _pctiles([v for _, v in _gauge_series(events,
+                                                 "serve_batch_fill")])
+    if fill:
+        out["batch_fill"] = fill
+    warm_ev = next((e for e in events if e.get("event") == "serve_warmup"),
+                   None)
+    if warm_ev:
+        out["warmup"] = {k: warm_ev.get(k) for k in
+                         ("wall_s", "sources", "export_cache_hit",
+                          "export_cache_miss", "persistent_cache_hits",
+                          "persistent_cache_misses") if k in warm_ev}
+    # zero-per-request-compile check: jax_event records inside the
+    # serving window (first live submission -> last live completion).
+    # Host-side work between warmup and serving (e.g. the load
+    # generator simulating its episode pool) compiles its own programs
+    # legitimately and must not pollute the claim.
+    t_open = [e["t"] - e.get("total_s", 0.0) for e in live
+              if e.get("t") is not None]
+    t_close = [e["t"] for e in live if e.get("t") is not None]
+    if t_open:
+        t0, t1 = min(t_open), max(t_close)
+        post = [e for e in events if e.get("event") == "jax_event"
+                and t0 <= (e.get("t") or 0) <= t1]
+        out["compiles_in_serving"] = len(post)
+        out["compiles_per_request"] = round(len(post) / len(live), 4)
+    counters = [e for e in events if e.get("event") == "counters"]
+    if counters:
+        vals = counters[-1].get("values") or {}
+        for k in ("serve_jobs", "serve_admitted", "serve_shed",
+                  "serve_degraded", "serve_deadline_miss",
+                  "persistent_cache_hits", "persistent_cache_misses",
+                  "export_cache_hit", "export_cache_miss"):
+            if k in vals:
+                out.setdefault("counters", {})[k] = vals[k]
+    return out
+
+
+def render_serving(sv, out):
+    head = (f"  requests={sv['requests']} (+{sv['warm_probes']} warm "
+            f"probes)  shed={sv['shed']} "
+            f"(rate {100 * sv['shed_rate']:.1f}%)  "
+            f"degraded={sv['degraded']}  "
+            f"deadline_miss={sv['deadline_miss']}")
+    out.append(head)
+    for k, label in (("total_s", "total latency"),
+                     ("queue_wait_s", "queue wait"),
+                     ("service_s", "service")):
+        if k in sv:
+            d = sv[k]
+            out.append(f"  {label:14s} p50={d['p50']}s p99={d['p99']}s "
+                       f"max={d['max']}s (n={d['n']})")
+    if sv.get("stages"):
+        out.append(f"  {'stage':16s} {'count':>6s} {'p50_s':>8s} "
+                   f"{'p99_s':>8s} {'mean_s':>8s}")
+        for name, d in sv["stages"].items():
+            out.append(f"  {name:16s} {d['n']:>6d} {d['p50']:>8.4f} "
+                       f"{d['p99']:>8.4f} {d['mean']:>8.4f}")
+    if "queue_depth" in sv:
+        d = sv["queue_depth"]
+        out.append(f"  queue depth: p50={d['p50']} p99={d['p99']} "
+                   f"max={d['max']}")
+    if "batch_fill" in sv:
+        out.append(f"  batch fill: mean={sv['batch_fill']['mean']} "
+                   f"(1.0 = all lanes carried a job)")
+    if sv.get("batch_failures"):
+        out.append(f"  BATCH FAILURES: {sv['batch_failures']}")
+    if "circuit_transitions" in sv:
+        state = "OPEN" if sv.get("circuit_open_last") else "closed"
+        out.append(f"  circuit: {sv['circuit_transitions']} transition(s), "
+                   f"last state {state}")
+    w = sv.get("warmup")
+    if w:
+        out.append(f"  warmup: {w.get('wall_s')}s  sources={w.get('sources')}"
+                   f"  export hit/miss={w.get('export_cache_hit')}"
+                   f"/{w.get('export_cache_miss')}  persistent hit/miss="
+                   f"{w.get('persistent_cache_hits')}"
+                   f"/{w.get('persistent_cache_misses')}")
+    if "compiles_in_serving" in sv:
+        per = sv.get("compiles_per_request")
+        out.append(f"  compiles in serving window: "
+                   f"{sv['compiles_in_serving']}"
+                   + (f" ({per} per request)" if per is not None else "")
+                   + ("  <-- steady state must be 0"
+                      if sv["compiles_in_serving"] else ""))
+
+
+# ---------------------------------------------------------------------------
 # Training health (diag / replay_health / watchdog_trip events)
 # ---------------------------------------------------------------------------
 
@@ -639,6 +790,7 @@ def build_report(runs, n_boot=1000, seed=0):
              "probes": probe_summary(ev),
              "solver": solver_summary(ev),
              "fleet": fleet_summary(ev),
+             "serving": serving_summary(ev),
              "training_health": training_health(ev),
              "roofline": roofline(ev, spans),
              "compile_events": len(compiles),
@@ -686,6 +838,9 @@ def render(report):
         if r.get("fleet"):
             out.append("-- fleet")
             render_fleet(r["fleet"], out)
+        if r.get("serving"):
+            out.append("-- serving SLO")
+            render_serving(r["serving"], out)
         if r["compile_events"]:
             out.append(f"-- jax compile: {r['compile_events']} events, "
                        f"{r['compile_secs']} s")

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Serve calibration jobs: warm up the AOT-exported CalibServer, drive
+it with the synthetic open-loop load generator, and record the SLO
+artifact.
+
+One invocation is one server LIFECYCLE: warmup (export-cache load or
+build — the cold/warm restart measurement), supervised serving under a
+sweep of offered rates, teardown.  Results merge-append into ``--out``:
+run it twice against the same ``--cache-dir`` and the artifact gains a
+``restart`` section comparing the cold boot to the warm one (the
+zero-recompile claim, measured).
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python tools/serve_calib.py \
+        --tier tiny --lanes 4 --rates 2,4,8 --duration 10 \
+        --cache-dir /tmp/serve_cache --metrics /tmp/serve.jsonl \
+        --out results/serve_r14.json
+
+SLO telemetry rides the obs stream (``--metrics``): per-stage spans
+(serve_pack/solve/influence), per-job ``serve_request`` events,
+queue-depth/shed gauges and counters — aggregate with
+``tools/obs_report.py`` (the "serving" section).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from smartcal_tpu import obs                               # noqa: E402
+from smartcal_tpu.train import blocks                      # noqa: E402
+
+TIERS = {
+    # n_stations, n_freqs, n_times, tdelta, admm, lbfgs, init, npix
+    "tiny": dict(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                 admm_iters=2, lbfgs_iters=3, init_iters=5, npix=32),
+    "small": dict(n_stations=10, n_freqs=2, n_times=8, tdelta=4,
+                  admm_iters=5, lbfgs_iters=5, init_iters=10, npix=64),
+    "medium": dict(n_stations=14, n_freqs=3, n_times=20, tdelta=10,
+                   admm_iters=10, lbfgs_iters=8, init_iters=30, npix=128),
+}
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--tier", choices=sorted(TIERS), default="tiny",
+                   help="backend scale (tiny = the CPU test tier)")
+    p.add_argument("--M", type=int, default=4,
+                   help="max calibration directions (jobs carry k <= M)")
+    p.add_argument("--lanes", type=int, default=4,
+                   help="micro-batch width (BatchedEpisode lanes)")
+    p.add_argument("--cache-dir", dest="cache_dir", required=True,
+                   help="AOT export + XLA compilation cache root")
+    p.add_argument("--rates", type=str, default="2,4",
+                   help="comma list of offered rates (jobs/s) to sweep")
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="seconds of offered load per rate")
+    p.add_argument("--pool", type=int, default=8,
+                   help="pre-built synthetic episodes cycled by the "
+                        "load generator")
+    p.add_argument("--max-wait-ms", dest="max_wait_ms", type=float,
+                   default=50.0, help="micro-batch max wait")
+    p.add_argument("--max-queue", dest="max_queue", type=int, default=32,
+                   help="bounded admission queue depth (overload sheds)")
+    p.add_argument("--deadline-ms", dest="deadline_ms", type=float,
+                   default=None, help="per-job SLO deadline (deadline-"
+                   "aware flush + deadline_miss accounting)")
+    p.add_argument("--policy", action="store_true",
+                   help="arm the exported policy head (fresh SAC actor): "
+                        "jobs without pinned rho get theirs from it")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", type=str, default=None,
+                   help="merge-append the run record into this JSON")
+    blocks.add_obs_args(p)
+    return p.parse_args(argv)
+
+
+def make_policy(args, M, npix):
+    from smartcal_tpu.rl import sac
+
+    obs_dim = npix * npix + (M + 1) * 7
+    agent = sac.SACAgent(sac.SACConfig(obs_dim=obs_dim, n_actions=2 * M),
+                         seed=args.seed, name_prefix="serve")
+    return agent.cfg, agent.state.actor_params
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    from smartcal_tpu.envs import radio
+    from smartcal_tpu.serve import CalibServer, loadgen
+
+    tobs = blocks.train_obs_from_args(args, "serve_calib",
+                                      tier=args.tier, lanes=args.lanes)
+    t_boot = time.time()
+    # arm the persistent XLA cache BEFORE the first compile of the
+    # process: jax latches the cache decision at first use, so a policy
+    # head initialized ahead of CalibServer would silently un-arm it
+    from smartcal_tpu.serve import enable_compile_cache
+    enable_compile_cache(args.cache_dir)
+    backend = radio.RadioBackend(**TIERS[args.tier])
+    policy = (make_policy(args, args.M, backend.npix)
+              if args.policy else None)
+    srv = CalibServer(backend, M=args.M, lanes=args.lanes,
+                      cache_dir=args.cache_dir, policy=policy,
+                      max_wait_s=args.max_wait_ms / 1e3,
+                      max_queue=args.max_queue)
+    warm = srv.warmup(seed=args.seed)
+    boot_s = round(time.time() - t_boot, 3)
+    tobs.echo(f"server up in {boot_s}s (warmup {warm['wall_s']}s, "
+              f"programs {warm['sources']})")
+
+    pool = loadgen.build_job_pool(backend, args.M, args.pool,
+                                  seed=args.seed + 1)
+    srv.start()
+    rates_out = []
+    c_steady0 = obs.counters_snapshot()
+    try:
+        for rate in (float(r) for r in args.rates.split(",") if r):
+            gen = loadgen.OpenLoopLoadGen(
+                srv, pool, rate=rate, duration_s=args.duration,
+                seed=args.seed,
+                deadline_s=(args.deadline_ms / 1e3
+                            if args.deadline_ms else None),
+                maxiter_choices=(None, max(1, backend.admm_iters - 1),
+                                 backend.admm_iters + 2))
+            r = gen.run()
+            r["stats"] = srv.stats()
+            rates_out.append(r)
+            tobs.echo(f"rate {rate}: " + json.dumps(r))
+    finally:
+        srv.stop()
+    c_steady1 = obs.counters_snapshot()
+    steady_compiles = (c_steady1.get("jax_compile_events", 0.0)
+                      - c_steady0.get("jax_compile_events", 0.0))
+    record = {
+        "tier": args.tier, "M": args.M, "lanes": args.lanes,
+        "policy": bool(args.policy),
+        "boot_s": boot_s,
+        "warmup": warm,
+        "rates": rates_out,
+        "steady_compile_events": steady_compiles,
+        "wall_s": round(time.time() - t_boot, 3),
+    }
+    obs.flush_counters()
+    tobs.close()
+    print(json.dumps(record, indent=1))
+    if args.out:
+        merge_out(args.out, record)
+    if steady_compiles:
+        print(f"WARNING: {steady_compiles:.0f} compile events in steady "
+              "state (expected 0)", file=sys.stderr)
+    return record
+
+
+def merge_out(path, record):
+    """Append ``record`` to the artifact's ``runs`` list; with >= 2 runs
+    derive the cold-vs-warm ``restart`` section (run 0 is the cold boot,
+    the last run the restarted server on the same cache)."""
+    doc = {"bench": "serve_calib", "runs": []}
+    if os.path.exists(path):
+        with open(path) as fh:
+            doc = json.load(fh)
+    doc.setdefault("runs", []).append(record)
+    runs = doc["runs"]
+    if len(runs) >= 2:
+        cold, warmr = runs[0], runs[-1]
+        doc["restart"] = {
+            "cold_boot_s": cold["boot_s"],
+            "warm_boot_s": warmr["boot_s"],
+            "cold_warmup_s": cold["warmup"]["wall_s"],
+            "warm_warmup_s": warmr["warmup"]["wall_s"],
+            "speedup": round(cold["warmup"]["wall_s"]
+                             / max(1e-9, warmr["warmup"]["wall_s"]), 2),
+            "warm_export_cache_hits":
+                warmr["warmup"].get("export_cache_hit"),
+            "warm_export_cache_misses":
+                warmr["warmup"].get("export_cache_miss"),
+            "warm_persistent_cache_misses":
+                warmr["warmup"].get("persistent_cache_misses"),
+        }
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    os.replace(tmp, path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+#!/bin/bash
+# Serving smoke, the restart-without-recompilation chain end to end:
+#
+# Phase 1 (COLD): serve_calib.py against a FRESH --cache-dir — warmup
+# must BUILD every program (export-cache misses), persist them, and the
+# server must actually complete jobs under open-loop load.
+#
+# Phase 2 (WARM): the same invocation against the SAME cache dir — a
+# brand-new process must come up with every program deserialized
+# (source == "cache", zero export-cache misses), serve with ZERO
+# compile events in steady state, and the merged artifact must carry
+# the cold-vs-warm ``restart`` section with a real warmup speedup.
+#
+# Then tools/obs_report.py over the warm run's RunLog must render the
+# serving-SLO section (per-stage p50/p99, queue depth, and the
+# "compiles in serving window: 0" line — the measured zero-recompile
+# claim).
+#
+# The CI companion of smoke_fleet.sh / smoke_obs.sh; the cold export
+# build dominates (~2-4 min on CPU), the warm phase is seconds.
+#
+#   bash tools/smoke_serve.sh [workdir]
+#
+# Exits non-zero on any broken link in the chain.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+REPO="$PWD"
+WORK="${1:-$(mktemp -d /tmp/smoke_serve.XXXXXX)}"
+CACHE="$WORK/cache"
+OUT="$WORK/serve.json"
+RUN_COLD="$WORK/serve_cold.jsonl"
+RUN_WARM="$WORK/serve_warm.jsonl"
+mkdir -p "$WORK"
+
+serve() {  # serve <metrics.jsonl>  — one full server lifecycle
+    (cd "$WORK" && PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+        JAX_PLATFORMS=cpu \
+        python "$REPO/tools/serve_calib.py" \
+        --tier tiny --M 3 --lanes 3 --rates 3 --duration 4 --pool 4 \
+        --cache-dir "$CACHE" --metrics "$1" --out "$OUT" --quiet \
+        > /dev/null)
+}
+
+echo "[smoke_serve] phase 1: COLD boot (fresh cache $CACHE)" >&2
+serve "$RUN_COLD"
+
+python - "$OUT" <<'EOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+cold = doc["runs"][0]
+w = cold["warmup"]
+assert set(w["sources"].values()) == {"export"}, \
+    f"cold warmup must BUILD every program: {w['sources']}"
+assert w["export_cache_miss"] >= 2, w
+served = sum(r["completed"] for r in cold["rates"])
+assert served > 0, f"cold server completed no jobs: {cold['rates']}"
+print("[smoke_serve] cold OK:", served, "jobs,",
+      f"warmup {w['wall_s']}s, sources {w['sources']}")
+EOF
+
+echo "[smoke_serve] phase 2: WARM restart (same cache, new process)" >&2
+serve "$RUN_WARM"
+
+python - "$OUT" <<'EOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+cold, warm = doc["runs"][0], doc["runs"][-1]
+w = warm["warmup"]
+
+# 1. every program deserialized — the restart never re-traced
+assert set(w["sources"].values()) == {"cache"}, \
+    f"warm restart must deserialize every program: {w['sources']}"
+assert w["export_cache_miss"] == 0, w
+assert w["export_cache_hit"] >= 2, w
+
+# 2. zero compile events while serving (steady state)
+assert warm["steady_compile_events"] == 0, \
+    f"{warm['steady_compile_events']} compiles in warm steady state"
+served = sum(r["completed"] for r in warm["rates"])
+assert served > 0, f"warm server completed no jobs: {warm['rates']}"
+
+# 3. the merged artifact carries the measured restart comparison
+r = doc["restart"]
+assert r["warm_warmup_s"] < r["cold_warmup_s"] / 5, \
+    f"warm warmup not much faster than cold: {r}"
+print("[smoke_serve] warm OK:", served, "jobs, warmup",
+      f"{r['warm_warmup_s']}s vs cold {r['cold_warmup_s']}s",
+      f"({r['speedup']}x), steady compiles 0")
+EOF
+
+echo "[smoke_serve] aggregating the warm RunLog with obs_report" >&2
+REPORT="$WORK/report.txt"
+python tools/obs_report.py "$RUN_WARM" > "$REPORT"
+grep -q "serving SLO" "$REPORT" || {
+    echo "[smoke_serve] FAIL: no serving-SLO section in obs_report" >&2
+    exit 1
+}
+grep -q "p99" "$REPORT" || {
+    echo "[smoke_serve] FAIL: no p99 line in the serving section" >&2
+    exit 1
+}
+grep -q "compiles in serving window: 0" "$REPORT" || {
+    echo "[smoke_serve] FAIL: compiles-in-serving-window not zero" >&2
+    grep "compiles in serving" "$REPORT" >&2 || true
+    exit 1
+}
+echo "[smoke_serve] PASS (workdir $WORK)" >&2
