@@ -83,6 +83,27 @@ SHARED_FIELD_SPECS = [
                "the service-time EWMA written by the batch worker while "
                "next_batch reads it for the deadline pull",
     },
+    {
+        "path": "smartcal_tpu/serve/fleet.py",
+        "class": "FleetRouter",
+        "fields": ["_replicas", "_stats", "_next_rid", "_retired"],
+        "locks": ["_lock"],
+        "why": "replica table + fleet counters written by the "
+               "supervision thread (spawn/reap/respawn) and every "
+               "client thread (submit/dispatch accounting) while "
+               "stats()/_live() read them from anywhere",
+    },
+    {
+        "path": "smartcal_tpu/serve/fleet.py",
+        "class": "_Replica",
+        "fields": ["_pending", "_gauges"],
+        "locks": ["_lock"],
+        "why": "in-flight job table written by dispatching client "
+               "threads and the pump thread (result/shed/crash "
+               "reclaim) — a torn read double-completes or leaks a "
+               "job; gauges written by the pump, read by the ranking "
+               "dispatcher",
+    },
 ]
 
 _MUTATORS = {"append", "add", "extend", "update", "insert", "pop",

@@ -42,6 +42,7 @@ TOOLS_STDOUT_ALLOWLIST = frozenset({
     "obs_report.py",
     "obs_tail.py",
     "serve_calib.py",
+    "serve_fleet.py",
     "summarize_demix_curves.py",
     "sweep_calib.py",
     "sweep_demix.py",

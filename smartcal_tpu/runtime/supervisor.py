@@ -301,6 +301,76 @@ class _ProcessActor(threading.Thread):
             self.finalize()
 
 
+class RestartTracker:
+    """Per-slot backoff-restart accounting, extracted from
+    :meth:`Fleet.poll` so the serving replica fleet
+    (:mod:`smartcal_tpu.serve.fleet`) shares the actor semantics
+    verbatim instead of reimplementing them:
+
+    * :meth:`note_down` schedules a backoff-delayed respawn for a slot
+      (carrying an opaque resume ``token`` — the actor fleet's next
+      iteration, the serve fleet's replica spec) or, when the slot has
+      exhausted ``max_restarts``, moves it to :attr:`failed`
+      permanently;
+    * :meth:`due` pops the respawns whose backoff has elapsed,
+      incrementing each slot's restart count.
+
+    Time is always an explicit ``now`` (monotonic seconds) so callers
+    with an injected clock — the router's autoscale tests — drive the
+    schedule deterministically.  NOT thread-safe by itself: callers
+    serialize access (Fleet polls from one loop; the router holds its
+    supervision to one thread)."""
+
+    def __init__(self, max_restarts: int, backoff: BackoffPolicy,
+                 rng=None):
+        import random
+
+        self.max_restarts = int(max_restarts)
+        self.backoff = backoff
+        self._rng = rng if rng is not None else random.Random(0)
+        self.pending: dict = {}        # slot -> (due_monotonic, token)
+        self.failed: set = set()       # slots past max_restarts
+        self.restarts: dict = {}       # slot -> completed restart count
+
+    def tracked(self, slot) -> bool:
+        """True while the slot is awaiting respawn or permanently down
+        (a supervision pass must not re-handle it)."""
+        return slot in self.pending or slot in self.failed
+
+    def attempts(self, slot) -> int:
+        return int(self.restarts.get(slot, 0))
+
+    def restarts_total(self) -> int:
+        return sum(self.restarts.values())
+
+    def note_down(self, slot, token=None,
+                  now: Optional[float] = None) -> Optional[float]:
+        """Record a down slot.  Returns the backoff delay (seconds)
+        until its scheduled respawn, or None when the slot just
+        exhausted ``max_restarts`` and joined :attr:`failed`."""
+        now = time.monotonic() if now is None else now
+        n = self.attempts(slot)
+        if n >= self.max_restarts:
+            self.failed.add(slot)
+            return None
+        delay = self.backoff.delay(n, self._rng)
+        self.pending[slot] = (now + delay, token)
+        return delay
+
+    def due(self, now: Optional[float] = None) -> list:
+        """Pop and return ``[(slot, token), ...]`` whose backoff has
+        elapsed, counting each as one completed restart."""
+        now = time.monotonic() if now is None else now
+        out = []
+        for slot in list(self.pending):
+            due_t, token = self.pending[slot]
+            if now >= due_t:
+                del self.pending[slot]
+                self.restarts[slot] = self.attempts(slot) + 1
+                out.append((slot, token))
+        return out
+
+
 class Fleet:
     """A supervised set of ``n_actors`` worker threads or processes
     (see module doc).
@@ -367,12 +437,14 @@ class Fleet:
         self._version = 0
         self._wlock = threading.Lock()
         self._actors: dict = {}              # slot -> _Actor (current)
-        self._restarts = {i: 0 for i in range(self.n_actors)}
-        self._pending: dict = {}             # slot -> (due_monotonic, iter)
-        self._failed: set = set()            # slots past max_restarts
         self._stopped = False
         import random
         self._rng = random.Random(seed)
+        # restart schedule + failed set + counts live in the tracker
+        # (shared with the serving replica fleet); the pending token is
+        # the resume iteration
+        self._tracker = RestartTracker(self.max_restarts, self.backoff,
+                                       rng=self._rng)
 
     # -- sharded ingest ----------------------------------------------------
     def slot_host(self, slot: int) -> int:
@@ -454,8 +526,8 @@ class Fleet:
         resume)."""
         out = {}
         for slot in range(self.n_actors):
-            if slot in self._pending:
-                out[slot] = int(self._pending[slot][1])
+            if slot in self._tracker.pending:
+                out[slot] = int(self._tracker.pending[slot][1])
             elif slot in self._actors:
                 a = self._actors[slot]
                 it = int(a.iteration)
@@ -549,10 +621,10 @@ class Fleet:
 
     @property
     def failed_slots(self) -> set:
-        return set(self._failed)
+        return set(self._tracker.failed)
 
     def restarts_total(self) -> int:
-        return sum(self._restarts.values())
+        return self._tracker.restarts_total()
 
     def poll(self) -> list:
         """One supervision pass: detect dead/hung actors, schedule and
@@ -563,7 +635,7 @@ class Fleet:
         now = time.monotonic()
         events = []
         for slot in range(self.n_actors):
-            if slot in self._failed or slot in self._pending:
+            if self._tracker.tracked(slot):
                 continue
             a = self._actors.get(slot)
             if a is None:
@@ -588,34 +660,28 @@ class Fleet:
                 a.finalize(timeout=1.0)
             reason = (f"error:{a.error!r}" if dead and a.error is not None
                       else ("exited" if dead else "hung"))
-            n = self._restarts[slot]
-            if n >= self.max_restarts:
-                self._failed.add(slot)
-                ev = {"event": "actor_failed", "actor": slot,
-                      "reason": reason, "restarts": n}
-                events.append(ev)
-                self._log(**ev)
-                continue
-            delay = self.backoff.delay(n, self._rng)
+            n = self._tracker.attempts(slot)
             # the replacement skips the iteration that killed its
             # predecessor (poison-pill protection)
-            self._pending[slot] = (now + delay, a.iteration + 1)
-            ev = {"event": "actor_down", "actor": slot, "reason": reason,
-                  "iteration": a.iteration, "restart_in_s": round(delay, 3),
-                  "attempt": n + 1}
+            delay = self._tracker.note_down(slot, token=a.iteration + 1,
+                                            now=now)
+            if delay is None:
+                ev = {"event": "actor_failed", "actor": slot,
+                      "reason": reason, "restarts": n}
+            else:
+                ev = {"event": "actor_down", "actor": slot,
+                      "reason": reason, "iteration": a.iteration,
+                      "restart_in_s": round(delay, 3), "attempt": n + 1}
             events.append(ev)
             self._log(**ev)
-        for slot in list(self._pending):
-            due, it = self._pending[slot]
-            if now >= due:
-                del self._pending[slot]
-                self._restarts[slot] += 1
-                self._spawn(slot, start_iteration=it)
-                ev = {"event": "actor_restart", "actor": slot,
-                      "iteration": it, "attempt": self._restarts[slot]}
-                events.append(ev)
-                self._log(**ev)
-                self._counter("actor_restarts")
+        for slot, it in self._tracker.due(now):
+            self._spawn(slot, start_iteration=int(it))
+            ev = {"event": "actor_restart", "actor": slot,
+                  "iteration": int(it),
+                  "attempt": self._tracker.attempts(slot)}
+            events.append(ev)
+            self._log(**ev)
+            self._counter("actor_restarts")
         if events:
             self._gauge()
         return events
@@ -623,7 +689,7 @@ class Fleet:
     def wait_pending(self, timeout: float = 30.0) -> None:
         """Block until no restart is pending (tests; bounded)."""
         deadline = time.monotonic() + timeout
-        while self._pending and time.monotonic() < deadline:
+        while self._tracker.pending and time.monotonic() < deadline:
             time.sleep(0.01)
             self.poll()
 

@@ -10,14 +10,49 @@ substrate.
   driver (Fleet-backed circuit breaker, ``solve_admm_safe`` degradation,
   SLO telemetry through the obs stack);
 * :mod:`~smartcal_tpu.serve.loadgen` — synthetic open-loop (Poisson)
-  load generator for the jobs/s-vs-offered-load curve.
+  load generator for the jobs/s-vs-offered-load curve;
+* :mod:`~smartcal_tpu.serve.fleet` — horizontal scale-out: replicated
+  ``CalibServer`` processes (shared AOT + XLA cache, so replica N
+  warm-starts) behind the deadline-aware least-loaded ``FleetRouter``
+  front door, with per-replica circuits and load-driven autoscale.
 
-Entry point: ``tools/serve_calib.py``; smoke: ``tools/smoke_serve.sh``.
+Entry points: ``tools/serve_calib.py`` (one server),
+``tools/serve_fleet.py`` (replica topology sweep); smokes:
+``tools/smoke_serve.sh``, ``tools/smoke_serve_fleet.sh``.
+
+Exports resolve LAZILY (PEP 562): a spawned replica process imports
+this package on its way to :mod:`~smartcal_tpu.serve.fleet`'s worker
+entry point, and an eager ``from .server import CalibServer`` here
+would make every stub-server replica (tests) pay the full jax import —
+the real server factory imports jax inside the worker when it actually
+builds a backend.
 """
 
-from .export import (ExportCache, ServeProgram,            # noqa: F401
-                     abstract_like, enable_compile_cache,
-                     prime_backend_kernels, sig_digest)
-from .router import (Job, JobResult, MicroBatcher,         # noqa: F401
-                     ShedError)
-from .server import CalibServer                            # noqa: F401
+import importlib
+
+_EXPORTS = {
+    "ExportCache": ".export", "ServeProgram": ".export",
+    "abstract_like": ".export", "enable_compile_cache": ".export",
+    "prime_backend_kernels": ".export", "sig_digest": ".export",
+    "AutoscalePolicy": ".fleet", "FleetRouter": ".fleet",
+    "calib_worker_spec": ".fleet", "make_calib_server": ".fleet",
+    "Job": ".router", "JobResult": ".router", "MicroBatcher": ".router",
+    "ShedError": ".router",
+    "CalibServer": ".server",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    submodule = _EXPORTS.get(name)
+    if submodule is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    value = getattr(importlib.import_module(submodule, __name__), name)
+    globals()[name] = value              # cache: resolve once
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,1047 @@
+"""Replicated CalibServer fleet behind a deadline-aware front door.
+
+One :class:`~smartcal_tpu.serve.server.CalibServer` is one batch worker
+— ~7.5 jobs/s on the CPU tier (results/serve_r14.json), a demo.  This
+module scales the service HORIZONTALLY: N replicas, each a spawned OS
+process running its own ``CalibServer``, supervised with the PR 12
+process-actor machinery transferred from actors to replicas — the
+framed CRC-checked transport of :mod:`smartcal_tpu.runtime.ipc`,
+heartbeat supervision, and backoff-restart accounting via
+:class:`~smartcal_tpu.runtime.supervisor.RestartTracker` — behind a
+:class:`FleetRouter` front door doing deadline-aware least-loaded
+dispatch on each replica's streamed queue-depth / batch-fill gauges.
+
+Scale-out stays cheap because every replica shares ONE on-disk AOT
+``ExportCache`` + persistent-XLA cache tree: replica N's cold start is
+every replica's warm start (seconds, not half a minute), which is what
+makes load-driven autoscale viable — :class:`AutoscalePolicy` spawns a
+replica on sustained queue pressure and reaps one on sustained idle.
+
+Failure domains are per-replica, never fleet-wide:
+
+* a replica crash costs only its in-flight jobs: the router reclaims
+  that replica's pending table and re-dispatches each job (at most
+  ``max_requeues`` times) to a survivor, shedding with a structured
+  ``replica_lost`` reason only when no survivor can take it;
+* a replica past ``max_restarts`` is marked failed — ITS circuit opens;
+  the fleet sheds ``fleet_down`` only when no live replica remains, and
+  ``fleet_saturated`` when every live replica's dispatch outbox is full.
+
+Message vocabulary (framed via :mod:`~smartcal_tpu.runtime.ipc`;
+tuples, kind first):
+
+* router -> replica: ``("job", payload_dict)``, ``("stop",)``
+* replica -> router: ``("ready", warmup_summary)``,
+  ``("beat", gauges)``, ``("result", job_id, result_dict)``,
+  ``("job_shed", job_id, reason)``, ``("job_failed", job_id, repr)``,
+  ``("error", repr)``
+
+The module imports no jax and no backend at import time: stub-server
+replicas (tests) pay only the numpy/obs import, and the real server
+factory (:func:`make_calib_server`) defers everything heavy until it
+runs inside the worker process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from smartcal_tpu import obs
+from smartcal_tpu.runtime import ipc
+from smartcal_tpu.runtime.backoff import BackoffPolicy
+from smartcal_tpu.runtime.supervisor import RestartTracker, _to_host
+
+from .router import Job, JobResult, ShedError
+
+# Job fields that cross the process boundary (future/warm stay local:
+# the future is the parent-side handle, and warmup probes never route)
+_JOB_FIELDS = ("k", "rho", "rho_spatial", "maxiter", "deadline_s",
+               "obs_vec", "job_id", "t_submit", "requeues")
+
+
+def _event(name: str, **fields) -> None:
+    rl = obs.active()
+    if rl is not None:
+        rl.log(name, **fields)
+
+
+# ---------------------------------------------------------------------------
+# worker side (runs inside each spawned replica process)
+# ---------------------------------------------------------------------------
+
+def make_calib_server(tier: dict, M: int, lanes: int, cache_dir: str,
+                      policy_seed: Optional[int] = None,
+                      max_wait_s: float = 0.05, max_queue: int = 64,
+                      deadline_default_s: Optional[float] = None,
+                      **server_kw):
+    """Picklable server factory for real replicas: builds a
+    ``RadioBackend`` + ``CalibServer`` against the SHARED ``cache_dir``
+    (AOT programs under ``programs/``, persistent XLA under ``xla/`` —
+    armed here, before the process's first compile, because jax latches
+    the cache decision at first use).  ``tier`` is the backend kwargs
+    dict (see ``SERVE_TIERS`` in :mod:`~smartcal_tpu.serve.loadgen`).
+    """
+    del deadline_default_s               # reserved for router-side SLOs
+    from .export import enable_compile_cache
+
+    enable_compile_cache(f"{cache_dir}/xla")
+    from smartcal_tpu.envs import radio
+
+    backend = radio.RadioBackend(**tier)
+    policy = None
+    if policy_seed is not None:
+        from smartcal_tpu.rl import sac
+
+        obs_dim = backend.npix * backend.npix + (M + 1) * 7
+        agent = sac.SACAgent(
+            sac.SACConfig(obs_dim=obs_dim, n_actions=2 * M),
+            seed=policy_seed, name_prefix="fleet")
+        policy = (agent.cfg, agent.state.actor_params)
+    from .server import CalibServer
+
+    return CalibServer(backend, M=M, lanes=lanes, cache_dir=cache_dir,
+                       policy=policy, max_wait_s=max_wait_s,
+                       max_queue=max_queue, **server_kw)
+
+
+class SleepServer:
+    """Stdlib-only replica server whose service is a timed sleep:
+    ``lanes`` worker threads each hold one job for ``service_s``.
+
+    This is the ROUTER-CAPACITY harness, not a solver: sleeps overlap
+    perfectly across processes even on a one-core host, so a fleet of
+    these measures the front door itself — dispatch + IPC + pending
+    bookkeeping per job — as a jobs/s ceiling that real replicas can
+    approach but never beat.  ``tools/serve_fleet.py --stub`` sweeps it
+    next to the real-CalibServer fleet for exactly that comparison."""
+
+    def __init__(self, lanes: int = 2, service_s: float = 0.05,
+                 max_queue: int = 128):
+        import queue as _queue
+
+        self.lanes = int(lanes)
+        self.service_s = float(service_s)
+        self._q: "queue.Queue" = _queue.Queue(
+            maxsize=max(1, int(max_queue)))
+        self._stop = threading.Event()
+        self._served = 0
+        self._slock = threading.Lock()
+        self._workers: List[threading.Thread] = []
+
+        outer = self
+
+        class _Batcher:
+            def depth(self):
+                return outer._q.qsize()
+
+            def service_estimate_s(self):
+                return outer.service_s
+
+        self.batcher = _Batcher()
+
+    def warmup(self, seed: int = 0) -> dict:
+        return {"wall_s": 0.0, "sources": {"solve": "sleep"},
+                "export_cache_hit": 0, "export_cache_miss": 0}
+
+    def start(self) -> None:
+        for i in range(self.lanes):
+            t = threading.Thread(target=self._loop, daemon=True,
+                                 name=f"sleep-lane{i}")
+            t.start()
+            self._workers.append(t)
+
+    def submit(self, job: Job):
+        try:
+            self._q.put_nowait(job)
+        except queue.Full:
+            raise ShedError("queue_full",
+                            depth=self._q.qsize()) from None
+        return job.future
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                job = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            time.sleep(self.service_s)
+            with self._slock:
+                self._served += 1
+                n = self._served
+            total = time.monotonic() - job.t_submit
+            job.future.set_result(JobResult(
+                job_id=job.job_id, lane=0, batch_id=n,
+                sigma_res=float(job.k), sigma_data_img=0.0,
+                sigma_res_img=0.0, img_std=0.0, degraded=False,
+                queue_wait_s=round(max(0.0, total - self.service_s), 6),
+                service_s=self.service_s, total_s=round(total, 6),
+                deadline_miss=(job.deadline_s is not None
+                               and total > job.deadline_s)))
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._workers:
+            t.join(timeout=1.0)
+
+    def stats(self) -> dict:
+        with self._slock:
+            served = self._served
+        return {"batches": served, "served": served, "degraded": 0,
+                "failed": 0, "deadline_miss": 0,
+                "service_est_s": self.service_s, "circuit_open": False}
+
+
+def make_sleep_server(**kw) -> SleepServer:
+    """Picklable factory for the router-capacity stub fleet."""
+    return SleepServer(**kw)
+
+
+def sleep_worker_spec(lanes: int = 2, service_s: float = 0.05,
+                      beat_s: float = 0.05) -> dict:
+    return {"factory": "smartcal_tpu.serve.fleet:make_sleep_server",
+            "kwargs": {"lanes": int(lanes), "service_s": float(service_s)},
+            "lanes": int(lanes), "beat_s": float(beat_s)}
+
+
+def calib_worker_spec(tier: dict, M: int, lanes: int, cache_dir: str,
+                      **factory_kw) -> dict:
+    """The picklable ``worker_spec`` for a real-CalibServer fleet."""
+    return {
+        "factory": "smartcal_tpu.serve.fleet:make_calib_server",
+        "kwargs": dict(tier=dict(tier), M=int(M), lanes=int(lanes),
+                       cache_dir=cache_dir, **factory_kw),
+        "lanes": int(lanes),
+    }
+
+
+def _server_gauges(server) -> dict:
+    """The load signals a replica streams in every beat frame.  The
+    compile counter rides along so the driver can assert ZERO
+    steady-state compiles FLEET-wide, not just in the parent."""
+    st = server.stats()
+    batches = st.get("batches", 0)
+    c = obs.counters_snapshot()
+    return {
+        "queue_depth": int(server.batcher.depth()),
+        "service_est_s": float(st.get("service_est_s",
+                               server.batcher.service_estimate_s())),
+        "batch_fill": round(st.get("served", 0)
+                            / max(1, batches * server.lanes), 4),
+        "circuit_open": bool(st.get("circuit_open", False)),
+        "served": int(st.get("served", 0)),
+        "failed": int(st.get("failed", 0)),
+        "degraded": int(st.get("degraded", 0)),
+        "deadline_miss": int(st.get("deadline_miss", 0)),
+        "compile_events": float(c.get("jax_compile_events", 0.0)),
+    }
+
+
+def _submit_remote(server, payload: dict, send) -> None:
+    """Rebuild the parent's Job (same job_id, same t_submit — monotonic
+    clocks are system-wide on Linux, so queue-wait/deadline accounting
+    spans the process boundary) and route its eventual outcome back as
+    a result / job_shed / job_failed frame."""
+    jid = payload["job_id"]
+    job = Job(episode=payload["episode"],
+              **{f: payload[f] for f in _JOB_FIELDS})
+    try:
+        fut = server.submit(job)
+    except ShedError as e:
+        send(("job_shed", jid, e.reason))
+        return
+    except Exception as e:
+        send(("job_failed", jid, repr(e)))
+        return
+
+    def _done(f, jid=jid):
+        try:
+            r = f.result()
+        except ShedError as e:
+            send(("job_shed", jid, e.reason))
+            return
+        except BaseException as e:      # noqa: BLE001 — relayed, not raised
+            send(("job_failed", jid, repr(e)))
+            return
+        send(("result", jid, dataclasses.asdict(r)))
+
+    fut.add_done_callback(_done)
+
+
+def replica_worker_main(conn, replica_id: int, spec: dict) -> None:
+    """Entry point of a spawned replica process: pin the platform
+    (same sitecustomize caveat as ``ipc.worker_main``), attach the
+    simulated host, build the server from its picklable factory spec,
+    warm up against the shared cache, then loop — drain job/stop
+    frames, stream gauge beats."""
+    platform = spec.get("platform", "cpu")
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", platform)
+        except Exception:
+            pass
+    if int(spec.get("n_hosts", 1)) > 1:
+        # only a multi-host topology needs the simulated attach (and
+        # the jax import it drags in — single-host stub replicas stay
+        # jax-free)
+        from smartcal_tpu.parallel import multihost
+
+        multihost.attach_simulated(spec.get("host_id", 0),
+                                   spec.get("n_hosts", 1))
+    rl = None
+    if spec.get("metrics"):
+        rl = obs.RunLog(spec["metrics"], run_id=f"replica{replica_id}")
+        obs.activate(rl)
+    obs.install_compile_listener()
+
+    send_lock = threading.Lock()
+
+    def send(msg) -> bool:
+        try:
+            with send_lock:              # done-callbacks run on the
+                ipc.send_msg(conn, msg)  # batch worker; beats on main
+            return True
+        except (OSError, BrokenPipeError, ValueError, EOFError):
+            return False
+
+    server = None
+    try:
+        factory = ipc.resolve_factory(spec["factory"])
+        server = factory(**(spec.get("kwargs") or {}))
+        summary = server.warmup(seed=int(spec.get("seed", 0)))
+        server.start()
+        send(("ready", summary))
+    except BaseException as e:          # noqa: BLE001 — death IS the signal
+        send(("error", repr(e)))
+        return
+    beat_s = float(spec.get("beat_s", 0.1))
+    last_beat = 0.0
+    try:
+        while True:
+            if conn.poll(beat_s):
+                try:
+                    msg = ipc.recv_msg(conn)
+                except ipc.CorruptPayloadError:
+                    continue             # router->replica corruption: skip
+                if msg[0] == "stop":
+                    break
+                if msg[0] == "job":
+                    _submit_remote(server, msg[1], send)
+            now = time.monotonic()
+            if now - last_beat >= beat_s:
+                last_beat = now
+                send(("beat", _server_gauges(server)))
+    except (EOFError, OSError, BrokenPipeError):
+        pass                             # router gone: nothing to report
+    finally:
+        try:
+            server.stop()
+        except Exception:
+            pass
+        if rl is not None:
+            try:
+                obs.flush_counters()
+                while obs.active() is not None:
+                    obs.deactivate()
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# parent side
+# ---------------------------------------------------------------------------
+
+class _Replica(threading.Thread):
+    """Parent-side replica slot: the spawned worker process, this pump
+    thread (sole reader of the duplex pipe), and a FIFO sender thread
+    (sole writer — jobs are NOT latest-wins like weights snapshots, so
+    the outbox is a bounded queue, not the `_ProcessActor` single
+    slot).  Duck-types the supervision surface the router polls
+    (``last_beat`` / ``error`` / ``healthy``) plus the dispatch surface
+    it ranks on (``gauges`` / ``dispatch`` / ``take_pending``)."""
+
+    def __init__(self, router: "FleetRouter", replica_id: int, spec: dict):
+        super().__init__(name=f"{router.name}-r{replica_id}-pump",
+                         daemon=True)
+        self.router = router
+        self.replica_id = int(replica_id)
+        self.spec = dict(spec)
+        self.lanes = int(spec.get("lanes", 1))
+        self._lock = threading.Lock()
+        self._pending: Dict[int, Job] = {}   # job_id -> parent-side Job
+        self._gauges = {
+            "queue_depth": 0, "batch_fill": 0.0, "circuit_open": False,
+            "service_est_s": float(spec.get("service_est_s", 0.5)),
+        }
+        self.t_spawn = time.monotonic()
+        self.last_beat = time.monotonic()
+        self.ready = threading.Event()
+        self.ready_summary: Optional[dict] = None
+        self.stop_event = threading.Event()
+        self.error: Optional[BaseException] = None
+        self._outbox: "queue.Queue[bytes]" = queue.Queue(
+            maxsize=max(1, int(spec.get("dispatch_cap", 64))))
+        self._sender: Optional[threading.Thread] = None
+        self.proc = None
+        self.conn = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def _launch(self) -> None:
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        self.conn, child = ctx.Pipe(duplex=True)
+        self.proc = ctx.Process(
+            target=replica_worker_main,
+            args=(child, self.replica_id, self.spec),
+            name=f"{self.router.name}-r{self.replica_id}", daemon=True)
+        self.proc.start()
+        child.close()                    # parent keeps one end only
+
+    def start(self) -> None:
+        self._launch()
+        self._sender = threading.Thread(
+            target=self._send_loop,
+            name=f"{self.router.name}-r{self.replica_id}-send", daemon=True)
+        self._sender.start()
+        super().start()
+
+    def healthy(self) -> bool:
+        """Pump alive and no terminal error — the slot can still speak."""
+        return self.is_alive() and self.error is None
+
+    def request_stop(self) -> None:
+        try:
+            self._outbox.put(ipc.frame_payload(("stop",)), timeout=0.2)
+        except queue.Full:
+            pass                         # sender drains; EOF stops worker
+        self.stop_event.set()
+
+    def hard_kill(self) -> None:
+        try:
+            if self.proc is not None and self.proc.is_alive():
+                self.proc.kill()
+        except Exception:
+            pass
+
+    def finalize(self, timeout: float = 2.0) -> None:
+        if self.proc is None:
+            return
+        try:
+            self.proc.join(timeout=timeout)
+            if self.proc.is_alive():
+                self.proc.terminate()
+                self.proc.join(timeout=1.0)
+        except Exception:
+            pass
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        self.request_stop()
+        if self.ident is not None:
+            self.join(timeout=timeout)
+        self.finalize(timeout=max(1.0, timeout / 2))
+
+    # -- dispatch surface --------------------------------------------------
+    def gauges(self) -> dict:
+        with self._lock:
+            g = dict(self._gauges)
+            g["pending"] = len(self._pending)
+        return g
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def dispatch(self, job: Job) -> bool:
+        """Stage ``job`` toward the worker; False when this replica's
+        bounded dispatch outbox is full (the router tries the next
+        candidate — per-replica back-pressure must never block the
+        front door)."""
+        blob = ipc.frame_payload(("job", _job_payload(job)))
+        with self._lock:
+            self._pending[job.job_id] = job
+        try:
+            self._outbox.put_nowait(blob)
+        except queue.Full:
+            with self._lock:
+                self._pending.pop(job.job_id, None)
+            return False
+        return True
+
+    def take_pending(self) -> List[Job]:
+        """Remove and return every in-flight job (crash reclaim)."""
+        with self._lock:
+            jobs = list(self._pending.values())
+            self._pending.clear()
+        return jobs
+
+    def _pop_pending(self, job_id: int) -> Optional[Job]:
+        with self._lock:
+            return self._pending.pop(job_id, None)
+
+    # -- threads -----------------------------------------------------------
+    def _send_loop(self) -> None:
+        while True:
+            try:
+                blob = self._outbox.get(timeout=0.2)
+            except queue.Empty:
+                if self.stop_event.is_set():
+                    return
+                continue
+            try:
+                ipc.send_blob(self.conn, blob)
+            except (OSError, BrokenPipeError, ValueError):
+                return
+
+    def run(self) -> None:
+        r = self.router
+        while not self.stop_event.is_set():
+            try:
+                if not self.conn.poll(0.2):
+                    if self.proc is not None and not self.proc.is_alive() \
+                            and not self.conn.poll(0):
+                        if self.error is None:
+                            self.error = RuntimeError(
+                                f"replica process exited (code "
+                                f"{self.proc.exitcode})")
+                        return
+                    continue
+                msg = ipc.recv_msg(self.conn)
+            except ipc.CorruptPayloadError as e:
+                # a replica died mid-send (or shipped garbage): drop the
+                # one broken frame, log it, keep pumping
+                r._log("ipc_corrupt_payload", replica=self.replica_id,
+                       error=repr(e))
+                obs.counter_add("ipc_corrupt_payloads")
+                continue
+            except (EOFError, OSError):
+                if not self.stop_event.is_set() and self.error is None:
+                    code = (self.proc.exitcode if self.proc is not None
+                            else None)
+                    self.error = RuntimeError(
+                        f"replica channel closed (exit code {code})")
+                return
+            self.last_beat = time.monotonic()
+            kind = msg[0]
+            if kind == "ready":
+                self.ready_summary = msg[1]
+                self.ready.set()
+            elif kind == "beat":
+                with self._lock:
+                    self._gauges.update(msg[1])
+            elif kind == "result":
+                job = self._pop_pending(msg[1])
+                if job is not None and not job.future.done():
+                    job.future.set_result(JobResult(**msg[2]))
+                r._note_result(self.replica_id, job, msg[2])
+            elif kind == "job_shed":
+                job = self._pop_pending(msg[1])
+                if job is not None:
+                    r._reclaim(job, self.replica_id, msg[2])
+            elif kind == "job_failed":
+                job = self._pop_pending(msg[1])
+                if job is not None and not job.future.done():
+                    job.future.set_exception(RuntimeError(msg[2]))
+                r._note_failed(self.replica_id, msg[1], msg[2])
+            elif kind == "error":
+                self.error = RuntimeError(msg[1])
+                return
+
+
+def _job_payload(job: Job) -> dict:
+    """The picklable half of a Job (device arrays pulled to host)."""
+    d = {f: getattr(job, f) for f in _JOB_FIELDS}
+    d["episode"] = _to_host(job.episode)
+    return d
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """Load-driven scale knobs: spawn a replica when the fleet-mean
+    backlog per live replica stays at/above ``spawn_depth`` jobs for
+    ``spawn_sustain_s``; reap the newest idle replica after
+    ``reap_idle_s`` of a drained fleet.  ``cooldown_s`` separates
+    consecutive scale events so one burst cannot thrash the fleet."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    spawn_depth: float = 2.0
+    spawn_sustain_s: float = 2.0
+    reap_idle_s: float = 10.0
+    cooldown_s: float = 5.0
+
+
+class FleetRouter:
+    """The front door (see module doc).  Lifecycle::
+
+        router = FleetRouter(calib_worker_spec(...), replicas=4)
+        router.start()                  # replica 0 builds the shared
+        fut = router.submit(Job(...))   # cache; 1..N warm-start off it
+        fut.result(timeout=...)
+        router.stop()
+
+    Dispatch ranks live replicas by load score ``(pending + queue_depth)
+    / lanes`` with batch-fill as the tiebreak; a job with a deadline
+    first narrows to replicas whose ETA fits its remaining slack,
+    falling back to plain least-loaded when none does (degrade to a
+    late answer, never shed a servable job).  ``replica_factory`` and
+    ``clock`` are injectable for tests (scripted gauges, fake time).
+    """
+
+    def __init__(self, worker_spec: dict, replicas: int = 1, *,
+                 hosts: int = 1, name: str = "calib-fleet",
+                 heartbeat_timeout: float = 10.0, max_restarts: int = 3,
+                 backoff: Optional[BackoffPolicy] = None, seed: int = 0,
+                 max_requeues: int = 1,
+                 autoscale: Optional[AutoscalePolicy] = None,
+                 poll_s: float = 0.05, metrics_dir: Optional[str] = None,
+                 replica_factory: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        import random
+
+        self.worker_spec = dict(worker_spec)
+        self.name = name
+        self.hosts = max(1, int(hosts))
+        self.n_initial = int(replicas)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.max_requeues = int(max_requeues)
+        self.autoscale = autoscale
+        self.metrics_dir = metrics_dir
+        self._clock = clock
+        self._poll_s = float(poll_s)
+        self._factory = replica_factory or _Replica
+        self._tracker = RestartTracker(
+            max_restarts,
+            backoff or BackoffPolicy(base_s=0.25, factor=2.0, max_s=10.0,
+                                     jitter=0.25),
+            rng=random.Random(seed))
+        self._lock = threading.Lock()
+        self._replicas: Dict[int, Any] = {}  # rid -> _Replica (current)
+        self._next_rid = 0
+        self._stats = {"submitted": 0, "dispatched": 0, "completed": 0,
+                       "failed": 0, "requeued": 0, "shed": 0,
+                       "shed_reasons": {}, "replica_restarts": 0,
+                       "scale_ups": 0, "scale_downs": 0}
+        self._rr = 0                     # dispatch tiebreak rotation
+        self._reclaim_q: "queue.Queue" = queue.Queue()
+        self._retired: List[Any] = []    # reaped replicas awaiting join
+        self._stop_ev = threading.Event()
+        self._sup: Optional[threading.Thread] = None
+        self._over_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._depth_ewma: Optional[float] = None
+        self._last_scale = -1e18
+
+    # -- topology ----------------------------------------------------------
+    def replica_host(self, rid: int) -> int:
+        """Simulated host of replica ``rid`` — round-robin, so scale-up
+        replicas spread across hosts instead of piling onto the last."""
+        return rid % self.hosts
+
+    def _spawn_replica(self):
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        spec = dict(self.worker_spec, host_id=self.replica_host(rid),
+                    n_hosts=self.hosts)
+        if self.metrics_dir:
+            spec["metrics"] = os.path.join(
+                self.metrics_dir,
+                f"replica{rid}-g{self._tracker.attempts(rid)}.jsonl")
+        r = self._factory(self, rid, spec)
+        r.start()
+        with self._lock:
+            self._replicas[rid] = r
+        obs.gauge_set("fleet_replicas_alive", len(self._live()))
+        return r
+
+    def _respawn(self, rid: int):
+        """Fresh process in an existing slot (same rid: restart
+        accounting and the per-slot circuit stay attached)."""
+        spec = dict(self.worker_spec, host_id=self.replica_host(rid),
+                    n_hosts=self.hosts)
+        if self.metrics_dir:
+            spec["metrics"] = os.path.join(
+                self.metrics_dir,
+                f"replica{rid}-g{self._tracker.attempts(rid)}.jsonl")
+        r = self._factory(self, rid, spec)
+        r.start()
+        with self._lock:
+            self._replicas[rid] = r
+        return r
+
+    def _live(self) -> list:
+        with self._lock:
+            reps = list(self._replicas.values())
+        return [r for r in reps if r.healthy()]
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, warm_timeout_s: float = 300.0,
+              stagger: bool = True) -> dict:
+        """Spawn the initial replicas and wait until every one is warm.
+        ``stagger`` (default) brings replica 0 up ALONE first so a cold
+        shared cache is built exactly once; the rest then warm-start
+        off it concurrently.  Returns {rid: warmup_summary}."""
+        if self._sup is not None:
+            raise RuntimeError("router already started")
+        first = self._spawn_replica()
+        if stagger:
+            self._wait_ready([first], warm_timeout_s)
+        rest = [self._spawn_replica() for _ in range(self.n_initial - 1)]
+        self._wait_ready(rest + ([] if stagger else [first]),
+                         warm_timeout_s)
+        sup = threading.Thread(target=self._supervise,
+                               name=f"{self.name}-router", daemon=True)
+        self._sup = sup
+        sup.start()
+        return self.warmups()
+
+    def _wait_ready(self, replicas: list, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        for r in replicas:
+            while not r.ready.wait(timeout=0.1):
+                if not r.healthy():
+                    raise RuntimeError(
+                        f"replica {r.replica_id} died during warmup: "
+                        f"{r.error!r}")
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"replica {r.replica_id} not ready after "
+                        f"{timeout_s}s")
+
+    def warmups(self) -> dict:
+        with self._lock:
+            reps = dict(self._replicas)
+        return {rid: r.ready_summary for rid, r in reps.items()
+                if r.ready_summary is not None}
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop every replica, then fail whatever is still pending with
+        a structured ``shutdown`` shed."""
+        self._stop_ev.set()
+        if self._sup is not None:
+            self._sup.join(timeout=timeout)
+        with self._lock:
+            reps = list(self._replicas.values())
+            retired = list(self._retired)
+        for r in reps:
+            r.request_stop()
+        for r in reps + retired:
+            r.shutdown(timeout=timeout)
+        for r in reps:
+            for job in r.take_pending():
+                self._shed_async(job, "shutdown")
+        while True:
+            try:
+                job, _reason = self._reclaim_q.get_nowait()
+            except queue.Empty:
+                break
+            self._shed_async(job, "shutdown")
+
+    # -- request path ------------------------------------------------------
+    def submit(self, job: Job):
+        """Admit ``job`` (returns its future) or shed synchronously:
+        ``shutdown`` / ``fleet_down`` (no live replica) /
+        ``fleet_saturated`` (every live replica's outbox full)."""
+        if self._stop_ev.is_set():
+            self._shed_sync(job, "shutdown")
+        with self._lock:
+            self._stats["submitted"] += 1
+        return self._dispatch(job)
+
+    def _candidates(self) -> list:
+        """Live, warm replicas whose per-slot circuit is closed."""
+        out = []
+        for r in self._live():
+            if not r.ready.is_set():
+                continue
+            if self._tracker.tracked(r.replica_id):
+                continue
+            if r.gauges().get("circuit_open"):
+                continue
+            out.append(r)
+        return out
+
+    def _rank(self, cands: list, job: Job) -> list:
+        """Deadline-aware least-loaded order.  ETA per replica is
+        (backlog batches + 1) * service estimate; a deadline narrows to
+        replicas that fit the job's remaining slack, falling back to
+        everyone when none does."""
+        now = self._clock()
+        scored = []
+        for r in cands:
+            g = r.gauges()
+            backlog = (g["pending"] + g["queue_depth"]) / max(1, r.lanes)
+            eta = (backlog + 1.0) * max(1e-3, g["service_est_s"])
+            scored.append((r, backlog, g.get("batch_fill", 0.0), eta))
+        if job.deadline_s is not None:
+            slack = job.deadline_s - (now - job.t_submit)
+            fits = [s for s in scored if s[3] <= slack]
+            if fits:
+                scored = fits
+        rr = self._rr
+        self._rr = rr + 1
+        scored.sort(key=lambda s: (s[1], s[2],
+                                   (s[0].replica_id - rr) % 997))
+        return [s[0] for s in scored]
+
+    def _dispatch(self, job: Job, requeue: bool = False):
+        cands = self._candidates()
+        if not cands:
+            if requeue:
+                return self._shed_async(job, "fleet_down")
+            self._shed_sync(job, "fleet_down")
+        for r in self._rank(cands, job):
+            if r.dispatch(job):
+                with self._lock:
+                    self._stats["dispatched"] += 1
+                    if requeue:
+                        self._stats["requeued"] += 1
+                obs.counter_add("fleet_dispatch")
+                _event("fleet_dispatch", job_id=job.job_id,
+                       replica=r.replica_id, requeue=bool(requeue))
+                return job.future
+        if requeue:
+            return self._shed_async(job, "fleet_saturated")
+        self._shed_sync(job, "fleet_saturated")
+
+    def _requeue(self, job: Job, reason: str) -> None:
+        """A replica lost/refused ``job`` after admission: re-dispatch
+        to a survivor (bounded), else shed with the structured reason
+        on the future the client already holds."""
+        if job.future.done():
+            return
+        job.requeues += 1
+        if job.requeues > self.max_requeues:
+            self._shed_async(job, reason)
+            return
+        self._dispatch(job, requeue=True)
+
+    def _shed_record(self, job: Job, reason: str) -> None:
+        with self._lock:
+            self._stats["shed"] += 1
+            reasons = self._stats["shed_reasons"]
+            reasons[reason] = reasons.get(reason, 0) + 1
+        obs.counter_add("serve_shed")
+        _event("serve_shed", job_id=job.job_id, reason=reason,
+               scope="fleet")
+
+    def _shed_sync(self, job: Job, reason: str) -> None:
+        self._shed_record(job, reason)
+        raise ShedError(reason)
+
+    def _shed_async(self, job: Job, reason: str) -> None:
+        """Shed a job whose future the client already holds (post-
+        admission loss): the reason travels as the future's exception."""
+        self._shed_record(job, reason)
+        if not job.future.done():
+            job.future.set_exception(ShedError(reason))
+
+    # -- pump-thread callbacks ---------------------------------------------
+    def _note_result(self, rid: int, job: Optional[Job], d: dict) -> None:
+        with self._lock:
+            self._stats["completed"] += 1
+        _event("fleet_result", replica=rid,
+               job_id=d.get("job_id"), total_s=d.get("total_s"),
+               degraded=d.get("degraded"),
+               deadline_miss=d.get("deadline_miss"),
+               requeues=getattr(job, "requeues", 0))
+
+    def _note_failed(self, rid: int, job_id: int, err: str) -> None:
+        with self._lock:
+            self._stats["failed"] += 1
+        _event("fleet_job_failed", replica=rid, job_id=job_id, error=err)
+
+    def _reclaim(self, job: Job, rid: int, reason: str) -> None:
+        """A remote shed (replica queue_full / circuit_open / shutdown)
+        arrived on the pump thread: queue it for the supervision loop
+        to re-dispatch (dispatching from the pump would deadlock a
+        full-outbox retry against the very thread draining results)."""
+        _event("fleet_reclaim", replica=rid, job_id=job.job_id,
+               reason=reason)
+        self._reclaim_q.put((job, reason))
+
+    # -- supervision -------------------------------------------------------
+    def _supervise(self) -> None:
+        while not self._stop_ev.wait(self._poll_s):
+            try:
+                self.poll()
+            except Exception as e:      # the front door must outlive a
+                obs.counter_add("fleet_router_errors")   # bad pass
+                _event("fleet_router_error", error=repr(e))
+
+    def poll(self) -> list:
+        """One supervision pass (public: tests drive it with an
+        injected clock): detect dead/hung replicas, reclaim + requeue
+        their in-flight jobs, perform due backoff respawns, drain the
+        remote-shed reclaim queue, evaluate autoscale.  Returns the
+        events emitted this pass."""
+        now = self._clock()
+        events = []
+        with self._lock:
+            replicas = dict(self._replicas)
+        for rid, r in replicas.items():
+            if self._tracker.tracked(rid):
+                continue
+            dead = not r.healthy()
+            hung = (not dead and r.ready.is_set()
+                    and now - r.last_beat > self.heartbeat_timeout)
+            if not dead and not hung:
+                continue
+            if hung:
+                r.hard_kill()
+            r.stop_event.set()
+            r.finalize(timeout=1.0)
+            lost = r.take_pending()
+            reason = (f"error:{r.error!r}" if r.error is not None
+                      else ("exited" if dead else "hung"))
+            n = self._tracker.attempts(rid)
+            delay = self._tracker.note_down(rid, now=now)
+            with self._lock:
+                self._replicas.pop(rid, None)
+            if delay is None:
+                ev = {"event": "fleet_replica_failed", "replica": rid,
+                      "reason": reason, "restarts": n,
+                      "lost_jobs": len(lost)}
+            else:
+                ev = {"event": "fleet_replica_down", "replica": rid,
+                      "reason": reason, "restart_in_s": round(delay, 3),
+                      "attempt": n + 1, "lost_jobs": len(lost)}
+            events.append(ev)
+            self._log(**ev)
+            for job in lost:
+                self._requeue(job, "replica_lost")
+        if not self._stop_ev.is_set():
+            for rid, _tok in self._tracker.due(now):
+                self._respawn(rid)
+                with self._lock:
+                    self._stats["replica_restarts"] += 1
+                ev = {"event": "fleet_replica_restart", "replica": rid,
+                      "attempt": self._tracker.attempts(rid)}
+                events.append(ev)
+                self._log(**ev)
+                obs.counter_add("fleet_replica_restarts")
+        while True:
+            try:
+                job, reason = self._reclaim_q.get_nowait()
+            except queue.Empty:
+                break
+            self._requeue(job, reason)
+        events.extend(self._autoscale_pass(now))
+        self._gauge_tick()
+        return events
+
+    def _autoscale_pass(self, now: float) -> list:
+        pol = self.autoscale
+        if pol is None or self._stop_ev.is_set():
+            return []
+        live = self._live()
+        if not live:
+            return []
+        gauges = [r.gauges() for r in live]
+        depth = sum(g["pending"] + g["queue_depth"] for g in gauges)
+        per = depth / len(live)
+        # the SPAWN signal is an EWMA with hysteresis: micro-batches
+        # drain the instantaneous depth to 0 between flushes, so the
+        # raw gauge oscillates through the threshold many times a
+        # second and a sustain clock keyed on it never runs out
+        ew = self._depth_ewma
+        ew = per if ew is None else ew + 0.3 * (per - ew)
+        self._depth_ewma = ew
+        events = []
+        if ew >= pol.spawn_depth:
+            if self._over_since is None:
+                self._over_since = now
+            if (now - self._over_since >= pol.spawn_sustain_s
+                    and len(live) < pol.max_replicas
+                    and now - self._last_scale >= pol.cooldown_s):
+                r = self._spawn_replica()
+                self._over_since = None
+                self._last_scale = now
+                with self._lock:
+                    self._stats["scale_ups"] += 1
+                ev = {"event": "fleet_scale_up", "replica": r.replica_id,
+                      "depth_per_replica": round(ew, 2),
+                      "replicas": len(live) + 1}
+                events.append(ev)
+                self._log(**ev)
+                obs.counter_add("fleet_scale_ups")
+        elif ew < 0.5 * pol.spawn_depth:
+            self._over_since = None
+        # the REAP signal stays instantaneous: a fleet is only safe to
+        # shrink once it has been LITERALLY empty for reap_idle_s
+        if depth == 0:
+            if self._idle_since is None:
+                self._idle_since = now
+            if (now - self._idle_since >= pol.reap_idle_s
+                    and len(live) > pol.min_replicas
+                    and now - self._last_scale >= pol.cooldown_s):
+                victim = max(live, key=lambda r: r.t_spawn)
+                if victim.pending_count() == 0:
+                    with self._lock:
+                        self._replicas.pop(victim.replica_id, None)
+                        self._retired.append(victim)
+                        self._stats["scale_downs"] += 1
+                    victim.request_stop()
+                    self._idle_since = None
+                    self._last_scale = now
+                    ev = {"event": "fleet_scale_down",
+                          "replica": victim.replica_id,
+                          "replicas": len(live) - 1}
+                    events.append(ev)
+                    self._log(**ev)
+                    obs.counter_add("fleet_scale_downs")
+        else:
+            self._idle_since = None
+        return events
+
+    def _gauge_tick(self) -> None:
+        live = self._live()
+        obs.gauge_set("fleet_replicas_alive", len(live))
+        depth = 0
+        for r in live:
+            g = r.gauges()
+            depth += g["pending"] + g["queue_depth"]
+            obs.gauge_set("fleet_replica_depth",
+                          g["pending"] + g["queue_depth"],
+                          replica=r.replica_id)
+        obs.gauge_set("fleet_queue_depth", depth)
+
+    # -- chaos / introspection ---------------------------------------------
+    def kill_replica(self, rid: int) -> bool:
+        """SIGKILL replica ``rid``'s worker process (chaos hook for the
+        kill-and-recover measurement); supervision handles the rest."""
+        with self._lock:
+            r = self._replicas.get(rid)
+        if r is None:
+            return False
+        r.hard_kill()
+        return True
+
+    def replicas_alive(self) -> int:
+        return len(self._live())
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["shed_reasons"] = dict(self._stats["shed_reasons"])
+            reps = dict(self._replicas)
+        out["replicas_alive"] = sum(1 for r in reps.values()
+                                    if r.healthy())
+        out["failed_replicas"] = sorted(self._tracker.failed)
+        out["per_replica"] = {
+            rid: dict(r.gauges(), healthy=r.healthy(),
+                      restarts=self._tracker.attempts(rid))
+            for rid, r in reps.items()}
+        return out
+
+    # -- telemetry ---------------------------------------------------------
+    def _log(self, event: str = "fleet_event", **fields) -> None:
+        _event(fields.pop("event", event), **fields)

@@ -21,19 +21,47 @@ import numpy as np
 
 from .router import Job, ShedError
 
+# Serving backend scale presets (the "tier" kwargs a RadioBackend takes);
+# shared by tools/serve_calib.py and tools/serve_fleet.py so the two
+# drivers can never drift apart on what "tiny" means.
+SERVE_TIERS = {
+    # n_stations, n_freqs, n_times, tdelta, admm, lbfgs, init, npix
+    "tiny": dict(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                 admm_iters=2, lbfgs_iters=3, init_iters=5, npix=32),
+    "small": dict(n_stations=10, n_freqs=2, n_times=8, tdelta=4,
+                  admm_iters=5, lbfgs_iters=5, init_iters=10, npix=64),
+    "medium": dict(n_stations=14, n_freqs=3, n_times=20, tdelta=10,
+                   admm_iters=10, lbfgs_iters=8, init_iters=30, npix=128),
+}
+
 
 def build_job_pool(backend, M: int, n: int, seed: int = 0,
-                   key0=None) -> List[Tuple[int, object]]:
-    """``n`` pre-built (k, episode) pairs with K cycling over [2, M]
-    (episodes padded to M directions — the server's contract)."""
+                   key0=None, mixed: bool = True,
+                   diffuse_frac: float = 0.25
+                   ) -> List[Tuple[int, object]]:
+    """``n`` pre-built (k, episode) pairs padded to M directions (the
+    server's contract).
+
+    ``mixed`` (the default since ISSUE 16) draws a HETEROGENEOUS pool:
+    K uniform over [2, M] and a ``diffuse_frac`` fraction of diffuse-sky
+    episodes per draw, instead of the old deterministic K cycle over
+    point-source skies — ROADMAP #3 flags every serving number measured
+    against the homogeneous pool as optimistic.  ``mixed=False`` keeps
+    the PR 15 pool bit-for-bit for comparability."""
     import jax
 
     key = jax.random.PRNGKey(seed) if key0 is None else key0
+    rng = np.random.default_rng(seed)
     pool = []
     for i in range(n):
         key, k = jax.random.split(key)
-        kdirs = 2 + i % max(1, M - 1)
-        ep, _ = backend.new_calib_episode(k, kdirs, M)
+        if mixed:
+            kdirs = int(rng.integers(2, M + 1))
+            diffuse = bool(rng.random() < diffuse_frac)
+        else:
+            kdirs = 2 + i % max(1, M - 1)
+            diffuse = False
+        ep, _ = backend.new_calib_episode(k, kdirs, M, diffuse=diffuse)
         pool.append((kdirs, ep))
     return pool
 
@@ -41,23 +69,39 @@ def build_job_pool(backend, M: int, n: int, seed: int = 0,
 class OpenLoopLoadGen:
     """Submit Poisson arrivals at ``rate`` jobs/s for ``duration_s``,
     then wait for the tail and summarize.  Shed jobs count against the
-    offered rate (they are the overload signal, not an error)."""
+    offered rate (they are the overload signal, not an error).
+
+    Every submitted job lands in EXACTLY one bucket of the summary —
+    ``completed`` (of which ``deadline_missed`` is the served-late
+    subset), ``shed`` (sync at submit OR async: a fleet router losing a
+    job's replica post-admission sheds it through the future with the
+    same structured :class:`ShedError`), or ``failed`` (any other
+    exception / drain timeout) — and the per-reason ``shed_reasons``
+    sum to ``shed`` (tools/smoke_serve_fleet.sh asserts both).
+
+    ``pick="random"`` (default) draws pool entries uniformly; ``"cycle"``
+    keeps the PR 15 deterministic walk for comparability."""
 
     def __init__(self, server, pool, rate: float, duration_s: float,
                  seed: int = 0, deadline_s: Optional[float] = None,
-                 maxiter_choices=(None,)):
+                 maxiter_choices=(None,), pick: str = "random"):
+        if pick not in ("random", "cycle"):
+            raise ValueError(f"pick must be 'random' or 'cycle', "
+                             f"got {pick!r}")
         self.server = server
         self.pool = pool
         self.rate = float(rate)
         self.duration_s = float(duration_s)
         self.deadline_s = deadline_s
         self.maxiter_choices = tuple(maxiter_choices)
+        self.pick = pick
         self._rng = np.random.default_rng(seed)
 
     def run(self, drain_timeout_s: float = 120.0) -> dict:
         rng = self._rng
         t_end = time.monotonic() + self.duration_s
-        futures, shed, submitted = [], 0, 0
+        futures, submitted = [], 0
+        shed_reasons: dict = {}
         i = 0
         next_t = time.monotonic()
         while True:
@@ -67,8 +111,14 @@ class OpenLoopLoadGen:
             delay = next_t - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
-            kdirs, ep = self.pool[i % len(self.pool)]
-            mi = self.maxiter_choices[i % len(self.maxiter_choices)]
+            if self.pick == "random":
+                idx = int(rng.integers(len(self.pool)))
+                mi = self.maxiter_choices[
+                    int(rng.integers(len(self.maxiter_choices)))]
+            else:
+                idx = i % len(self.pool)
+                mi = self.maxiter_choices[i % len(self.maxiter_choices)]
+            kdirs, ep = self.pool[idx]
             rho = None
             if rng.random() < 0.5:       # half pinned-rho, half default/policy
                 rho = np.exp(rng.uniform(np.log(0.1), np.log(10.0),
@@ -79,22 +129,37 @@ class OpenLoopLoadGen:
             i += 1
             try:
                 futures.append(self.server.submit(job))
-            except ShedError:
-                shed += 1
+            except ShedError as e:
+                shed_reasons[e.reason] = shed_reasons.get(e.reason, 0) + 1
         t0_wall = time.monotonic()
         results = []
+        failed = 0
         for fut in futures:
             remaining = drain_timeout_s - (time.monotonic() - t0_wall)
             try:
                 results.append(fut.result(timeout=max(0.1, remaining)))
-            except Exception:            # failed/timed-out job: counted only
-                pass
-        return self.summarize(submitted, shed, results)
+            except ShedError as e:       # async shed (post-admission loss)
+                shed_reasons[e.reason] = shed_reasons.get(e.reason, 0) + 1
+            except Exception:            # failed / drain-timed-out job
+                failed += 1
+        return self.summarize(submitted, sum(shed_reasons.values()),
+                              results, shed_reasons=shed_reasons,
+                              failed=failed)
 
-    def summarize(self, submitted: int, shed: int, results) -> dict:
+    def summarize(self, submitted: int, shed: int, results,
+                  shed_reasons: Optional[dict] = None,
+                  failed: int = 0) -> dict:
+        # deadline misses are the served-LATE subset of completed jobs:
+        # disjoint from sheds by construction (a shed job never serves)
+        deadline_missed = int(sum(1 for r in results
+                                  if getattr(r, "deadline_miss", False)))
         out = {"offered_rate": self.rate, "duration_s": self.duration_s,
                "submitted": submitted, "shed": shed,
+               "shed_reasons": dict(shed_reasons or {}),
+               "failed": int(failed),
                "completed": len(results),
+               "deadline_missed": deadline_missed,
+               "accounted": shed + int(failed) + len(results),
                "shed_rate": round(shed / max(1, submitted), 4)}
         if results:
             totals = np.asarray([r.total_s for r in results])

@@ -71,6 +71,9 @@ class Job:
     job_id: int = dataclasses.field(default_factory=lambda: next(_ids))
     t_submit: float = dataclasses.field(default_factory=time.monotonic)
     future: Future = dataclasses.field(default_factory=Future)
+    # times the fleet router re-dispatched this job after losing its
+    # replica mid-flight (serve.fleet; bounded by max_requeues)
+    requeues: int = 0
 
 
 @dataclasses.dataclass
@@ -88,6 +91,9 @@ class JobResult:
     queue_wait_s: float
     service_s: float
     total_s: float
+    # served past its SLO deadline (completed anyway — deadline misses
+    # and sheds are DISJOINT populations in the load-gen accounting)
+    deadline_miss: bool = False
 
 
 class MicroBatcher:

@@ -316,6 +316,7 @@ class CalibServer:
         self.batcher.note_service_time(service)
         obs.gauge_set("serve_batch_fill", len(batch) / E)
         n_degraded = 0
+        n_missed = 0
         for lane, job in enumerate(batch):
             degraded = not np.isfinite(sig[lane])
             if degraded:
@@ -331,13 +332,15 @@ class CalibServer:
             total = time.monotonic() - job.t_submit
             missed = (job.deadline_s is not None and total > job.deadline_s)
             if missed:
+                n_missed += 1
                 obs.counter_add("serve_deadline_miss")
             result = JobResult(
                 job_id=job.job_id, lane=lane, batch_id=batch_id,
                 sigma_res=vals[0], sigma_data_img=vals[1],
                 sigma_res_img=vals[2], img_std=vals[3], degraded=degraded,
                 queue_wait_s=round(t_start - job.t_submit, 6),
-                service_s=round(service, 6), total_s=round(total, 6))
+                service_s=round(service, 6), total_s=round(total, 6),
+                deadline_miss=missed)
             _event("serve_request", job_id=job.job_id, lane=lane,
                    batch=batch_id, k=job.k, maxiter=job.maxiter,
                    degraded=degraded, deadline_miss=missed,
@@ -352,6 +355,7 @@ class CalibServer:
             self._stats["batches"] += 1
             self._stats["served"] += len(batch)
             self._stats["degraded"] += n_degraded
+            self._stats["deadline_miss"] += n_missed
         return len(batch)
 
     def process_once(self, jobs, timeout: float = 0.0) -> int:

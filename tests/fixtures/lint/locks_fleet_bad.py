@@ -1,0 +1,48 @@
+"""Positive fixture: unlocked writes to the FLEET-ROUTER shared state
+(the ISSUE 16 replica table / fleet counters / per-replica pending
+table and gauges).
+
+The test registers this file with two specs mirroring the shipped
+SHARED_FIELD_SPECS rows: class FleetRouter, fields {_replicas, _stats,
+_next_rid, _retired}, lock {_lock}; class Replica, fields {_pending,
+_gauges}, lock {_lock}.
+"""
+import threading
+
+
+class FleetRouter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas = {}            # ok: __init__ runs pre-sharing
+        self._stats = {"shed": 0}
+        self._next_rid = 0
+        self._retired = []
+
+    def spawn(self, r):
+        self._next_rid += 1            # BAD: aug-assign without the lock
+        self._replicas[0] = r          # BAD: subscript store, no lock
+
+    def reap(self, rid, r):
+        self._replicas.pop(rid)        # BAD: mutator without the lock
+        self._retired.append(r)        # BAD: mutator without the lock
+
+    def shed(self):
+        self._stats["shed"] += 1       # BAD: subscript store, no lock
+
+
+class Replica:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = {}
+        self._gauges = {"queue_depth": 0}
+
+    def dispatch(self, job):
+        self._pending[job.job_id] = job  # BAD: pending insert, no lock
+
+    def on_beat(self, g):
+        self._gauges.update(g)         # BAD: mutator without the lock
+
+    def take(self):
+        jobs = list(self._pending.values())
+        self._pending = {}             # BAD: table swap without the lock
+        return jobs

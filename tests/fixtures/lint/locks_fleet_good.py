@@ -1,0 +1,56 @@
+"""Negative fixture: lock-disciplined fleet-router shared state — zero
+findings.  Registered with the same specs as locks_fleet_bad.py.
+"""
+import threading
+
+
+class FleetRouter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas = {}
+        self._stats = {"shed": 0}
+        self._next_rid = 0
+        self._retired = []
+
+    def spawn(self, r):
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1        # ok: under the annotated lock
+            self._replicas[rid] = r
+
+    def reap(self, rid, r):
+        with self._lock:
+            self._replicas.pop(rid)
+            self._retired.append(r)
+
+    def shed(self):
+        with self._lock:
+            self._stats["shed"] += 1
+
+    def stats(self):
+        with self._lock:
+            return dict(self._stats)   # reads unchecked
+
+    def _register_locked(self, rid, r):
+        self._replicas[rid] = r        # ok: *_locked caller-holds-lock
+
+
+class Replica:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = {}
+        self._gauges = {"queue_depth": 0}
+
+    def dispatch(self, job):
+        with self._lock:
+            self._pending[job.job_id] = job
+
+    def on_beat(self, g):
+        with self._lock:
+            self._gauges.update(g)     # ok: under the annotated lock
+
+    def take(self):
+        with self._lock:
+            jobs = list(self._pending.values())
+            self._pending = {}
+        return jobs

@@ -1,0 +1,110 @@
+"""Picklable stub-server factories for tests/test_serve_fleet.py.
+
+A replica worker process builds its server from a ``module:callable``
+spec (the spawn context inherits ``sys.path``, so this tests-directory
+module resolves inside workers exactly like ``fleet_proc_worker`` does
+for the actor fleet).  :class:`StubServer` duck-types the CalibServer
+surface the fleet worker drives — ``warmup`` / ``start`` / ``submit`` /
+``stop`` / ``stats`` / ``batcher`` / ``lanes`` — without jax or a radio
+backend, so the process-level router tests (spawn, dispatch round-trip,
+kill, restart, requeue) run in seconds.  ``sigma_res`` encodes the
+job's ``k`` (plus a per-replica ``tag``) so the parent can verify which
+payload came back from where.
+"""
+
+import os
+import queue
+import threading
+import time
+
+from smartcal_tpu.serve.router import JobResult, ShedError
+
+
+class _StubBatcher:
+    def __init__(self, q, service_s):
+        self._q = q
+        self._service_s = float(service_s)
+
+    def depth(self):
+        return self._q.qsize()
+
+    def service_estimate_s(self):
+        return self._service_s
+
+
+class StubServer:
+    """Single-worker FIFO 'server'.  ``die_at_job=N`` calls
+    ``os._exit`` mid-service of its N-th job (the future never
+    resolves — the parent's pending-table reclaim is what recovers
+    it); ``shed_after=N`` sheds every submit past the N-th with a
+    structured ``queue_full``."""
+
+    def __init__(self, lanes=2, service_s=0.02, max_queue=32,
+                 die_at_job=None, shed_after=None, tag=0.0):
+        self.lanes = int(lanes)
+        self.service_s = float(service_s)
+        self.die_at_job = die_at_job
+        self.shed_after = shed_after
+        self.tag = float(tag)
+        self._q = queue.Queue(maxsize=max(1, int(max_queue)))
+        self.batcher = _StubBatcher(self._q, service_s)
+        self._accepted = 0
+        self._served = 0
+        self._stop = threading.Event()
+        self._worker = None
+
+    def warmup(self, seed=0):
+        return {"wall_s": 0.001, "sources": {"solve": "stub"},
+                "export_cache_hit": 0, "export_cache_miss": 0,
+                "jax_compile_events": 0.0}
+
+    def start(self):
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def submit(self, job):
+        if self.shed_after is not None \
+                and self._accepted >= self.shed_after:
+            raise ShedError("queue_full", depth=self._q.qsize())
+        try:
+            self._q.put_nowait(job)
+        except queue.Full:
+            raise ShedError("queue_full",
+                            depth=self._q.qsize()) from None
+        self._accepted += 1
+        return job.future
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                job = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            n = self._served + 1
+            if self.die_at_job is not None and n == self.die_at_job:
+                os._exit(3)             # mid-service death: future stranded
+            time.sleep(self.service_s)
+            self._served = n
+            total = time.monotonic() - job.t_submit
+            job.future.set_result(JobResult(
+                job_id=job.job_id, lane=0, batch_id=n,
+                sigma_res=float(job.k) + self.tag,
+                sigma_data_img=0.0, sigma_res_img=0.0, img_std=0.0,
+                degraded=False, queue_wait_s=0.0,
+                service_s=self.service_s, total_s=round(total, 6),
+                deadline_miss=(job.deadline_s is not None
+                               and total > job.deadline_s)))
+
+    def stop(self):
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join(timeout=2.0)
+
+    def stats(self):
+        return {"batches": self._served, "served": self._served,
+                "degraded": 0, "failed": 0, "deadline_miss": 0,
+                "service_est_s": self.service_s, "circuit_open": False}
+
+
+def make_stub_server(**kw):
+    return StubServer(**kw)

@@ -209,6 +209,46 @@ def test_shipped_shared_specs_cover_serving_fields():
             "_accepted", "_shed", "_service_est_s"} <= fields
 
 
+# the ISSUE 16 fleet fields: replica table + fleet counters + slot
+# bookkeeping (FleetRouter) and the in-flight pending table + gauges
+# (_Replica) — mirrors the shipped SHARED_FIELD_SPECS rows
+def _fleet_specs(path):
+    return [
+        {"path": path, "class": "FleetRouter",
+         "fields": ["_replicas", "_stats", "_next_rid", "_retired"],
+         "locks": ["_lock"], "why": "fixture"},
+        {"path": path, "class": "Replica",
+         "fields": ["_pending", "_gauges"],
+         "locks": ["_lock"], "why": "fixture"},
+    ]
+
+
+def test_locks_fleet_rule_positive():
+    opts = {"shared_specs": _fleet_specs("locks_fleet_bad.py")}
+    fs = fixture_findings("locks_fleet_bad.py", "unlocked-shared-write",
+                          opts)
+    assert lines_of(fs) == [22, 23, 26, 27, 30, 40, 43, 47], fs
+
+
+def test_locks_fleet_rule_negative():
+    opts = {"shared_specs": _fleet_specs("locks_fleet_good.py")}
+    assert fixture_findings("locks_fleet_good.py",
+                            "unlocked-shared-write", opts) == []
+
+
+def test_shipped_shared_specs_cover_fleet_fields():
+    """The SHIPPED spec table must keep the ISSUE 16 rows: the router's
+    replica table / fleet counters / slot bookkeeping and each replica
+    handle's in-flight pending table + gauges."""
+    from smartcal_tpu.analysis.rules.locks import SHARED_FIELD_SPECS
+
+    fields = {f for s in SHARED_FIELD_SPECS
+              if s["path"].endswith("serve/fleet.py")
+              for f in s["fields"]}
+    assert {"_replicas", "_stats", "_next_rid", "_retired",
+            "_pending", "_gauges"} <= fields
+
+
 def _lint_as_package(tmp_path, *names):
     """Copy fixtures under a fake smartcal_tpu/ so path-scoped rules
     (pickle outside tests/, bare-print) see them as package code."""

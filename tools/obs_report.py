@@ -515,6 +515,106 @@ def render_serving(sv, out):
 
 
 # ---------------------------------------------------------------------------
+# Fleet SLO (FleetRouter: fleet_* events, fleet-scoped sheds, gauges)
+# ---------------------------------------------------------------------------
+
+def serve_fleet_summary(events):
+    """Aggregate the serving-fleet router telemetry (the PARENT-side
+    stream of ``tools/serve_fleet.py``), or None for a run with no
+    fleet-router signals.
+
+    Per-replica latency is split out because the fleet-wide percentile
+    hides a slow replica (one cold or overloaded replica looks like a
+    mild global p99 bump — the per-replica table is how the least-
+    loaded dispatch claim is audited).  ``dispatch_balance`` is
+    min/max completions across replicas: 1.0 is a perfectly even
+    spread, ~0 means one replica took (almost) everything."""
+    disp = [e for e in events if e.get("event") == "fleet_dispatch"]
+    res = [e for e in events if e.get("event") == "fleet_result"]
+    if not (disp or res):
+        return None
+    out = {"dispatched": len(disp),
+           "requeue_dispatches": sum(1 for e in disp
+                                     if e.get("requeue")),
+           "completed": len(res),
+           "deadline_miss": sum(1 for e in res
+                                if e.get("deadline_miss")),
+           "requeued_jobs_completed": sum(1 for e in res
+                                          if (e.get("requeues") or 0))}
+    per = {}
+    for e in res:
+        per.setdefault(e.get("replica"), []).append(e.get("total_s"))
+    out["per_replica"] = {
+        str(rid): dict(_pctiles(v) or {},
+                       share=round(len(v) / max(1, len(res)), 4))
+        for rid, v in sorted(per.items(), key=lambda kv: str(kv[0]))}
+    counts = [len(v) for v in per.values()]
+    if len(counts) > 1:
+        out["dispatch_balance"] = round(min(counts) / max(1, max(counts)),
+                                        4)
+    reasons = {}
+    for e in events:
+        if e.get("event") == "serve_shed" and e.get("scope") == "fleet":
+            reasons[e.get("reason")] = reasons.get(e.get("reason"), 0) + 1
+    out["shed"] = sum(reasons.values())
+    out["shed_reasons"] = reasons
+    downs = [e for e in events if e.get("event") in
+             ("fleet_replica_down", "fleet_replica_failed")]
+    out["replica_downs"] = len(downs)
+    out["lost_jobs"] = sum(int(e.get("lost_jobs") or 0) for e in downs)
+    out["replica_restarts"] = sum(1 for e in events if e.get("event")
+                                  == "fleet_replica_restart")
+    out["autoscale_events"] = [
+        {k: e.get(k) for k in ("event", "replica", "replicas",
+                               "depth_per_replica") if k in e}
+        for e in events
+        if e.get("event") in ("fleet_scale_up", "fleet_scale_down")]
+    alive = _series_stats([v for _, v in
+                           _gauge_series(events, "fleet_replicas_alive")])
+    if alive:
+        out["replicas_alive"] = alive
+    depth = _pctiles([v for _, v in
+                      _gauge_series(events, "fleet_queue_depth")])
+    if depth:
+        out["fleet_queue_depth"] = depth
+    return out
+
+
+def render_serve_fleet(fv, out):
+    out.append(f"  dispatched={fv['dispatched']} "
+               f"(requeues {fv['requeue_dispatches']})  "
+               f"completed={fv['completed']}  shed={fv['shed']}"
+               + (f" {fv['shed_reasons']}" if fv["shed_reasons"] else "")
+               + f"  deadline_miss={fv['deadline_miss']}")
+    for rid, d in fv["per_replica"].items():
+        out.append(f"  replica {rid}: n={d.get('n', 0)} "
+                   f"share={d.get('share')} p50={d.get('p50')}s "
+                   f"p99={d.get('p99')}s")
+    if "dispatch_balance" in fv:
+        out.append(f"  dispatch balance (min/max completions): "
+                   f"{fv['dispatch_balance']}")
+    if fv["replica_downs"] or fv["replica_restarts"]:
+        out.append(f"  replica downs={fv['replica_downs']} "
+                   f"restarts={fv['replica_restarts']} "
+                   f"lost_jobs={fv['lost_jobs']} "
+                   f"(requeued jobs completed: "
+                   f"{fv['requeued_jobs_completed']})")
+    for e in fv["autoscale_events"]:
+        arrow = "+" if e["event"] == "fleet_scale_up" else "-"
+        out.append(f"  autoscale {arrow} replica {e.get('replica')} "
+                   f"-> {e.get('replicas')} replicas"
+                   + (f" (depth/replica {e['depth_per_replica']})"
+                      if "depth_per_replica" in e else ""))
+    if "replicas_alive" in fv:
+        a = fv["replicas_alive"]
+        out.append(f"  replicas alive: mean={a['mean']} last={a['last']}")
+    if "fleet_queue_depth" in fv:
+        d = fv["fleet_queue_depth"]
+        out.append(f"  fleet queue depth: p50={d['p50']} p99={d['p99']} "
+                   f"max={d['max']}")
+
+
+# ---------------------------------------------------------------------------
 # Training health (diag / replay_health / watchdog_trip events)
 # ---------------------------------------------------------------------------
 
@@ -790,6 +890,7 @@ def build_report(runs, n_boot=1000, seed=0):
              "probes": probe_summary(ev),
              "solver": solver_summary(ev),
              "fleet": fleet_summary(ev),
+             "serve_fleet": serve_fleet_summary(ev),
              "serving": serving_summary(ev),
              "training_health": training_health(ev),
              "roofline": roofline(ev, spans),
@@ -841,6 +942,9 @@ def render(report):
         if r.get("serving"):
             out.append("-- serving SLO")
             render_serving(r["serving"], out)
+        if r.get("serve_fleet"):
+            out.append("-- fleet SLO (serving scale-out)")
+            render_serve_fleet(r["serve_fleet"], out)
         if r["compile_events"]:
             out.append(f"-- jax compile: {r['compile_events']} events, "
                        f"{r['compile_secs']} s")

@@ -31,17 +31,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from smartcal_tpu import obs                               # noqa: E402
+from smartcal_tpu.serve.loadgen import SERVE_TIERS as TIERS  # noqa: E402
 from smartcal_tpu.train import blocks                      # noqa: E402
-
-TIERS = {
-    # n_stations, n_freqs, n_times, tdelta, admm, lbfgs, init, npix
-    "tiny": dict(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
-                 admm_iters=2, lbfgs_iters=3, init_iters=5, npix=32),
-    "small": dict(n_stations=10, n_freqs=2, n_times=8, tdelta=4,
-                  admm_iters=5, lbfgs_iters=5, init_iters=10, npix=64),
-    "medium": dict(n_stations=14, n_freqs=3, n_times=20, tdelta=10,
-                   admm_iters=10, lbfgs_iters=8, init_iters=30, npix=128),
-}
 
 
 def parse_args(argv=None):
@@ -61,6 +52,12 @@ def parse_args(argv=None):
     p.add_argument("--pool", type=int, default=8,
                    help="pre-built synthetic episodes cycled by the "
                         "load generator")
+    p.add_argument("--pool-mode", dest="pool_mode",
+                   choices=("mixed", "uniform"), default="mixed",
+                   help="mixed (default): heterogeneous K/diffuse pool "
+                        "drawn at random; uniform: the PR 15 "
+                        "deterministic-cycle pool, for comparability "
+                        "with results/serve_r14.json")
     p.add_argument("--max-wait-ms", dest="max_wait_ms", type=float,
                    default=50.0, help="micro-batch max wait")
     p.add_argument("--max-queue", dest="max_queue", type=int, default=32,
@@ -113,7 +110,8 @@ def main(argv=None):
               f"programs {warm['sources']})")
 
     pool = loadgen.build_job_pool(backend, args.M, args.pool,
-                                  seed=args.seed + 1)
+                                  seed=args.seed + 1,
+                                  mixed=(args.pool_mode == "mixed"))
     srv.start()
     rates_out = []
     c_steady0 = obs.counters_snapshot()
@@ -125,7 +123,9 @@ def main(argv=None):
                 deadline_s=(args.deadline_ms / 1e3
                             if args.deadline_ms else None),
                 maxiter_choices=(None, max(1, backend.admm_iters - 1),
-                                 backend.admm_iters + 2))
+                                 backend.admm_iters + 2),
+                pick=("cycle" if args.pool_mode == "uniform"
+                      else "random"))
             r = gen.run()
             r["stats"] = srv.stats()
             rates_out.append(r)
@@ -137,7 +137,7 @@ def main(argv=None):
                       - c_steady0.get("jax_compile_events", 0.0))
     record = {
         "tier": args.tier, "M": args.M, "lanes": args.lanes,
-        "policy": bool(args.policy),
+        "policy": bool(args.policy), "pool_mode": args.pool_mode,
         "boot_s": boot_s,
         "warmup": warm,
         "rates": rates_out,

@@ -1,0 +1,342 @@
+#!/usr/bin/env python
+"""Serve calibration from a replicated fleet: sweep replica topologies
+behind the deadline-aware FleetRouter front door and record the
+scaling / kill-and-recover / autoscale artifact.
+
+One invocation runs up to three measurements against ONE shared
+on-disk cache (replica 0 of the first topology builds it cold; every
+later replica — and every later topology — warm-starts off it):
+
+* ``--replicas 1,2,4``  — the SCALING sweep: per topology, offered
+  load of ``--rate-per-replica * n`` for ``--duration`` seconds, with
+  per-replica compile-event gauges sampled before and after the load
+  so the zero-steady-state-compile claim is asserted FLEET-wide (every
+  replica process, not just the parent).  Append ``@2`` to a point
+  (e.g. ``4@2``) to spread its replicas over 2 simulated hosts.
+* ``--kill``            — 2 replicas under load, one SIGKILLed mid-run:
+  the run must complete every admitted job (survivor requeue), shed
+  nothing, and respawn the slot; time-to-recover is measured.
+* ``--autoscale``       — 1 replica + AutoscalePolicy under a rate
+  step: the router must scale up under sustained backlog and reap back
+  to the floor when the load drains.
+
+``--stub`` swaps the CalibServer factory for the stdlib SleepServer
+(see :class:`smartcal_tpu.serve.fleet.SleepServer`): sleeps overlap
+across processes even on a one-core host, so the stub sweep is the
+ROUTER-CAPACITY ceiling the real fleet is compared against — on a
+many-core host the real curve approaches it; on a starved one the gap
+is the host, not the front door (``host_cores`` is recorded).
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python tools/serve_fleet.py \
+        --tier tiny --M 3 --lanes 3 --replicas 1,2,4 --kill --autoscale \
+        --cache-dir /tmp/fleet_cache --metrics /tmp/fleet.jsonl \
+        --out results/serve_fleet_r15.json
+
+Fleet telemetry rides the parent RunLog (``--metrics``): fleet_dispatch
+/ fleet_result events, fleet-scoped sheds, scale and replica-lifecycle
+events, fleet gauges — aggregate with ``tools/obs_report.py`` (the
+"fleet SLO" section).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from smartcal_tpu import obs                               # noqa: E402
+from smartcal_tpu.runtime.backoff import BackoffPolicy     # noqa: E402
+from smartcal_tpu.serve.fleet import (                     # noqa: E402
+    AutoscalePolicy, FleetRouter, calib_worker_spec, sleep_worker_spec)
+from smartcal_tpu.serve.loadgen import SERVE_TIERS as TIERS  # noqa: E402
+from smartcal_tpu.train import blocks                      # noqa: E402
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--tier", choices=sorted(TIERS), default="tiny")
+    p.add_argument("--M", type=int, default=3)
+    p.add_argument("--lanes", type=int, default=3)
+    p.add_argument("--cache-dir", dest="cache_dir", required=True,
+                   help="SHARED AOT export + XLA cache root (all "
+                        "replicas, all topologies)")
+    p.add_argument("--replicas", type=str, default="1,2,4",
+                   help="comma list of topology points; 'N@H' spreads "
+                        "N replicas over H simulated hosts (e.g. "
+                        "1,2,4,4@2); empty string skips the sweep")
+    p.add_argument("--rate-per-replica", dest="rate_per_replica",
+                   type=float, default=6.0,
+                   help="offered jobs/s PER REPLICA at each point")
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="seconds of offered load per topology point")
+    p.add_argument("--pool", type=int, default=8)
+    p.add_argument("--pool-mode", dest="pool_mode",
+                   choices=("mixed", "uniform"), default="mixed")
+    p.add_argument("--deadline-ms", dest="deadline_ms", type=float,
+                   default=None)
+    p.add_argument("--kill", action="store_true",
+                   help="run the kill-and-recover measurement")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the rate-step autoscale measurement")
+    p.add_argument("--stub", action="store_true",
+                   help="SleepServer replicas (router-capacity ceiling "
+                        "instead of the real CalibServer fleet)")
+    p.add_argument("--stub-service-ms", dest="stub_service_ms",
+                   type=float, default=50.0)
+    p.add_argument("--max-requeues", dest="max_requeues", type=int,
+                   default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", type=str, default=None)
+    blocks.add_obs_args(p)
+    return p.parse_args(argv)
+
+
+def _spec(args):
+    if args.stub:
+        return sleep_worker_spec(lanes=args.lanes,
+                                 service_s=args.stub_service_ms / 1e3)
+    return calib_worker_spec(TIERS[args.tier], M=args.M,
+                             lanes=args.lanes, cache_dir=args.cache_dir,
+                             max_wait_s=0.02, max_queue=64)
+
+
+def _pool(args, backend):
+    from smartcal_tpu.serve import loadgen
+
+    if args.stub:
+        # sleeps don't look at the episode: an empty payload keeps the
+        # stub sweep measuring dispatch+IPC, not episode pickling
+        return [(1 + i % args.M, None) for i in range(args.pool)]
+    return loadgen.build_job_pool(backend, args.M, args.pool,
+                                  seed=args.seed + 1,
+                                  mixed=(args.pool_mode == "mixed"))
+
+
+def _router(args, replicas, hosts=1, autoscale=None, metrics_dir=None):
+    return FleetRouter(
+        _spec(args), replicas=replicas, hosts=hosts,
+        heartbeat_timeout=30.0, max_restarts=3,
+        backoff=BackoffPolicy(base_s=0.1, factor=2.0, max_s=2.0,
+                              jitter=0.0),
+        seed=args.seed, max_requeues=args.max_requeues,
+        autoscale=autoscale, poll_s=0.05, metrics_dir=metrics_dir)
+
+
+def _compile_gauges(router):
+    """{rid: cumulative compile events in that replica process} from
+    the latest beat each replica streamed."""
+    per = router.stats()["per_replica"]
+    return {rid: float(g.get("compile_events", 0.0))
+            for rid, g in per.items()}
+
+
+def _settle(router, beats=3, beat_s=0.1):
+    time.sleep(beats * beat_s)           # let every replica beat again
+
+
+def _run_load(args, router, pool, rate, duration):
+    from smartcal_tpu.serve import loadgen
+
+    gen = loadgen.OpenLoopLoadGen(
+        router, pool, rate=rate, duration_s=duration, seed=args.seed,
+        deadline_s=(args.deadline_ms / 1e3 if args.deadline_ms
+                    else None),
+        pick=("cycle" if args.pool_mode == "uniform" else "random"))
+    return gen.run()
+
+
+def sweep_point(args, tobs, pool, replicas, hosts):
+    t0 = time.time()
+    router = _router(args, replicas, hosts=hosts)
+    try:
+        warm = router.start(warm_timeout_s=900.0)
+        boot_s = round(time.time() - t0, 3)
+        _settle(router)
+        c0 = _compile_gauges(router)
+        rate = args.rate_per_replica * replicas
+        summary = _run_load(args, router, pool, rate, args.duration)
+        _settle(router)
+        c1 = _compile_gauges(router)
+        steady = sum(c1.get(rid, 0.0) - c0.get(rid, 0.0) for rid in c1)
+        point = {
+            "replicas": replicas, "hosts": hosts, "boot_s": boot_s,
+            "warm_sources": {rid: sorted(set(w["sources"].values()))
+                             for rid, w in warm.items()},
+            "offered_rate": rate,
+            "summary": summary,
+            "steady_compile_events_fleet": steady,
+            "router_stats": {k: v for k, v in router.stats().items()
+                             if k != "per_replica"},
+        }
+    finally:
+        router.stop(timeout=20.0)
+    tobs.echo(f"replicas={replicas}x{hosts}h rate={rate}: "
+              f"{summary.get('achieved_jobs_s')} jobs/s, "
+              f"p99={summary.get('latency_p99_s')}s, "
+              f"fleet steady compiles={steady:.0f}")
+    return point
+
+
+def kill_run(args, tobs, pool):
+    router = _router(args, 2)
+    try:
+        router.start(warm_timeout_s=900.0)
+        rate = args.rate_per_replica * 2
+        duration = max(6.0, args.duration)
+        killed = {}
+
+        def _chaos():
+            time.sleep(duration / 3)
+            t_kill = time.monotonic()
+            router.kill_replica(0)
+            deadline = t_kill + 60.0
+            while (router.replicas_alive() < 2
+                   or router.stats()["replica_restarts"] < 1):
+                if time.monotonic() > deadline:
+                    return
+                time.sleep(0.02)
+            killed["recover_s"] = round(time.monotonic() - t_kill, 3)
+
+        chaos = threading.Thread(target=_chaos, daemon=True)
+        chaos.start()
+        summary = _run_load(args, router, pool, rate, duration)
+        chaos.join(timeout=90.0)
+        recover_s = killed.get("recover_s")
+        st = router.stats()
+    finally:
+        router.stop(timeout=20.0)
+    rec = {"summary": summary, "recover_s": recover_s,
+           "replica_restarts": st["replica_restarts"],
+           "requeued": st["requeued"],
+           "shed_reasons": st["shed_reasons"],
+           "replicas_alive_after": st["replicas_alive"]}
+    tobs.echo(f"kill: completed={summary['completed']}/"
+              f"{summary['submitted']} shed={summary['shed']} "
+              f"requeued={st['requeued']} recover={recover_s}s")
+    return rec
+
+
+def autoscale_run(args, tobs, pool):
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                          spawn_depth=1.5, spawn_sustain_s=1.0,
+                          reap_idle_s=3.0, cooldown_s=2.0)
+    router = _router(args, 1, autoscale=pol)
+    try:
+        router.start(warm_timeout_s=900.0)
+        low = _run_load(args, router, pool, args.rate_per_replica * 0.5,
+                        max(4.0, args.duration / 2))
+        # the step must OVERRUN one replica, not merely busy it: 8x the
+        # per-replica operating point keeps depth/replica past
+        # spawn_depth for the sustain window
+        high = _run_load(args, router, pool, args.rate_per_replica * 8,
+                         max(6.0, args.duration))
+        peak = router.replicas_alive()
+        deadline = time.monotonic() + 30.0
+        while (router.replicas_alive() > pol.min_replicas
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        st = router.stats()
+    finally:
+        router.stop(timeout=20.0)
+    rec = {"low": low, "high": high, "policy": pol.__dict__,
+           "scale_ups": st["scale_ups"], "scale_downs": st["scale_downs"],
+           "peak_replicas": peak,
+           "replicas_after_drain": st["replicas_alive"]}
+    tobs.echo(f"autoscale: ups={st['scale_ups']} "
+              f"downs={st['scale_downs']} peak={peak} "
+              f"drained_to={st['replicas_alive']}")
+    return rec
+
+
+def parse_points(s):
+    points = []
+    for tok in (t for t in s.split(",") if t.strip()):
+        n, _, h = tok.partition("@")
+        points.append((int(n), int(h or 1)))
+    return points
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    tobs = blocks.train_obs_from_args(args, "serve_fleet",
+                                      tier=args.tier, lanes=args.lanes)
+    t_start = time.time()
+    backend = None
+    if not args.stub:
+        from smartcal_tpu.envs import radio
+
+        backend = radio.RadioBackend(**TIERS[args.tier])
+    pool = _pool(args, backend)
+    record = {
+        "bench": "serve_fleet",
+        "tier": args.tier, "M": args.M, "lanes": args.lanes,
+        "stub": bool(args.stub), "pool_mode": args.pool_mode,
+        "rate_per_replica": args.rate_per_replica,
+        "duration_s": args.duration,
+        "host_cores": len(os.sched_getaffinity(0)),
+        "scaling": [],
+    }
+    for n, h in parse_points(args.replicas):
+        record["scaling"].append(sweep_point(args, tobs, pool, n, h))
+    if args.kill:
+        record["kill"] = kill_run(args, tobs, pool)
+    if args.autoscale:
+        record["autoscale"] = autoscale_run(args, tobs, pool)
+    record["wall_s"] = round(time.time() - t_start, 3)
+    obs.flush_counters()
+    tobs.close()
+    print(json.dumps(record, indent=1))
+    if args.out:
+        merge_out(args.out, record)
+    return record
+
+
+def merge_out(path, record):
+    """Merge-append into ``runs``; derive the scaling digest (jobs/s vs
+    replicas, normalized to the 1-replica point of the same run) from
+    the latest run that swept more than one topology."""
+    doc = {"bench": "serve_fleet", "runs": []}
+    if os.path.exists(path):
+        with open(path) as fh:
+            doc = json.load(fh)
+    doc.setdefault("runs", []).append(record)
+    digests = []
+    for run in doc["runs"]:
+        pts = [p for p in run.get("scaling", [])
+               if p["summary"].get("achieved_jobs_s")]
+        if len(pts) < 2:
+            continue
+        base = next((p for p in pts if p["replicas"] == 1), pts[0])
+        b = base["summary"]["achieved_jobs_s"]
+        digests.append({
+            "stub": run.get("stub", False),
+            "host_cores": run.get("host_cores"),
+            "base_jobs_s": b,
+            "curve": [{
+                "replicas": p["replicas"], "hosts": p["hosts"],
+                "jobs_s": p["summary"]["achieved_jobs_s"],
+                "speedup": round(p["summary"]["achieved_jobs_s"]
+                                 / max(1e-9, b), 2),
+                "efficiency": round(p["summary"]["achieved_jobs_s"]
+                                    / max(1e-9, b * p["replicas"]), 3),
+                "p99_s": p["summary"].get("latency_p99_s"),
+                "shed": p["summary"].get("shed"),
+                "steady_compiles":
+                    p["steady_compile_events_fleet"],
+            } for p in pts],
+        })
+    if digests:
+        doc["scaling_digests"] = digests
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    os.replace(tmp, path)
+
+
+if __name__ == "__main__":
+    main()

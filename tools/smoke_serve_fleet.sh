@@ -1,0 +1,129 @@
+#!/bin/bash
+# Fleet-serving smoke, the scale-out chain end to end:
+#
+# Phase 1 (COLD): serve_fleet.py with ONE replica against a FRESH
+# --cache-dir — replica 0 must BUILD every program (export sources) and
+# complete jobs through the router front door.
+#
+# Phase 2 (WARM FLEET + KILL): a 2-replica fleet against the SAME
+# cache — BOTH replicas must come up entirely from cache (cold build
+# happened exactly once, fleet-wide), serve with ZERO steady-state
+# compile events summed across every replica process, and survive a
+# mid-run SIGKILL of replica 0: every admitted job completes on the
+# survivor (requeue), nothing sheds, and the slot respawns (measured
+# recover time).
+#
+# Every load summary must also satisfy the shed-accounting identity:
+# per-reason shed counts sum to the shed total, and shed + failed +
+# completed == submitted (sheds and deadline misses are DISJOINT).
+#
+# Then tools/obs_report.py over the fleet RunLog must render the
+# fleet-SLO section (per-replica p50/p99, dispatch balance, replica
+# lifecycle).
+#
+# The scale-out companion of smoke_serve.sh; the cold export build
+# dominates (~2-4 min on CPU), the warm fleet phase is seconds.
+#
+#   bash tools/smoke_serve_fleet.sh [workdir]
+#
+# Exits non-zero on any broken link in the chain.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+REPO="$PWD"
+WORK="${1:-$(mktemp -d /tmp/smoke_serve_fleet.XXXXXX)}"
+CACHE="$WORK/cache"
+OUT="$WORK/fleet.json"
+RUN_COLD="$WORK/fleet_cold.jsonl"
+RUN_WARM="$WORK/fleet_warm.jsonl"
+mkdir -p "$WORK"
+
+fleet() {  # fleet <metrics.jsonl> <extra args...>
+    local metrics="$1"; shift
+    (cd "$WORK" && PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+        JAX_PLATFORMS=cpu \
+        python "$REPO/tools/serve_fleet.py" \
+        --tier tiny --M 3 --lanes 3 --rate-per-replica 4 --duration 4 \
+        --pool 4 --cache-dir "$CACHE" --metrics "$metrics" \
+        --out "$OUT" --quiet "$@" > /dev/null)
+}
+
+echo "[smoke_serve_fleet] phase 1: COLD single replica (fresh $CACHE)" >&2
+fleet "$RUN_COLD" --replicas 1
+
+python - "$OUT" <<'EOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+cold = doc["runs"][0]["scaling"][0]
+assert cold["warm_sources"] == {"0": ["export"]}, \
+    f"cold replica 0 must BUILD every program: {cold['warm_sources']}"
+s = cold["summary"]
+assert s["completed"] > 0, f"cold fleet completed no jobs: {s}"
+print("[smoke_serve_fleet] cold OK:", s["completed"], "jobs through",
+      "the front door, boot", cold["boot_s"], "s")
+EOF
+
+echo "[smoke_serve_fleet] phase 2: WARM 2-replica fleet + kill" >&2
+fleet "$RUN_WARM" --replicas 2 --kill
+
+python - "$OUT" <<'EOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+warm = doc["runs"][-1]
+pt = warm["scaling"][0]
+
+# 1. BOTH replicas warm-start entirely off the shared cache: the cold
+#    build happened exactly once, fleet-wide
+assert pt["warm_sources"] == {"0": ["cache"], "1": ["cache"]}, \
+    f"warm fleet must deserialize everything: {pt['warm_sources']}"
+
+# 2. zero steady-state compiles summed across EVERY replica process
+assert pt["steady_compile_events_fleet"] == 0, \
+    (f"{pt['steady_compile_events_fleet']} compile events in warm "
+     f"fleet steady state")
+
+# 3. shed-accounting identity on every load summary of the run:
+#    per-reason counts sum to shed; shed/failed/completed partition
+#    the submitted jobs (deadline misses are a subset of completed,
+#    disjoint from sheds)
+summaries = [p["summary"] for p in warm["scaling"]]
+summaries += [warm["kill"]["summary"]]
+for s in summaries:
+    assert sum(s["shed_reasons"].values()) == s["shed"], s
+    assert s["shed"] + s["failed"] + s["completed"] == s["submitted"], s
+    assert s["accounted"] == s["submitted"], s
+    assert s["deadline_missed"] <= s["completed"], s
+
+# 4. the kill cost nothing: every admitted job completed on the
+#    survivor, the slot respawned, recovery was measured
+k = warm["kill"]
+ks = k["summary"]
+assert ks["completed"] == ks["submitted"] and ks["shed"] == 0, ks
+assert k["replica_restarts"] >= 1, k
+assert k["replicas_alive_after"] == 2, k
+assert k["recover_s"] is not None and k["recover_s"] < 30, k
+print("[smoke_serve_fleet] warm fleet OK:", pt["summary"]["completed"],
+      "jobs, fleet steady compiles 0; kill:", ks["completed"], "/",
+      ks["submitted"], "completed, recover", k["recover_s"], "s")
+EOF
+
+echo "[smoke_serve_fleet] aggregating the fleet RunLog with obs_report" >&2
+REPORT="$WORK/report.txt"
+python tools/obs_report.py "$RUN_WARM" > "$REPORT"
+grep -q "fleet SLO" "$REPORT" || {
+    echo "[smoke_serve_fleet] FAIL: no fleet-SLO section in obs_report" >&2
+    exit 1
+}
+grep -q "replica 0:" "$REPORT" || {
+    echo "[smoke_serve_fleet] FAIL: no per-replica latency line" >&2
+    exit 1
+}
+grep -q "replica downs=" "$REPORT" || {
+    echo "[smoke_serve_fleet] FAIL: no replica-lifecycle line" >&2
+    exit 1
+}
+echo "[smoke_serve_fleet] PASS (workdir $WORK)" >&2
