@@ -990,6 +990,173 @@ def _nscale_full_tier_footprint(ns, npix=1024, n_times=20, tdelta=10,
     return rows
 
 
+def _mesh_compose_measure(ns=(62, 256), lanes=2, k_dirs=2):
+    """The measurement body of :func:`bench_mesh_compose` (runs in the
+    8-device child when the parent backend is single-device)."""
+    from smartcal_tpu.envs.radio import RadioBackend
+    from smartcal_tpu.obs import costs as obs_costs
+    from smartcal_tpu.parallel.mesh import (AXIS_BASELINE, AXIS_LANE,
+                                            largest_divisor)
+
+    ndev = jax.device_count()
+    rows = []
+    for n in ns:
+        B = n * (n - 1) // 2
+        backend = RadioBackend(n_stations=n, n_freqs=1, n_times=2,
+                               tdelta=2, admm_iters=1, lbfgs_iters=2,
+                               init_iters=2, npix=32)
+        eps, rhos = [], []
+        for i in range(lanes):
+            ep, mdl = backend.new_demixing_episode(
+                jax.random.PRNGKey(7 + i), k_dirs)
+            eps.append(ep)
+            rhos.append(np.asarray(mdl.rho))
+        bep = backend.stack_episodes(eps)
+        rho = np.stack(rhos).astype(np.float32)
+        alpha = np.zeros_like(rho)
+        # one fused-program footprint per N: the lowered cost is the
+        # single-device equivalent for EVERY arm — only the per-axis
+        # division differs (obs/costs.py sharding-aware accounting)
+        nb_full = largest_divisor(B, ndev)
+        nb_half = largest_divisor(B, max(ndev // lanes, 1))
+        arms = (("unsharded", 0, 0),
+                ("lane_only", lanes, 0),
+                ("baseline_only", 0, nb_full),
+                ("lane_x_baseline", lanes, nb_half))
+        fused_peak = None
+        arm_rows = []
+        for label, nl, nb in arms:
+            if label != "unsharded" and max(nl, 1) * max(nb, 1) <= 1:
+                # e.g. N=62: B=1891 = 31 x 61 has NO divisor <= 8 — the
+                # baseline axis genuinely cannot shard on this mesh
+                # (make_mesh would raise MeshFactorizationError); report
+                # the fact instead of silently mislabeling the arm
+                arm_rows.append({
+                    "arm": label, "skipped":
+                        f"B={B} has no divisor <= {ndev} "
+                        "(baseline axis cannot shard; see "
+                        "parallel/mesh.nearest_factorization)"})
+                continue
+            compose = (nl, nb)
+            res = backend.calibrate_batched(bep, rho, compose=compose)
+            jax.block_until_ready(res.J)
+            img = backend.influence_images_batched(bep, res, rho, alpha,
+                                                   compose=compose)
+            jax.block_until_ready(img)
+            t0 = time.time()
+            res = backend.calibrate_batched(bep, rho, compose=compose)
+            jax.block_until_ready(res.J)
+            t_solve = time.time() - t0
+            t0 = time.time()
+            img = backend.influence_images_batched(bep, res, rho, alpha,
+                                                   compose=compose)
+            jax.block_until_ready(img)
+            t_inf = time.time() - t0
+            if fused_peak is None:
+                ops = backend.batched_influence_operands(bep, res, rho,
+                                                         alpha)
+                fp = obs_costs.stage_cost(
+                    backend.batched_influence_callable(bep.n_dirs,
+                                                       backend.npix),
+                    *ops)
+                fused_peak = fp.get("peak_bytes")
+            shard_axes = {}
+            if nl > 1:
+                shard_axes[AXIS_LANE] = nl
+            if nb > 1:
+                shard_axes[AXIS_BASELINE] = nb
+            total = 1
+            for s in shard_axes.values():
+                total *= s
+            row = {"arm": label, "lane_shards": nl, "baseline_shards": nb,
+                   "t_solve_s": round(t_solve, 3),
+                   "t_influence_s": round(t_inf, 3),
+                   "peak_bytes_fused": fused_peak}
+            if fused_peak:
+                row["peak_bytes_per_shard"] = fused_peak / total
+                row["peak_bytes_per_axis"] = {
+                    a: fused_peak / s for a, s in shard_axes.items()}
+            arm_rows.append(row)
+        rows.append({"n_stations": n, "n_baselines": B, "devices": ndev,
+                     "lanes": lanes, "arms": arm_rows})
+    return rows
+
+
+def bench_mesh_compose(ns=(62, 256), lanes=2, out_path=None):
+    """Composed-mesh influence/solve arms (ISSUE 17 tentpole metric):
+    warm wall-clock + per-axis footprint of the batched chain under
+    unsharded / lane-only / baseline-only / lane x baseline placement
+    at N in {62, 256} (minimal-depth tier — 1 band, 1 chunk, K=2; the
+    SHAPES carry the signal, iteration depth does not).
+
+    The footprint columns are the obs/costs.py sharding-aware
+    accounting: the fused single-device peak divided per axis
+    (``peak_bytes_per_axis`` — what each axis alone buys) and by the
+    composed product (``peak_bytes_per_shard`` — the per-device peak on
+    the composed mesh).  N=62's B=1891 = 31 x 61 has no divisor <= 8,
+    so its baseline arms report the factorization refusal instead of a
+    number — the honest shape of the reference scale.
+
+    On a single-device CPU backend the measurement re-runs in a child
+    process with 8 virtual host devices (the tests' conftest mesh); an
+    already-multi-device parent (chip or forced-host) measures inline.
+    ``BENCH_MESH_NS`` (comma-separated) overrides the sweep; the payload
+    also lands in ``results/mesh_compose_r16.json`` (or ``out_path``).
+    """
+    env_ns = os.environ.get("BENCH_MESH_NS", "").strip()
+    if env_ns:
+        ns = tuple(int(x) for x in env_ns.split(",") if x.strip())
+    if jax.device_count() >= 8:
+        rows = _mesh_compose_measure(ns, lanes)
+    else:
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(suffix=".json",
+                                         delete=False) as fh:
+            tmp = fh.name
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        code = ("import json, bench\n"
+                f"rows = bench._mesh_compose_measure({tuple(ns)!r}, "
+                f"{int(lanes)})\n"
+                f"json.dump(rows, open({tmp!r}, 'w'))\n")
+        subprocess.run([sys.executable, "-c", code], check=True, env=env,
+                       cwd=os.path.dirname(os.path.abspath(__file__)))
+        with open(tmp) as fh:
+            rows = json.load(fh)
+        os.unlink(tmp)
+    sharded = [a for r in rows for a in r["arms"]
+               if a.get("arm") == "lane_x_baseline"
+               and "t_influence_s" in a]
+    out = {
+        "metric": "mesh_compose",
+        "value": sharded[-1]["t_influence_s"] if sharded else None,
+        "unit": f"seconds (influence, lane x baseline, N={ns[-1]})",
+        "vs_baseline": None,
+        "scale": "minimal-depth tier: Nf=1, T=2 (Ts=1), K=2, npix=32, "
+                 "admm 1 — N and the mesh are real, depth is not",
+        "platform": jax.devices()[0].platform,
+        "results": rows,
+        "note": "wall-clock is warm steady-state; footprints are the "
+                "fused-program peak divided per axis/shard "
+                "(obs/costs.py sharding-aware accounting — shard_map "
+                "programs don't AOT-lower through the plain-args "
+                "contract).",
+    }
+    if out_path is None:
+        res_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "results")
+        if os.path.isdir(res_dir):
+            out_path = os.path.join(res_dir, "mesh_compose_r16.json")
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(out, fh, indent=1)
+    return out
+
+
 def bench_actor_scaling(arms=None, episodes=16, out_path=None,
                         replay_shards=4):
     """Aggregate env-steps/s of the supervised async actor-learner fleet
@@ -1276,7 +1443,8 @@ def _measured_main():
                   (bench_calib_batched,
                    "calib_batched_env_steps_per_sec"),
                   (bench_actor_scaling, "actor_scaling"),
-                  (bench_nscale, "nscale")]
+                  (bench_nscale, "nscale"),
+                  (bench_mesh_compose, "mesh_compose")]
         if os.environ.get("BENCH_SKIP_CALIB"):
             out["extra"].append({"metric": "calib_episode_wall_clock",
                                  "skipped": "BENCH_SKIP_CALIB=1"})

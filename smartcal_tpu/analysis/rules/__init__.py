@@ -7,6 +7,7 @@ from . import donation     # noqa: F401
 from . import dtype_discipline  # noqa: F401
 from . import jit_sync     # noqa: F401
 from . import locks        # noqa: F401
+from . import mesh_axes    # noqa: F401
 from . import pickle_io    # noqa: F401
 from . import prints       # noqa: F401
 from . import rng          # noqa: F401

@@ -34,6 +34,7 @@ POLICIED_PATHS = (
     "smartcal_tpu/cal/imager.py",
     "smartcal_tpu/cal/influence.py",
     "smartcal_tpu/cal/kernels.py",
+    "smartcal_tpu/ops/pallas_hessian.py",
     "smartcal_tpu/ops/pallas_imager.py",
 )
 

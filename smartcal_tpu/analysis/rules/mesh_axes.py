@@ -1,0 +1,129 @@
+"""mesh-axis-literal: bare mesh-axis name strings outside the registry.
+
+PR 17's one-mesh contract (parallel/mesh.py): every device-mesh axis
+name — "rp"/"dp"/"lane"/"fp"/"sp"/"bp" — has exactly one definition, the
+``AXIS_*`` registry constants, and every consumer (PartitionSpecs,
+``mesh.shape[...]`` lookups, collectives, mesh builders) spells the axis
+through the registry.  A bare axis-name string literal is a silent fork
+of the registry: rename or re-map an axis and the literal site keeps the
+old spelling, compiling fine until the shard_map axis-binding error (or
+worse, a wrong-axis collective that type-checks) fires at run time.
+
+The rule flags axis-name string constants only in AXIS CONTEXTS —
+``P("dp")``/mesh-builder calls, ``axis=``/``axis_name=``-style keywords
+and parameter defaults, ``mesh.shape["dp"]`` subscripts — so ordinary
+two-letter strings elsewhere ("sp" as a variable suffix, docstrings)
+never trip it.
+
+Sites that genuinely cannot import the registry (a module layered BELOW
+``parallel/`` whose import would cycle through the package __init__)
+carry a ``# graftlint: disable=mesh-axis-literal -- <reason>`` — the
+stated reason is the audit trail, exactly like dtype-discipline pins.
+
+Scope: ``smartcal_tpu/`` and ``tools/`` (tests may spell axes literally
+— exercising the string contract IS part of their job); the registry
+itself (parallel/mesh.py) is exempt — it is where the literals live.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from ..core import FileContext, Finding, Rule, register
+
+#: axis value -> the registry constant that should spell it
+AXIS_CONSTANTS = {
+    "rp": "AXIS_REPLAY",
+    "dp": "AXIS_DATA",
+    "lane": "AXIS_LANE",
+    "fp": "AXIS_FREQ",
+    "sp": "AXIS_CHUNK",
+    "bp": "AXIS_BASELINE",
+}
+
+#: keyword/parameter names whose string values are axis names
+AXIS_KWARGS = ("axis", "axis_name", "axis_names", "lane_axis",
+               "baseline_axis", "replay_axis")
+
+#: callables whose positional string/tuple-of-string args are axis names
+AXIS_CALLS = ("P", "PartitionSpec", "make_mesh", "compose_mesh",
+              "psum", "pmean", "pmax", "pmin", "axis_index",
+              "all_gather", "ppermute", "psum_scatter",
+              "check_axis_divides")
+
+POLICIED_PREFIXES = ("smartcal_tpu/", "tools/")
+EXEMPT_PATHS = ("smartcal_tpu/parallel/mesh.py",)
+
+
+def _axis_strings(node: ast.AST):
+    """Yield (node, value) for axis-name string constants in ``node``,
+    looking through one level of tuple/list (P(("fp", "sp")),
+    make_mesh((2,), ("dp",)))."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if node.value in AXIS_CONSTANTS:
+            yield node, node.value
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            yield from _axis_strings(elt)
+
+
+def _call_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+@register
+class MeshAxisLiteral(Rule):
+    name = "mesh-axis-literal"
+    doc = ("bare mesh-axis name string in an axis context — spell it "
+           "with the parallel/mesh.py AXIS_* registry constant")
+
+    def _msg(self, value: str, where: str) -> str:
+        return (f'bare mesh-axis literal "{value}" in {where} — use '
+                f"parallel.mesh.{AXIS_CONSTANTS[value]} (one registry, "
+                "one spelling per axis) or add a reasoned "
+                "'# graftlint: disable=mesh-axis-literal'")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        prefixes = ctx.options.get("mesh_axis_policied_prefixes",
+                                   POLICIED_PREFIXES)
+        exempt = ctx.options.get("mesh_axis_exempt_paths", EXEMPT_PATHS)
+        if any(ctx.rel.endswith(p) for p in exempt):
+            return iter(())
+        if not any(ctx.rel.startswith(p) for p in prefixes):
+            return iter(())
+        findings: List[Finding] = []
+
+        def flag(container: ast.AST, where: str) -> None:
+            for n, v in _axis_strings(container):
+                findings.append(ctx.finding(self.name, n,
+                                            self._msg(v, where)))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                fname = _call_name(node.func)
+                if fname in AXIS_CALLS:
+                    for arg in node.args:
+                        flag(arg, f"a {fname}(...) call")
+                for kw in node.keywords:
+                    if kw.arg in AXIS_KWARGS:
+                        flag(kw.value, f"keyword {kw.arg}=")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = node.args
+                pos = a.posonlyargs + a.args
+                for prm, dfl in zip(pos[len(pos) - len(a.defaults):],
+                                    a.defaults):
+                    if prm.arg in AXIS_KWARGS:
+                        flag(dfl, f"parameter default {prm.arg}=")
+                for prm, dfl in zip(a.kwonlyargs, a.kw_defaults):
+                    if dfl is not None and prm.arg in AXIS_KWARGS:
+                        flag(dfl, f"parameter default {prm.arg}=")
+            elif isinstance(node, ast.Subscript):
+                if isinstance(node.value, ast.Attribute) and \
+                        node.value.attr == "shape":
+                    flag(node.slice, "a .shape[...] axis lookup")
+        return iter(sorted(set(findings)))

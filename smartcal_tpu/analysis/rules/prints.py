@@ -32,6 +32,7 @@ TOOLS_STDOUT_ALLOWLIST = frozenset({
     "bench_per.py",
     "bench_solve_eval.py",
     "capture_calib_episode.py",
+    "capture_kernel_roofline.py",
     "certify_batched.py",
     "chip_checks.py",
     "convert_ateam.py",

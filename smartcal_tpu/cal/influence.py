@@ -130,7 +130,8 @@ def _chunk_post(pol_means, fullpol):
 
 
 def _chunk_influence_opt(R3, C5, Jp, Jq, lhs, hadd, n_stations, fullpol,
-                         perdir, block_baselines=0, precision="f32"):
+                         perdir, block_baselines=0, precision="f32",
+                         use_pallas=False):
     """One calibration interval, OPTIMIZED formulation, on hoisted
     operands: the split-real block forms (R3, C5), the station-gathered
     Jones blocks (Jp, Jq) and the shared Dsolutions/Dresiduals lhs are
@@ -144,13 +145,24 @@ def _chunk_influence_opt(R3, C5, Jp, Jq, lhs, hadd, n_stations, fullpol,
     blocks bounding the einsum temporaries to the block, the B ~ N^2
     memory tier); ``precision`` (static, cal/precision.py) narrows the
     colmeans contraction under the ``colmeans_contract`` policy row —
-    the Hessian build and the transpose solve stay pinned f32."""
+    the Hessian build and the transpose solve stay pinned f32.
+
+    ``use_pallas`` (static) promotes the blocked tier to the tiled
+    Mosaic kernel (ops/pallas_hessian.hessian_res_core_pallas_sr) when
+    the backend is a TPU — the SAME static-threshold routing as the
+    blocked XLA core, one more rung on the ladder; CPU/GPU and sharded
+    callers fall through to the lax.scan twin."""
     Td = C5.shape[1]
     p_idx, _ = kernels.baseline_indices(n_stations)
     if block_baselines:
-        H = kernels._hessian_res_core_blocked_sr(R3, C5, Jp, Jq,
-                                                 n_stations,
-                                                 block_baselines)
+        from smartcal_tpu.ops import pallas_hessian  # lazy: ops is optional
+        if use_pallas and pallas_hessian.pallas_available():
+            H = pallas_hessian.hessian_res_core_pallas_sr(
+                R3, C5, Jp, Jq, n_stations)
+        else:
+            H = kernels._hessian_res_core_blocked_sr(R3, C5, Jp, Jq,
+                                                     n_stations,
+                                                     block_baselines)
     else:
         H = kernels._hessian_res_core_sr(R3, C5, Jp, Jq, n_stations)
     N4 = H.shape[1]
@@ -165,11 +177,13 @@ def _chunk_influence_opt(R3, C5, Jp, Jq, lhs, hadd, n_stations, fullpol,
 
 @partial(jax.jit, static_argnames=("n_stations", "n_chunks", "fullpol",
                                    "perdir", "optimized",
-                                   "block_baselines", "precision"))
+                                   "block_baselines", "precision",
+                                   "use_pallas"))
 def influence_visibilities(R, C, J, hadd, n_stations, n_chunks,
                            fullpol=False, perdir=False,
                            optimized=True, block_baselines=0,
-                           precision="f32") -> InfluenceResult:
+                           precision="f32",
+                           use_pallas=True) -> InfluenceResult:
     """Influence visibilities over all calibration intervals.
 
     R : (2*B*T, 2, 2) kernel-convention residuals for one sub-band
@@ -193,7 +207,10 @@ def influence_visibilities(R, C, J, hadd, n_stations, n_chunks,
     blocked Hessian core — at N >= 256 the unblocked per-chunk einsum
     temporaries are the memory wall; ``precision`` (static,
     cal/precision.py) selects the mixed bf16 policy for the colmeans
-    contraction (documented tolerance; solve/Hessian pinned f32).
+    contraction (documented tolerance; solve/Hessian pinned f32);
+    ``use_pallas`` (static, default True) lets the blocked tier promote
+    to the tiled Mosaic Hessian on TPU — sharded callers (GSPMD
+    programs, where pallas_call has no partitioning rule) pass False.
     """
     B = n_stations * (n_stations - 1) // 2
     T = C.shape[1] // B
@@ -217,7 +234,8 @@ def influence_visibilities(R, C, J, hadd, n_stations, n_chunks,
             return _chunk_influence_opt(r3, c5, jp, jq, lh, hadd,
                                         n_stations, fullpol, perdir,
                                         block_baselines=block_baselines,
-                                        precision=precision)
+                                        precision=precision,
+                                        use_pallas=use_pallas)
 
         vis_b, llr = lax.map(one, (R3, C5, Jp, Jq, lhs))
     else:
@@ -277,7 +295,7 @@ def _chunk_influence_bshard(r3l, c5l, jpl, jql, lhs_l, p_idx_l, q_idx_l,
 def influence_visibilities_blocal(R3, C5, J, p_idx_l, q_idx_l, hadd,
                                   n_stations, b_total,
                                   fullpol=False, perdir=False,
-                                  axis_name="bp", precision="f32"):
+                                  axis_name="bp", precision="f32"):  # graftlint: disable=mesh-axis-literal -- cal layers below parallel (importing the registry would cycle through parallel.__init__); value matches mesh.AXIS_BASELINE, callers pass the constant
     """Shard-LOCAL body of the baseline-sharded influence engine (called
     inside ``shard_map`` by parallel/sharded_cal.influence_baseline_
     sharded; per-shard shapes).
@@ -367,7 +385,8 @@ def influence_images_multi(residual, C, J, hadd_all, freqs, uvw, cell,
             inf = influence_visibilities(rk, c, j, hadd, n_stations,
                                          n_chunks, optimized=True,
                                          block_baselines=block_baselines,
-                                         precision=precision)
+                                         precision=precision,
+                                         use_pallas=use_pallas)
             ivis = stokes_i_influence(inf.vis)
             if imager_block_r:
                 # use_pallas doubles as the GSPMD guard here, exactly as

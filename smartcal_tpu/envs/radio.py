@@ -45,6 +45,11 @@ from smartcal_tpu import obs
 from smartcal_tpu.obs import costs as obs_costs
 from smartcal_tpu.cal import (coherency, imager, influence, observation,
                               simulate, solver)
+# the canonical axis-name registry (ISSUE 17): mesh.py has no package-
+# internal imports, so this resolves before any parallel/envs cycle
+from smartcal_tpu.parallel.mesh import (AXIS_BASELINE, AXIS_CHUNK,
+                                        AXIS_FREQ, AXIS_LANE,
+                                        largest_divisor)
 
 # calibration-unit thresholds (see RadioBackend._fused_work): one fused
 # XLA program above _WATCHDOG_WORK risks tripping device/tunnel watchdogs
@@ -200,7 +205,7 @@ class RadioBackend:
         self.imager_block_r = imager_block_r
         self._sweep_fns = {}     # (n_dirs, n_masks, batch) -> jitted sweep
         self._batched_fns = {}   # (kind, shape sig) -> jitted batched prog
-        self._meshes = {}        # axis size -> cached 1D mesh
+        self._meshes = {}        # (size, axis) / (nl, nb) -> cached mesh
         # double-buffer worker (run_pipelined / env prefetch)
         self._prefetch_lock = threading.Lock()
         self._prefetch_ex = None
@@ -432,14 +437,31 @@ class RadioBackend:
                 return size
         return 0
 
-    def _mesh(self, size):
-        mesh = self._meshes.get(size)
+    def _mesh(self, size, axis=AXIS_FREQ):
+        """Cached 1-D mesh whose single axis carries the registry name of
+        the ROLE it plays (frequency / chunk / baseline / lane) — until
+        PR 16 every route reused one "fp"-named mesh regardless of role."""
+        mesh = self._meshes.get((size, axis))
         if mesh is None:
             from smartcal_tpu.parallel import make_mesh
 
-            mesh = make_mesh((size,), ("fp",),
+            mesh = make_mesh((size,), (axis,),
                              devices=jax.devices()[:size])
-            self._meshes[size] = mesh
+            self._meshes[(size, axis)] = mesh
+        return mesh
+
+    def _mesh2(self, n_lane, n_baseline):
+        """Cached composed lane x baseline mesh (parallel/mesh.compose_
+        mesh): ONE topology the batched solve (P(lane) specs, baseline
+        axis replicated) and the composed influence program share, so no
+        resharding sits between them."""
+        mesh = self._meshes.get((n_lane, n_baseline))
+        if mesh is None:
+            from smartcal_tpu.parallel import compose_mesh
+
+            mesh = compose_mesh({AXIS_LANE: n_lane,
+                                 AXIS_BASELINE: n_baseline})
+            self._meshes[(n_lane, n_baseline)] = mesh
         return mesh
 
     def calibrate(self, ep: Episode, rho, mask=None, admm_iters=None):
@@ -501,10 +523,11 @@ class RadioBackend:
                 def route_fn(rho_arr):
                     with obs.span("solve", route="sharded", shards=nfp):
                         return sharded_cal.solve_admm_sharded(
-                            self._mesh(nfp), ep.V, C, ep.obs.freqs, ep.f0,
+                            self._mesh(nfp, AXIS_FREQ), ep.V, C,
+                            ep.obs.freqs, ep.f0,
                             jnp.asarray(rho_arr),
                             self._solver_cfg(ep.n_dirs),
-                            axis="fp", n_chunks=self.n_chunks,
+                            axis=AXIS_FREQ, n_chunks=self.n_chunks,
                             admm_iters=None if admm_iters is None
                             else int(admm_iters), collect_stats=collect)
             elif self._use_host_solver(admm_iters):
@@ -730,7 +753,7 @@ class RadioBackend:
                     ep, result, hadd_all, uvw, cell, npix, nbp, statics)
                 self._record_influence_cost(result, ep, hadd_all, uvw,
                                             cell, npix, statics,
-                                            shards=nbp)
+                                            shards={AXIS_BASELINE: nbp})
                 return out
         nfp = self._shard_size(self.n_freqs, work)
         if nfp:
@@ -738,11 +761,13 @@ class RadioBackend:
 
             sp.tag(route="freq_sharded", shards=nfp)
             out = sharded_cal.influence_images_sharded(
-                self._mesh(nfp), result.residual, ep.Ccal, result.J,
-                hadd_all, ep.obs.freqs, uvw, cell, self.n_stations,
-                self.n_chunks, npix, **statics)
+                self._mesh(nfp, AXIS_FREQ), result.residual, ep.Ccal,
+                result.J, hadd_all, ep.obs.freqs, uvw, cell,
+                self.n_stations, self.n_chunks, npix, axis=AXIS_FREQ,
+                **statics)
             self._record_influence_cost(result, ep, hadd_all, uvw, cell,
-                                        npix, statics, shards=nfp)
+                                        npix, statics,
+                                        shards={AXIS_FREQ: nfp})
             return out
         nsp = self._shard_size(self.n_chunks, work)
         if nsp:
@@ -750,7 +775,8 @@ class RadioBackend:
             out = self._influence_image_chunk_sharded(
                 ep, result, hadd_all, uvw, cell, npix, nsp, statics)
             self._record_influence_cost(result, ep, hadd_all, uvw, cell,
-                                        npix, statics, shards=nsp)
+                                        npix, statics,
+                                        shards={AXIS_CHUNK: nsp})
             return out
         if self._use_host_solver():
             # single device at watchdog scale: same proxy as the solve —
@@ -793,6 +819,66 @@ class RadioBackend:
                 "imager_matmul", statics.get("precision", "f32"))),
             cell=cell, n_stations=self.n_stations, n_chunks=self.n_chunks,
             npix=npix, **statics)
+        self._record_kernel_costs(ep.n_dirs, npix, cell, statics)
+
+    def _record_kernel_costs(self, n_dirs, npix, cell, statics=None):
+        """Kernel-family roofline rows (ISSUE 17): when a blocked tier
+        is engaged, record BOTH implementations of the kernel — the
+        blocked XLA path and its tiled pallas twin — as
+        ``kernel:<name>`` cost events lowered from shape-only operands,
+        so tools/obs_report.py can print the pallas-vs-XLA comparison
+        that gates kernel promotion.  The pallas rows lower the real
+        Mosaic kernel on TPU and the interpreter form elsewhere —
+        interpreter numbers certify parity and plumbing, only the TPU
+        rows are rooflines.  Deferred and deduped by abstract signature
+        like every cost event."""
+        from smartcal_tpu.cal import kernels as _kernels
+        from smartcal_tpu.ops import pallas_hessian, pallas_imager
+
+        statics = statics or self._influence_statics(npix)
+        sds = jax.ShapeDtypeStruct
+        f32 = jnp.float32
+        K, B = n_dirs, self.n_baselines
+        Td = max(self.n_times // self.n_chunks, 1)
+        R = self.n_times * B
+        on_tpu = pallas_imager.pallas_available()
+        bb = statics.get("block_baselines", 0)
+        if bb:
+            r3 = sds((Td, B, 2, 2, 2), f32)
+            c5 = sds((K, Td, B, 2, 2, 2), f32)
+            jb = sds((K, B, 2, 2, 2), f32)
+            obs_costs.record_stage_cost(
+                "kernel:hessian_blocked_xla",
+                _kernels._hessian_res_core_blocked_sr, r3, c5, jb, jb,
+                static_argnames=("n_stations", "block_baselines"),
+                defer=True, n_stations=self.n_stations,
+                block_baselines=bb)
+            obs_costs.record_stage_cost(
+                "kernel:hessian_pallas",
+                pallas_hessian.hessian_res_core_pallas_sr, r3, c5, jb,
+                jb, static_argnames=("n_stations", "interpret"),
+                defer=True, n_stations=self.n_stations,
+                interpret=not on_tpu)
+        ibr = statics.get("imager_block_r", 0)
+        if ibr:
+            uvw_s = sds((R, 3), f32)
+            vis_s = sds((R, 2), f32)
+            freq_s = sds((), f32)
+            prec_s = statics.get("precision", "f32")
+            obs_costs.record_stage_cost(
+                "kernel:imager_blocked_xla",
+                imager.dirty_image_factored_blocked_sr, uvw_s, vis_s,
+                freq_s, float(cell),
+                static_argnames=("npix", "block_r", "precision"),
+                defer=True, npix=npix, block_r=ibr, precision=prec_s)
+            if npix % pallas_imager.TILE_L == 0:
+                obs_costs.record_stage_cost(
+                    "kernel:imager_pallas",
+                    pallas_imager.dirty_image_factored_pallas, uvw_s,
+                    vis_s, freq_s, float(cell),
+                    static_argnames=("npix", "precision", "interpret"),
+                    defer=True, npix=npix, precision=prec_s,
+                    interpret=not on_tpu)
 
     def _influence_image_host_segmented(self, ep, result, hadd_all, uvw,
                                         cell, npix, statics=None):
@@ -835,14 +921,14 @@ class RadioBackend:
 
         statics = statics if statics is not None \
             else self._influence_statics(npix)
-        mesh = self._mesh(nsp)
+        mesh = self._mesh(nsp, AXIS_CHUNK)
         freqs = np.asarray(ep.obs.freqs)
         imgs = []
         for fi in range(self.n_freqs):
             Rk = solver.residual_to_kernel(result.residual[fi])
             inf = sharded_cal.influence_sharded(
                 mesh, Rk, ep.Ccal[fi], result.J[fi], hadd_all[fi],
-                self.n_stations, self.n_chunks, axis="fp",
+                self.n_stations, self.n_chunks, axis=AXIS_CHUNK,
                 block_baselines=statics["block_baselines"],
                 precision=statics.get("precision", "f32"))
             ivis = influence.stokes_i_influence(inf.vis)
@@ -872,21 +958,20 @@ class RadioBackend:
         the (B, ...) residual/coherency/lhs tensors and every
         per-baseline einsum temporary partition across the mesh, so an
         N >= 256 episode's influence chain fits where the unsharded
-        chain is footprint-bounded.  The mesh is the backend's generic
-        1D mesh, whose single axis is NAMED "fp" (the historical
-        routing name) — here it plays the baseline-partition ROLE; the
-        "bp" default of influence_baseline_sharded is just the name
-        tests/standalone callers use for their own meshes."""
+        chain is footprint-bounded.  The mesh axis carries the registry
+        baseline name (AXIS_BASELINE) — the pre-registry kludge of
+        reusing the "fp"-named generic mesh for the baseline ROLE is
+        gone (ISSUE 17 satellite 2)."""
         from smartcal_tpu.parallel import sharded_cal
 
-        mesh = self._mesh(nbp)
+        mesh = self._mesh(nbp, AXIS_BASELINE)
         freqs = np.asarray(ep.obs.freqs)
         imgs = []
         for fi in range(self.n_freqs):
             Rk = solver.residual_to_kernel(result.residual[fi])
             inf = sharded_cal.influence_baseline_sharded(
                 mesh, Rk, ep.Ccal[fi], result.J[fi], hadd_all[fi],
-                self.n_stations, self.n_chunks, axis="fp",
+                self.n_stations, self.n_chunks, axis=AXIS_BASELINE,
                 precision=statics.get("precision", "f32"))
             ivis = influence.stokes_i_influence(inf.vis)
             imgs.append(self._image_ivis(uvw, ivis, float(freqs[fi]),
@@ -1001,6 +1086,28 @@ class RadioBackend:
         one fused program's size."""
         return self._shard_size(n_lanes, self._fused_work() * n_lanes)
 
+    def _compose_sizes(self, n_lanes):
+        """(n_lane, n_baseline) shape of the composed batched mesh
+        (ISSUE 17): lanes fill the mesh first (independent episodes are
+        the cheapest parallelism — no collectives), and leftover devices
+        go to the baseline axis only in the blocked-B tier
+        (``n_baselines >= _BLOCK_MIN_B``), where partitioning B is what
+        makes the program FIT rather than merely faster.
+        ``SMARTCAL_COMPOSE=1`` forces the baseline axis on below the
+        tier (tests/bench arms); ``=0`` disables it.  ``n_baseline`` is
+        0 when the composed program would degenerate to lane-only."""
+        nl = self._batch_shard_size(n_lanes)
+        env = os.environ.get("SMARTCAL_COMPOSE", "").strip().lower()
+        if env in ("0", "false", "no", "off"):
+            return nl, 0
+        spare = jax.device_count() // max(nl, 1)
+        want_b = env in ("1", "true", "yes", "on") or \
+            self.n_baselines >= _BLOCK_MIN_B
+        if not want_b or spare < 2:
+            return nl, 0
+        nb = largest_divisor(self.n_baselines, spare)
+        return nl, (nb if nb >= 2 else 0)
+
     def batched_solve_callable(self, n_dirs):
         """The UNJITTED vmapped masked-ADMM solve over a leading lane
         axis — positional operands as built by
@@ -1018,8 +1125,8 @@ class RadioBackend:
 
         return jax.vmap(one)
 
-    def _batched_solve_fn(self, n_dirs, n_lanes, nbp):
-        key = ("solve", n_dirs, n_lanes, nbp)
+    def _batched_solve_fn(self, n_dirs, n_lanes, nbp, nb=0):
+        key = ("solve", n_dirs, n_lanes, nbp, nb)
         fn = self._batched_fns.get(key)
         if fn is not None:
             return fn
@@ -1029,8 +1136,14 @@ class RadioBackend:
 
             from smartcal_tpu.parallel import sharded_cal
 
-            mesh = self._mesh(nbp)
-            ax = "fp"  # the backend's generic 1D mesh axis name
+            # composed topology (ISSUE 17): when the influence chain
+            # shards lanes x baselines, the solve runs on the SAME mesh
+            # with the baseline axis replicated — learner, solve and
+            # influence share one topology, so the solve -> influence
+            # hand-off never reshards
+            mesh = self._mesh2(nbp, nb) if nb else \
+                self._mesh(nbp, AXIS_LANE)
+            ax = AXIS_LANE
             out_specs = solver.SolveResult(
                 J=P(ax), Z=P(ax), residual=P(ax), sigma_res=P(ax),
                 sigma_data=P(ax), final_cost=P(ax), stats=None)
@@ -1059,7 +1172,8 @@ class RadioBackend:
                 jnp.asarray(bep.f0, jnp.float32), rho, masks, iters)
 
     def calibrate_batched(self, bep: BatchedEpisode, rho, mask=None,
-                          admm_iters=None) -> solver.SolveResult:
+                          admm_iters=None,
+                          compose=None) -> solver.SolveResult:
         """Batched :meth:`calibrate`: B lanes' masked ADMM solves as ONE
         program.  ``rho`` (E, K) per-lane regularization; ``mask``
         (E, K) in {0, 1} (None = all directions); ``admm_iters`` a
@@ -1068,14 +1182,22 @@ class RadioBackend:
         value is a traced argument, so one compile serves every episode
         batch of this shape.  Solver stats are not collected on this
         route (the batched program's output tree stays the fused-solve
-        shape, same rule as the traced hint sweep)."""
+        shape, same rule as the traced hint sweep).
+
+        ``compose`` forces the ``(n_lane, n_baseline)`` mesh shape
+        (None = the :meth:`_compose_sizes` policy); a baseline size
+        >= 2 places the solve on the composed lane x baseline mesh with
+        the baseline axis replicated, so it shares the influence
+        chain's topology."""
         E = int(bep.V.shape[0])
-        nbp = self._batch_shard_size(E)
-        route = "batched_sharded" if nbp else "batched_vmap"
-        fn = self._batched_solve_fn(bep.n_dirs, E, nbp)
+        nl, nb = self._compose_sizes(E) if compose is None \
+            else (int(compose[0]), int(compose[1]))
+        route = "batched_sharded" if nl else "batched_vmap"
+        fn = self._batched_solve_fn(bep.n_dirs, E, nl, nb if nl else 0)
         ops = self.batched_solve_operands(bep, rho, mask, admm_iters)
         with obs.span("solve", route=route, lanes=E,
-                      **({"shards": nbp} if nbp else {})):
+                      **({"shards": nl} if nl else {}),
+                      **({"baseline_shards": nb} if nl and nb else {})):
             obs.gauge_set("batched_lanes", E)
             return fn(*ops)
 
@@ -1124,19 +1246,65 @@ class RadioBackend:
 
     def influence_images_batched(self, bep: BatchedEpisode,
                                  result: solver.SolveResult, rho,
-                                 rho_spatial, npix=None):
+                                 rho_spatial, npix=None, compose=None):
         """Batched :meth:`influence_image`: (E, npix, npix) mean influence
         dirty images, the whole formulation-optimized chain (scatter-free
         Hessian, adjoint 4-RHS transpose solve, rank-factored DFT imager
         — matmul-only, so it vmaps/shards cleanly) over the lane axis in
-        one dispatch.  ``rho``/``rho_spatial`` are (E, K) per lane."""
+        one dispatch.  ``rho``/``rho_spatial`` are (E, K) per lane.
+
+        ``compose`` forces the ``(n_lane, n_baseline)`` mesh shape
+        (None = the :meth:`_compose_sizes` policy).  A baseline size
+        >= 2 routes through the composed lane x baseline ``shard_map``
+        program (parallel/sharded_cal.influence_images_batched_sharded)
+        — the ISSUE 17 tentpole route: one program shards BOTH axes,
+        with the Hessian/adjoint/imager collectives confined to the
+        baseline axis."""
         E = int(bep.V.shape[0])
         npix = npix or self.npix
-        fn = self._batched_influence_fn(bep.n_dirs, E, npix)
+        nl, nb = self._compose_sizes(E) if compose is None \
+            else (int(compose[0]), int(compose[1]))
         ops = self.batched_influence_operands(bep, result, rho, rho_spatial)
+        statics = self._influence_statics(npix)
+        if nb >= 2:
+            from smartcal_tpu.parallel import sharded_cal
+
+            nl = max(int(nl), 1)
+            with obs.span("influence") as sp:
+                sp.tag(route="batched_lane_bshard", lanes=E,
+                       lane_shards=nl, baseline_shards=nb)
+                out = sharded_cal.influence_images_batched_sharded(
+                    self._mesh2(nl, nb), *ops, self.n_stations,
+                    self.n_chunks, npix, n_poly=self.n_poly,
+                    polytype=self.polytype,
+                    imager_block_r=statics["imager_block_r"],
+                    precision=statics["precision"])
+                self._record_batched_influence_cost(
+                    bep, ops, npix, statics,
+                    shards={AXIS_LANE: nl, AXIS_BASELINE: nb})
+            return out
+        fn = self._batched_influence_fn(bep.n_dirs, E, npix)
         with obs.span("influence") as sp:
             sp.tag(route="batched_vmap", lanes=E)
             return fn(*ops)
+
+    def _record_batched_influence_cost(self, bep, ops, npix, statics,
+                                       shards):
+        """Deferred cost event for the batched influence routes: like
+        :meth:`_record_influence_cost`, the sharded route accounts the
+        fused (vmapped) single-device equivalent and divides the
+        footprint by the per-axis ``shards`` mapping — the composed
+        mesh's per-device peak, broken out per axis in obs_report."""
+        from smartcal_tpu.cal import precision as _prec
+
+        obs_costs.record_stage_cost(
+            "influence", self.batched_influence_callable(bep.n_dirs,
+                                                         npix),
+            *ops, defer=True, shards=shards,
+            compute_dtype=_prec.dtype_name(_prec.contraction_dtype(
+                "imager_matmul", statics.get("precision", "f32"))))
+        self._record_kernel_costs(bep.n_dirs, npix,
+                                  float(np.asarray(bep.cell)[0]), statics)
 
     def _batched_sigma_fn(self, n_lanes, npix):
         key = ("sigmas", n_lanes, npix)

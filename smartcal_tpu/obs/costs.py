@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import sys
 import threading
-from typing import Callable, Optional, Sequence
+from typing import Callable, Mapping, Optional, Sequence, Union
 
 from .runlog import active
 
@@ -137,6 +137,16 @@ def _compute_and_log(stage, fn, args, static_argnames, kwargs,
                           **kwargs)
     except Exception as e:  # noqa: BLE001 — never kill the observed run
         cost = {"error": f"{type(e).__name__}: {e}"}
+    shard_axes = None
+    if isinstance(shards, Mapping):
+        # per-axis form {axis name: size} (composed meshes, ISSUE 17):
+        # the total division is over the product, and the per-axis sizes
+        # are logged so the report can break the footprint out by axis.
+        shard_axes = {str(a): int(n) for a, n in shards.items()
+                      if int(n) > 1}
+        shards = 1
+        for n in shard_axes.values():
+            shards *= n
     if shards and shards > 1 and "peak_bytes" in cost:
         # sharding-aware division: the lowered program is the fused
         # single-device equivalent (shard_map programs don't AOT-lower
@@ -147,6 +157,12 @@ def _compute_and_log(stage, fn, args, static_argnames, kwargs,
         # scales where sharding is on.  Both numbers are logged.
         cost = dict(cost, shards=int(shards),
                     peak_bytes_per_shard=cost["peak_bytes"] / shards)
+        if shard_axes:
+            # footprint if ONLY that axis were sharded — the report's
+            # per-axis column, showing what each axis alone buys
+            cost["shard_axes"] = shard_axes
+            cost["peak_bytes_per_axis"] = {
+                a: cost["peak_bytes"] / n for a, n in shard_axes.items()}
     if compute_dtype is not None:
         cost = dict(cost, compute_dtype=str(compute_dtype))
     if rl is not None:
@@ -156,7 +172,8 @@ def _compute_and_log(stage, fn, args, static_argnames, kwargs,
 
 def record_stage_cost(stage: str, fn: Callable, *args: object,
                       static_argnames: Sequence[str] = (),
-                      defer: bool = False, shards: int = 1,
+                      defer: bool = False,
+                      shards: Union[int, Mapping[str, int]] = 1,
                       compute_dtype: Optional[str] = None,
                       **kwargs: object) -> Optional[dict]:
     """Log the ``cost`` event for ``stage`` once per abstract signature.
@@ -171,7 +188,10 @@ def record_stage_cost(stage: str, fn: Callable, *args: object,
 
     ``shards``/``compute_dtype`` are ACCOUNTING metadata, never passed
     to ``fn``: ``shards`` > 1 adds the sharding-aware footprint division
-    (``peak_bytes_per_shard``); ``compute_dtype`` tags the event with
+    (``peak_bytes_per_shard``); a ``{axis name: size}`` mapping divides
+    by the product and additionally logs ``shard_axes`` plus the
+    per-axis ``peak_bytes_per_axis`` breakout (registry names from
+    ``parallel/mesh.py``); ``compute_dtype`` tags the event with
     the kernel's policy dtype ("bf16"/"f32") so the roofline report can
     pick the matching device peak instead of assuming f32.
     """

@@ -1,0 +1,247 @@
+"""Tiled Pallas TPU kernel for the blocked residual-Hessian core.
+
+The B ~ N^2 memory tier of the influence engine runs the blocked XLA
+Hessian (cal/kernels._hessian_res_core_blocked_sr): a ``lax.scan`` over
+baseline blocks whose per-block einsum temporaries — A1/A2/Sp/Sq and
+their conjugates, each (K, Td, blk, 2, 2, 2) — still round-trip HBM
+between the einsums XLA fuses imperfectly.  This kernel is the Mosaic
+twin (ISSUE 17, promoted after the imager family): the baseline axis is
+the grid, each step holds ONE (rows, TILE_B) tile of every operand in
+VMEM, the split-real 2x2 block algebra is fully unrolled on the VPU,
+and the two outputs leave VMEM exactly once per tile —
+
+* ``off``  (K*32, B)  — the off-diagonal block table, written tile by
+  tile (the block index map follows the grid);
+* ``Dsum`` (N, K*8)   — the station-summed diagonal contributions,
+  reduced on the MXU as two one-hot matmuls per tile and ACCUMULATED
+  across the grid (init at i == 0 — the standard Pallas pattern, same
+  as ops/pallas_imager).
+
+Layout contract: every VMEM tile keeps the BASELINE axis as the minor
+(lane) dimension — tiles are ``(rows, TILE_B)`` with TILE_B = 128, so
+the only tiled dimension is lane-aligned and every leading-dim reshape
+is Mosaic-trivial.  The 2x2 complex algebra is unrolled into python
+loops over (u, v, w) at trace time: ~16 fused multiply-add chains over
+(K, Td, TILE_B) planes, no gather, no transpose of the minor axis.
+
+The host wrapper zero-pads B to the tile size with SENTINEL station
+indices (>= N), which produce all-zero one-hot columns — the same
+padding convention as the blocked XLA core, so any phase of a padded
+baseline contributes nothing.  The placement tail
+(cal/kernels._hessian_assemble) is shared verbatim with the XLA paths:
+one copy of the placement math, three front-ends.
+
+Dispatch lives in cal/influence._chunk_influence_opt under the SAME
+static threshold as the blocked XLA core (``block_baselines`` > 0),
+gated by :func:`ops.pallas_imager.pallas_available`; ``interpret=True``
+runs the kernel through the Pallas interpreter on CPU — the tier-1
+parity gate against the XLA oracles — and ``interpret=False`` is the
+flag-flip on hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from smartcal_tpu.ops.pallas_imager import _VMEM, pallas_available  # noqa: F401
+
+# One baseline tile per grid step: the off-diagonal output block is
+# (K*32, 128) — sublane count K*32 is always a multiple of 8, lane count
+# 128 — so every tiled block satisfies the Mosaic (8, 128) alignment for
+# any direction count K and any Td (input tiles are full in the sublane
+# dimension; only the lane/baseline axis is tiled).
+TILE_B = 128
+
+
+def _hessian_kernel(Kn, Td, cre_ref, cim_ref, rre_ref, rim_ref, jpr_ref,
+                    jpi_ref, jqr_ref, jqi_ref, ohp_ref, ohq_ref, off_ref,
+                    dsum_ref):
+    i = pl.program_id(0)
+    f32 = jnp.float32  # graftlint: disable=dtype-discipline -- split-real Hessian blocks are pinned f32 by construction (the solve downstream rejects narrowed operands); ops layers below cal so the policy helper can't be imported at kernel scope
+    Cr = cre_ref[:].reshape(Kn, Td, 4, TILE_B)
+    Ci = cim_ref[:].reshape(Kn, Td, 4, TILE_B)
+    Rr = rre_ref[:].reshape(Td, 4, TILE_B)
+    Ri = rim_ref[:].reshape(Td, 4, TILE_B)
+    Jpr = jpr_ref[:].reshape(Kn, 4, TILE_B)
+    Jpi = jpi_ref[:].reshape(Kn, 4, TILE_B)
+    Jqr = jqr_ref[:].reshape(Kn, 4, TILE_B)
+    Jqi = jqi_ref[:].reshape(Kn, 4, TILE_B)
+
+    # off[k, a=i*2+u, c=j*2+v] = -sum_t conj(C)[k,t,(i,j)] * R[t,(u,v)]
+    # (the kernels._hessian_block_sums "kbiujv" row order, flattened)
+    rows = []
+    for a in range(4):
+        ii, u = divmod(a, 2)
+        for c in range(4):
+            jj, v = divmod(c, 2)
+            cr, ci = Cr[:, :, ii * 2 + jj], Ci[:, :, ii * 2 + jj]
+            rr, ri = Rr[None, :, u * 2 + v], Ri[None, :, u * 2 + v]
+            rows.append(-jnp.sum(cr * rr + ci * ri, axis=1))   # real
+            rows.append(-jnp.sum(cr * ri - ci * rr, axis=1))   # imag
+    off_ref[:] = jnp.stack(rows, axis=1).reshape(Kn * 32, TILE_B)
+
+    # diag at p: A1[u, w] = sum_v C[u, v] conj(Jq)[w, v]
+    a1r, a1i = {}, {}
+    for u in range(2):
+        for w in range(2):
+            ar = ai = 0.0
+            for v in range(2):
+                cr, ci = Cr[:, :, u * 2 + v], Ci[:, :, u * 2 + v]
+                jr = Jqr[:, None, w * 2 + v]
+                ji = Jqi[:, None, w * 2 + v]
+                ar = ar + cr * jr + ci * ji
+                ai = ai + ci * jr - cr * ji
+            a1r[u, w], a1i[u, w] = ar, ai           # (K, Td, TILE_B)
+    # Sp[u, v] = sum_t,w A1[u, w] conj(A1)[v, w]
+    sp = []
+    for u in range(2):
+        for v in range(2):
+            sr = si = 0.0
+            for w in range(2):
+                sr = sr + a1r[u, w] * a1r[v, w] + a1i[u, w] * a1i[v, w]
+                si = si + a1i[u, w] * a1r[v, w] - a1r[u, w] * a1i[v, w]
+            sp.append(jnp.sum(sr, axis=1))
+            sp.append(jnp.sum(si, axis=1))
+    Sp = jnp.stack(sp, axis=1).reshape(Kn * 8, TILE_B)
+
+    # diag at q: A2[u, w] = sum_v Jp[u, v] C[v, w]
+    a2r, a2i = {}, {}
+    for u in range(2):
+        for w in range(2):
+            ar = ai = 0.0
+            for v in range(2):
+                jr = Jpr[:, None, u * 2 + v]
+                ji = Jpi[:, None, u * 2 + v]
+                cr, ci = Cr[:, :, v * 2 + w], Ci[:, :, v * 2 + w]
+                ar = ar + jr * cr - ji * ci
+                ai = ai + jr * ci + ji * cr
+            a2r[u, w], a2i[u, w] = ar, ai
+    # Sq[v, w] = sum_t,u conj(A2)[u, v] A2[u, w]
+    sq = []
+    for v in range(2):
+        for w in range(2):
+            sr = si = 0.0
+            for u in range(2):
+                sr = sr + a2r[u, v] * a2r[u, w] + a2i[u, v] * a2i[u, w]
+                si = si + a2r[u, v] * a2i[u, w] - a2i[u, v] * a2r[u, w]
+            sq.append(jnp.sum(sr, axis=1))
+            sq.append(jnp.sum(si, axis=1))
+    Sq = jnp.stack(sq, axis=1).reshape(Kn * 8, TILE_B)
+
+    # station reduction on the MXU: one-hot (N, TILE_B) x (K*8, TILE_B)
+    # contracting the lane axis — sentinel columns are all-zero, so
+    # padded baselines contribute nothing
+    dn = (((1,), (1,)), ((), ()))
+    acc = (jax.lax.dot_general(ohp_ref[:], Sp, dn,
+                               preferred_element_type=f32)
+           + jax.lax.dot_general(ohq_ref[:], Sq, dn,
+                                 preferred_element_type=f32))
+
+    @pl.when(i == 0)
+    def _init():
+        dsum_ref[:] = acc
+
+    @pl.when(i != 0)
+    def _accum():
+        dsum_ref[:] += acc
+
+
+def _planes(x, lead):
+    """(..., B, 2, 2, 2) split-real block tensor -> two (lead*4, B)
+    component planes (re, im) with the baseline axis minor."""
+    re = jnp.moveaxis(x[..., 0], -3, -1)        # (..., 2, 2, B)
+    im = jnp.moveaxis(x[..., 1], -3, -1)
+    return re.reshape(lead * 4, -1), im.reshape(lead * 4, -1)
+
+
+@functools.partial(jax.jit, static_argnames=("n_stations", "interpret"))
+def hessian_block_sums_pallas(R3, C5, Jp, Jq, p_idx, q_idx, n_stations,
+                              interpret=False):
+    """Tiled Pallas twin of :func:`cal.kernels._hessian_block_sums` over
+    the FULL baseline set: R3 (Td, B, 2, 2, 2); C5 (K, Td, B, 2, 2, 2);
+    Jp/Jq (K, B, 2, 2, 2); p_idx/q_idx (B,) station indices.  Returns
+    (off (K, B, 4, 4, 2), Dsum (K, N, 2, 2, 2)), UNNORMALIZED — the
+    shared placement tail (kernels._hessian_assemble) runs in XLA.
+    B is zero-padded to TILE_B internally (sentinel station indices on
+    the pad -> zero one-hot columns, the blocked-XLA convention)."""
+    from smartcal_tpu.cal import kernels as _kernels
+    from smartcal_tpu.cal import precision as prec
+
+    K, Td, B = C5.shape[0], C5.shape[1], C5.shape[2]
+    N = n_stations
+    Bp = pl.cdiv(B, TILE_B) * TILE_B
+    padb = Bp - B
+
+    def pad_b(x, axis):
+        pw = [(0, 0)] * x.ndim
+        pw[axis] = (0, padb)
+        return jnp.pad(x, pw)
+
+    pi = jnp.concatenate(
+        [jnp.asarray(p_idx), jnp.full((padb,), N, jnp.asarray(p_idx).dtype)])
+    qi = jnp.concatenate(
+        [jnp.asarray(q_idx), jnp.full((padb,), N, jnp.asarray(q_idx).dtype)])
+    ohp = _kernels._block_onehot(pi, N, prec.F32)          # (N, Bp)
+    ohq = _kernels._block_onehot(qi, N, prec.F32)
+
+    cre, cim = _planes(pad_b(C5, 2), K * Td)               # (K*Td*4, Bp)
+    rre, rim = _planes(pad_b(R3, 1), Td)                   # (Td*4, Bp)
+    jpr, jpi = _planes(pad_b(Jp, 1), K)                    # (K*4, Bp)
+    jqr, jqi = _planes(pad_b(Jq, 1), K)
+
+    lane = lambda i: (0, i)                                # noqa: E731
+    tile = functools.partial(pl.BlockSpec, index_map=lane,
+                             memory_space=_VMEM)
+    off, dsum = pl.pallas_call(
+        functools.partial(_hessian_kernel, K, Td),
+        grid=(Bp // TILE_B,),
+        in_specs=[
+            tile((K * Td * 4, TILE_B)), tile((K * Td * 4, TILE_B)),
+            tile((Td * 4, TILE_B)), tile((Td * 4, TILE_B)),
+            tile((K * 4, TILE_B)), tile((K * 4, TILE_B)),
+            tile((K * 4, TILE_B)), tile((K * 4, TILE_B)),
+            tile((N, TILE_B)), tile((N, TILE_B)),
+        ],
+        out_specs=[
+            pl.BlockSpec((K * 32, TILE_B), lambda i: (0, i),
+                         memory_space=_VMEM),
+            pl.BlockSpec((N, K * 8), lambda i: (0, 0),
+                         memory_space=_VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K * 32, Bp), prec.F32),
+            jax.ShapeDtypeStruct((N, K * 8), prec.F32),
+        ],
+        interpret=interpret,
+    )(cre, cim, rre, rim, jpr, jpi, jqr, jqi, ohp, ohq)
+
+    # (K*32, Bp) rows k*32 + (a*4 + c)*2 + z -> (K, B, 4, 4, 2)
+    off = jnp.moveaxis(off.reshape(K, 4, 4, 2, Bp)[..., :B], -1, 1)
+    # (N, K*8) cols k*8 + (u*2 + v)*2 + z -> (K, N, 2, 2, 2)
+    Dsum = jnp.moveaxis(dsum.reshape(N, K, 2, 2, 2), 1, 0)
+    return off, Dsum
+
+
+@functools.partial(jax.jit, static_argnames=("n_stations", "interpret"))
+def hessian_res_core_pallas_sr(R3, C5, Jp, Jq, n_stations,
+                               interpret=False):
+    """Pallas-fronted :func:`cal.kernels._hessian_res_core_sr` /
+    ``_hessian_res_core_blocked_sr``: tiled block sums in Mosaic, the
+    shared ``_hessian_assemble`` placement tail in XLA.  Same operands
+    and output — (K, 4N, 4N, 2) normalized by the global B*Td — so the
+    influence engine's dispatch is a one-line swap.  Equal to the XLA
+    cores to float round-off (the tile reduction reassociates the
+    station sums exactly like the blocked scan; parity tested in
+    interpret mode, tests/test_pallas_hessian.py)."""
+    from smartcal_tpu.cal import kernels as _kernels
+
+    Td, B = C5.shape[1], C5.shape[2]
+    p_idx, q_idx = _kernels.baseline_indices(n_stations)
+    off, Dsum = hessian_block_sums_pallas(R3, C5, Jp, Jq, p_idx, q_idx,
+                                          n_stations,
+                                          interpret=interpret)
+    return _kernels._hessian_assemble(off, Dsum, n_stations, B, Td)

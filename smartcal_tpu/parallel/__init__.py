@@ -1,4 +1,18 @@
-from .mesh import make_mesh, replicated, sharded_batch  # noqa: F401
+from .mesh import (  # noqa: F401
+    AXIS_BASELINE,
+    AXIS_CHUNK,
+    AXIS_DATA,
+    AXIS_FREQ,
+    AXIS_LANE,
+    AXIS_REPLAY,
+    MESH_AXES,
+    MeshFactorizationError,
+    compose_mesh,
+    make_mesh,
+    nearest_factorization,
+    replicated,
+    sharded_batch,
+)
 from . import multihost  # noqa: F401
 from .trainer import (  # noqa: F401
     ParallelTrainState,

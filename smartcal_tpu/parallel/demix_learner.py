@@ -44,6 +44,7 @@ from ..envs.demixing import (EPS, INF_SCALE, META_SCALE, REWARD_MEAN,
                              REWARD_STD, scalar_to_kvec)
 from ..rl import replay as rp
 from ..rl import sac_discrete as dsac
+from .mesh import AXIS_DATA
 
 
 class DemixWorkload(NamedTuple):
@@ -240,11 +241,11 @@ def make_distributed_demix_sac(backend: radio.RadioBackend, K: int,
     ``provide_influence`` populates the infmap block of the observation
     (the reference variant's [1, Ninf, Ninf] input) — with False the block
     is zeros and ``agent_cfg.use_image`` should be False too."""
-    if n_actors % mesh.shape["dp"] != 0:
+    if n_actors % mesh.shape[AXIS_DATA] != 0:
         raise ValueError(f"n_actors={n_actors} not divisible by dp axis "
-                         f"{mesh.shape['dp']}")
+                         f"{mesh.shape[AXIS_DATA]}")
     repl = NamedSharding(mesh, P())
-    shard = NamedSharding(mesh, P("dp"))
+    shard = NamedSharding(mesh, P(AXIS_DATA))
     n_trans = rollout_epochs * rollout_steps
     spec = dsac.transition_spec(agent_cfg.obs_dim)
     _actor_rollout = make_demix_actor_rollout(
@@ -320,7 +321,7 @@ def train_distributed_demix(seed=0, episodes=10, n_actors=None, mesh=None,
     from . import make_mesh
 
     mesh = mesh or make_mesh()
-    n_actors = n_actors or mesh.shape["dp"]
+    n_actors = n_actors or mesh.shape[AXIS_DATA]
     backend = backend or radio.RadioBackend()
     md_dim = 3 * K + 2
     agent_cfg = dsac.DSACConfig(

@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..envs import enet
 from ..rl import replay as rp
 from ..rl import sac
+from .mesh import AXIS_DATA
 
 
 class DistPERState(NamedTuple):
@@ -209,11 +210,11 @@ def make_distributed_per_sac(env_cfg: enet.EnetConfig,
     everything and trains.  ``agent_cfg.prioritized`` should be True for
     parity (distributed PER).
     """
-    if n_actors % mesh.shape["dp"] != 0:
+    if n_actors % mesh.shape[AXIS_DATA] != 0:
         raise ValueError(f"n_actors={n_actors} not divisible by dp axis "
-                         f"{mesh.shape['dp']}")
+                         f"{mesh.shape[AXIS_DATA]}")
     repl = NamedSharding(mesh, P())
-    shard = NamedSharding(mesh, P("dp"))
+    shard = NamedSharding(mesh, P(AXIS_DATA))
     n_trans = rollout_epochs * rollout_steps
 
     def init_fn(key) -> DistPERState:
@@ -296,7 +297,7 @@ def train_distributed(seed=0, episodes=100, n_actors=None, mesh=None,
     from . import make_mesh
 
     mesh = mesh or make_mesh()
-    n_actors = n_actors or mesh.shape["dp"]
+    n_actors = n_actors or mesh.shape[AXIS_DATA]
     env_cfg = enet.EnetConfig(**(env_kwargs or {}))
     agent_kwargs = dict(agent_kwargs or {})
     agent_kwargs.setdefault("prioritized", True)

@@ -1,54 +1,163 @@
-"""Device-mesh helpers.
+"""Device-mesh helpers and THE canonical mesh-axis registry (ISSUE 17).
+
+Every mesh axis name in the tree comes from here.  Until PR 16 the system
+grew three *separate* 1-D meshes (episode lanes, replay shards, baselines),
+each hard-coding its own axis string — the lane mesh even reused the
+historical ``"fp"`` name for a baseline-partition role.  The registry plus
+``compose_mesh`` turn those point-solutions into one topology: a single
+2-D/3-D mesh whose axes a sharded learner, a lane-batched episode, and a
+baseline-sharded influence program can share.
 
 The framework's parallel axes (SURVEY.md section 2.4 mapping):
 
-* ``dp``  — data parallel: parallel environment rollouts + learn-batch
-  sharding (replaces the reference's torch-RPC learner/actor fan-out,
-  ``elasticnet/distributed_per_sac.py``).
-* ``fp``  — frequency parallel: consensus-ADMM calibration across frequency
-  sub-bands (replaces sagecal-mpi's MPI ranks, ``calibration/docal.sh:12``);
-  the Z-polynomial consensus update is a ``psum`` over this axis.
-* ``sp``  — sequence/baseline parallel: the time x baseline axis of the
+* ``AXIS_REPLAY``/``rp``   — replay-buffer shards (PR 12's ring parity;
+  the reference's per-actor replay processes).
+* ``AXIS_DATA``/``dp``     — data parallel: parallel environment rollouts +
+  learn-batch sharding (replaces the reference's torch-RPC learner/actor
+  fan-out, ``elasticnet/distributed_per_sac.py``).
+* ``AXIS_LANE``/``lane``   — batched-episode lanes (PR 9's lane-packed
+  vectorized episodes; one lane = one live episode).
+* ``AXIS_FREQ``/``fp``     — frequency parallel: consensus-ADMM calibration
+  across sub-bands (replaces sagecal-mpi's MPI ranks,
+  ``calibration/docal.sh:12``); the Z consensus update is a ``psum`` here.
+* ``AXIS_CHUNK``/``sp``    — calibration-interval (chunk) axis of the
   influence kernels (the reference chunks it over multiprocessing pools,
   ``calibration/analysis.py:54-62``).
+* ``AXIS_BASELINE``/``bp`` — station-pair (baseline) axis of the blocked
+  Hessian/influence kernels (PR 13); Hessian assembly is a ``psum`` here.
+
+Collectives must stay confined to their own axis: consensus psums ride
+``AXIS_FREQ``, Hessian/imager partial sums ride ``AXIS_BASELINE``, and the
+lane/replay/data axes never carry a collective (they only batch).
 
 All collectives ride ICI within a host and DCN across hosts — placement is
-XLA's job once shardings are annotated.
+XLA's job once shardings are annotated.  graftlint's ``mesh-axis-literal``
+rule keeps bare axis strings out of every other module.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Mapping, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
 
+# --- the axis-name registry -------------------------------------------------
+# The string VALUES are frozen ABI: checkpoints, serving signatures and the
+# dryrun drivers all reference meshes by these names.  Add axes here (and to
+# MESH_AXES in canonical order); never inline the strings elsewhere.
+AXIS_REPLAY = "rp"
+AXIS_DATA = "dp"
+AXIS_LANE = "lane"
+AXIS_FREQ = "fp"
+AXIS_CHUNK = "sp"
+AXIS_BASELINE = "bp"
+
+#: Canonical axis order for composed meshes: batching axes (replay/data/lane)
+#: lead, collective-bearing axes (freq/chunk/baseline) trail, so the
+#: innermost (fastest-wire) device dimension carries the chattiest psum.
+MESH_AXES: Tuple[str, ...] = (AXIS_REPLAY, AXIS_DATA, AXIS_LANE,
+                              AXIS_FREQ, AXIS_CHUNK, AXIS_BASELINE)
+
+
+class MeshFactorizationError(ValueError):
+    """Axis sizes do not factor over the available devices / data.
+
+    Raised instead of the opaque XLA sharding error (or a silent gcd
+    degrade) when a requested mesh shape cannot be honored; the message
+    always names the offending axis and suggests the nearest valid
+    factorization so the caller can fix the request, not guess.
+    """
+
+
+def largest_divisor(n: int, cap: int) -> int:
+    """Largest divisor of ``n`` that is <= ``cap`` (>=1 for n >= 1)."""
+    n, cap = int(n), max(1, int(cap))
+    for d in range(min(n, cap), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def nearest_factorization(axis_sizes: Mapping[str, int],
+                          n_devices: int) -> Dict[str, int]:
+    """Nearest valid shrink of ``axis_sizes`` onto ``n_devices`` devices.
+
+    Greedy in mapping order: each axis keeps the largest divisor of its
+    requested size that still fits the remaining device budget.  The
+    result's product always divides into ``n_devices`` and every suggested
+    size divides the requested one (so data that divided before still
+    divides).  Deterministic — used verbatim in error messages.
+    """
+    left = max(1, int(n_devices))
+    out: Dict[str, int] = {}
+    for name, size in axis_sizes.items():
+        d = largest_divisor(size, left)
+        out[name] = d
+        left //= d
+    return out
+
+
+def check_axis_divides(n_items: int, n_shards: int, *, axis: str,
+                       what: str) -> None:
+    """Raise :class:`MeshFactorizationError` unless n_shards | n_items."""
+    if n_shards <= 0 or n_items % n_shards != 0:
+        hint = largest_divisor(n_items, n_shards)
+        raise MeshFactorizationError(
+            f"{what}: axis {axis!r} wants {n_shards} shards but "
+            f"{n_items} items do not divide; nearest valid size is "
+            f"{hint} (divisors of {n_items} only)")
+
 
 def make_mesh(axis_sizes: Optional[Tuple[int, ...]] = None,
-              axis_names: Sequence[str] = ("dp",),
+              axis_names: Sequence[str] = (AXIS_DATA,),
               devices=None) -> Mesh:
     """Build a mesh over the available devices.
 
-    Default: all devices on one ``dp`` axis.  ``axis_sizes`` reshapes the
-    device list (row-major) for multi-axis meshes, e.g.
-    ``make_mesh((4, 2), ("dp", "fp"))``.
+    Default: all devices on one ``AXIS_DATA`` axis.  ``axis_sizes``
+    reshapes the device list (row-major) for multi-axis meshes, e.g.
+    ``make_mesh((4, 2), (AXIS_DATA, AXIS_FREQ))``.
     """
     devices = list(jax.devices()) if devices is None else list(devices)
     if axis_sizes is None:
         axis_sizes = (len(devices),)
     n = int(np.prod(axis_sizes))
     if n > len(devices):
-        raise ValueError(
-            f"mesh wants {n} devices, only {len(devices)} available")
+        req = dict(zip(axis_names, axis_sizes))
+        raise MeshFactorizationError(
+            f"mesh wants {n} devices ({req}), only {len(devices)} "
+            f"available; nearest valid factorization: "
+            f"{nearest_factorization(req, len(devices))}")
     dev_array = np.asarray(devices[:n]).reshape(axis_sizes)
     return Mesh(dev_array, tuple(axis_names))
+
+
+def compose_mesh(axis_sizes: Mapping[str, int], devices=None) -> Mesh:
+    """Build the unified multi-axis mesh from ``{axis name: size}``.
+
+    Axes are laid out in :data:`MESH_AXES` canonical order regardless of
+    mapping order, so ``compose_mesh({AXIS_BASELINE: 4, AXIS_LANE: 2})``
+    and ``compose_mesh({AXIS_LANE: 2, AXIS_BASELINE: 4})`` are the SAME
+    topology — callers can share one composed mesh (learner beside sharded
+    episode) without coordinating dict order.  Unknown axis names are an
+    error; size-1 axes are kept (a P(axis) spec on them is a no-op, which
+    lets one program serve every arm of the route matrix).
+    """
+    for name in axis_sizes:
+        if name not in MESH_AXES:
+            raise MeshFactorizationError(
+                f"unknown mesh axis {name!r}; registry axes are "
+                f"{MESH_AXES} (add new axes in parallel/mesh.py)")
+    names = tuple(a for a in MESH_AXES if a in axis_sizes)
+    sizes = tuple(int(axis_sizes[a]) for a in names)
+    return make_mesh(sizes, names, devices=devices)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def sharded_batch(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+def sharded_batch(mesh: Mesh, axis: str = AXIS_DATA) -> NamedSharding:
     """Leading-axis sharding over ``axis``."""
     return NamedSharding(mesh, P(axis))

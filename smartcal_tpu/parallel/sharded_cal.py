@@ -31,10 +31,17 @@ except ImportError:                   # older pins: experimental module
 
 from ..cal import influence as influence_mod
 from ..cal import solver
+from . import mesh as mesh_registry
+from .mesh import (AXIS_BASELINE, AXIS_CHUNK, AXIS_DATA, AXIS_FREQ,
+                   AXIS_LANE)
 
 # jitted baseline-sharded influence programs, keyed on (mesh, statics) —
 # see influence_baseline_sharded
 _BSHARD_CACHE: dict = {}
+
+# jitted lane x baseline composed batched-influence programs, keyed on
+# (mesh, statics) — see influence_images_batched_sharded
+_COMPOSE_CACHE: dict = {}
 
 
 def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
@@ -47,7 +54,7 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
 
 
 def solve_admm_sharded(mesh: Mesh, V, C, freqs, f0, rho,
-                       cfg: solver.SolverConfig, axis: str = "fp",
+                       cfg: solver.SolverConfig, axis: str = AXIS_FREQ,
                        n_chunks: Optional[int] = None,
                        admm_iters=None, freq_range=None,
                        collect_stats: bool = False):
@@ -64,8 +71,8 @@ def solve_admm_sharded(mesh: Mesh, V, C, freqs, f0, rho,
     the stats come out replicated/global).
     """
     nfp = mesh.shape[axis]
-    if V.shape[0] % nfp != 0:
-        raise ValueError(f"Nf={V.shape[0]} not divisible by {axis}={nfp}")
+    mesh_registry.check_axis_divides(V.shape[0], nfp, axis=axis,
+                                     what="solve_admm_sharded Nf")
     if cfg.polytype == 1 and freq_range is None:
         import numpy as np
         fr = np.asarray(freqs)
@@ -105,8 +112,8 @@ def solve_admm_sharded(mesh: Mesh, V, C, freqs, f0, rho,
 
 
 def solve_admm_sharded2d(mesh: Mesh, Vb, Cb, freqs_b, f0_b, rho,
-                         cfg: solver.SolverConfig, dp_axis: str = "dp",
-                         fp_axis: str = "fp",
+                         cfg: solver.SolverConfig, dp_axis: str = AXIS_DATA,
+                         fp_axis: str = AXIS_FREQ,
                          n_chunks: Optional[int] = None,
                          admm_iters=None, freq_range=None):
     """Batched frequency-consensus solves on a 2D (dp x fp) mesh.
@@ -123,11 +130,10 @@ def solve_admm_sharded2d(mesh: Mesh, Vb, Cb, freqs_b, f0_b, rho,
     program on one mesh.
     """
     ndp, nfp = mesh.shape[dp_axis], mesh.shape[fp_axis]
-    if Vb.shape[0] % ndp != 0:
-        raise ValueError(f"E={Vb.shape[0]} not divisible by {dp_axis}={ndp}")
-    if Vb.shape[1] % nfp != 0:
-        raise ValueError(f"Nf={Vb.shape[1]} not divisible by "
-                         f"{fp_axis}={nfp}")
+    mesh_registry.check_axis_divides(Vb.shape[0], ndp, axis=dp_axis,
+                                     what="solve_admm_sharded2d E")
+    mesh_registry.check_axis_divides(Vb.shape[1], nfp, axis=fp_axis,
+                                     what="solve_admm_sharded2d Nf")
     # Bernstein basis band edges are PER EPISODE (each episode's own
     # global band — a single shared range would build every episode a
     # different basis than its own per-episode solve uses), carried as
@@ -171,7 +177,7 @@ def solve_admm_sharded2d(mesh: Mesh, Vb, Cb, freqs_b, f0_b, rho,
 
 
 def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
-                      n_chunks: int, axis: str = "sp", fullpol=False,
+                      n_chunks: int, axis: str = AXIS_CHUNK, fullpol=False,
                       perdir=False, optimized=True, block_baselines=0,
                       precision: str = "f32"):
     """Influence visibilities with the calibration-interval (chunk) axis
@@ -185,9 +191,8 @@ def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
     records); ``n_chunks`` must divide by the axis size.
     """
     nsp = mesh.shape[axis]
-    if n_chunks % nsp != 0:
-        raise ValueError(f"n_chunks={n_chunks} not divisible by "
-                         f"{axis}={nsp}")
+    mesh_registry.check_axis_divides(n_chunks, nsp, axis=axis,
+                                     what="influence_sharded n_chunks")
     B = n_stations * (n_stations - 1) // 2
     T = C.shape[1] // B
     Td = T // n_chunks
@@ -201,10 +206,12 @@ def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
     def local(r4, c4, j):
         r = r4.reshape(local_chunks * 2 * B * Td, 2, 2)
         c = jnp.moveaxis(c4, 0, 1).reshape(K, local_chunks * B * Td, 4, 2)
+        # use_pallas=False: pallas_call has no GSPMD partitioning rule
         return influence_mod.influence_visibilities(
             r, c, j, hadd, n_stations, local_chunks, fullpol=fullpol,
             perdir=perdir, optimized=optimized,
-            block_baselines=block_baselines, precision=precision)
+            block_baselines=block_baselines, precision=precision,
+            use_pallas=False)
 
     out_specs = influence_mod.InfluenceResult(
         vis=P(None, axis) if perdir else P(axis), llr=P(axis))
@@ -218,7 +225,7 @@ def influence_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
 
 
 def influence_baseline_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
-                               n_chunks: int, axis: str = "bp",
+                               n_chunks: int, axis: str = AXIS_BASELINE,
                                fullpol=False, perdir=False,
                                precision: str = "f32"):
     """Influence visibilities with the BASELINE axis sharded over
@@ -241,8 +248,8 @@ def influence_baseline_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
 
     nbp = mesh.shape[axis]
     B = n_stations * (n_stations - 1) // 2
-    if B % nbp != 0:
-        raise ValueError(f"B={B} not divisible by {axis}={nbp}")
+    mesh_registry.check_axis_divides(B, nbp, axis=axis,
+                                     what="influence_baseline_sharded B")
     T = C.shape[1] // B
     Td = T // n_chunks
     K = C.shape[0]
@@ -299,7 +306,7 @@ def influence_baseline_sharded(mesh: Mesh, R, C, J, hadd, n_stations: int,
 
 def influence_images_sharded(mesh: Mesh, residual, C, J, hadd_all, freqs,
                              uvw, cell, n_stations: int, n_chunks: int,
-                             npix: int, axis: str = "fp", optimized=True,
+                             npix: int, axis: str = AXIS_FREQ, optimized=True,
                              block_baselines=0, imager_block_r=0,
                              precision: str = "f32"):
     """Mean influence dirty image with the FREQUENCY axis sharded over
@@ -317,8 +324,8 @@ def influence_images_sharded(mesh: Mesh, residual, C, J, hadd_all, freqs,
     """
     nfp = mesh.shape[axis]
     Nf = residual.shape[0]
-    if Nf % nfp != 0:
-        raise ValueError(f"Nf={Nf} not divisible by {axis}={nfp}")
+    mesh_registry.check_axis_divides(Nf, nfp, axis=axis,
+                                     what="influence_images_sharded Nf")
 
     def local(r, c, j, h, f, uvw_):
         imgs = influence_mod.influence_images_multi(
@@ -334,3 +341,128 @@ def influence_images_sharded(mesh: Mesh, residual, C, J, hadd_all, freqs,
                         out_specs=P(), check_vma=False)
     return sharded(residual, C, J, hadd_all, jnp.asarray(freqs),
                    uvw) / Nf
+
+
+def influence_images_batched_sharded(mesh: Mesh, residual_b, Cb, Jb, rho_b,
+                                     alpha_b, freqs_b, f0_b, uvw_b, cell_b,
+                                     n_stations: int, n_chunks: int,
+                                     npix: int, n_poly: int = 2,
+                                     polytype: int = 0,
+                                     lane_axis: str = AXIS_LANE,
+                                     baseline_axis: str = AXIS_BASELINE,
+                                     imager_block_r: int = 0,
+                                     precision: str = "f32"):
+    """Batched mean influence images with the LANE axis **and** the
+    BASELINE axis sharded in ONE ``shard_map`` program on the composed
+    registry mesh (ISSUE 17 tentpole): each device holds E/n_lane lanes
+    by B/n_baseline baselines, runs the shard-local influence engine
+    (:func:`cal.influence.influence_visibilities_blocal`) per lane per
+    sub-band, and images its local baselines' partial DFT.  Collectives
+    stay CONFINED to their axis: the Hessian / adjoint-G / LLR psums and
+    the final partial-image sum ride ``baseline_axis`` only; the lane
+    axis never carries a collective (lanes are independent episodes).
+
+    Operand layout mirrors ``RadioBackend.batched_influence_operands``:
+    residual_b (E, Nf, T, B, 2, 2, 2); Cb (E, Nf, K, T*B, 4, 2);
+    Jb (E, Nf, Ts, K, 2N, 2, 2); rho_b/alpha_b (E, K); freqs_b (E, Nf);
+    f0_b (E,); uvw_b (E, T*B, 3); cell_b (E,).  Returns (E, npix, npix)
+    lane-sharded mean images — equal to the vmapped unsharded chain to
+    float round-off (the baseline psum reassociates the station sums).
+
+    Either axis may have size 1 (a P(axis) spec on it is a no-op), so
+    the SAME program expresses the lane-only, baseline-only and composed
+    arms of the route matrix.  The per-baseline-block imager runs the
+    plain/blocked XLA factored DFT (never pallas: shapes are already
+    local here, and the promotion gate routes pallas only outside
+    shard_map until the hardware flag-flip).
+    """
+    import numpy as np
+
+    nl = mesh.shape[lane_axis]
+    nb = mesh.shape[baseline_axis]
+    E = residual_b.shape[0]
+    B = n_stations * (n_stations - 1) // 2
+    mesh_registry.check_axis_divides(
+        E, nl, axis=lane_axis, what="influence_images_batched_sharded E")
+    mesh_registry.check_axis_divides(
+        B, nb, axis=baseline_axis,
+        what="influence_images_batched_sharded B")
+    Nf = residual_b.shape[1]
+    T = residual_b.shape[2]
+    K = Cb.shape[2]
+
+    # expose the baseline axis for sharding: the (T*B,) sample axis is
+    # t-major, so a bare P on it would split TIMES across shards — the
+    # (T, B) unfold makes the shard slice a contiguous baseline range
+    C7 = Cb.reshape(E, Nf, K, T, B, 4, 2)
+    U4 = jnp.asarray(uvw_b).reshape(E, T, B, 3)
+    p_np, q_np = np.triu_indices(n_stations, 1)
+    p_idx = np.asarray(p_np, np.int32)
+    q_idx = np.asarray(q_np, np.int32)
+
+    in_specs = (P(lane_axis, None, None, baseline_axis),
+                P(lane_axis, None, None, None, baseline_axis),
+                P(lane_axis), P(lane_axis), P(lane_axis), P(lane_axis),
+                P(lane_axis), P(lane_axis, None, baseline_axis),
+                P(lane_axis), P(baseline_axis), P(baseline_axis))
+    cache_key = (mesh, lane_axis, baseline_axis, n_stations, n_chunks,
+                 npix, n_poly, polytype, imager_block_r, precision)
+    sharded = _COMPOSE_CACHE.get(cache_key)
+    if sharded is None:
+        from ..cal import imager as imager_mod
+
+        Ts = n_chunks
+
+        def lane(res, c7, j, r, a, f, f0_, u4, cl, pi, qi):
+            hadd = influence_mod.consensus_hadd_all(
+                r, a, f, f0_, n_poly=n_poly, polytype=polytype)  # (Nf, K)
+            Bl = res.shape[2]
+            Td = res.shape[1] // Ts
+
+            def band(args):
+                rk, c, jj, h, ff = args
+                R3 = rk.reshape(Ts, Td, Bl, 2, 2, 2)
+                C5 = jnp.moveaxis(jnp.swapaxes(
+                    c.reshape(K, Ts, Td, Bl, 2, 2, 2), -3, -2), 1, 0)
+                inf = influence_mod.influence_visibilities_blocal(
+                    R3, C5, jj, pi, qi, h, n_stations, B,
+                    axis_name=baseline_axis, precision=precision)
+                ivis = influence_mod.stokes_i_influence(
+                    inf.vis.reshape(Ts * Td * Bl, 4, 2))
+                ul = u4.reshape(-1, 3)
+                if imager_block_r:
+                    return imager_mod.dirty_image_factored_blocked_sr(
+                        ul, ivis, ff, cl, npix=npix,
+                        block_r=imager_block_r, precision=precision)
+                return imager_mod.dirty_image_factored_sr(
+                    ul, ivis, ff, cl, npix=npix, precision=precision)
+
+            imgs = jax.lax.map(band, (res, c7, j, hadd, f))
+            return jnp.mean(imgs, axis=0)
+
+        def local(res, c7, j, r, a, f, f0_, u4, cl, pi, qi):
+            per_lane = jax.vmap(
+                lane, in_axes=(0,) * 9 + (None, None))(
+                    res, c7, j, r, a, f, f0_, u4, cl, pi, qi)
+            # each shard imaged its local baselines with a local-R
+            # normalization; the psum over the baseline axis plus the
+            # 1/nb rescale restores the global (1/R_total) * sum image
+            return jax.lax.psum(per_lane, baseline_axis) / nb
+
+        sharded = jax.jit(shard_map(
+            local, mesh=mesh, in_specs=in_specs,
+            out_specs=P(lane_axis), check_vma=False))
+        _COMPOSE_CACHE[cache_key] = sharded
+    # explicit placement onto the composed mesh (the _BSHARD_CACHE
+    # pattern): operands arrive committed to the solve's sharding or the
+    # host, and the explicit device_put keeps the steady-state call legal
+    # under jax.transfer_guard("disallow")
+    operands = [
+        jax.device_put(x, NamedSharding(mesh, spec)) for x, spec in
+        zip((residual_b, C7, jnp.asarray(Jb),
+             jnp.asarray(rho_b, jnp.float32),
+             jnp.asarray(alpha_b, jnp.float32),
+             jnp.asarray(freqs_b), jnp.asarray(f0_b, jnp.float32),
+             U4, jnp.asarray(cell_b, jnp.float32), p_idx, q_idx),
+            in_specs)]
+    return sharded(*operands)

@@ -37,6 +37,7 @@ from smartcal_tpu import obs
 from ..envs import enet
 from ..rl import replay as rp
 from ..rl import sac
+from .mesh import AXIS_DATA
 
 
 def _instrument(fn, kind: str, env_steps_per_call: int,
@@ -109,12 +110,12 @@ def make_parallel_sac(env_cfg: enet.EnetConfig, agent_cfg: sac.SACConfig,
     shape (episodes_per_dispatch,), each the mean step reward of that
     episode across the env batch.
     """
-    if n_envs % mesh.shape["dp"] != 0:
+    if n_envs % mesh.shape[AXIS_DATA] != 0:
         raise ValueError(f"n_envs={n_envs} not divisible by dp axis "
-                         f"{mesh.shape['dp']}")
+                         f"{mesh.shape[AXIS_DATA]}")
 
     repl = NamedSharding(mesh, P())
-    shard = NamedSharding(mesh, P("dp"))
+    shard = NamedSharding(mesh, P(AXIS_DATA))
 
     def _fresh_envs(k_envs):
         """Reset all envs, draw the first noisy observation, compute hints.

@@ -48,7 +48,6 @@ buffer type (:func:`smartcal_tpu.rl.replay.backend_for`).
 
 from __future__ import annotations
 
-import math
 from typing import NamedTuple, Optional
 
 import jax
@@ -56,6 +55,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import replay as rp
+# the canonical axis-name registry (ISSUE 17): the replay axis is a
+# submesh of the composed topology, so learner and sharded episode can
+# share one mesh (mesh.py has no package-internal imports — no cycle)
+from ..parallel.mesh import (AXIS_REPLAY, MeshFactorizationError,
+                             check_axis_divides, largest_divisor)
 
 
 class ShardedReplayState(NamedTuple):
@@ -103,11 +107,19 @@ def replay_init(size: int, spec: dict, n_shards: int) -> ShardedReplayState:
     )
 
 
-def shardings(buf: ShardedReplayState, mesh, axis: str = "rp"):
+def shardings(buf: ShardedReplayState, mesh, axis: str = AXIS_REPLAY):
     """The buffer's sharding pytree: leading-axis sharded data +
-    priority, replicated counters."""
+    priority, replicated counters.  ``mesh`` may be a COMPOSED
+    multi-axis mesh (parallel/mesh.compose_mesh) — the buffer shards
+    over ``axis`` and replicates over every other axis, which is how
+    the learner's replay rides alongside a lane x baseline episode on
+    one topology."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if axis not in mesh.shape:
+        raise MeshFactorizationError(
+            f"replay shardings: mesh has no axis {axis!r} "
+            f"(mesh axes: {tuple(mesh.shape)})")
     shard = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
     return ShardedReplayState(
@@ -115,21 +127,37 @@ def shardings(buf: ShardedReplayState, mesh, axis: str = "rp"):
         cntr=repl, priority=shard, beta=repl)
 
 
-def place_on_mesh(buf: ShardedReplayState, mesh=None, axis: str = "rp"):
+def place_on_mesh(buf: ShardedReplayState, mesh=None,
+                  axis: str = AXIS_REPLAY):
     """Commit the buffer to the device mesh, shard axis leading.
 
     Default mesh: the largest divisor of ``n_shards`` that the local
     device count supports, over all devices — so an S=4 buffer on the
     8-device virtual test mesh occupies 4 devices, and on a single-CPU
     host degenerates (gracefully) to one device still carrying the
-    sharded LAYOUT the cluster run uses.
+    sharded LAYOUT the cluster run uses.  (The pre-registry code used
+    ``gcd`` here, which silently under-used devices — S=6 on 4 devices
+    landed on 2 instead of the documented 3.)
+
+    An EXPLICIT mesh is a contract, not a hint: if its ``axis`` size
+    does not divide ``n_shards``, this raises
+    :class:`~smartcal_tpu.parallel.mesh.MeshFactorizationError` naming
+    the nearest valid size instead of letting XLA fail opaquely (or
+    silently mis-sharding the ring).
     """
     if mesh is None:
         from jax.sharding import Mesh
 
         devs = jax.devices()
-        n = math.gcd(buf.n_shards, len(devs))
+        n = largest_divisor(buf.n_shards, len(devs))
         mesh = Mesh(np.asarray(devs[:n]), (axis,))
+    else:
+        if axis not in mesh.shape:
+            raise MeshFactorizationError(
+                f"place_on_mesh: mesh has no axis {axis!r} "
+                f"(mesh axes: {tuple(mesh.shape)})")
+        check_axis_divides(buf.n_shards, mesh.shape[axis], axis=axis,
+                           what="place_on_mesh n_shards")
     return jax.device_put(buf, shardings(buf, mesh, axis))
 
 

@@ -1,0 +1,28 @@
+"""Positive fixture: bare mesh-axis literals in axis contexts (linted
+with this file's path in mesh_axis_policied_prefixes)."""
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def shard_batch(mesh, x):
+    if x.shape[0] % mesh.shape["dp"] != 0:          # BAD: shape lookup
+        raise ValueError("bad batch")
+    return jax.device_put(x, NamedSharding(mesh, P("dp")))   # BAD: P()
+
+
+def reduce_lanes(v):
+    return jax.lax.psum(v, "lane")                  # BAD: collective
+
+
+def place(buf, mesh, axis="rp"):                    # BAD: param default
+    return buf
+
+
+def build(devices):
+    from smartcal_tpu.parallel.mesh import make_mesh
+
+    return make_mesh((2, 2), ("fp", "sp"), devices=devices)  # BAD x2
+
+
+def lookup(tree, mesh):
+    return tree.walk(axis_name="bp")                # BAD: axis keyword

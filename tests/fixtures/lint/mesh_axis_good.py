@@ -1,0 +1,30 @@
+"""Negative fixture: registry-spelled axes, non-axis short strings, and
+a reasoned literal pin."""
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from smartcal_tpu.parallel.mesh import AXIS_DATA, AXIS_LANE, make_mesh
+
+
+def shard_batch(mesh, x):
+    if x.shape[0] % mesh.shape[AXIS_DATA] != 0:     # registry constant
+        raise ValueError("bad batch")
+    return jax.device_put(x, NamedSharding(mesh, P(AXIS_DATA)))
+
+
+def reduce_lanes(v):
+    return jax.lax.psum(v, AXIS_LANE)
+
+
+def build(devices):
+    return make_mesh((2,), (AXIS_DATA,), devices=devices)
+
+
+def not_axis_contexts(df):
+    mode = "sp"                       # plain string, no axis context
+    df.sort_values("dp")              # not an axis call/keyword
+    return {"lane": 1, "bp": 2}[mode[:2]], df.shape[0]
+
+
+def layered_below(v, axis_name="bp"):  # graftlint: disable=mesh-axis-literal -- fixture: module layered below parallel, registry import would cycle
+    return jax.lax.psum(v, axis_name)

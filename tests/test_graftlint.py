@@ -113,6 +113,43 @@ def test_dtype_rule_policy_module_exempt():
         == []
 
 
+_MESH_OPTS_BAD = {"mesh_axis_policied_prefixes": ("tests/fixtures",)}
+
+
+def test_mesh_axis_rule_positive():
+    fs = fixture_findings("mesh_axis_bad.py", "mesh-axis-literal",
+                          _MESH_OPTS_BAD)
+    assert lines_of(fs) == [8, 10, 14, 17, 24, 28], fs
+    # the make_mesh axis tuple plants TWO literals on one line
+    assert len(fs) == 7, fs
+
+
+def test_mesh_axis_rule_negative():
+    assert fixture_findings("mesh_axis_good.py", "mesh-axis-literal",
+                            _MESH_OPTS_BAD) == []
+
+
+def test_mesh_axis_rule_scoped_and_registry_exempt():
+    """Outside the policed prefixes (tests spell axes literally on
+    purpose) and inside the registry itself, literals are not findings."""
+    assert fixture_findings("mesh_axis_bad.py", "mesh-axis-literal",
+                            {"mesh_axis_policied_prefixes":
+                             ("smartcal_tpu/",)}) == []
+    assert fixture_findings("mesh_axis_bad.py", "mesh-axis-literal",
+                            dict(_MESH_OPTS_BAD,
+                                 mesh_axis_exempt_paths=(
+                                     "mesh_axis_bad.py",))) == []
+
+
+def test_mesh_axis_rule_clean_tree():
+    """THE GATE for ISSUE 17 satellite 2: the shipped package and tools
+    spell every mesh axis through the registry (or carry a reasoned
+    disable) — zero findings at default scope."""
+    fs = [f for f in analysis.lint_paths(["smartcal_tpu", "tools"], ROOT)
+          if f.rule == "mesh-axis-literal"]
+    assert fs == [], fs
+
+
 _LOCK_SPEC = {"class": "Fleet",
               "fields": ["_weights", "_version", "_queue"],
               "locks": ["_wlock"], "why": "fixture"}

@@ -1,0 +1,296 @@
+"""Unified 2-D/3-D mesh composition (ISSUE 17 tentpole).
+
+Three layers:
+
+* registry/composition units — canonical axis order, size-1 axis
+  retention, the named :class:`MeshFactorizationError` with its
+  nearest-valid-factorization hint (satellite 1);
+* composed batched-route parity — the lane x baseline ``shard_map``
+  influence/solve programs against the lane-only, baseline-only and
+  unsharded-vmap oracles on the virtual 8-device mesh, including the
+  masked ``splice_episode`` reset and the steady-state transfer-guard
+  proof (no host round-trip once placed);
+* the replay axis as a submesh ALONGSIDE the episode axes — one
+  composed mesh serves the learner's replay shards and the batched
+  episode program without resharding.
+
+Tolerance classes match the neighbouring suites: shard_map psums
+reassociate f32 reductions (test_sharded_cal documents ~2e-3 worst
+case through the ADMM iterations), images compare at the batched-radio
+round-off class.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from smartcal_tpu.envs.radio import RadioBackend
+from smartcal_tpu.parallel.mesh import (AXIS_BASELINE, AXIS_CHUNK,
+                                        AXIS_DATA, AXIS_FREQ, AXIS_LANE,
+                                        AXIS_REPLAY, MESH_AXES,
+                                        MeshFactorizationError,
+                                        check_axis_divides, compose_mesh,
+                                        largest_divisor, make_mesh,
+                                        nearest_factorization)
+from smartcal_tpu.rl import replay_sharded as rps
+
+K = 3
+E = 2
+
+
+def _rel(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# registry + composition units
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_canonical_order_and_frozen_values(self):
+        """The string values are checkpoint/serving ABI — frozen."""
+        assert MESH_AXES == (AXIS_REPLAY, AXIS_DATA, AXIS_LANE,
+                             AXIS_FREQ, AXIS_CHUNK, AXIS_BASELINE)
+        assert (AXIS_REPLAY, AXIS_DATA, AXIS_LANE, AXIS_FREQ,
+                AXIS_CHUNK, AXIS_BASELINE) == \
+            ("rp", "dp", "lane", "fp", "sp", "bp")
+
+    def test_compose_mesh_canonical_order_any_dict_order(self):
+        m1 = compose_mesh({AXIS_BASELINE: 4, AXIS_LANE: 2})
+        m2 = compose_mesh({AXIS_LANE: 2, AXIS_BASELINE: 4})
+        assert m1.axis_names == (AXIS_LANE, AXIS_BASELINE)
+        assert m1.axis_names == m2.axis_names
+        assert m1.shape == m2.shape == {AXIS_LANE: 2, AXIS_BASELINE: 4}
+
+    def test_compose_mesh_keeps_size1_axes(self):
+        """A P(axis) spec on a size-1 axis is a no-op — keeping the axis
+        lets ONE program serve every arm of the route matrix."""
+        m = compose_mesh({AXIS_LANE: 1, AXIS_BASELINE: 4})
+        assert m.axis_names == (AXIS_LANE, AXIS_BASELINE)
+        assert m.shape[AXIS_LANE] == 1
+
+    def test_compose_mesh_rejects_unknown_axis(self):
+        with pytest.raises(MeshFactorizationError, match="registry"):
+            compose_mesh({"zz": 2})
+
+    def test_make_mesh_error_names_nearest_factorization(self):
+        with pytest.raises(MeshFactorizationError,
+                           match="nearest valid factorization"):
+            make_mesh((4, 4), (AXIS_LANE, AXIS_BASELINE))  # 16 > 8
+
+    def test_largest_divisor(self):
+        assert largest_divisor(6, 4) == 3       # NOT gcd (gcd gives 2)
+        assert largest_divisor(32640, 8) == 8
+        assert largest_divisor(7, 4) == 1
+
+    def test_nearest_factorization_divides_and_fits(self):
+        out = nearest_factorization({AXIS_LANE: 6, AXIS_BASELINE: 4}, 8)
+        assert out == {AXIS_LANE: 6, AXIS_BASELINE: 1}
+        assert 6 % out[AXIS_LANE] == 0 and 4 % out[AXIS_BASELINE] == 0
+
+    def test_check_axis_divides_hint(self):
+        with pytest.raises(MeshFactorizationError,
+                           match="nearest valid size is 3"):
+            check_axis_divides(15, 4, axis=AXIS_BASELINE, what="test")
+        check_axis_divides(15, 3, axis=AXIS_BASELINE, what="test")
+
+
+# ---------------------------------------------------------------------------
+# composed batched routes vs the single-axis / unsharded oracles
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def batched():
+    backend = RadioBackend(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                           admm_iters=2, lbfgs_iters=2, init_iters=3,
+                           npix=16)
+    eps, rhos = [], []
+    for i in range(E):
+        ep, mdl = backend.new_demixing_episode(jax.random.PRNGKey(20 + i),
+                                               K)
+        eps.append(ep)
+        rhos.append(np.asarray(mdl.rho))
+    bep = backend.stack_episodes(eps)
+    rho = np.stack(rhos).astype(np.float32)
+    alpha = np.zeros_like(rho)
+    res = backend.calibrate_batched(bep, rho, compose=(0, 0))
+    img = backend.influence_images_batched(bep, res, rho, alpha,
+                                           compose=(0, 0))
+    return backend, eps, bep, rho, alpha, res, img
+
+
+class TestComposedParity:
+    def test_lane_by_baseline_solve_matches_vmap(self, batched):
+        backend, _, bep, rho, _, res, _ = batched
+        out = backend.calibrate_batched(bep, rho, compose=(E, 3))
+        np.testing.assert_allclose(np.asarray(out.J), np.asarray(res.J),
+                                   rtol=5e-3, atol=5e-4)
+        assert _rel(out.residual, res.residual) < 1e-3
+        np.testing.assert_allclose(np.asarray(out.sigma_res),
+                                   np.asarray(res.sigma_res), rtol=5e-3)
+
+    @pytest.mark.parametrize("compose", [(E, 3), (0, 3), (E, 0)],
+                             ids=["lane_x_baseline", "baseline_only",
+                                  "lane_only"])
+    def test_influence_arms_match_vmap(self, batched, compose):
+        """B=15 shards 3-way on the baseline axis; every composed arm
+        reproduces the unsharded vmap images (collectives confined to
+        the baseline axis cannot leak across lanes)."""
+        backend, _, bep, rho, alpha, res, img = batched
+        out = backend.influence_images_batched(bep, res, rho, alpha,
+                                               compose=compose)
+        assert np.asarray(out).shape == np.asarray(img).shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(img),
+                                   rtol=2e-3, atol=2e-5)
+
+    def test_masked_splice_on_composed_route(self, batched):
+        """splice_episode (the batched envs' masked reset) feeds the
+        composed route: lane 1 replaced by a fresh episode, the
+        composed solve+influence match the vmap oracles on the spliced
+        batch and lane 0 is untouched."""
+        backend, eps, _, rho, alpha, _, _ = batched
+        # splice donates its input (in-place lane swap), so build a
+        # private stack rather than consuming the shared fixture
+        bep_local = backend.stack_episodes(eps)
+        v0 = np.asarray(bep_local.V[0])
+        ep_new, mdl_new = backend.new_demixing_episode(
+            jax.random.PRNGKey(99), K)
+        bep2 = backend.splice_episode(bep_local, 1, ep_new)
+        rho2 = rho.copy()
+        rho2[1] = np.asarray(mdl_new.rho, np.float32)
+        res_v = backend.calibrate_batched(bep2, rho2, compose=(0, 0))
+        res_c = backend.calibrate_batched(bep2, rho2, compose=(E, 3))
+        np.testing.assert_allclose(np.asarray(res_c.J),
+                                   np.asarray(res_v.J),
+                                   rtol=5e-3, atol=5e-4)
+        img_v = backend.influence_images_batched(bep2, res_v, rho2, alpha,
+                                                 compose=(0, 0))
+        img_c = backend.influence_images_batched(bep2, res_v, rho2, alpha,
+                                                 compose=(E, 3))
+        np.testing.assert_allclose(np.asarray(img_c), np.asarray(img_v),
+                                   rtol=2e-3, atol=2e-5)
+        # lane 0 of the spliced batch is bit-identical input data
+        np.testing.assert_array_equal(np.asarray(bep2.V[0]), v0)
+
+    def test_composed_route_transfer_guard_steady_state(self, batched):
+        """Once compiled and placed, the composed lane x baseline
+        program runs with NO implicit host transfer (PR 12/13 guard
+        pattern): first call warms the cache, the guarded call is the
+        steady-state proof."""
+        backend, _, bep, rho, alpha, res, _ = batched
+        # host-side numpy episode fields -> device arrays up front; the
+        # guarded call must then stay on-device end to end
+        bep_dev = bep._replace(
+            freqs=jnp.asarray(bep.freqs),
+            f0=jnp.asarray(bep.f0, jnp.float32),
+            uvw=jnp.asarray(bep.uvw),
+            cell=jnp.asarray(bep.cell, jnp.float32))
+        rho_d = jnp.asarray(rho)
+        alpha_d = jnp.asarray(alpha)
+        out1 = backend.influence_images_batched(bep_dev, res, rho_d,
+                                                alpha_d, compose=(E, 3))
+        jax.block_until_ready(out1)
+        with jax.transfer_guard("disallow"):
+            out2 = backend.influence_images_batched(bep_dev, res, rho_d,
+                                                    alpha_d,
+                                                    compose=(E, 3))
+            jax.block_until_ready(out2)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+    def test_nondividing_baseline_axis_fails_with_hint(self, batched):
+        """compose=(E, 4): B=15 does not divide 4-way — the named error
+        with the nearest-valid suggestion, not an opaque XLA failure
+        (satellite 1)."""
+        backend, _, bep, rho, alpha, res, _ = batched
+        with pytest.raises(MeshFactorizationError, match="nearest valid"):
+            backend.influence_images_batched(bep, res, rho, alpha,
+                                             compose=(E, 4))
+
+
+# ---------------------------------------------------------------------------
+# SKA-size composed parity (small tier: minimal depth, full N=256 shapes)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_lane_by_baseline_parity_n256():
+    """THE acceptance arm (ISSUE 17): N=256 stations (B=32640, the
+    blocked-Hessian tier engages on its own threshold), E=2 lanes x 4
+    baseline shards on the virtual mesh, vs the unsharded vmap oracle.
+    Depth is minimal (1 band, 1 chunk, 1 ADMM sweep) — the SHAPES are
+    the point.
+
+    slow-tier (~90 s of compile on the 1-core CI container — the tier-1
+    wall budget can't absorb it): run with ``-m slow`` or by node id.
+    The composed PROGRAM is identical at every scale, and the small-N
+    arms above gate it in tier-1; this arm adds the SKA shapes."""
+    kd = 2
+    backend = RadioBackend(n_stations=256, n_freqs=1, n_times=2,
+                           tdelta=2, admm_iters=1, lbfgs_iters=2,
+                           init_iters=2, npix=16)
+    eps, rhos = [], []
+    for i in range(E):
+        ep, mdl = backend.new_demixing_episode(jax.random.PRNGKey(40 + i),
+                                               kd)
+        eps.append(ep)
+        rhos.append(np.asarray(mdl.rho))
+    bep = backend.stack_episodes(eps)
+    rho = np.stack(rhos).astype(np.float32)
+    alpha = np.zeros_like(rho)
+    res = backend.calibrate_batched(bep, rho, compose=(0, 0))
+    img = backend.influence_images_batched(bep, res, rho, alpha,
+                                           compose=(0, 0))
+    res_c = backend.calibrate_batched(bep, rho, compose=(E, 4))
+    np.testing.assert_allclose(np.asarray(res_c.J), np.asarray(res.J),
+                               rtol=5e-3, atol=5e-4)
+    img_c = backend.influence_images_batched(bep, res, rho, alpha,
+                                             compose=(E, 4))
+    assert np.asarray(img_c).shape == (E, 16, 16)
+    np.testing.assert_allclose(np.asarray(img_c), np.asarray(img),
+                               rtol=2e-3, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# replay axis as a submesh alongside the episode axes
+# ---------------------------------------------------------------------------
+
+SPEC = {"x": ((), jnp.float32)}
+
+
+class TestReplaySubmesh:
+    def test_place_on_composed_mesh(self):
+        """The learner's replay shards live on the SAME composed mesh as
+        the episode program: sharded over AXIS_REPLAY, replicated over
+        the lane/baseline axes — no resharding between learn and act."""
+        buf = rps.replay_init(32, SPEC, 4)
+        mesh = compose_mesh({AXIS_REPLAY: 2, AXIS_LANE: 2,
+                             AXIS_BASELINE: 2})
+        placed = rps.place_on_mesh(buf, mesh)
+        assert placed.priority.sharding.spec == P(AXIS_REPLAY)
+        assert placed.data["x"].sharding.spec == P(AXIS_REPLAY)
+        assert placed.cntr.sharding.spec == P()
+        assert placed.priority.sharding.mesh.shape == {
+            AXIS_REPLAY: 2, AXIS_LANE: 2, AXIS_BASELINE: 2}
+
+    def test_explicit_mesh_without_replay_axis_raises(self):
+        buf = rps.replay_init(32, SPEC, 4)
+        mesh = compose_mesh({AXIS_LANE: 2, AXIS_BASELINE: 2})
+        with pytest.raises(MeshFactorizationError, match=AXIS_REPLAY):
+            rps.place_on_mesh(buf, mesh)
+
+    def test_explicit_nondividing_mesh_raises_with_hint(self):
+        buf = rps.replay_init(32, SPEC, 4)
+        mesh = compose_mesh({AXIS_REPLAY: 3})
+        with pytest.raises(MeshFactorizationError, match="nearest valid"):
+            rps.place_on_mesh(buf, mesh)
+
+    def test_default_mesh_takes_largest_divisor(self):
+        """S=12 shards on 8 devices: the default mesh is the LARGEST
+        divisor (6), not gcd (4) — place_on_mesh's documented
+        contract."""
+        buf = rps.replay_init(24, SPEC, 12)
+        placed = rps.place_on_mesh(buf)
+        mesh = placed.priority.sharding.mesh
+        assert mesh.shape[AXIS_REPLAY] == 6

@@ -1,0 +1,104 @@
+"""Interpret-mode parity gate for the tiled Pallas Hessian kernel
+(ops/pallas_hessian, ISSUE 17 tentpole).
+
+The kernel is the Mosaic twin of the blocked XLA Hessian core
+(cal/kernels._hessian_res_core_blocked_sr), selected by the SAME static
+``block_baselines`` threshold via ``influence_visibilities(...,
+use_pallas=True)``; ``interpret=True`` runs the exact kernel program
+through the Pallas interpreter on CPU, so these tests certify the tile
+algebra, layouts, and padding without a TPU — the hardware flip is the
+same code with ``interpret=False``.
+
+Tolerances are float-round-off class: the tile reduction reassociates
+the station sums exactly like the blocked scan does (the blocked-vs-
+unblocked XLA parity test in test_influence.py documents the same
+class).
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+import jax.numpy as jnp  # noqa: E402
+
+from smartcal_tpu.cal import kernels  # noqa: E402
+from smartcal_tpu.ops import pallas_hessian  # noqa: E402
+
+RTOL, ATOL = 2e-4, 2e-5
+
+
+def _operands(n_stations, K=3, Td=4, seed=0):
+    rng = np.random.default_rng(seed)
+    B = n_stations * (n_stations - 1) // 2
+    R3 = jnp.asarray(rng.standard_normal((Td, B, 2, 2, 2)), jnp.float32)
+    C5 = jnp.asarray(rng.standard_normal((K, Td, B, 2, 2, 2)),
+                     jnp.float32)
+    p, q = kernels.baseline_indices(n_stations)
+    J4 = jnp.asarray(rng.standard_normal((K, n_stations, 2, 2, 2)),
+                     jnp.float32)
+    return R3, C5, J4[:, p], J4[:, q], p, q
+
+
+@pytest.mark.parametrize("n_stations", [6, 20])
+def test_block_sums_parity(n_stations):
+    """Tile-kernel block sums == the einsum oracle, both in the
+    unaligned single-tile regime (N=6 -> B=15, padded to 128) and the
+    multi-tile regime with a ragged tail (N=20 -> B=190 -> 2 tiles,
+    66 pad slots)."""
+    R3, C5, Jp, Jq, p, q = _operands(n_stations)
+    off_ref, dsum_ref = kernels._hessian_block_sums(R3, C5, Jp, Jq, p, q,
+                                                    n_stations)
+    off_pl, dsum_pl = pallas_hessian.hessian_block_sums_pallas(
+        R3, C5, Jp, Jq, p, q, n_stations, interpret=True)
+    assert off_pl.shape == off_ref.shape
+    assert dsum_pl.shape == dsum_ref.shape
+    np.testing.assert_allclose(np.asarray(off_pl), np.asarray(off_ref),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(np.asarray(dsum_pl), np.asarray(dsum_ref),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_full_core_parity_vs_blocked_and_unblocked():
+    """hessian_res_core_pallas_sr == both XLA cores end to end (shared
+    _hessian_assemble placement tail, so this pins the decode reshapes
+    too)."""
+    N = 8
+    R3, C5, Jp, Jq, _, _ = _operands(N, K=2, Td=3, seed=1)
+    h_blk = kernels._hessian_res_core_blocked_sr(R3, C5, Jp, Jq, N, 8)
+    h_unb = kernels._hessian_res_core_sr(R3, C5, Jp, Jq, N)
+    h_pl = pallas_hessian.hessian_res_core_pallas_sr(R3, C5, Jp, Jq, N,
+                                                     interpret=True)
+    assert h_pl.shape == (2, 4 * N, 4 * N, 2)
+    np.testing.assert_allclose(np.asarray(h_pl), np.asarray(h_blk),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(np.asarray(h_pl), np.asarray(h_unb),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_pallas_dispatch_gated_off_cpu():
+    """On CPU the blocked influence tier must keep routing to the XLA
+    scan: pallas_available() is False, so use_pallas=True (the default)
+    changes nothing — the flag only engages on a TPU backend."""
+    assert not pallas_hessian.pallas_available()
+    from smartcal_tpu.cal import influence
+
+    N, K, Tchunks, Td = 6, 2, 2, 2
+    B = N * (N - 1) // 2
+    T = Tchunks * Td
+    rng = np.random.default_rng(2)
+    R = jnp.asarray(rng.standard_normal((2 * B * T, 2, 2)), jnp.float32)
+    C = jnp.asarray(rng.standard_normal((K, T * B, 4, 2)), jnp.float32)
+    J = jnp.asarray(rng.standard_normal((Tchunks, K, 2 * N, 2, 2)),
+                    jnp.float32)
+    hadd = jnp.zeros((K,), jnp.float32)
+    base = influence.influence_visibilities(R, C, J, hadd, N, Tchunks,
+                                            block_baselines=8,
+                                            use_pallas=False)
+    flag = influence.influence_visibilities(R, C, J, hadd, N, Tchunks,
+                                            block_baselines=8,
+                                            use_pallas=True)
+    np.testing.assert_allclose(np.asarray(flag.vis),
+                               np.asarray(base.vis), rtol=0, atol=0)
+    np.testing.assert_allclose(np.asarray(flag.llr),
+                               np.asarray(base.llr), rtol=0, atol=0)

@@ -1,0 +1,84 @@
+"""Standalone capture of the pallas-vs-blocked-XLA kernel rooflines
+(ISSUE 17 promotion gate evidence).
+
+Drives ONE batched influence dispatch at the blocked tier (default
+N=256, npix=1024 — both kernel families engage: Hessian at B >= 8128,
+imager at npix >= 512) with cost collection armed, so
+``RadioBackend._record_kernel_costs`` records the ``kernel:<fam>_pallas``
+vs ``kernel:<fam>_blocked_xla`` cost rows and the per-axis footprint
+rides on the influence cost event.  On TPU the pallas rows lower the
+real Mosaic kernels — those are the rooflines that gate promotion; on
+CPU (``--allow_cpu``) the interpreter lowering only certifies plumbing
+and the artifact says so.
+
+The JSONL artifact is a plain RunLog — render it with::
+
+    python tools/obs_report.py results/kernel_roofline_<round>.jsonl
+
+Usage: python tools/capture_kernel_roofline.py \
+           [--out results/kernel_roofline_r16.jsonl] [--stations 256]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "results", "kernel_roofline_r16.jsonl"))
+    ap.add_argument("--stations", type=int, default=256)
+    ap.add_argument("--npix", type=int, default=1024)
+    ap.add_argument("--lanes", type=int, default=2)
+    ap.add_argument("--allow_cpu", action="store_true",
+                    help="deliberate CPU run (interpreter pallas rows — "
+                    "plumbing evidence, NOT rooflines; never promoted "
+                    "as a chip capture)")
+    args = ap.parse_args()
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform not in ("tpu", "axon") and not args.allow_cpu:
+        print(f"platform is {platform!r}, not a TPU — refusing to capture "
+              "(interpreter pallas rows are not rooflines; --allow_cpu "
+              "for plumbing checks)", file=sys.stderr)
+        return 1
+
+    import numpy as np
+
+    from smartcal_tpu import obs
+    from smartcal_tpu.envs.radio import RadioBackend
+    from smartcal_tpu.obs import costs as obs_costs
+
+    backend = RadioBackend(n_stations=args.stations, n_freqs=1,
+                           n_times=2, tdelta=2, admm_iters=1,
+                           lbfgs_iters=2, init_iters=2, npix=args.npix)
+    eps, rhos = [], []
+    for i in range(args.lanes):
+        ep, mdl = backend.new_demixing_episode(jax.random.PRNGKey(i), 2)
+        eps.append(ep)
+        rhos.append(np.asarray(mdl.rho))
+    bep = backend.stack_episodes(eps)
+    rho = np.stack(rhos).astype(np.float32)
+    alpha = np.zeros_like(rho)
+
+    obs_costs.set_enabled(True)
+    tmp = args.out + ".tmp"
+    with obs.recording(tmp):
+        res = backend.calibrate_batched(bep, rho)
+        img = backend.influence_images_batched(bep, res, rho, alpha)
+        jax.block_until_ready(img)
+        n = obs_costs.flush_pending()
+    os.replace(tmp, args.out)
+    print(f"captured {n} cost event(s) on {platform!r} -> {args.out}")
+    print(f"render: python tools/obs_report.py {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,6 +119,18 @@ try_capture "primary_clean"  "python tools/chip_checks.py primary /tmp/bench_pri
 try_capture "extras_tpu"     "python tools/chip_checks.py extras /tmp/bench_extras_${R}.out ${R}" \
   bash -c "exec env BENCH_SKIP_CALIB=1 BENCH_PROBE_ATTEMPTS=1 python bench.py > /tmp/bench_extras_${R}.out 2>/tmp/bench_extras_${R}.err"
 
+# ISSUE 17: the composed-mesh arms (wall + per-axis footprint at
+# N in {62, 256}) and the pallas-vs-blocked-XLA kernel rooflines at the
+# full blocked tier — on TPU the pallas rows lower the real Mosaic
+# kernels, which is the promotion-gate evidence (CPU interpreter rows
+# are plumbing only; these two captures refuse/degrade accordingly)
+try_capture "mesh_compose"   "test -s results/mesh_compose_${R}.json" \
+  bash -c "exec python -c \"import bench; bench.bench_mesh_compose(out_path='results/mesh_compose_${R}.json')\""
+
+try_capture "kernel_roofline" "test -s results/kernel_roofline_${R}.jsonl" \
+  python tools/capture_kernel_roofline.py --stations 256 \
+    --out "results/kernel_roofline_${R}.jsonl"
+
 # optional (runs only after the five core captures): the solve-eval
 # microbench — planes vs one-hot formulation of the inner cost+grad at
 # N=62 on the chip (VERDICT r4 item 6 evidence; two variants only to

@@ -135,7 +135,7 @@ def main():
     import numpy as np
 
     from smartcal_tpu.envs import enet
-    from smartcal_tpu.parallel import make_mesh, make_parallel_sac
+    from smartcal_tpu.parallel import AXIS_DATA, make_mesh, make_parallel_sac
     from smartcal_tpu.rl import replay as rp
     from smartcal_tpu.rl import sac
     from smartcal_tpu.train.enet_sac import make_episode_fn
@@ -193,7 +193,7 @@ def main():
 
         # ---- batched (episode-block; scores are already mean step
         # reward per episode across the env batch)
-        mesh = make_mesh((1,), ("dp",), devices=jax.devices()[:1])
+        mesh = make_mesh((1,), (AXIS_DATA,), devices=jax.devices()[:1])
         init_fn, _, _, run_block = make_parallel_sac(
             env_cfg, agent_cfg, mesh, n_envs=args.n_envs,
             episode_block=(STEPS, n_vec_episodes))

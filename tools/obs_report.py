@@ -727,7 +727,8 @@ def roofline(events, spans):
         d = by_stage.setdefault(e.get("stage", "?"),
                                 {"flops": [], "bytes": [], "errors": 0,
                                  "peak_bytes": [], "shard_bytes": [],
-                                 "shards": [], "dtypes": set()})
+                                 "shards": [], "dtypes": set(),
+                                 "shard_axes": {}, "axis_bytes": {}})
         if e.get("error"):
             d["errors"] += 1
         else:
@@ -738,6 +739,14 @@ def roofline(events, spans):
             if e.get("peak_bytes_per_shard") is not None:
                 d["shard_bytes"].append(float(e["peak_bytes_per_shard"]))
                 d["shards"].append(int(e.get("shards") or 1))
+            # composed-mesh per-axis breakout (registry axis names):
+            # keep the largest signature's per-axis footprint per axis
+            for a, n in (e.get("shard_axes") or {}).items():
+                d["shard_axes"][str(a)] = max(
+                    d["shard_axes"].get(str(a), 0), int(n))
+            for a, b in (e.get("peak_bytes_per_axis") or {}).items():
+                d["axis_bytes"][str(a)] = max(
+                    d["axis_bytes"].get(str(a), 0.0), float(b))
             d["dtypes"].add(str(e.get("compute_dtype") or "f32"))
     stages = {}
     for stage, d in sorted(by_stage.items()):
@@ -760,6 +769,11 @@ def roofline(events, spans):
         if d["shard_bytes"]:
             row["peak_bytes_per_shard_max"] = float(np.max(d["shard_bytes"]))
             row["shards"] = int(max(d["shards"]))
+        if d["shard_axes"]:
+            row["shard_axes"] = dict(sorted(d["shard_axes"].items()))
+            row["peak_bytes_per_axis"] = {
+                a: d["axis_bytes"][a] for a in row["shard_axes"]
+                if a in d["axis_bytes"]}
         leaf = _STAGE_SPAN_ALIASES.get(stage, stage)
         matches = [p for p in spans if p.rsplit("/", 1)[-1] == leaf]
         if matches and "flops_per_call" in row:
@@ -830,9 +844,51 @@ def render_roofline(rl, out):
             f"{(f'{span_s:.2f}' if span_s is not None else '-'):>8s} "
             f"{_fmt_si(row.get('achieved_flops_per_s')):>9s} "
             f"{(f'{100 * frac:.2f}%' if frac is not None else '-'):>7s}")
+        axes = row.get("shard_axes")
+        if axes:
+            # footprint if ONLY that axis were sharded — what each mesh
+            # axis alone buys (obs/costs.py peak_bytes_per_axis)
+            per_ax = row.get("peak_bytes_per_axis") or {}
+            parts = " x ".join(
+                f"{a}={n}"
+                + (f" ({per_ax[a] / 1e6:.1f} MB alone)" if a in per_ax
+                   else "")
+                for a, n in axes.items())
+            out.append(f"    mesh axes: {parts}")
         if row.get("errors"):
             out.append(f"    ({row['errors']} cost-analysis failure(s) "
                        f"recorded for {stage})")
+    _render_kernel_rows(rl["stages"], out)
+
+
+def _render_kernel_rows(stages, out):
+    """Pallas-vs-blocked-XLA comparison for the ``kernel:*`` cost rows
+    (envs/radio._record_kernel_costs): for each kernel family with both
+    variants recorded, quote the traffic and arithmetic-intensity deltas
+    the promotion gate (ISSUE 17) reads before flipping a flag."""
+    fams = {}
+    for stage, row in stages.items():
+        if not stage.startswith("kernel:"):
+            continue
+        name = stage[len("kernel:"):]
+        for suffix, variant in (("_blocked_xla", "xla"),
+                                ("_pallas", "pallas")):
+            if name.endswith(suffix):
+                fams.setdefault(name[:-len(suffix)], {})[variant] = row
+    for fam, pair in sorted(fams.items()):
+        xla, pls = pair.get("xla"), pair.get("pallas")
+        if not (xla and pls):
+            continue
+        bx = xla.get("bytes_per_call")
+        bp = pls.get("bytes_per_call")
+        ax, ap = xla.get("arith_intensity"), pls.get("arith_intensity")
+        ratio = (f"{bx / bp:.2f}x less traffic" if bx and bp and bp > 0
+                 else "-")
+        out.append(
+            f"  kernel {fam}: pallas AI "
+            f"{(f'{ap:.2f}' if ap is not None else '-')} vs XLA "
+            f"{(f'{ax:.2f}' if ax is not None else '-')}, bytes/call "
+            f"{_fmt_si(bp)} vs {_fmt_si(bx)} ({ratio})")
 
 
 def render_training_health(th, out):

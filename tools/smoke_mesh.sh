@@ -1,0 +1,134 @@
+#!/bin/bash
+# Composed-mesh smoke (ISSUE 17): drive the lane x baseline batched
+# route on the 8-virtual-device CPU mesh with the blocked-kernel tier
+# forced on, record it, and assert the whole observability chain —
+# per-axis footprint accounting on the influence cost event, the
+# pallas-vs-blocked-XLA kernel roofline rows, the obs_report rendering
+# of both, and the bench_mesh_compose extra's artifact.  ~2 min on CPU.
+#
+#   bash tools/smoke_mesh.sh [workdir]
+#
+# Exits non-zero on any broken link in the chain.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+REPO="$PWD"
+WORK="${1:-$(mktemp -d /tmp/smoke_mesh.XXXXXX)}"
+RUN="$WORK/mesh_run.jsonl"
+mkdir -p "$WORK"
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+echo "[smoke_mesh] recording composed lane x baseline episode -> $RUN" >&2
+# N=17 -> B=136 = 8*17: factors cleanly as lane=2 x bp=4 on 8 devices.
+# block_baselines=8 / imager_block_r=64 force the blocked tier at this
+# toy scale so the kernel-family rows (hessian + imager, pallas + XLA)
+# are recorded; npix=128 = pallas_imager.TILE_L so the pallas imager
+# row is eligible.
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" python - "$RUN" <<'EOF'
+import sys
+
+import jax
+import numpy as np
+
+from smartcal_tpu import obs
+from smartcal_tpu.envs.radio import RadioBackend
+from smartcal_tpu.obs import costs as obs_costs
+
+assert jax.device_count() == 8, jax.devices()
+backend = RadioBackend(n_stations=17, n_freqs=1, n_times=2, tdelta=2,
+                       admm_iters=1, lbfgs_iters=2, init_iters=2,
+                       npix=128, block_baselines=8, imager_block_r=64)
+eps, rhos = [], []
+for i in range(2):
+    ep, mdl = backend.new_demixing_episode(jax.random.PRNGKey(i), 2)
+    eps.append(ep)
+    rhos.append(np.asarray(mdl.rho))
+bep = backend.stack_episodes(eps)
+rho = np.stack(rhos).astype(np.float32)
+alpha = np.zeros_like(rho)
+obs_costs.set_enabled(True)   # --diag equivalent: arm cost collection
+with obs.recording(sys.argv[1]):
+    res = backend.calibrate_batched(bep, rho, compose=(2, 4))
+    img = backend.influence_images_batched(bep, res, rho, alpha,
+                                           compose=(2, 4))
+    jax.block_until_ready(img)
+    n = obs_costs.flush_pending()
+print("[smoke_mesh] recorded, flushed", n, "deferred cost event(s)")
+EOF
+
+python - "$RUN" <<'EOF'
+import json
+import sys
+
+events = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+costs = [e for e in events if e["event"] == "cost" and not e.get("error")]
+inf = [e for e in costs if e.get("stage") == "influence"]
+assert inf, f"no influence cost event: {sorted({e.get('stage') for e in costs})}"
+row = inf[0]
+assert row.get("shard_axes") == {"lane": 2, "bp": 4}, row.get("shard_axes")
+pba = row.get("peak_bytes_per_axis") or {}
+assert set(pba) == {"lane", "bp"} and all(v > 0 for v in pba.values()), pba
+assert row.get("peak_bytes_per_shard", 0) > 0, row
+kstages = sorted({e["stage"] for e in costs
+                  if str(e.get("stage", "")).startswith("kernel:")})
+for want in ("kernel:hessian_blocked_xla", "kernel:hessian_pallas",
+             "kernel:imager_blocked_xla", "kernel:imager_pallas"):
+    assert want in kstages, f"missing {want}: {kstages}"
+print("[smoke_mesh] cost events OK: per-axis footprint",
+      {k: int(v) for k, v in pba.items()}, "+", len(kstages),
+      "kernel-family row(s)")
+EOF
+
+echo "[smoke_mesh] checking obs_report rendering (json + text)" >&2
+python tools/obs_report.py "$RUN" --json --bootstrap 50 > "$WORK/report.json"
+python - "$WORK/report.json" <<'EOF'
+import json
+import sys
+
+report = json.load(open(sys.argv[1]))
+rl = (report["runs"][0] or {}).get("roofline") or {}
+stages = rl.get("stages") or {}
+assert "influence" in stages, f"roofline lost influence: {list(stages)}"
+row = stages["influence"]
+assert row.get("shard_axes") == {"bp": 4, "lane": 2}, row.get("shard_axes")
+assert (row.get("peak_bytes_per_axis") or {}).get("bp", 0) > 0, row
+kern = [s for s in stages if s.startswith("kernel:")]
+assert len(kern) >= 4, f"kernel rows missing from roofline: {kern}"
+print("[smoke_mesh] report OK:", len(kern), "kernel row(s), axes",
+      row["shard_axes"])
+EOF
+python tools/obs_report.py "$RUN" > "$WORK/report.txt"
+grep -q "mesh axes:" "$WORK/report.txt" || {
+    echo "[smoke_mesh] FAIL: no 'mesh axes:' line in text report" >&2
+    exit 1
+}
+grep -q "kernel hessian: pallas" "$WORK/report.txt" || {
+    echo "[smoke_mesh] FAIL: no pallas-vs-XLA kernel line in text report" >&2
+    exit 1
+}
+
+echo "[smoke_mesh] running bench_mesh_compose extra (N=17 tier)" >&2
+BENCH_MESH_NS=17 PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+    python - "$WORK/mesh_compose.json" <<'EOF'
+import json
+import sys
+
+import bench
+
+out = bench.bench_mesh_compose(out_path=sys.argv[1])
+rows = out["results"]
+assert rows and rows[0]["arms"], out
+arms = {a["arm"]: a for a in rows[0]["arms"]}
+assert set(arms) == {"unsharded", "lane_only", "baseline_only",
+                     "lane_x_baseline"}, sorted(arms)
+lb = arms["lane_x_baseline"]
+assert lb["t_influence_s"] >= 0 and lb["peak_bytes_per_axis"], lb
+assert lb["peak_bytes_per_shard"] < arms["unsharded"]["peak_bytes_fused"]
+print("[smoke_mesh] bench OK: lane_x_baseline",
+      lb["lane_shards"], "x", lb["baseline_shards"], "shards,",
+      "per-shard peak", int(lb["peak_bytes_per_shard"]), "bytes")
+EOF
+
+echo "[smoke_mesh] OK (artifacts in $WORK)"
