@@ -1157,6 +1157,125 @@ def bench_mesh_compose(ns=(62, 256), lanes=2, out_path=None):
     return out
 
 
+def _trace_overhead_measure(duration_s=6.0, rate=40.0, service_s=0.005,
+                            lanes=2, replicas=2):
+    """Armed-vs-disarmed stub-fleet arms for bench_trace_overhead.
+
+    Same router, same offered load, twice: DISARMED (no RunLog anywhere,
+    flight recorder off — every obs call takes the no-op fast path, no
+    trace carriers are minted) then ARMED (router + per-replica RunLog
+    streams, trace propagation across the IPC frames, flight-recorder
+    ring in every worker).  The armed arm also scores its own merged
+    trace completeness, so the measurement doubles as a stitching check.
+    """
+    import contextlib
+    import shutil
+    import tempfile
+
+    from smartcal_tpu import obs
+    from smartcal_tpu.obs import collect
+    from smartcal_tpu.serve import loadgen
+    from smartcal_tpu.serve.fleet import FleetRouter, sleep_worker_spec
+
+    arms = {}
+    for arm in ("disarmed", "armed"):
+        workdir = tempfile.mkdtemp(prefix=f"trace_ovh_{arm}_")
+        spec = sleep_worker_spec(lanes=lanes, service_s=service_s)
+        if arm == "disarmed":
+            spec["flight_recorder"] = False
+        cm = (obs.recording(os.path.join(workdir, "router.jsonl"),
+                            run_id="router")
+              if arm == "armed" else contextlib.nullcontext())
+        with cm:
+            router = FleetRouter(
+                spec, replicas=replicas, poll_s=0.05, seed=0,
+                metrics_dir=(workdir if arm == "armed" else None))
+            try:
+                router.start(warm_timeout_s=120.0)
+                gen = loadgen.OpenLoopLoadGen(
+                    router, [(1, None)] * 4, rate=rate,
+                    duration_s=duration_s, seed=0)
+                summary = gen.run()
+            finally:
+                router.stop(timeout=20.0)
+        rec = {"jobs_s": summary.get("achieved_jobs_s"),
+               "p99_s": summary.get("latency_p99_s"),
+               "p50_s": summary.get("latency_p50_s"),
+               "completed": summary.get("completed"),
+               "submitted": summary.get("submitted"),
+               "shed": summary.get("shed")}
+        if arm == "armed":
+            merged = collect.merge_directory(workdir)
+            rec["events_logged"] = len(merged)
+            rec["trace_completeness"] = collect.completeness(
+                collect.request_paths(merged))
+        arms[arm] = rec
+        shutil.rmtree(workdir, ignore_errors=True)
+    return arms
+
+
+def bench_trace_overhead(duration_s=None, out_path=None):
+    """Distributed-tracing tax on the serving fleet (ISSUE 18 satellite):
+    stub-fleet jobs/s + p99 with the full tracing stack ARMED (RunLog
+    streams in every process, trace carriers across IPC, flight
+    recorder) vs DISARMED (obs no-op fast path).  The claim under test
+    is that the tax is within run-to-run noise — the armed fleet keeps
+    the disarmed fleet's throughput and tail.
+
+    Runs in a child process pinned to JAX_PLATFORMS=cpu: the stub fleet
+    never needs a chip, and the workers must not race the parent for
+    one.  ``BENCH_TRACE_OVH_DURATION_S`` overrides the per-arm load
+    window; the payload also lands in ``results/trace_overhead_r17.json``
+    (or ``out_path``).
+    """
+    import tempfile
+
+    if duration_s is None:
+        try:
+            duration_s = float(os.environ.get("BENCH_TRACE_OVH_DURATION_S",
+                                              "6"))
+        except ValueError:
+            duration_s = 6.0
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as fh:
+        tmp = fh.name
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    code = ("import json, bench\n"
+            f"arms = bench._trace_overhead_measure({float(duration_s)!r})\n"
+            f"json.dump(arms, open({tmp!r}, 'w'))\n")
+    subprocess.run([sys.executable, "-c", code], check=True, env=env,
+                   cwd=os.path.dirname(os.path.abspath(__file__)))
+    with open(tmp) as fh:
+        arms = json.load(fh)
+    os.unlink(tmp)
+    dis, arm = arms["disarmed"], arms["armed"]
+    delta = None
+    if dis.get("jobs_s") and arm.get("jobs_s"):
+        delta = round((arm["jobs_s"] - dis["jobs_s"]) / dis["jobs_s"], 4)
+    out = {
+        "metric": "trace_overhead",
+        "value": delta,
+        "unit": "relative jobs/s delta, armed vs disarmed (0 = free)",
+        "vs_baseline": None,
+        "platform": "cpu (stub fleet, child process)",
+        "duration_s_per_arm": duration_s,
+        "results": arms,
+        "note": "open-loop stub fleet (2 replicas x 2 lanes, 5 ms "
+                "service): both arms are offered the same load, so the "
+                "tracing tax shows up as lost throughput or a fatter "
+                "p99, not as a different workload.",
+    }
+    if out_path is None:
+        res_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "results")
+        if os.path.isdir(res_dir):
+            out_path = os.path.join(res_dir, "trace_overhead_r17.json")
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(out, fh, indent=1)
+    return out
+
+
 def bench_actor_scaling(arms=None, episodes=16, out_path=None,
                         replay_shards=4):
     """Aggregate env-steps/s of the supervised async actor-learner fleet
@@ -1444,7 +1563,8 @@ def _measured_main():
                    "calib_batched_env_steps_per_sec"),
                   (bench_actor_scaling, "actor_scaling"),
                   (bench_nscale, "nscale"),
-                  (bench_mesh_compose, "mesh_compose")]
+                  (bench_mesh_compose, "mesh_compose"),
+                  (bench_trace_overhead, "trace_overhead")]
         if os.environ.get("BENCH_SKIP_CALIB"):
             out["extra"].append({"metric": "calib_episode_wall_clock",
                                  "skipped": "BENCH_SKIP_CALIB=1"})

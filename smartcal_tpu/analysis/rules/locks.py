@@ -96,13 +96,48 @@ SHARED_FIELD_SPECS = [
     {
         "path": "smartcal_tpu/serve/fleet.py",
         "class": "_Replica",
-        "fields": ["_pending", "_gauges"],
+        "fields": ["_pending", "_gauges", "_frames"],
         "locks": ["_lock"],
         "why": "in-flight job table written by dispatching client "
                "threads and the pump thread (result/shed/crash "
                "reclaim) — a torn read double-completes or leaks a "
                "job; gauges written by the pump, read by the ranking "
-               "dispatcher",
+               "dispatcher; the received-frame ring (parent-side black "
+               "box) written by the pump and dumped by the supervision "
+               "thread on replica death",
+    },
+    {
+        "path": "smartcal_tpu/obs/flightrec.py",
+        "class": "FlightRecorder",
+        "fields": ["_ring", "_dir", "_flushes", "_n_flushes",
+                   "_shed_times"],
+        "locks": ["_lock"],
+        "why": "the crash ring is teed from every thread that logs "
+               "(RunLog._emit) while flush() snapshots it from "
+               "supervisor/watchdog threads and arm/disarm swap it "
+               "from the worker main — an unlocked write can dump a "
+               "torn ring or race the rate-limit table",
+    },
+    {
+        "path": "smartcal_tpu/obs/slo.py",
+        "class": "SloBurnDetector",
+        "fields": ["_obs", "_state"],
+        "locks": ["_lock"],
+        "why": "burn-rate windows fed by every client thread "
+               "(observe on each result/shed) while the router's "
+               "supervision thread prunes + evaluates them and "
+               "snapshot() reads from anywhere — racing the deque "
+               "prune corrupts the percentile windows",
+    },
+    {
+        "path": "smartcal_tpu/obs/collect.py",
+        "class": "TimelineMerger",
+        "fields": ["_streams", "_offsets", "_n_corrupt"],
+        "locks": ["_lock"],
+        "why": "merge state grown by live-tailer reader threads "
+               "(add_stream) while a reporter thread calls "
+               "merge()/stats() — an unlocked extend tears the "
+               "per-stream event lists mid-sort",
     },
 ]
 

@@ -47,6 +47,7 @@ TOOLS_STDOUT_ALLOWLIST = frozenset({
     "summarize_demix_curves.py",
     "sweep_calib.py",
     "sweep_demix.py",
+    "trace_export.py",
     "sweep_enet.py",
 })
 

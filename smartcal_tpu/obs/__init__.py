@@ -17,17 +17,22 @@ with ``tools/obs_report.py``.  The package imports neither jax nor numpy
 never initialize (or wedge) an accelerator backend.
 """
 
+from . import collect, flightrec, slo, tracectx            # noqa: F401
 from .console import echo, emit_json                       # noqa: F401
 from .costs import (device_peak, log_roofline_peak,        # noqa: F401
                     record_stage_cost, stage_cost)
 from .diagnostics import (UpdateDiag, diag_steps,          # noqa: F401
                           diag_to_host, make_diag, zero_diag)
+from .flightrec import (arm_flight_recorder,               # noqa: F401
+                        flight_recorder_stats, flush_flight_recorder,
+                        note_shed)
 from .registry import (counter_add, counters_snapshot,     # noqa: F401
                        flush_counters, gauge_set, install_cache_listener,
                        install_compile_listener, log_memory_gauges,
                        reset_counters)
 from .runlog import (SCHEMA_VERSION, RunLog, activate,     # noqa: F401
                      active, deactivate, recording, sanitize)
+from .slo import SloBurnDetector                           # noqa: F401
 from .spans import span                                    # noqa: F401
 from .watchdog import Watchdog, WatchdogConfig             # noqa: F401
 

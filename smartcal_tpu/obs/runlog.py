@@ -44,6 +44,8 @@ import threading
 import time
 from typing import Iterator, Optional
 
+from . import flightrec, tracectx
+
 # Schema history (the header's ``schema`` field; readers should accept
 # >= their known version — every bump so far is purely additive):
 #
@@ -55,7 +57,14 @@ from typing import Iterator, Optional
 #     watchdog with ring-buffer context, obs/watchdog.py), ``cost``
 #     (per-stage XLA flops/bytes, obs/costs.py) and ``roofline_peak``
 #     (the fraction-of-peak denominator).
-SCHEMA_VERSION = 2
+# 3 — fleet-wide tracing: any event may carry optional ``trace`` /
+#     ``span`` / ``parent`` W3C-style ids (obs/tracectx.py; attached
+#     automatically when a trace is adopted); new events
+#     ``clock_offset`` (per-peer skew estimate from IPC envelope
+#     send/recv timestamps), ``slo_burn`` (windowed burn-rate detector,
+#     obs/slo.py) and ``blackbox_flush`` (flight-recorder dump header,
+#     obs/flightrec.py).
+SCHEMA_VERSION = 3
 
 
 def _gen_run_id() -> str:
@@ -167,11 +176,15 @@ class RunLog:
         if self._fh is None:
             return
         rec = {"t": round(time.time(), 3), "event": event}
+        tf = tracectx.current_fields()
+        if tf:
+            rec.update(tf)       # explicit fields below may override
         rec.update(fields)
         self._emit(rec)
 
     def _emit(self, rec, force_flush: bool = False):
         line = json.dumps(sanitize(rec), allow_nan=False) + "\n"
+        flightrec.record_line(line)   # flight-recorder tee (no-op unarmed)
         with self._lock:
             if self._fh is None:
                 return

@@ -21,6 +21,7 @@ import sys
 import threading
 import time
 
+from . import tracectx
 from .runlog import RunLog, active
 
 _tls = threading.local()
@@ -69,7 +70,7 @@ _NULL_SPAN = _NullSpan()
 
 
 class Span:
-    __slots__ = ("_rl", "name", "tags", "path", "_t0", "_ann")
+    __slots__ = ("_rl", "name", "tags", "path", "_t0", "_ann", "_ids")
 
     def __init__(self, rl: "RunLog", name: str,
                  tags: dict) -> None:
@@ -79,6 +80,7 @@ class Span:
         self.path = name
         self._t0 = 0.0
         self._ann = None
+        self._ids = None
 
     def tag(self, **tags: object) -> "Span":
         """Attach/override tags after entry (e.g. a routing decision made
@@ -90,6 +92,9 @@ class Span:
         st = _stack()
         st.append(self.name)
         self.path = "/".join(st)
+        # child span id under the adopted trace (None when no trace —
+        # span events then carry no trace fields, exactly as before)
+        self._ids = tracectx.push_span()
         ta = _trace_annotation()
         if ta is not None:
             try:
@@ -115,9 +120,15 @@ class Span:
             # a failed stage STILL records (the chip-tunnel probes failed
             # 87/87 with no structured trace of the error — never again)
             rec["error"] = repr(ev) if ev is not None else et.__name__
+        if self._ids is not None and self._ids[1] is not None:
+            rec["parent"] = self._ids[1]
+        # log BEFORE popping the trace stack: the auto-attached ``span``
+        # field must be this span's own id, not its parent's
         self._rl.log("span", name=self.name, path=self.path,
                      dur_s=round(dur, 6),
                      thread=threading.current_thread().name, **rec)
+        if self._ids is not None:
+            tracectx.pop_span(self._ids[0])
         return False
 
 

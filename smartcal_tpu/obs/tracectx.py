@@ -1,0 +1,172 @@
+"""W3C-style trace context: the process-crossing half of tracing.
+
+PR 3 spans time host stages *within* one process; the fleet (PR 12
+process actors, PR 16 serving replicas) crosses process boundaries, so
+a request's spans land in different JSONL streams with nothing joining
+them.  This module owns the (trace_id, span_id, parent_span_id) lineage
+that joins them:
+
+* a **carrier** is the serializable form — ``{"trace": <32-hex>,
+  "span": <16-hex>}`` — small enough to ride in a framed-IPC envelope
+  (:mod:`smartcal_tpu.runtime.ipc`) or a Job payload dict;
+* an **envelope** is a carrier plus the sender's wall-clock ``t``, the
+  raw material of the clock-offset handshake that lets the collector
+  (:mod:`smartcal_tpu.obs.collect`) merge per-process timelines
+  skew-corrected;
+* the thread-local **active trace** is what :func:`current_fields`
+  reads; :meth:`RunLog.log <smartcal_tpu.obs.runlog.RunLog.log>`
+  auto-attaches it to every event, and :class:`~smartcal_tpu.obs.spans.
+  Span` allocates child span ids from it, so instrumented code needs no
+  changes to become trace-aware.
+
+Dependency-free on purpose (stdlib only, no runlog/spans import): both
+runlog and spans import *this* module, never the reverse.
+
+STRICT NO-OP CONTRACT (mirrors spans): with no adopted trace,
+:func:`current_fields` returns the shared empty dict and
+:func:`push_span` returns ``None`` — instrumentation costs one
+thread-local read.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Iterator, Optional, Tuple
+
+_tls = threading.local()
+
+_EMPTY: Dict[str, object] = {}
+
+
+def new_trace_id() -> str:
+    """A fresh 16-byte (32 hex char) trace id, W3C traceparent sized."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """A fresh 8-byte (16 hex char) span id."""
+    return os.urandom(8).hex()
+
+
+def _trace() -> Optional[str]:
+    return getattr(_tls, "trace", None)
+
+
+def _stack() -> list:
+    st = getattr(_tls, "spans", None)
+    if st is None:
+        st = _tls.spans = []
+    return st
+
+
+def new_root_carrier() -> Dict[str, str]:
+    """Mint a root carrier for a new request (no thread state touched):
+    the router stamps one onto each Job at admission."""
+    return {"trace": new_trace_id(), "span": new_span_id()}
+
+
+def current_fields() -> Dict[str, object]:
+    """``{"trace": ..., "span": ...}`` of the adopted trace, or the
+    shared empty dict.  RunLog.log merges this into every record."""
+    tid = _trace()
+    if tid is None:
+        return _EMPTY
+    st = _stack()
+    if st:
+        return {"trace": tid, "span": st[-1]}
+    return {"trace": tid}
+
+
+def carrier() -> Optional[Dict[str, str]]:
+    """The adopted trace as a serializable carrier, or None."""
+    tid = _trace()
+    if tid is None:
+        return None
+    st = _stack()
+    out = {"trace": tid}
+    if st:
+        out["span"] = st[-1]
+    return out
+
+
+def envelope() -> Optional[Dict[str, object]]:
+    """Carrier + sender wall time ``t`` — what rides an IPC frame.  The
+    receiver's recv time minus ``t`` (minimized over frames) estimates
+    the per-peer clock offset."""
+    car = carrier()
+    if car is None:
+        return {"t": round(time.time(), 6)}
+    out: Dict[str, object] = dict(car)
+    out["t"] = round(time.time(), 6)
+    return out
+
+
+def fields_of(car: Optional[Dict[str, str]]) -> Dict[str, object]:
+    """Event fields naming the carrier's own span (no new ids): for
+    events that ARE the carrier's point of origin (``fleet_dispatch``)."""
+    if not car or "trace" not in car:
+        return {}
+    out: Dict[str, object] = {"trace": car["trace"]}
+    if car.get("span"):
+        out["span"] = car["span"]
+    return out
+
+
+def child_fields(car: Optional[Dict[str, str]]) -> Dict[str, object]:
+    """Event fields for a NEW child span of the carrier: a fresh span id
+    with ``parent`` pointing at the carrier's span.  For point events
+    that mark a hop (``serve_admit``, ``serve_request``)."""
+    if not car or "trace" not in car:
+        return {}
+    out: Dict[str, object] = {"trace": car["trace"],
+                              "span": new_span_id()}
+    if car.get("span"):
+        out["parent"] = car["span"]
+    return out
+
+
+def push_span() -> Optional[Tuple[str, Optional[str]]]:
+    """Allocate a child span id under the adopted trace and make it
+    current.  Returns ``(span_id, parent_span_id)``, or None when no
+    trace is adopted (the no-op fast path).  Span.__enter__ calls this;
+    Span.__exit__ must pair it with :func:`pop_span`."""
+    tid = _trace()
+    if tid is None:
+        return None
+    st = _stack()
+    parent = st[-1] if st else None
+    sid = new_span_id()
+    st.append(sid)
+    return sid, parent
+
+
+def pop_span(span_id: str) -> None:
+    """Pop ``span_id`` off the current thread's span stack (tolerant of
+    a mismatched top, same as the spans name stack)."""
+    st = _stack()
+    if st and st[-1] == span_id:
+        st.pop()
+    elif span_id in st:
+        st.remove(span_id)
+
+
+@contextlib.contextmanager
+def use_trace(car: Optional[Dict[str, str]]) -> Iterator[None]:
+    """Adopt a remote carrier for the current thread: events logged and
+    spans opened inside become part of the caller's trace.  ``None`` (or
+    a carrier-less dict) is a no-op, so call sites need no guard."""
+    if not car or "trace" not in car:
+        yield
+        return
+    prev_trace = getattr(_tls, "trace", None)
+    prev_spans = getattr(_tls, "spans", None)
+    _tls.trace = car["trace"]
+    _tls.spans = [car["span"]] if car.get("span") else []
+    try:
+        yield
+    finally:
+        _tls.trace = prev_trace
+        _tls.spans = prev_spans

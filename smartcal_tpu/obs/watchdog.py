@@ -159,3 +159,5 @@ class Watchdog:
             rl.log("watchdog_trip", reason=reason, step=step,
                    observations=self._seen, ring=list(self._ring), **tags)
             rl.flush()
+        from . import flightrec
+        flightrec.flush("watchdog_trip", {"reason": reason, "step": step})

@@ -15,9 +15,12 @@ runs are reproducible and a post-recovery retry does NOT re-fire:
   ``kill_at`` (the supervisor must detect the death and restart; the
   replacement resumes AFTER the poisoned iteration, so a deterministic
   kill cannot crash-loop the fleet).
-* ``delay_stage``/``delay_at``/``delay_s`` — sleep ``delay_s`` seconds
-  inside the named stage at index ``delay_at`` (hung-actor / slow-
-  dispatch detection).
+* ``delay_stage``/``delay_at``/``delay_s``/``delay_span`` — sleep
+  ``delay_s`` seconds inside the named stage at every index in
+  ``[delay_at, delay_at + delay_span)`` (default span 1, the original
+  single-shot).  A span > 1 makes the slowdown SUSTAINED — what the
+  SLO burn-rate detector needs to see before it may fire (a one-batch
+  blip must not trip a multi-window alarm).
 
 Each firing is recorded once as a ``fault_injected`` RunLog event (when
 a run is recording).  With no plan installed every hook is one ``None``
@@ -47,6 +50,7 @@ class FaultPlan:
     delay_stage: Optional[str] = None
     delay_at: Optional[int] = None
     delay_s: float = 0.0
+    delay_span: int = 1
 
 
 _plan: Optional[FaultPlan] = None
@@ -133,10 +137,14 @@ def should_kill_actor(actor_id: int, iteration: int) -> bool:
 
 
 def maybe_delay(stage: str, index: int) -> float:
-    """Sleep the planned delay at (stage, index); returns seconds slept."""
+    """Sleep the planned delay when (stage, index) falls inside the
+    plan's delay window; returns seconds slept.  Each firing index
+    records its own ``fault_injected`` event."""
     p = _plan
-    if (p is None or p.delay_stage != stage or p.delay_at != index
+    if (p is None or p.delay_stage != stage or p.delay_at is None
             or p.delay_s <= 0.0):
+        return 0.0
+    if not p.delay_at <= index < p.delay_at + max(1, int(p.delay_span)):
         return 0.0
     _record("delay", stage=stage, index=index, delay_s=p.delay_s)
     time.sleep(p.delay_s)

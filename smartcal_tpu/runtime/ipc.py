@@ -30,57 +30,119 @@ the learner's business.
 from __future__ import annotations
 
 import importlib
+import json
 import os
 import pickle
 import struct
 import zlib
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from .atomic import CorruptStateError
 
 MAGIC = b"SCF1"
+TRACED_MAGIC = b"SCT1"
 _HEADER = struct.Struct("!4sII")
+# traced-frame prelude: TRACED_MAGIC | trace_len(4, BE) | trace_json |
+# <embedded standard SCF1 frame>.  The trace envelope rides OUTSIDE the
+# CRC'd pickle body on purpose: when a worker dies mid-send and the
+# body arrives corrupt, the intact prelude still names the trace the
+# frame belonged to, so the drop is reported against its request
+# instead of vanishing from the merged timeline.
+_THEADER = struct.Struct("!4sI")
+_MAX_TRACE_BYTES = 4096
 
 
 class CorruptPayloadError(CorruptStateError):
     """An IPC frame failed validation (bad magic / length / CRC /
     unpicklable body) — the mid-send-death signature of a worker
-    process, surfaced as droppable corruption instead of a crash."""
+    process, surfaced as droppable corruption instead of a crash.
+
+    ``trace`` carries the traced-frame envelope (dict) when the broken
+    frame's prelude survived, else None."""
+
+    trace: Optional[Dict[str, Any]] = None
 
 
-def frame_payload(obj: Any) -> bytes:
-    """Serialize ``obj`` into one self-validating frame."""
+def frame_payload(obj: Any,
+                  trace: Optional[Dict[str, Any]] = None) -> bytes:
+    """Serialize ``obj`` into one self-validating frame, optionally
+    prefixed with a trace envelope (see module docstring)."""
     body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    return _HEADER.pack(MAGIC, len(body), zlib.crc32(body)) + body
+    frame = _HEADER.pack(MAGIC, len(body), zlib.crc32(body)) + body
+    if trace is None:
+        return frame
+    tbody = json.dumps(trace).encode("utf-8")
+    if len(tbody) > _MAX_TRACE_BYTES:   # never let tags starve payloads
+        tbody = json.dumps({k: trace[k] for k in ("trace", "span", "t")
+                            if k in trace}).encode("utf-8")
+    return _THEADER.pack(TRACED_MAGIC, len(tbody)) + tbody + frame
+
+
+def _split_traced(data: bytes) -> Tuple[bytes, Optional[Dict[str, Any]]]:
+    """Strip a traced-frame prelude, returning (inner_frame, trace).
+    A mangled prelude degrades to (data, None) — the inner validation
+    then reports the corruption."""
+    if len(data) < _THEADER.size or data[:4] != TRACED_MAGIC:
+        return data, None
+    _, tlen = _THEADER.unpack_from(data)
+    end = _THEADER.size + tlen
+    if tlen > _MAX_TRACE_BYTES or len(data) < end:
+        return data[_THEADER.size:], None
+    try:
+        trace = json.loads(data[_THEADER.size:end].decode("utf-8"))
+    except (UnicodeDecodeError, ValueError):
+        trace = None
+    if not isinstance(trace, dict):
+        trace = None
+    return data[end:], trace
+
+
+def unframe_payload_traced(
+        data: bytes) -> Tuple[Any, Optional[Dict[str, Any]]]:
+    """Validate + deserialize one frame, returning ``(obj, trace)``
+    (trace None for plain frames).  On any integrity failure raises
+    :class:`CorruptPayloadError` with ``.trace`` set from the prelude
+    when it survived."""
+    inner, trace = _split_traced(data)
+    if len(inner) < _HEADER.size:
+        raise _corrupt(
+            f"IPC frame truncated: {len(inner)} bytes < "
+            f"{_HEADER.size}-byte header", trace)
+    magic, length, crc = _HEADER.unpack_from(inner)
+    body = inner[_HEADER.size:]
+    if magic != MAGIC:
+        raise _corrupt(f"IPC frame bad magic {magic!r}", trace)
+    if len(body) != length:
+        raise _corrupt(
+            f"IPC frame length mismatch: header says {length}, "
+            f"got {len(body)} payload bytes (mid-send death?)", trace)
+    if zlib.crc32(body) != crc:
+        raise _corrupt("IPC frame CRC mismatch", trace)
+    try:
+        return pickle.loads(body), trace
+    except Exception as e:
+        raise _corrupt(
+            f"IPC frame body unpicklable ({e!r})", trace) from e
+
+
+def _corrupt(msg: str,
+             trace: Optional[Dict[str, Any]]) -> CorruptPayloadError:
+    err = CorruptPayloadError(msg)
+    err.trace = trace
+    return err
 
 
 def unframe_payload(data: bytes) -> Any:
-    """Validate + deserialize one frame; raises
-    :class:`CorruptPayloadError` on any integrity failure."""
-    if len(data) < _HEADER.size:
-        raise CorruptPayloadError(
-            f"IPC frame truncated: {len(data)} bytes < "
-            f"{_HEADER.size}-byte header")
-    magic, length, crc = _HEADER.unpack_from(data)
-    body = data[_HEADER.size:]
-    if magic != MAGIC:
-        raise CorruptPayloadError(f"IPC frame bad magic {magic!r}")
-    if len(body) != length:
-        raise CorruptPayloadError(
-            f"IPC frame length mismatch: header says {length}, "
-            f"got {len(body)} payload bytes (mid-send death?)")
-    if zlib.crc32(body) != crc:
-        raise CorruptPayloadError("IPC frame CRC mismatch")
-    try:
-        return pickle.loads(body)
-    except Exception as e:
-        raise CorruptPayloadError(
-            f"IPC frame body unpicklable ({e!r})") from e
+    """Validate + deserialize one frame (trace prelude, if any,
+    discarded); raises :class:`CorruptPayloadError` on any integrity
+    failure."""
+    return unframe_payload_traced(data)[0]
 
 
-def send_msg(conn, obj: Any) -> None:
+def send_msg(conn, obj: Any,
+             trace: Optional[Dict[str, Any]] = None) -> None:
     """Frame + send one message on a Connection."""
-    conn.send_bytes(frame_payload(obj))
+    conn.send_bytes(frame_payload(obj, trace=trace))
 
 
 def send_blob(conn, blob: bytes) -> None:
@@ -92,6 +154,13 @@ def recv_msg(conn) -> Any:
     """Receive + validate one message.  Raises ``EOFError``/``OSError``
     when the peer is gone, :class:`CorruptPayloadError` on a bad frame."""
     return unframe_payload(conn.recv_bytes())
+
+
+def recv_msg_traced(conn) -> Tuple[Any, Optional[Dict[str, Any]]]:
+    """Receive + validate one message, returning ``(obj, trace)`` —
+    the trace-aware pump's receive path (fleet replica / actor pumps
+    use the envelope's ``t`` for the clock-offset handshake)."""
+    return unframe_payload_traced(conn.recv_bytes())
 
 
 def resolve_factory(spec: str) -> Callable:
@@ -132,6 +201,9 @@ def worker_main(conn, actor_id: int, start_iteration: int,
     if platform:
         os.environ["JAX_PLATFORMS"] = platform
 
+    import time
+
+    from smartcal_tpu.obs import tracectx
     from smartcal_tpu.parallel import multihost
     from smartcal_tpu.runtime import faults as rt_faults
 
@@ -154,7 +226,14 @@ def worker_main(conn, actor_id: int, start_iteration: int,
     weights: Any = None
     version = 0
     have_weights = False
+    ctl_trace: Optional[Dict[str, Any]] = None
     test_corrupt = _test_corrupt_plan()
+
+    def beat_env() -> Dict[str, Any]:
+        # beats always carry the send wall time: the parent pump's
+        # recv-minus-send minimum is the clock-offset handshake
+        return {"t": round(time.time(), 6)}
+
     try:
         while True:
             # drain the control inbox; the newest weights frame wins.
@@ -162,7 +241,7 @@ def worker_main(conn, actor_id: int, start_iteration: int,
             # initial rollout never runs against nothing.
             while conn.poll(0 if have_weights else 0.2):
                 try:
-                    msg = recv_msg(conn)
+                    msg, msg_trace = recv_msg_traced(conn)
                 except CorruptPayloadError:
                     continue            # parent->worker corruption: skip
                 if msg[0] == "stop":
@@ -170,14 +249,20 @@ def worker_main(conn, actor_id: int, start_iteration: int,
                 if msg[0] == "weights":
                     version, weights = int(msg[1]), msg[2]
                     have_weights = True
+                    if msg_trace and "trace" in msg_trace:
+                        ctl_trace = msg_trace
             if not have_weights:
-                send_msg(conn, ("beat", iteration))
+                send_msg(conn, ("beat", iteration), trace=beat_env())
                 continue
-            send_msg(conn, ("beat", iteration))
+            send_msg(conn, ("beat", iteration), trace=beat_env())
             try:
-                out = work_fn(actor_id, iteration, weights)
+                # rollout spans/events become children of the learner's
+                # publishing span when the weights frame carried one
+                with tracectx.use_trace(ctl_trace):
+                    out = work_fn(actor_id, iteration, weights)
             except BaseException as e:  # noqa: BLE001 — death IS the signal
-                send_msg(conn, ("error", iteration, repr(e)))
+                send_msg(conn, ("error", iteration, repr(e)),
+                         trace=beat_env())
                 return
             if test_corrupt is not None and iteration == test_corrupt:
                 # test hook (SMARTCAL_IPC_TEST_CORRUPT=<iteration>):
@@ -185,11 +270,13 @@ def worker_main(conn, actor_id: int, start_iteration: int,
                 # corrupted frame instead of the result, then die, so
                 # the drop-and-log path is exercisable end to end
                 blob = bytearray(frame_payload(
-                    ("result", iteration, version, out)))
+                    ("result", iteration, version, out),
+                    trace=beat_env()))
                 blob[-1] ^= 0xFF
                 send_blob(conn, bytes(blob))
                 return
-            send_msg(conn, ("result", iteration, version, out))
+            send_msg(conn, ("result", iteration, version, out),
+                     trace=beat_env())
             iteration += 1
     except (EOFError, OSError, BrokenPipeError):
         return                          # parent gone: nothing to report

@@ -668,6 +668,15 @@ class Fleet:
             if delay is None:
                 ev = {"event": "actor_failed", "actor": slot,
                       "reason": reason, "restarts": n}
+                # a slot past max_restarts is this fleet's circuit
+                # opening: dump the parent's flight-recorder ring (the
+                # last events before the fleet gave up on the slot)
+                try:
+                    from smartcal_tpu import obs
+                    obs.flush_flight_recorder(
+                        "circuit_open", {"actor": slot, "reason": reason})
+                except Exception:
+                    pass
             else:
                 ev = {"event": "actor_down", "actor": slot,
                       "reason": reason, "iteration": a.iteration,

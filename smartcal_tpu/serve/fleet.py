@@ -44,7 +44,9 @@ runs inside the worker process.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import json
 import os
 import queue
 import threading
@@ -52,6 +54,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from smartcal_tpu import obs
+from smartcal_tpu.obs import tracectx
+from smartcal_tpu.runtime import faults as rt_faults
 from smartcal_tpu.runtime import ipc
 from smartcal_tpu.runtime.backoff import BackoffPolicy
 from smartcal_tpu.runtime.supervisor import RestartTracker, _to_host
@@ -59,9 +63,11 @@ from smartcal_tpu.runtime.supervisor import RestartTracker, _to_host
 from .router import Job, JobResult, ShedError
 
 # Job fields that cross the process boundary (future/warm stay local:
-# the future is the parent-side handle, and warmup probes never route)
+# the future is the parent-side handle, and warmup probes never route).
+# ``trace`` is the W3C carrier minted at fleet admission — it crosses
+# so replica-side events join the request's span tree.
 _JOB_FIELDS = ("k", "rho", "rho_spatial", "maxiter", "deadline_s",
-               "obs_vec", "job_id", "t_submit", "requeues")
+               "obs_vec", "job_id", "t_submit", "requeues", "trace")
 
 
 def _event(name: str, **fields) -> None:
@@ -169,17 +175,32 @@ class SleepServer:
                 job = self._q.get(timeout=0.05)
             except queue.Empty:
                 continue
-            time.sleep(self.service_s)
             with self._slock:
                 self._served += 1
                 n = self._served
+            # minimal serve instrumentation mirroring CalibServer: the
+            # stub fleet must exercise the SAME trace-stitching path
+            # (serve_request + a batch-tagged stage span) so loadgen
+            # demonstrations don't need a real solver; the fault hook
+            # makes one replica's injected slowdown visible here too
+            t0 = time.monotonic()
+            with obs.span("serve_solve", batch=n):
+                rt_faults.maybe_delay("serve_batch", n)
+                time.sleep(self.service_s)
+            service = time.monotonic() - t0
             total = time.monotonic() - job.t_submit
+            _event("serve_request", job_id=job.job_id, lane=0,
+                   batch=n, k=job.k,
+                   queue_wait_s=round(max(0.0, total - service), 6),
+                   service_s=round(service, 6),
+                   total_s=round(total, 6),
+                   **tracectx.child_fields(job.trace))
             job.future.set_result(JobResult(
                 job_id=job.job_id, lane=0, batch_id=n,
                 sigma_res=float(job.k), sigma_data_img=0.0,
                 sigma_res_img=0.0, img_std=0.0, degraded=False,
-                queue_wait_s=round(max(0.0, total - self.service_s), 6),
-                service_s=self.service_s, total_s=round(total, 6),
+                queue_wait_s=round(max(0.0, total - service), 6),
+                service_s=round(service, 6), total_s=round(total, 6),
                 deadline_miss=(job.deadline_s is not None
                                and total > job.deadline_s)))
 
@@ -241,33 +262,44 @@ def _server_gauges(server) -> dict:
     }
 
 
-def _submit_remote(server, payload: dict, send) -> None:
+def _submit_remote(server, payload: dict, send,
+                   replica_id: int = 0) -> None:
     """Rebuild the parent's Job (same job_id, same t_submit — monotonic
     clocks are system-wide on Linux, so queue-wait/deadline accounting
     spans the process boundary) and route its eventual outcome back as
     a result / job_shed / job_failed frame."""
     jid = payload["job_id"]
     job = Job(episode=payload["episode"],
-              **{f: payload[f] for f in _JOB_FIELDS})
+              **{f: payload[f] for f in _JOB_FIELDS
+                 if f in payload})
+    # the admission hop gets its own span: serve_admit's wall t minus
+    # fleet_dispatch's wall t (offset-corrected by the collector) is
+    # the request's IPC + outbox time; the request's later events
+    # chain under the admit span, not the remote root
+    tf = tracectx.child_fields(job.trace)
+    if tf:
+        _event("serve_admit", job_id=jid, replica=replica_id,
+               requeues=job.requeues, **tf)
+        job.trace = {"trace": str(tf["trace"]), "span": str(tf["span"])}
     try:
         fut = server.submit(job)
     except ShedError as e:
-        send(("job_shed", jid, e.reason))
+        send(("job_shed", jid, e.reason), trace=job.trace)
         return
     except Exception as e:
-        send(("job_failed", jid, repr(e)))
+        send(("job_failed", jid, repr(e)), trace=job.trace)
         return
 
     def _done(f, jid=jid):
         try:
             r = f.result()
         except ShedError as e:
-            send(("job_shed", jid, e.reason))
+            send(("job_shed", jid, e.reason), trace=job.trace)
             return
         except BaseException as e:      # noqa: BLE001 — relayed, not raised
-            send(("job_failed", jid, repr(e)))
+            send(("job_failed", jid, repr(e)), trace=job.trace)
             return
-        send(("result", jid, dataclasses.asdict(r)))
+        send(("result", jid, dataclasses.asdict(r)), trace=job.trace)
 
     fut.add_done_callback(_done)
 
@@ -299,15 +331,27 @@ def replica_worker_main(conn, replica_id: int, spec: dict) -> None:
     if spec.get("metrics"):
         rl = obs.RunLog(spec["metrics"], run_id=f"replica{replica_id}")
         obs.activate(rl)
+        # fleet workers fly with the recorder armed by default: a
+        # crash/circuit-open/shed-burst dumps the last events next to
+        # the replica's own JSONL stream
+        if spec.get("flight_recorder", True):
+            obs.arm_flight_recorder(
+                os.path.dirname(spec["metrics"]) or ".")
     obs.install_compile_listener()
+    if spec.get("faults"):
+        # per-replica deterministic fault plan (the injected-slowdown
+        # demonstration targets exactly one replica of the fleet)
+        rt_faults.install(rt_faults.FaultPlan(**dict(spec["faults"])))
 
     send_lock = threading.Lock()
 
-    def send(msg) -> bool:
+    def send(msg, trace=None) -> bool:
+        env = dict(trace) if trace else {}
+        env["t"] = round(time.time(), 6)  # clock-offset handshake
         try:
             with send_lock:              # done-callbacks run on the
-                ipc.send_msg(conn, msg)  # batch worker; beats on main
-            return True
+                ipc.send_msg(conn, msg, trace=env)  # batch worker;
+            return True                  # beats on main
         except (OSError, BrokenPipeError, ValueError, EOFError):
             return False
 
@@ -319,6 +363,8 @@ def replica_worker_main(conn, replica_id: int, spec: dict) -> None:
         server.start()
         send(("ready", summary))
     except BaseException as e:          # noqa: BLE001 — death IS the signal
+        _event("replica_fatal", replica=replica_id, error=repr(e))
+        obs.flush_flight_recorder("crash", {"error": repr(e)})
         send(("error", repr(e)))
         return
     beat_s = float(spec.get("beat_s", 0.1))
@@ -327,13 +373,18 @@ def replica_worker_main(conn, replica_id: int, spec: dict) -> None:
         while True:
             if conn.poll(beat_s):
                 try:
-                    msg = ipc.recv_msg(conn)
-                except ipc.CorruptPayloadError:
-                    continue             # router->replica corruption: skip
+                    msg, _mtrace = ipc.recv_msg_traced(conn)
+                except ipc.CorruptPayloadError as e:
+                    # router->replica corruption: skip the one frame,
+                    # but name its trace if the prelude survived
+                    _event("ipc_corrupt_payload", side="replica",
+                           replica=replica_id, error=repr(e),
+                           **tracectx.fields_of(e.trace))
+                    continue
                 if msg[0] == "stop":
                     break
                 if msg[0] == "job":
-                    _submit_remote(server, msg[1], send)
+                    _submit_remote(server, msg[1], send, replica_id)
             now = time.monotonic()
             if now - last_beat >= beat_s:
                 last_beat = now
@@ -350,8 +401,9 @@ def replica_worker_main(conn, replica_id: int, spec: dict) -> None:
                 obs.flush_counters()
                 while obs.active() is not None:
                     obs.deactivate()
-            except Exception:
-                pass
+                rl.close()           # flush the buffered tail — a short
+            except Exception:        # run otherwise fits entirely in the
+                pass                 # RunLog buffer and leaves no stream
 
 
 # ---------------------------------------------------------------------------
@@ -380,6 +432,17 @@ class _Replica(threading.Thread):
             "queue_depth": 0, "batch_fill": 0.0, "circuit_open": False,
             "service_est_s": float(spec.get("service_est_s", 0.5)),
         }
+        # last-received-frame summaries: the PARENT-side black box for
+        # this replica.  A SIGKILLed worker can never flush its own
+        # ring, so the crashed replica's final observable events are
+        # what the parent saw — dumped by the router on death detection.
+        self._frames: "collections.deque" = collections.deque(
+            maxlen=int(spec.get("frame_ring", 64)))
+        # clock-offset handshake state (pump thread only): minimum of
+        # (parent recv wall - peer send wall) over received envelopes
+        self._offset_min: Optional[float] = None
+        self._offset_logged: Optional[float] = None
+        self._offset_last_log = 0.0
         self.t_spawn = time.monotonic()
         self.last_beat = time.monotonic()
         self.ready = threading.Event()
@@ -464,7 +527,8 @@ class _Replica(threading.Thread):
         bounded dispatch outbox is full (the router tries the next
         candidate — per-replica back-pressure must never block the
         front door)."""
-        blob = ipc.frame_payload(("job", _job_payload(job)))
+        blob = ipc.frame_payload(("job", _job_payload(job)),
+                                 trace=job.trace)
         with self._lock:
             self._pending[job.job_id] = job
         try:
@@ -500,6 +564,61 @@ class _Replica(threading.Thread):
             except (OSError, BrokenPipeError, ValueError):
                 return
 
+    def _note_frame(self, kind: str, detail: dict) -> None:
+        rec = {"t": round(time.time(), 3), "kind": kind,
+               "replica": self.replica_id}
+        rec.update(detail)
+        with self._lock:
+            self._frames.append(rec)
+
+    def _note_envelope(self, trace: Optional[dict]) -> None:
+        """Feed one received envelope into the clock-offset estimate:
+        min over frames of (recv wall - send wall) bounds the peer's
+        clock ahead-ness by the one-way delay.  Logged periodically as
+        a ``clock_offset`` event (the collector's skew correction)."""
+        if not trace or "t" not in trace:
+            return
+        try:
+            delta = time.time() - float(trace["t"])
+        except (TypeError, ValueError):
+            return
+        if self._offset_min is None or delta < self._offset_min:
+            self._offset_min = delta
+        now = time.monotonic()
+        if (self._offset_logged != self._offset_min
+                and now - self._offset_last_log >= 1.0):
+            self._offset_last_log = now
+            self._offset_logged = self._offset_min
+            # offset_s: ADD to the peer's wall timestamps to land on
+            # the parent's clock (<= one-way delay of the best frame)
+            self.router._log("clock_offset",
+                             peer=f"replica{self.replica_id}",
+                             replica=self.replica_id,
+                             offset_s=round(-self._offset_min, 6))
+
+    def blackbox(self, reason: str, directory: str) -> Optional[str]:
+        """Dump this slot's received-frame ring (the parent-side black
+        box) to ``blackbox_replica<rid>.jsonl`` in ``directory``."""
+        with self._lock:
+            frames = list(self._frames)
+        if not frames:
+            return None
+        try:
+            os.makedirs(directory, exist_ok=True)
+            path = os.path.join(
+                directory, f"blackbox_replica{self.replica_id}.jsonl")
+            header = {"t": round(time.time(), 3),
+                      "event": "blackbox_flush", "reason": reason,
+                      "side": "parent", "replica": self.replica_id,
+                      "n_events": len(frames)}
+            with open(path, "a") as fh:
+                fh.write(json.dumps(header) + "\n")
+                for rec in frames:
+                    fh.write(json.dumps(obs.sanitize(rec)) + "\n")
+            return path
+        except OSError:
+            return None
+
     def run(self) -> None:
         r = self.router
         while not self.stop_event.is_set():
@@ -513,12 +632,16 @@ class _Replica(threading.Thread):
                                 f"{self.proc.exitcode})")
                         return
                     continue
-                msg = ipc.recv_msg(self.conn)
+                msg, mtrace = ipc.recv_msg_traced(self.conn)
             except ipc.CorruptPayloadError as e:
                 # a replica died mid-send (or shipped garbage): drop the
-                # one broken frame, log it, keep pumping
+                # one broken frame, log it — WITH the trace the frame's
+                # surviving prelude names, so the merged timeline shows
+                # which request's frame was lost instead of a bare drop
                 r._log("ipc_corrupt_payload", replica=self.replica_id,
-                       error=repr(e))
+                       error=repr(e), **tracectx.fields_of(e.trace))
+                self._note_frame("corrupt", {"error": repr(e),
+                                             **tracectx.fields_of(e.trace)})
                 obs.counter_add("ipc_corrupt_payloads")
                 continue
             except (EOFError, OSError):
@@ -529,29 +652,43 @@ class _Replica(threading.Thread):
                         f"replica channel closed (exit code {code})")
                 return
             self.last_beat = time.monotonic()
+            self._note_envelope(mtrace)
             kind = msg[0]
             if kind == "ready":
                 self.ready_summary = msg[1]
                 self.ready.set()
+                self._note_frame("ready", {})
             elif kind == "beat":
                 with self._lock:
                     self._gauges.update(msg[1])
+                self._note_frame("beat", {k: msg[1].get(k) for k in
+                                          ("queue_depth", "served",
+                                           "circuit_open")})
             elif kind == "result":
                 job = self._pop_pending(msg[1])
                 if job is not None and not job.future.done():
                     job.future.set_result(JobResult(**msg[2]))
+                self._note_frame("result", {
+                    "job_id": msg[1],
+                    "total_s": msg[2].get("total_s"),
+                    **tracectx.fields_of(mtrace)})
                 r._note_result(self.replica_id, job, msg[2])
             elif kind == "job_shed":
                 job = self._pop_pending(msg[1])
+                self._note_frame("job_shed", {"job_id": msg[1],
+                                              "reason": msg[2]})
                 if job is not None:
                     r._reclaim(job, self.replica_id, msg[2])
             elif kind == "job_failed":
                 job = self._pop_pending(msg[1])
                 if job is not None and not job.future.done():
                     job.future.set_exception(RuntimeError(msg[2]))
+                self._note_frame("job_failed", {"job_id": msg[1],
+                                                "error": msg[2]})
                 r._note_failed(self.replica_id, msg[1], msg[2])
             elif kind == "error":
                 self.error = RuntimeError(msg[1])
+                self._note_frame("error", {"error": msg[1]})
                 return
 
 
@@ -603,6 +740,7 @@ class FleetRouter:
                  autoscale: Optional[AutoscalePolicy] = None,
                  poll_s: float = 0.05, metrics_dir: Optional[str] = None,
                  replica_factory: Optional[Callable] = None,
+                 slo: Optional["obs.SloBurnDetector"] = None,
                  clock: Callable[[], float] = time.monotonic):
         import random
 
@@ -614,6 +752,7 @@ class FleetRouter:
         self.max_requeues = int(max_requeues)
         self.autoscale = autoscale
         self.metrics_dir = metrics_dir
+        self.slo = slo
         self._clock = clock
         self._poll_s = float(poll_s)
         self._factory = replica_factory or _Replica
@@ -645,17 +784,29 @@ class FleetRouter:
         replicas spread across hosts instead of piling onto the last."""
         return rid % self.hosts
 
-    def _spawn_replica(self):
-        with self._lock:
-            rid = self._next_rid
-            self._next_rid += 1
+    def _replica_spec(self, rid: int) -> dict:
+        """The per-process worker spec for slot ``rid``: base spec +
+        host pinning + this generation's metrics path + any
+        ``per_replica`` overrides ({rid: {...}} in the base spec — the
+        injected-slowdown demonstration targets one replica's fault
+        plan without touching the rest of the fleet)."""
         spec = dict(self.worker_spec, host_id=self.replica_host(rid),
                     n_hosts=self.hosts)
+        over = spec.pop("per_replica", None) or {}
+        ov = over.get(rid, over.get(str(rid)))
+        if ov:
+            spec.update(dict(ov))
         if self.metrics_dir:
             spec["metrics"] = os.path.join(
                 self.metrics_dir,
                 f"replica{rid}-g{self._tracker.attempts(rid)}.jsonl")
-        r = self._factory(self, rid, spec)
+        return spec
+
+    def _spawn_replica(self):
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        r = self._factory(self, rid, self._replica_spec(rid))
         r.start()
         with self._lock:
             self._replicas[rid] = r
@@ -665,13 +816,7 @@ class FleetRouter:
     def _respawn(self, rid: int):
         """Fresh process in an existing slot (same rid: restart
         accounting and the per-slot circuit stay attached)."""
-        spec = dict(self.worker_spec, host_id=self.replica_host(rid),
-                    n_hosts=self.hosts)
-        if self.metrics_dir:
-            spec["metrics"] = os.path.join(
-                self.metrics_dir,
-                f"replica{rid}-g{self._tracker.attempts(rid)}.jsonl")
-        r = self._factory(self, rid, spec)
+        r = self._factory(self, rid, self._replica_spec(rid))
         r.start()
         with self._lock:
             self._replicas[rid] = r
@@ -793,6 +938,12 @@ class FleetRouter:
         return [s[0] for s in scored]
 
     def _dispatch(self, job: Job, requeue: bool = False):
+        if job.trace is None and obs.active() is not None:
+            # mint the request's trace root at fleet admission — every
+            # later event (serve_admit / serve_request / fleet_result,
+            # on either side of the pipe) joins this tree.  A requeue
+            # keeps the ORIGINAL carrier: same trace_id, annotated hop.
+            job.trace = tracectx.new_root_carrier()
         cands = self._candidates()
         if not cands:
             if requeue:
@@ -806,7 +957,8 @@ class FleetRouter:
                         self._stats["requeued"] += 1
                 obs.counter_add("fleet_dispatch")
                 _event("fleet_dispatch", job_id=job.job_id,
-                       replica=r.replica_id, requeue=bool(requeue))
+                       replica=r.replica_id, requeue=bool(requeue),
+                       **tracectx.fields_of(job.trace))
                 return job.future
         if requeue:
             return self._shed_async(job, "fleet_saturated")
@@ -830,8 +982,11 @@ class FleetRouter:
             reasons = self._stats["shed_reasons"]
             reasons[reason] = reasons.get(reason, 0) + 1
         obs.counter_add("serve_shed")
+        obs.note_shed()                 # flight recorder burst detection
+        if self.slo is not None:
+            self.slo.observe(shed=True, now=self._clock())
         _event("serve_shed", job_id=job.job_id, reason=reason,
-               scope="fleet")
+               scope="fleet", **tracectx.fields_of(job.trace))
 
     def _shed_sync(self, job: Job, reason: str) -> None:
         self._shed_record(job, reason)
@@ -848,11 +1003,19 @@ class FleetRouter:
     def _note_result(self, rid: int, job: Optional[Job], d: dict) -> None:
         with self._lock:
             self._stats["completed"] += 1
+        if self.slo is not None:
+            try:
+                lat = float(d.get("total_s") or 0.0)
+            except (TypeError, ValueError):
+                lat = 0.0
+            self.slo.observe(latency_s=lat, replica=rid,
+                             now=self._clock())
         _event("fleet_result", replica=rid,
                job_id=d.get("job_id"), total_s=d.get("total_s"),
                degraded=d.get("degraded"),
                deadline_miss=d.get("deadline_miss"),
-               requeues=getattr(job, "requeues", 0))
+               requeues=getattr(job, "requeues", 0),
+               **tracectx.fields_of(getattr(job, "trace", None)))
 
     def _note_failed(self, rid: int, job_id: int, err: str) -> None:
         with self._lock:
@@ -902,6 +1065,11 @@ class FleetRouter:
             lost = r.take_pending()
             reason = (f"error:{r.error!r}" if r.error is not None
                       else ("exited" if dead else "hung"))
+            if self.metrics_dir and hasattr(r, "blackbox"):
+                # a SIGKILLed worker never flushes its own flight
+                # recorder; the parent-side frame ring is the crashed
+                # replica's black box
+                r.blackbox(reason, self.metrics_dir)
             n = self._tracker.attempts(rid)
             delay = self._tracker.note_down(rid, now=now)
             with self._lock:
@@ -935,6 +1103,15 @@ class FleetRouter:
                 break
             self._requeue(job, reason)
         events.extend(self._autoscale_pass(now))
+        if self.slo is not None:
+            ev = self.slo.evaluate(now=now)
+            if ev is not None:
+                ev = dict(ev, event="slo_burn")
+                events.append(ev)
+                self._log(**ev)
+                obs.counter_add("fleet_slo_transitions")
+            snap_fast = self.slo.snapshot(now=now)["fast"]
+            obs.gauge_set("fleet_slo_burn", float(snap_fast["burn"]))
         self._gauge_tick()
         return events
 

@@ -74,6 +74,10 @@ class Job:
     # times the fleet router re-dispatched this job after losing its
     # replica mid-flight (serve.fleet; bounded by max_requeues)
     requeues: int = 0
+    # W3C-style trace carrier ({"trace": ..., "span": ...}) minted at
+    # fleet admission (obs/tracectx.py): crosses the process boundary
+    # in the job payload so replica-side events join the request's tree
+    trace: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -122,6 +126,7 @@ class MicroBatcher:
             with self._lock:
                 self._shed += 1
             obs.counter_add("serve_shed")
+            obs.note_shed()             # flight recorder: burst detection
             rl = obs.active()
             if rl is not None:
                 rl.log("serve_shed", job_id=job.job_id, reason="queue_full",

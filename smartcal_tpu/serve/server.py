@@ -43,6 +43,8 @@ import numpy as np
 
 from smartcal_tpu import obs
 from smartcal_tpu.envs import calib as calib_env
+from smartcal_tpu.obs import tracectx
+from smartcal_tpu.runtime import faults as rt_faults
 from smartcal_tpu.runtime import supervisor
 
 from .export import ExportCache, abstract_like, enable_compile_cache
@@ -216,6 +218,7 @@ class CalibServer:
             raise ShedError("shutdown")
         if self.circuit_open:
             obs.counter_add("serve_shed")
+            obs.note_shed()
             _event("serve_shed", job_id=job.job_id, reason="circuit_open")
             raise ShedError("circuit_open")
         if job.episode.n_dirs != self.M:
@@ -226,7 +229,7 @@ class CalibServer:
         return self.batcher.submit(job)
 
     # -- batch execution ---------------------------------------------------
-    def _lane_params(self, batch):
+    def _lane_params(self, batch, batch_id: int = 0):
         """(rho, mask, alpha, iters) lane arrays for this batch.  Idle
         lanes re-run their stale (valid) episode under the default rho —
         the program shape is fixed at ``lanes``.  Jobs with rho=None and
@@ -252,7 +255,8 @@ class CalibServer:
             elif self._policy is not None:
                 want_policy.append(lane)
         if want_policy:
-            with obs.span("serve_policy", lanes=len(want_policy)):
+            with obs.span("serve_policy", lanes=len(want_policy),
+                          batch=batch_id):
                 obs_dim = self.npix * self.npix + (self.M + 1) * 7
                 ovec = np.zeros((E, obs_dim), np.float32)
                 for lane in want_policy:
@@ -293,21 +297,26 @@ class CalibServer:
             self._batch_id += 1
             batch_id = self._batch_id
         with obs.span("serve_batch", jobs=len(batch), batch=batch_id):
-            with obs.span("serve_pack", jobs=len(batch)):
+            # chaos hook: a planned serve_batch delay (runtime/faults)
+            # inflates this replica's service time — the injected-
+            # slowdown demonstration the SLO burn detector must catch
+            rt_faults.maybe_delay("serve_batch", batch_id)
+            with obs.span("serve_pack", jobs=len(batch), batch=batch_id):
                 for lane, job in enumerate(batch):
                     self._bep = self.backend.splice_episode(
                         self._bep, lane, job.episode)
-                rho, mask, alpha, iters = self._lane_params(batch)
+                rho, mask, alpha, iters = self._lane_params(batch,
+                                                            batch_id)
             ops = self.backend.batched_solve_operands(
                 self._bep, rho, mask, iters)
-            with obs.span("serve_solve", lanes=E):
+            with obs.span("serve_solve", lanes=E, batch=batch_id):
                 res = self._program("solve")(*ops)
                 sig = np.asarray(res.sigma_res)
-            with obs.span("serve_influence", lanes=E):
+            with obs.span("serve_influence", lanes=E, batch=batch_id):
                 imgs = np.asarray(self._program("influence")(
                     *self.backend.batched_influence_operands(
                         self._bep, res, rho, alpha)))
-            with obs.span("serve_sigma"):
+            with obs.span("serve_sigma", batch=batch_id):
                 sig_d, sig_r = (np.asarray(a) for a in
                                 self.backend.image_sigmas_batched(
                                     self._bep, res, npix=self.npix))
@@ -347,6 +356,7 @@ class CalibServer:
                    queue_wait_s=result.queue_wait_s,
                    service_s=result.service_s, total_s=result.total_s,
                    sigma_res=vals[0],
+                   **tracectx.child_fields(job.trace),
                    **({"warm": True} if job.warm else {}))
             obs.counter_add("serve_jobs_warm" if job.warm
                             else "serve_jobs")
@@ -428,6 +438,12 @@ class CalibServer:
                     obs.counter_add("serve_circuit_transitions")
                     _event("serve_circuit", open=open_now,
                            restarts=fleet.restarts_total())
+                    if open_now:
+                        # circuit OPEN is a postmortem moment: dump the
+                        # flight-recorder ring with the lead-up events
+                        obs.flush_flight_recorder(
+                            "circuit_open",
+                            {"restarts": fleet.restarts_total()})
                 obs.gauge_set("serve_queue_depth", self.batcher.depth())
             except Exception as e:   # breaker must outlive a bad pass
                 obs.counter_add("serve_breaker_errors")

@@ -1,0 +1,52 @@
+"""Positive fixture: unlocked writes to the ISSUE 18 observability
+shared state (flight-recorder ring, SLO burn windows, timeline-merge
+state).
+
+The test registers this file with three specs mirroring the shipped
+SHARED_FIELD_SPECS rows: class FlightRecorder, fields {_ring,
+_flushes, _n_flushes}, lock {_lock}; class SloBurnDetector, fields
+{_obs, _state}, lock {_lock}; class TimelineMerger, fields {_streams,
+_offsets, _n_corrupt}, lock {_lock}.
+"""
+import threading
+
+
+class FlightRecorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = []                # ok: __init__ runs pre-sharing
+        self._flushes = {}
+        self._n_flushes = 0
+
+    def record_line(self, line):
+        self._ring.append(line)        # BAD: tee without the lock
+
+    def flush(self, reason):
+        self._flushes[reason] = 0.0    # BAD: rate-limit store, no lock
+        self._n_flushes += 1           # BAD: aug-assign without lock
+
+
+class SloBurnDetector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._obs = []
+        self._state = {"firing": False}
+
+    def observe(self, latency_s):
+        self._obs.append(latency_s)    # BAD: window grow, no lock
+
+    def evaluate(self):
+        self._state["firing"] = True   # BAD: subscript store, no lock
+
+
+class TimelineMerger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._streams = {}
+        self._offsets = {}
+        self._n_corrupt = 0
+
+    def add_stream(self, proc, events, bad):
+        self._streams[proc] = events   # BAD: stream store, no lock
+        self._offsets.update({})       # BAD: mutator without the lock
+        self._n_corrupt += bad         # BAD: aug-assign without lock

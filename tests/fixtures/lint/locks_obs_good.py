@@ -1,0 +1,58 @@
+"""Negative fixture: lock-disciplined observability shared state —
+zero findings.  Registered with the same specs as locks_obs_bad.py.
+"""
+import threading
+
+
+class FlightRecorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = []
+        self._flushes = {}
+        self._n_flushes = 0
+
+    def record_line(self, line):
+        with self._lock:
+            self._ring.append(line)    # ok: under the annotated lock
+
+    def flush(self, reason):
+        with self._lock:
+            self._flushes[reason] = 0.0
+            self._n_flushes += 1
+            return list(self._ring)    # reads unchecked
+
+    def _drop_locked(self):
+        self._ring = []                # ok: *_locked caller-holds-lock
+
+
+class SloBurnDetector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._obs = []
+        self._state = {"firing": False}
+
+    def observe(self, latency_s):
+        with self._lock:
+            self._obs.append(latency_s)
+
+    def evaluate(self):
+        with self._lock:
+            self._state["firing"] = True
+
+
+class TimelineMerger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._streams = {}
+        self._offsets = {}
+        self._n_corrupt = 0
+
+    def add_stream(self, proc, events, bad):
+        with self._lock:
+            self._streams[proc] = events
+            self._offsets.update({})
+            self._n_corrupt += bad
+
+    def merge(self):
+        with self._lock:
+            return dict(self._streams)  # reads unchecked

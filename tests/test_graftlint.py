@@ -286,6 +286,56 @@ def test_shipped_shared_specs_cover_fleet_fields():
             "_pending", "_gauges"} <= fields
 
 
+# the ISSUE 18 observability fields: the crash flight-recorder ring,
+# the SLO burn-rate windows, and the timeline-merger state — mirrors
+# the shipped SHARED_FIELD_SPECS rows
+def _obs_specs(path):
+    return [
+        {"path": path, "class": "FlightRecorder",
+         "fields": ["_ring", "_flushes", "_n_flushes"],
+         "locks": ["_lock"], "why": "fixture"},
+        {"path": path, "class": "SloBurnDetector",
+         "fields": ["_obs", "_state"],
+         "locks": ["_lock"], "why": "fixture"},
+        {"path": path, "class": "TimelineMerger",
+         "fields": ["_streams", "_offsets", "_n_corrupt"],
+         "locks": ["_lock"], "why": "fixture"},
+    ]
+
+
+def test_locks_obs_rule_positive():
+    opts = {"shared_specs": _obs_specs("locks_obs_bad.py")}
+    fs = fixture_findings("locks_obs_bad.py", "unlocked-shared-write",
+                          opts)
+    assert lines_of(fs) == [22, 25, 26, 36, 39, 50, 51, 52], fs
+
+
+def test_locks_obs_rule_negative():
+    opts = {"shared_specs": _obs_specs("locks_obs_good.py")}
+    assert fixture_findings("locks_obs_good.py",
+                            "unlocked-shared-write", opts) == []
+
+
+def test_shipped_shared_specs_cover_obs_fields():
+    """The SHIPPED spec table must keep the ISSUE 18 rows: the
+    flight-recorder ring + flush bookkeeping, the burn-rate detector's
+    observation window + latch state, the timeline merger's
+    stream/offset tables, and the parent-side received-frame ring on
+    the replica handle."""
+    from smartcal_tpu.analysis.rules.locks import SHARED_FIELD_SPECS
+
+    obs_fields = {f for s in SHARED_FIELD_SPECS
+                  if "smartcal_tpu/obs/" in s["path"]
+                  for f in s["fields"]}
+    assert {"_ring", "_flushes", "_n_flushes", "_shed_times",
+            "_obs", "_state",
+            "_streams", "_offsets", "_n_corrupt"} <= obs_fields
+    fleet_fields = {f for s in SHARED_FIELD_SPECS
+                    if s["path"].endswith("serve/fleet.py")
+                    for f in s["fields"]}
+    assert "_frames" in fleet_fields
+
+
 def _lint_as_package(tmp_path, *names):
     """Copy fixtures under a fake smartcal_tpu/ so path-scoped rules
     (pickle outside tests/, bare-print) see them as package code."""

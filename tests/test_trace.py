@@ -1,0 +1,512 @@
+"""Fleet-wide distributed tracing (ISSUE 18): trace-context units
+(carrier lineage, adoption, thread-local no-op contract), traced IPC
+framing (corrupt body preserves the prelude's trace), the SLO
+burn-rate detector's hysteresis state machine on an injected clock,
+the crash flight recorder (ring capacity, dump format, rate limit,
+shed-burst trigger), timeline collection (rotation-aware discovery,
+skew-corrected merge, request-path reconstruction, completeness
+scoring), router wiring (per_replica spec overrides, slo observe/
+evaluate through poll, parent-side black box), and the trace-
+continuity-under-failure runs: a corrupt frame's trace is reported,
+not silently dropped, and a replica kill requeues under the ORIGINAL
+trace id."""
+
+import json
+import os
+import threading
+import time
+import types
+
+import pytest
+
+from smartcal_tpu import obs
+from smartcal_tpu.obs import collect, tracectx
+from smartcal_tpu.obs.flightrec import FlightRecorder
+from smartcal_tpu.runtime import ipc
+from smartcal_tpu.serve import fleet as serve_fleet
+from smartcal_tpu.serve.fleet import FleetRouter, _Replica
+from smartcal_tpu.serve.router import Job
+
+from test_serve_fleet import (FakeReplica, _drain, _fake_router,
+                              _fast_backoff)
+
+
+@pytest.fixture(autouse=True)
+def in_tmp(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+
+
+def _read_jsonl(path):
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# trace context units
+# ---------------------------------------------------------------------------
+
+def test_carrier_shapes_and_lineage():
+    car = tracectx.new_root_carrier()
+    assert len(car["trace"]) == 32 and len(car["span"]) == 16
+    int(car["trace"], 16), int(car["span"], 16)   # valid hex
+    # fields_of names the carrier's OWN span (the point of origin)
+    assert tracectx.fields_of(car) == {"trace": car["trace"],
+                                       "span": car["span"]}
+    # child_fields mints a fresh span under the carrier's
+    cf = tracectx.child_fields(car)
+    assert cf["trace"] == car["trace"]
+    assert cf["parent"] == car["span"]
+    assert len(cf["span"]) == 16 and cf["span"] != car["span"]
+    # carrier-less inputs degrade to empty fields, never raise
+    assert tracectx.fields_of(None) == {}
+    assert tracectx.child_fields({}) == {}
+    assert tracectx.fields_of({"span": "x"}) == {}
+
+
+def test_use_trace_adoption_and_noop_contract():
+    assert tracectx.current_fields() == {}
+    assert tracectx.carrier() is None
+    assert tracectx.push_span() is None      # no adopted trace: no-op
+    car = tracectx.new_root_carrier()
+    with tracectx.use_trace(car):
+        assert tracectx.current_fields() == {"trace": car["trace"],
+                                             "span": car["span"]}
+        sid, parent = tracectx.push_span()
+        assert parent == car["span"] and sid != car["span"]
+        assert tracectx.current_fields()["span"] == sid
+        tracectx.pop_span(sid)
+        assert tracectx.current_fields()["span"] == car["span"]
+    assert tracectx.current_fields() == {}   # restored on exit
+    with tracectx.use_trace(None):           # None adopts nothing
+        assert tracectx.carrier() is None
+
+
+def test_runlog_auto_attaches_adopted_trace():
+    car = tracectx.new_root_carrier()
+    with obs.recording("trace_rl.jsonl", run_id="t") as rl:
+        with tracectx.use_trace(car):
+            rl.log("traced_evt", x=1)
+        rl.log("plain_evt")
+    recs = {r["event"]: r for r in _read_jsonl("trace_rl.jsonl")}
+    assert recs["traced_evt"]["trace"] == car["trace"]
+    assert recs["traced_evt"]["span"] == car["span"]
+    assert "trace" not in recs["plain_evt"]
+
+
+# ---------------------------------------------------------------------------
+# traced IPC framing
+# ---------------------------------------------------------------------------
+
+def test_traced_frame_roundtrip_and_plain():
+    env = {"trace": "ab" * 16, "span": "cd" * 8, "t": 123.456}
+    blob = ipc.frame_payload(("result", 7), trace=env)
+    obj, trace = ipc.unframe_payload_traced(blob)
+    assert obj == ("result", 7) and trace == env
+    # plain frames carry no trace and stay readable by both paths
+    plain = ipc.frame_payload(("beat", 1))
+    assert ipc.unframe_payload_traced(plain) == (("beat", 1), None)
+    assert ipc.unframe_payload(blob) == ("result", 7)
+
+
+def test_corrupt_body_preserves_trace_prelude():
+    env = {"trace": "ab" * 16, "span": "cd" * 8, "t": 1.0}
+    blob = bytearray(ipc.frame_payload(("result", 7, {}), trace=env))
+    blob[-1] ^= 0xFF                         # mid-send death: torn body
+    with pytest.raises(ipc.CorruptPayloadError) as ei:
+        ipc.unframe_payload_traced(bytes(blob))
+    assert ei.value.trace == env             # the drop names its request
+    # an untraced corrupt frame reports trace None (nothing to name)
+    plain = bytearray(ipc.frame_payload(("result", 7)))
+    plain[-1] ^= 0xFF
+    with pytest.raises(ipc.CorruptPayloadError) as ei2:
+        ipc.unframe_payload_traced(bytes(plain))
+    assert ei2.value.trace is None
+    # truncation below even the header is still a structured error
+    with pytest.raises(ipc.CorruptPayloadError):
+        ipc.unframe_payload_traced(b"SC")
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate detector (injected clock)
+# ---------------------------------------------------------------------------
+
+def test_slo_fire_localize_clear():
+    det = obs.SloBurnDetector(p99_target_s=0.1, fast_window_s=10.0,
+                              slow_window_s=20.0, sustain_s=2.0,
+                              clear_sustain_s=3.0, min_samples=5)
+    for i in range(8):                       # replica 1 is the slow one
+        det.observe(latency_s=0.5, replica=1, now=0.5 + 0.05 * i)
+        det.observe(latency_s=0.05, replica=0, now=0.5 + 0.05 * i)
+    assert det.evaluate(now=1.0) is None     # burning, not yet sustained
+    ev = det.evaluate(now=3.5)
+    assert ev is not None and ev["state"] == "firing"
+    assert ev["worst_replica"] == 1
+    assert ev["burn_fast"] >= 2.0
+    assert det.firing and det.snapshot(now=3.5)["firing"]
+    # recovery: the bad window ages out, good traffic takes over
+    for i in range(6):
+        det.observe(latency_s=0.01, replica=1, now=24.0 + 0.2 * i)
+    assert det.evaluate(now=26.0) is None    # quiet, not yet sustained
+    ev2 = det.evaluate(now=29.5)
+    assert ev2 is not None and ev2["state"] == "cleared"
+    snap = det.snapshot(now=29.5)
+    assert not snap["firing"] and snap["transitions"] == 2
+
+
+def test_slo_min_samples_and_shed_burn():
+    det = obs.SloBurnDetector(p99_target_s=0.1, min_samples=20,
+                              sustain_s=0.0)
+    for i in range(5):                       # too few samples: no alarm
+        det.observe(latency_s=9.9, now=float(i) * 0.1)
+    assert det.evaluate(now=1.0) is None and not det.firing
+    # shed rate alone burns (latencies all within target)
+    det2 = obs.SloBurnDetector(p99_target_s=0.1, shed_target=0.02,
+                               min_samples=5, sustain_s=1.0)
+    for i in range(10):
+        det2.observe(shed=True, now=0.1 * i)
+    assert det2.evaluate(now=1.0) is None
+    ev = det2.evaluate(now=2.5)
+    assert ev is not None and ev["state"] == "firing"
+    assert ev["shed_rate_fast"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_ring_flush_and_rate_limit(tmp_path):
+    fr = FlightRecorder()
+    assert not fr.armed
+    fr.record_line('{"dropped": true}\n')    # disarmed: no-op
+    fr.arm(str(tmp_path / "bb"), capacity=4)
+    for i in range(6):
+        fr.record_line(json.dumps({"i": i}) + "\n")
+    assert fr.stats() == {"armed": True, "depth": 4, "flushes": 0}
+    path = fr.flush("crash", {"error": "boom"})
+    assert path is not None and os.path.basename(path) == \
+        f"blackbox_{os.getpid()}.jsonl"
+    recs = _read_jsonl(path)
+    hdr = recs[0]
+    assert hdr["event"] == "blackbox_flush" and hdr["reason"] == "crash"
+    assert hdr["n_events"] == 4 and hdr["error"] == "boom"
+    assert [r["i"] for r in recs[1:]] == [2, 3, 4, 5]   # capacity kept
+    # same-reason dumps are rate-limited; a new reason appends at once
+    assert fr.flush("crash") is None
+    assert fr.flush("watchdog_trip") == path
+    assert _read_jsonl(path)[5]["reason"] == "watchdog_trip"
+    fr.disarm()
+    assert fr.flush("crash") is None and not fr.armed
+
+
+def test_flight_recorder_shed_burst_triggers_dump(tmp_path):
+    fr = FlightRecorder()
+    fr.arm(str(tmp_path / "bb"), capacity=8)
+    fr.record_line('{"event": "x"}\n')
+    for i in range(7):                       # below the burst bar
+        fr.note_shed(now=10.0 + 0.1 * i)
+    assert fr.stats()["flushes"] == 0
+    fr.note_shed(now=10.8)                   # 8 sheds inside 2 s: burst
+    assert fr.stats()["flushes"] == 1
+    hdr = _read_jsonl(os.path.join(
+        str(tmp_path / "bb"), f"blackbox_{os.getpid()}.jsonl"))[0]
+    assert hdr["reason"] == "shed_burst"
+    assert hdr["sheds_in_window"] == 8
+
+
+# ---------------------------------------------------------------------------
+# timeline collection
+# ---------------------------------------------------------------------------
+
+def test_discover_streams_rotation_order_and_exclusions(tmp_path):
+    d = str(tmp_path / "run")
+    os.makedirs(d)
+    for name in ("r.jsonl", "r.jsonl.1", "r.jsonl.2", "s.jsonl",
+                 "blackbox_123.jsonl", "notes.txt"):
+        with open(os.path.join(d, name), "w") as fh:
+            fh.write("")
+    streams = collect.discover_streams(d)
+    assert sorted(streams) == ["r.jsonl", "s.jsonl"]
+    assert [os.path.basename(p) for p in streams["r.jsonl"]] == \
+        ["r.jsonl.1", "r.jsonl.2", "r.jsonl"]   # write order
+    assert collect.discover_streams(str(tmp_path / "missing")) == {}
+
+
+def test_read_stream_proc_naming_and_corrupt_tolerance(tmp_path):
+    p = str(tmp_path / "replica0-g0.jsonl")
+    with open(p, "w") as fh:
+        fh.write(json.dumps({"event": "run_header",
+                             "run_id": "replica0"}) + "\n")
+        fh.write(json.dumps({"event": "x", "t": 1.0}) + "\n")
+        fh.write('{"torn tail\n')            # crashed writer
+        fh.write("3\n")                      # non-dict line
+    proc, events, bad = collect.read_stream([p])
+    assert proc == "replica0" and bad == 2 and len(events) == 2
+    # no header: proc falls back to the filename stem
+    q = str(tmp_path / "router.jsonl")
+    with open(q, "w") as fh:
+        fh.write(json.dumps({"event": "y", "t": 2.0}) + "\n")
+    assert collect.read_stream([q])[0] == "router"
+
+
+def _router_stream(trace):
+    return [
+        {"t": 100.0, "event": "clock_offset", "peer": "replica0",
+         "offset_s": 4.5},
+        {"t": 100.0, "event": "fleet_dispatch", "job_id": 7,
+         "trace": trace, "span": "a" * 16, "requeue": False},
+        {"t": 101.0, "event": "fleet_result", "job_id": 7,
+         "trace": trace, "total_s": 0.8},
+    ]
+
+
+def _replica_stream(trace):
+    return [
+        {"t": 95.7, "event": "serve_admit", "trace": trace,
+         "replica": 0, "requeues": 0},
+        {"t": 96.0, "event": "serve_request", "trace": trace,
+         "queue_wait_s": 0.05, "service_s": 0.5, "total_s": 0.8,
+         "batch": 3},
+        {"t": 96.1, "event": "span", "name": "serve_solve",
+         "batch": 3, "dur_s": 0.4},
+    ]
+
+
+def test_merge_applies_clock_offset_and_paths_reconstruct():
+    T = "ff" * 16
+    m = collect.TimelineMerger()
+    m.add_stream("router", _router_stream(T))
+    m.add_stream("replica0", _replica_stream(T))
+    assert m.offsets() == {"replica0": 4.5}
+    merged = m.merge()
+    admit = next(e for e in merged if e["event"] == "serve_admit")
+    assert admit["proc"] == "replica0"
+    assert admit["t_corr"] == pytest.approx(100.2)   # 95.7 + 4.5
+    assert [e["event"] for e in merged[:2]] == \
+        ["clock_offset", "fleet_dispatch"]           # time-ordered
+    paths = collect.request_paths(merged)
+    assert len(paths) == 1
+    (p,) = paths
+    assert p["trace"] == T and p["replica"] == 0
+    assert p["proc"] == "replica0" and p["completed"] and p["complete"]
+    assert not p["requeued"] and p["requeues"] == 0
+    assert p["ipc_s"] == pytest.approx(0.2)
+    assert p["queue_s"] == 0.05 and p["solve_s"] == 0.4
+    comp = collect.completeness(paths, require_stages=True)
+    assert comp == {"n_requests": 1, "n_completed": 1,
+                    "n_complete_trees": 1, "fraction": 1.0}
+
+
+def test_request_paths_requeue_keeps_trace_and_scores():
+    T, U = "aa" * 16, "bb" * 16
+    router = [
+        {"t": 10.0, "event": "fleet_dispatch", "trace": T,
+         "job_id": 1, "requeue": False},
+        {"t": 10.5, "event": "fleet_dispatch", "trace": T,
+         "job_id": 1, "requeue": True},      # same trace, second hop
+        {"t": 11.0, "event": "fleet_result", "trace": T, "job_id": 1},
+        # a trace whose replica-side events died with the replica
+        {"t": 12.0, "event": "fleet_dispatch", "trace": U, "job_id": 2},
+        {"t": 12.4, "event": "fleet_result", "trace": U, "job_id": 2},
+    ]
+    replica1 = [
+        {"t": 10.6, "event": "serve_admit", "trace": T, "replica": 1,
+         "requeues": 1},
+        {"t": 10.7, "event": "serve_request", "trace": T,
+         "total_s": 0.4},
+    ]
+    m = collect.TimelineMerger()
+    m.add_stream("router", router)
+    m.add_stream("replica1", replica1)
+    paths = {p["trace"]: p for p in collect.request_paths(m.merge())}
+    p = paths[T]
+    assert p["requeued"] and p["requeues"] == 1 and p["dispatches"] == 2
+    assert p["replica"] == 1 and p["complete"] and p["completed"]
+    # ipc_s measures from the LAST dispatch (the hop that served)
+    assert p["ipc_s"] == pytest.approx(0.1)
+    assert paths[U]["completed"] and not paths[U]["complete"]
+    comp = collect.completeness(list(paths.values()))
+    assert comp["n_completed"] == 2 and comp["fraction"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# router wiring (scripted fakes, injected clock)
+# ---------------------------------------------------------------------------
+
+def test_replica_spec_merges_per_replica_overrides():
+    clk = [0.0]
+    router = FleetRouter(
+        {"lanes": 2, "per_replica": {0: {"faults": {
+            "delay_stage": "serve_batch", "delay_at": 10}}}},
+        replicas=0, replica_factory=FakeReplica,
+        clock=lambda: clk[0], backoff=_fast_backoff())
+    s0, s1 = router._replica_spec(0), router._replica_spec(1)
+    assert s0["faults"]["delay_stage"] == "serve_batch"
+    assert "faults" not in s1
+    assert "per_replica" not in s0 and "per_replica" not in s1
+    # the override table survives in the base spec for respawns
+    assert 0 in router.worker_spec["per_replica"]
+
+
+def test_router_poll_emits_slo_burn_transitions():
+    clk = [0.0]
+    det = obs.SloBurnDetector(p99_target_s=0.1, fast_window_s=10.0,
+                              slow_window_s=10.0, sustain_s=1.0,
+                              clear_sustain_s=1.0, min_samples=5)
+    router = _fake_router(clk, slo=det)
+    router._spawn_replica()
+    for _ in range(6):                       # results feed the detector
+        router._note_result(0, None, {"total_s": 0.5})
+    assert det.snapshot(now=0.0)["fast"]["n"] == 6
+    assert router.poll() == []               # pending, not sustained
+    clk[0] = 1.5
+    events = router.poll()
+    burns = [e for e in events if e.get("event") == "slo_burn"]
+    assert len(burns) == 1 and burns[0]["state"] == "firing"
+    assert burns[0]["worst_replica"] == 0
+    # sheds feed the detector too
+    job = Job(episode=None, k=1, t_submit=0.0)
+    router._shed_record(job, "fleet_down")
+    assert det.snapshot(now=clk[0])["fast"]["n"] == 7
+    clk[0] = 15.0                            # bad window ages out:
+    assert router.poll() == []               # quiet, clear not sustained
+    clk[0] = 16.5
+    clears = [e for e in router.poll() if e.get("event") == "slo_burn"]
+    assert len(clears) == 1 and clears[0]["state"] == "cleared"
+    assert det.snapshot(now=clk[0])["transitions"] == 2
+
+
+def test_parent_blackbox_dump_format(tmp_path):
+    rep = _Replica(types.SimpleNamespace(name="t"), 3, {"frame_ring": 8})
+    assert rep.blackbox("exited", str(tmp_path)) is None   # empty ring
+    rep._note_frame("beat", {"queue_depth": 1})
+    rep._note_frame("result", {"job_id": 4, "trace": "ee" * 16})
+    path = rep.blackbox("exited", str(tmp_path))
+    assert os.path.basename(path) == "blackbox_replica3.jsonl"
+    recs = _read_jsonl(path)
+    assert recs[0]["event"] == "blackbox_flush"
+    assert recs[0]["side"] == "parent" and recs[0]["replica"] == 3
+    assert recs[0]["n_events"] == 2
+    assert [r["kind"] for r in recs[1:]] == ["beat", "result"]
+    assert recs[2]["trace"] == "ee" * 16
+
+
+# ---------------------------------------------------------------------------
+# trace continuity under failure
+# ---------------------------------------------------------------------------
+
+class _RouterStub:
+    """Log-recording stand-in for FleetRouter on the pump-only path."""
+
+    name = "t"
+
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def _log(self, event, **fields):
+        with self._lock:
+            self.events.append(dict(fields, event=event))
+
+    def of(self, event):
+        with self._lock:
+            return [e for e in self.events if e["event"] == event]
+
+
+def test_pump_reports_corrupt_frame_trace():
+    """A replica frame whose body is torn mid-send is dropped — but the
+    drop is logged as ``ipc_corrupt_payload`` WITH the trace id the
+    surviving prelude names, so the merged timeline shows which request
+    lost a frame instead of a silent gap."""
+    import multiprocessing as mp
+
+    stub = _RouterStub()
+    rep = _Replica(stub, 0, {})
+    parent, child = mp.Pipe(duplex=True)
+    rep.conn = parent
+    rep.proc = types.SimpleNamespace(is_alive=lambda: True)
+    threading.Thread.start(rep)              # pump only; no process
+    try:
+        env = {"trace": "ab" * 16, "span": "cd" * 8,
+               "t": round(time.time(), 6)}
+        blob = bytearray(ipc.frame_payload(
+            ("result", 9, {"total_s": 0.1}), trace=env))
+        blob[-1] ^= 0xFF                     # emulate mid-send death
+        child.send_bytes(bytes(blob))
+        child.send_bytes(ipc.frame_payload(
+            ("beat", {"queue_depth": 2, "served": 1,
+                      "circuit_open": False}),
+            trace={"t": round(time.time(), 6)}))
+        deadline = time.monotonic() + 5.0
+        while (not stub.of("ipc_corrupt_payload")
+               or not stub.of("clock_offset")):
+            assert time.monotonic() < deadline, stub.events
+            time.sleep(0.01)
+    finally:
+        rep.stop_event.set()
+        rep.join(timeout=2.0)
+        parent.close()
+        child.close()
+    (bad,) = stub.of("ipc_corrupt_payload")
+    assert bad["trace"] == "ab" * 16 and bad["span"] == "cd" * 8
+    assert bad["replica"] == 0
+    # the parent-side frame ring remembers the drop for the black box
+    kinds = [f["kind"] for f in rep._frames]
+    assert "corrupt" in kinds and "beat" in kinds
+    corrupt = next(f for f in rep._frames if f["kind"] == "corrupt")
+    assert corrupt["trace"] == "ab" * 16
+    # the intact beat still landed (one bad frame costs one frame)
+    assert rep.gauges()["queue_depth"] == 2
+    # the envelope handshake produced a usable skew estimate
+    (off,) = stub.of("clock_offset")
+    assert off["peer"] == "replica0" and abs(off["offset_s"]) < 5.0
+
+
+def test_trace_continuity_replica_kill_requeue():
+    """SIGKILL one of two replicas mid-run: requeued jobs keep their
+    ORIGINAL trace id across the hop (annotated, not re-rooted), the
+    survivor's spans complete those trees, and the dead replica leaves
+    a parent-side black box."""
+    d = os.path.abspath("procs")
+    os.makedirs(d)
+    # the fleet's own sleep stub, not the tests' StubServer: it mirrors
+    # CalibServer's serve_request + batch-span instrumentation, which is
+    # exactly what the continuity assertions below reconstruct
+    spec = serve_fleet.sleep_worker_spec(lanes=2, service_s=0.05,
+                                         beat_s=0.05)
+    router = FleetRouter(spec, replicas=2, heartbeat_timeout=10.0,
+                         poll_s=0.02, backoff=_fast_backoff(),
+                         max_requeues=2, metrics_dir=d)
+    with obs.recording(os.path.join(d, "router.jsonl"),
+                       run_id="router"):
+        try:
+            router.start(warm_timeout_s=60.0, stagger=False)
+            jobs = [Job(episode=None, k=i % 5) for i in range(16)]
+            futs = [router.submit(j) for j in jobs]
+            assert router.kill_replica(0)
+            results = _drain(futs, timeout_s=60.0)
+            assert len(results) == 16
+            st = router.stats()
+            assert st["completed"] == 16 and st["shed"] == 0
+            assert st["requeued"] >= 1, st
+        finally:
+            router.stop()
+    # the SIGKILLed worker could never flush its own ring: the parent-
+    # side frame ring is its black box
+    assert os.path.exists(os.path.join(d, "blackbox_replica0.jsonl"))
+    hdr = _read_jsonl(os.path.join(d, "blackbox_replica0.jsonl"))[0]
+    assert hdr["event"] == "blackbox_flush" and hdr["side"] == "parent"
+    paths = collect.request_paths(collect.merge_directory(d))
+    assert len(paths) == 16                  # every admission traced
+    assert len({p["trace"] for p in paths}) == 16
+    requeued = [p for p in paths if p["requeued"]]
+    assert requeued, "kill produced no requeued request paths"
+    for p in requeued:
+        # continuity: the re-dispatch rode the SAME trace id (one
+        # record per trace), annotated as a later hop, and the
+        # survivor's spans completed the tree
+        assert p["dispatches"] >= 2 and p["requeues"] >= 1
+        assert p["completed"] and p["complete"], p
+    # requeued-and-served requests were flushed by a clean-exit
+    # replica, so their chains must ALL have reconstructed
+    comp = collect.completeness(requeued)
+    assert comp["fraction"] == 1.0

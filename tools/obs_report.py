@@ -25,6 +25,16 @@ given.  Output sections:
   per-slot ingest-queue depth (the aggregate hides a single slow
   shard), per-shard replay occupancy, and the staleness / IS-clip
   gauge trajectories.
+* **Critical path** (fleet-run DIRECTORIES) — pass a directory of
+  per-process streams (``tools/serve_fleet.py --metrics-dir``) and the
+  per-process JSONLs are merged onto the router's clock (via the
+  ``clock_offset`` handshake, ``smartcal_tpu.obs.collect``); each
+  request's cross-process span tree is reconstructed and the per-stage
+  critical path (queue wait vs IPC vs pack/policy/solve/influence) is
+  rendered per replica, with the trace-completeness fraction.
+* **SLO burn** — ``slo_burn`` detector transitions (obs/slo.py):
+  firing/cleared with the fast/slow burn rates and the localized worst
+  replica.
 * **Training health** (``--diag`` runs) — grad-norm trajectory over the
   learning updates (quarter means, so a ramp or a blowup is visible at a
   glance), non-finite counts, watchdog trips with their reasons, and the
@@ -77,6 +87,36 @@ def load_run(path):
     header = next((e for e in events if e.get("event") == "run_header"), {})
     return {"path": path, "run_id": header.get("run_id", os.path.basename(path)),
             "header": header, "events": events, "bad_lines": bad}
+
+
+def _collect_mod():
+    """smartcal_tpu.obs.collect (stdlib-only), tolerating bare
+    ``python tools/obs_report.py`` invocations without PYTHONPATH=."""
+    try:
+        from smartcal_tpu.obs import collect
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from smartcal_tpu.obs import collect
+    return collect
+
+
+def load_fleet_dir(path):
+    """Read a fleet-run DIRECTORY (one stream per process) as one
+    merged run: events carry ``proc`` tags and skew-corrected
+    ``t_corr`` timestamps (see smartcal_tpu/obs/collect.py)."""
+    collect = _collect_mod()
+    merger = collect.TimelineMerger()
+    merger.add_directory(path)
+    events = merger.merge()
+    st = merger.stats()
+    header = next((e for e in events if e.get("event") == "run_header"),
+                  {})
+    return {"path": path,
+            "run_id": f"fleet:{os.path.basename(os.path.normpath(path))}",
+            "header": header, "events": events,
+            "bad_lines": st["corrupt_lines"], "fleet_dir": True,
+            "procs": st["procs"], "clock_offsets": st["offsets"]}
 
 
 # ---------------------------------------------------------------------------
@@ -615,6 +655,91 @@ def render_serve_fleet(fv, out):
 
 
 # ---------------------------------------------------------------------------
+# Cross-process critical path (merged fleet directories) + SLO burn
+# ---------------------------------------------------------------------------
+
+# per-request critical-path columns, in pipeline order (collect.py
+# reconstructs them; absent columns — e.g. policy on a stub fleet —
+# are simply skipped)
+_CP_COLUMNS = ("queue_s", "ipc_s", "pack_s", "policy_s", "solve_s",
+               "influence_s", "sigma_s", "service_s", "total_s")
+
+
+def critical_path_summary(events):
+    """Per-replica per-stage percentile breakdown of the reconstructed
+    request chains, or None when the stream has no stitched traces
+    (single-process runs, pre-schema-3 streams)."""
+    collect = _collect_mod()
+    paths = collect.request_paths(events)
+    if not paths:
+        return None
+    comp = collect.completeness(paths)
+    by_rep = {}
+    for p in paths:
+        by_rep.setdefault(p.get("replica"), []).append(p)
+    per_replica = {}
+    for rid, ps in sorted(by_rep.items(), key=lambda kv: str(kv[0])):
+        row = {}
+        for col in _CP_COLUMNS:
+            d = _pctiles([p.get(col) for p in ps])
+            if d:
+                row[col] = d
+        row["requests"] = len(ps)
+        row["requeued"] = sum(1 for p in ps if p.get("requeued"))
+        per_replica[str(rid)] = row
+    return {"completeness": comp, "per_replica": per_replica,
+            "requeued_traces": sum(1 for p in paths if p.get("requeued"))}
+
+
+def render_critical_path(cp, out):
+    c = cp["completeness"]
+    out.append(f"  trace completeness: {c['n_complete_trees']}"
+               f"/{c['n_completed']} completed requests rebuilt a full "
+               f"cross-process tree ({100 * c['fraction']:.1f}%)"
+               + (f"; {cp['requeued_traces']} requeued"
+                  if cp.get("requeued_traces") else ""))
+    for rid, row in cp["per_replica"].items():
+        out.append(f"  replica {rid}  (n={row['requests']}"
+                   + (f", requeued={row['requeued']}"
+                      if row.get("requeued") else "") + ")")
+        out.append(f"    {'stage':12s} {'p50_s':>9s} {'p99_s':>9s} "
+                   f"{'mean_s':>9s}")
+        for col in _CP_COLUMNS:
+            if col in row:
+                d = row[col]
+                out.append(f"    {col:12s} {d['p50']:>9.4f} "
+                           f"{d['p99']:>9.4f} {d['mean']:>9.4f}")
+
+
+def slo_summary(events):
+    """``slo_burn`` detector transitions, or None when none fired."""
+    evs = [e for e in events if e.get("event") == "slo_burn"]
+    if not evs:
+        return None
+    return {"transitions": [
+        {k: e.get(k) for k in ("t", "t_corr", "state", "burn_fast",
+                               "burn_slow", "p99_fast_s",
+                               "shed_rate_fast", "p99_target_s",
+                               "worst_replica") if k in e}
+        for e in evs],
+        "final_state": evs[-1].get("state")}
+
+
+def render_slo(sl, out):
+    for e in sl["transitions"]:
+        state = str(e.get("state", "?")).upper()
+        line = (f"  {state}: fast burn {e.get('burn_fast')}x / slow "
+                f"{e.get('burn_slow')}x  p99_fast={e.get('p99_fast_s')}s"
+                f" (target {e.get('p99_target_s')}s)")
+        if e.get("shed_rate_fast"):
+            line += f"  shed_rate={e['shed_rate_fast']}"
+        if e.get("worst_replica") is not None:
+            line += f"  worst replica: {e['worst_replica']}"
+        out.append(line)
+    out.append(f"  final state: {str(sl['final_state']).upper()}")
+
+
+# ---------------------------------------------------------------------------
 # Training health (diag / replay_health / watchdog_trip events)
 # ---------------------------------------------------------------------------
 
@@ -948,6 +1073,9 @@ def build_report(runs, n_boot=1000, seed=0):
              "fleet": fleet_summary(ev),
              "serve_fleet": serve_fleet_summary(ev),
              "serving": serving_summary(ev),
+             "critical_path": (critical_path_summary(ev)
+                               if run.get("fleet_dir") else None),
+             "slo": slo_summary(ev),
              "training_health": training_health(ev),
              "roofline": roofline(ev, spans),
              "compile_events": len(compiles),
@@ -1001,6 +1129,12 @@ def render(report):
         if r.get("serve_fleet"):
             out.append("-- fleet SLO (serving scale-out)")
             render_serve_fleet(r["serve_fleet"], out)
+        if r.get("critical_path"):
+            out.append("-- critical path (merged cross-process traces)")
+            render_critical_path(r["critical_path"], out)
+        if r.get("slo"):
+            out.append("-- SLO burn transitions")
+            render_slo(r["slo"], out)
         if r["compile_events"]:
             out.append(f"-- jax compile: {r['compile_events']} events, "
                        f"{r['compile_secs']} s")
@@ -1034,15 +1168,18 @@ def render(report):
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("paths", nargs="+", help="run JSONL path(s); rotated "
-                   "segments <path>.N are folded in automatically")
+    p.add_argument("paths", nargs="+", help="run JSONL path(s) — rotated "
+                   "segments <path>.N are folded in automatically — or a "
+                   "fleet-run DIRECTORY of per-process streams, merged "
+                   "onto one clock (critical-path section)")
     p.add_argument("--json", action="store_true",
                    help="emit the full report as one JSON document")
     p.add_argument("--bootstrap", type=int, default=1000)
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
-    runs = [load_run(path) for path in args.paths]
+    runs = [load_fleet_dir(path) if os.path.isdir(path)
+            else load_run(path) for path in args.paths]
     report = build_report(runs, n_boot=args.bootstrap, seed=args.seed)
     if args.json:
         print(json.dumps(report))

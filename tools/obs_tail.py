@@ -11,12 +11,26 @@ segment), and renders each event kind on one line:
     12:03:41 replay     entropy=0.98 max/mean=3.1 beta=0.43 filled=4096
     12:03:42 WATCHDOG   non_finite:critic_loss at update 113 (ring=32)
 
+Fleet mode: point it at a DIRECTORY (a fleet run's ``--metrics-dir``
+tree of per-process streams) and it tails every stream at once, merging
+lines onto the router's clock via the ``clock_offset`` handshake events
+and tagging each line with its process:
+
+    [router  ] 12:03:41 fleet_dispatch {"job_id": 14, ...}
+    [replica0] 12:03:41 serve_request  job=14 total=0.031s
+    [replica1] 12:03:42 slo_burn       {"state": "firing", ...}
+
+New per-replica generations appearing mid-run are picked up on the
+next poll; ``blackbox_*`` crash dumps are excluded (different artifact
+class — read those whole).
+
 Usage:
-    python tools/obs_tail.py run.jsonl [--events diag,episode,...]
-        [--no-follow] [--interval 0.5]
+    python tools/obs_tail.py <run.jsonl | fleet-dir>
+        [--events diag,episode,...] [--no-follow] [--interval 0.5]
 
 ``--no-follow`` renders what is on disk and exits (scripting / tests).
-stdlib only — runs anywhere, never touches jax or a device.
+stdlib only — runs anywhere, never touches jax or a device (the fleet
+merge imports smartcal_tpu.obs.collect, itself stdlib-only).
 """
 
 from __future__ import annotations
@@ -26,6 +40,16 @@ import json
 import os
 import sys
 import time
+
+
+def _collect_mod():
+    try:
+        from smartcal_tpu.obs import collect
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from smartcal_tpu.obs import collect
+    return collect
 
 
 def _ts(e):
@@ -169,10 +193,137 @@ def tail(path, wanted=None, follow=True, interval=0.5, out=sys.stdout,
             time.sleep(interval)
 
 
+class _ProcTail:
+    """Follow ONE per-process stream, yielding parsed event dicts.
+
+    Same rotation handling as :func:`tail` (inode change / truncation
+    reopens the base path after draining the old segment's tail), but
+    events are returned to the fleet merger instead of printed, so the
+    caller can order them across processes."""
+
+    def __init__(self, proc, path):
+        self.proc = proc            # display tag; upgraded to the
+        self.path = path            # run_header run_id when seen
+        self._fh = None
+        self._ino = None
+        self._partial = ""
+
+    def _parse(self, lines):
+        events = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue            # mid-write partial line
+            if isinstance(e, dict):
+                if e.get("event") == "run_header" \
+                        and isinstance(e.get("run_id"), str):
+                    # the stream names itself (replica<rid>) — that is
+                    # the name clock_offset events key their peer by
+                    self.proc = e["run_id"]
+                events.append(e)
+        return events
+
+    def poll(self):
+        """Newly available events since the last poll (maybe empty)."""
+        if self._fh is None:
+            try:
+                self._fh = open(self.path)
+                self._ino = os.fstat(self._fh.fileno()).st_ino
+            except OSError:
+                return []
+        chunk = self._fh.read()
+        if chunk:
+            buf = self._partial + chunk
+            lines = buf.split("\n")
+            self._partial = lines.pop()
+            return self._parse(lines)
+        try:
+            st = os.stat(self.path)
+            if st.st_ino != self._ino or st.st_size < self._fh.tell():
+                last = self._fh.read()
+                events = self._parse((self._partial + last).split("\n"))
+                self._fh.close()
+                self._fh = None
+                self._partial = ""
+                return events
+        except OSError:
+            pass                    # transiently missing mid-rotate
+        return []
+
+    def drain_tail(self):
+        """Final flush of a trailing unterminated line (no-follow)."""
+        return self._parse([self._partial]) if self._partial else []
+
+
+def fleet_tail(directory, wanted=None, follow=True, interval=0.5,
+               out=sys.stdout, max_iters=None):
+    """Tail every stream under ``directory`` merged onto one clock.
+
+    Each poll cycle rescans the directory (new replica generations
+    appear as new files mid-run), reads what every stream grew, learns
+    clock offsets from any ``clock_offset`` events seen so far, then
+    emits the cycle's batch sorted by skew-corrected timestamp with a
+    ``[proc]`` tag per line.  Ordering is exact within a cycle; across
+    cycles it is as good as the poll interval — the offline merger
+    (obs_report / trace_export on the same directory) is the ground
+    truth."""
+    collect = _collect_mod()
+    tails = {}                      # base filename -> _ProcTail
+    offsets = {}                    # proc -> seconds to ADD to its t
+    iters = 0
+    while True:
+        for base, paths in collect.discover_streams(directory).items():
+            if base in tails:
+                continue
+            t = _ProcTail(base.split(".jsonl")[0], paths[-1])
+            # attach late: replay this stream's rotated history first
+            for seg in paths[:-1]:
+                try:
+                    with open(seg) as fh:
+                        t._history = t._parse(fh.read().split("\n"))
+                except OSError:
+                    t._history = []
+            tails[base] = t
+        batch = []
+        for t in tails.values():
+            events = getattr(t, "_history", []) + t.poll()
+            t._history = []
+            if not follow:
+                events += t.drain_tail()
+            for e in events:
+                if e.get("event") == "clock_offset" \
+                        and isinstance(e.get("peer"), str) \
+                        and isinstance(e.get("offset_s"), (int, float)):
+                    offsets[e["peer"]] = float(e["offset_s"])
+                batch.append((t, e))
+        width = max([8] + [len(t.proc) for t in tails.values()])
+        batch.sort(key=lambda te: (
+            (float(te[1]["t"]) if isinstance(te[1].get("t"), (int, float))
+             else 0.0) + offsets.get(te[0].proc, 0.0)))
+        for t, e in batch:
+            if wanted and e.get("event") not in wanted:
+                continue
+            txt = render_event(e)
+            if txt:
+                out.write(f"[{t.proc:<{width}}] {txt}\n")
+        out.flush()
+        if not follow:
+            return
+        iters += 1
+        if max_iters is not None and iters >= max_iters:
+            return
+        time.sleep(interval)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("path", help="RunLog JSONL path (the --metrics file "
-                   "of a running driver)")
+                   "of a running driver) or a fleet --metrics-dir "
+                   "directory of per-process streams")
     p.add_argument("--events", default=None,
                    help="comma-separated event kinds to show "
                         "(default: all)")
@@ -182,9 +333,10 @@ def main(argv=None):
                    help="poll interval in seconds (default 0.5)")
     args = p.parse_args(argv)
     wanted = (set(args.events.split(",")) if args.events else None)
+    fn = fleet_tail if os.path.isdir(args.path) else tail
     try:
-        tail(args.path, wanted=wanted, follow=not args.no_follow,
-             interval=args.interval)
+        fn(args.path, wanted=wanted, follow=not args.no_follow,
+           interval=args.interval)
     except KeyboardInterrupt:
         pass
     except BrokenPipeError:             # | head — exit quietly

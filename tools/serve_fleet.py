@@ -19,6 +19,22 @@ later replica — and every later topology — warm-starts off it):
 * ``--autoscale``       — 1 replica + AutoscalePolicy under a rate
   step: the router must scale up under sustained backlog and reap back
   to the floor when the load drains.
+* ``--slowdown``        — the SLO burn-rate demonstration: 2 replicas
+  under load, one replica's solve stage stalled mid-run by a
+  deterministic fault plan (runtime.faults delay); the fleet's
+  SloBurnDetector must FIRE while the stall holds p99 over target,
+  LOCALIZE the slow replica (worst per-replica p99), and CLEAR after
+  recovery.  The run fails soft (recorded, not raised) so the artifact
+  always lands.
+
+``--trace-dir DIR`` gives every measurement its own per-process stream
+directory (``DIR/<phase>/``: the router's stream plus one stream per
+replica generation, clock-offset handshakes included).  Each phase
+record then carries a ``trace`` digest — merged-event counts, per-peer
+clock offsets, and the cross-process trace completeness score — and the
+directories replay offline through ``tools/obs_report.py`` (critical
+path), ``tools/trace_export.py`` (Perfetto) and ``tools/obs_tail.py``
+(merged tail).
 
 ``--stub`` swaps the CalibServer factory for the stdlib SleepServer
 (see :class:`smartcal_tpu.serve.fleet.SleepServer`): sleeps overlap
@@ -39,6 +55,7 @@ events, fleet gauges — aggregate with ``tools/obs_report.py`` (the
 """
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -82,6 +99,21 @@ def parse_args(argv=None):
                    help="run the kill-and-recover measurement")
     p.add_argument("--autoscale", action="store_true",
                    help="run the rate-step autoscale measurement")
+    p.add_argument("--slowdown", action="store_true",
+                   help="run the injected-slowdown SLO burn-rate "
+                        "demonstration (stub fleets only: the fault "
+                        "stalls the stub's solve stage)")
+    p.add_argument("--trace-dir", dest="trace_dir", default=None,
+                   help="root for per-phase per-process trace streams "
+                        "(<dir>/<phase>/{router,replicaN-gK}.jsonl); "
+                        "enables the merged-timeline trace digest per "
+                        "measurement")
+    p.add_argument("--slo-p99-ms", dest="slo_p99_ms", type=float,
+                   default=None,
+                   help="p99 target for the fleet SLO burn-rate "
+                        "detector (default: detector off except in "
+                        "--slowdown, which derives one from the stub "
+                        "service time)")
     p.add_argument("--stub", action="store_true",
                    help="SleepServer replicas (router-capacity ceiling "
                         "instead of the real CalibServer fleet)")
@@ -116,14 +148,59 @@ def _pool(args, backend):
                                   mixed=(args.pool_mode == "mixed"))
 
 
-def _router(args, replicas, hosts=1, autoscale=None, metrics_dir=None):
+def _router(args, replicas, hosts=1, autoscale=None, metrics_dir=None,
+            slo=None, spec=None):
     return FleetRouter(
-        _spec(args), replicas=replicas, hosts=hosts,
+        spec if spec is not None else _spec(args),
+        replicas=replicas, hosts=hosts,
         heartbeat_timeout=30.0, max_restarts=3,
         backoff=BackoffPolicy(base_s=0.1, factor=2.0, max_s=2.0,
                               jitter=0.0),
         seed=args.seed, max_requeues=args.max_requeues,
-        autoscale=autoscale, poll_s=0.05, metrics_dir=metrics_dir)
+        autoscale=autoscale, poll_s=0.05, metrics_dir=metrics_dir,
+        slo=slo)
+
+
+def _phase_dir(args, name):
+    """Per-measurement stream directory under --trace-dir (or None)."""
+    if not args.trace_dir:
+        return None
+    d = os.path.join(args.trace_dir, name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+@contextlib.contextmanager
+def _phase_obs(pdir):
+    """Route the router-side stream into the phase directory: a fresh
+    ``router.jsonl`` RunLog shadows the global one for the phase (stack
+    discipline), so dispatch/result/clock_offset events land next to
+    the replica streams they merge with."""
+    if pdir is None:
+        yield
+        return
+    with obs.recording(os.path.join(pdir, "router.jsonl"),
+                       run_id="router"):
+        yield
+
+
+def _slo(args):
+    if args.slo_p99_ms is None:
+        return None
+    return obs.SloBurnDetector(p99_target_s=args.slo_p99_ms / 1e3)
+
+
+def _trace_digest(pdir):
+    """Merge a phase's streams and score its trace reconstruction."""
+    if pdir is None:
+        return None
+    from smartcal_tpu.obs import collect
+
+    merger = collect.TimelineMerger()
+    merger.add_directory(pdir)
+    events = merger.merge()
+    comp = collect.completeness(collect.request_paths(events))
+    return {"dir": pdir, **merger.stats(), "completeness": comp}
 
 
 def _compile_gauges(router):
@@ -150,30 +227,35 @@ def _run_load(args, router, pool, rate, duration):
 
 
 def sweep_point(args, tobs, pool, replicas, hosts):
+    pdir = _phase_dir(args, f"scale{replicas}x{hosts}")
     t0 = time.time()
-    router = _router(args, replicas, hosts=hosts)
-    try:
-        warm = router.start(warm_timeout_s=900.0)
-        boot_s = round(time.time() - t0, 3)
-        _settle(router)
-        c0 = _compile_gauges(router)
-        rate = args.rate_per_replica * replicas
-        summary = _run_load(args, router, pool, rate, args.duration)
-        _settle(router)
-        c1 = _compile_gauges(router)
-        steady = sum(c1.get(rid, 0.0) - c0.get(rid, 0.0) for rid in c1)
-        point = {
-            "replicas": replicas, "hosts": hosts, "boot_s": boot_s,
-            "warm_sources": {rid: sorted(set(w["sources"].values()))
-                             for rid, w in warm.items()},
-            "offered_rate": rate,
-            "summary": summary,
-            "steady_compile_events_fleet": steady,
-            "router_stats": {k: v for k, v in router.stats().items()
-                             if k != "per_replica"},
-        }
-    finally:
-        router.stop(timeout=20.0)
+    with _phase_obs(pdir):
+        router = _router(args, replicas, hosts=hosts, metrics_dir=pdir,
+                         slo=_slo(args))
+        try:
+            warm = router.start(warm_timeout_s=900.0)
+            boot_s = round(time.time() - t0, 3)
+            _settle(router)
+            c0 = _compile_gauges(router)
+            rate = args.rate_per_replica * replicas
+            summary = _run_load(args, router, pool, rate, args.duration)
+            _settle(router)
+            c1 = _compile_gauges(router)
+            steady = sum(c1.get(rid, 0.0) - c0.get(rid, 0.0)
+                         for rid in c1)
+            point = {
+                "replicas": replicas, "hosts": hosts, "boot_s": boot_s,
+                "warm_sources": {rid: sorted(set(w["sources"].values()))
+                                 for rid, w in warm.items()},
+                "offered_rate": rate,
+                "summary": summary,
+                "steady_compile_events_fleet": steady,
+                "router_stats": {k: v for k, v in router.stats().items()
+                                 if k != "per_replica"},
+            }
+        finally:
+            router.stop(timeout=20.0)
+    point["trace"] = _trace_digest(pdir)
     tobs.echo(f"replicas={replicas}x{hosts}h rate={rate}: "
               f"{summary.get('achieved_jobs_s')} jobs/s, "
               f"p99={summary.get('latency_p99_s')}s, "
@@ -182,41 +264,117 @@ def sweep_point(args, tobs, pool, replicas, hosts):
 
 
 def kill_run(args, tobs, pool):
-    router = _router(args, 2)
-    try:
-        router.start(warm_timeout_s=900.0)
-        rate = args.rate_per_replica * 2
-        duration = max(6.0, args.duration)
-        killed = {}
+    pdir = _phase_dir(args, "kill")
+    with _phase_obs(pdir):
+        router = _router(args, 2, metrics_dir=pdir, slo=_slo(args))
+        try:
+            router.start(warm_timeout_s=900.0)
+            rate = args.rate_per_replica * 2
+            duration = max(6.0, args.duration)
+            killed = {}
 
-        def _chaos():
-            time.sleep(duration / 3)
-            t_kill = time.monotonic()
-            router.kill_replica(0)
-            deadline = t_kill + 60.0
-            while (router.replicas_alive() < 2
-                   or router.stats()["replica_restarts"] < 1):
-                if time.monotonic() > deadline:
-                    return
-                time.sleep(0.02)
-            killed["recover_s"] = round(time.monotonic() - t_kill, 3)
+            def _chaos():
+                time.sleep(duration / 3)
+                t_kill = time.monotonic()
+                router.kill_replica(0)
+                deadline = t_kill + 60.0
+                while (router.replicas_alive() < 2
+                       or router.stats()["replica_restarts"] < 1):
+                    if time.monotonic() > deadline:
+                        return
+                    time.sleep(0.02)
+                killed["recover_s"] = round(time.monotonic() - t_kill, 3)
 
-        chaos = threading.Thread(target=_chaos, daemon=True)
-        chaos.start()
-        summary = _run_load(args, router, pool, rate, duration)
-        chaos.join(timeout=90.0)
-        recover_s = killed.get("recover_s")
-        st = router.stats()
-    finally:
-        router.stop(timeout=20.0)
+            chaos = threading.Thread(target=_chaos, daemon=True)
+            chaos.start()
+            summary = _run_load(args, router, pool, rate, duration)
+            chaos.join(timeout=90.0)
+            recover_s = killed.get("recover_s")
+            st = router.stats()
+        finally:
+            router.stop(timeout=20.0)
     rec = {"summary": summary, "recover_s": recover_s,
            "replica_restarts": st["replica_restarts"],
            "requeued": st["requeued"],
            "shed_reasons": st["shed_reasons"],
            "replicas_alive_after": st["replicas_alive"]}
+    if pdir is not None:
+        # the SIGKILLed replica can't flush its own black box — the
+        # router's parent-side frame ring must have dumped one
+        try:
+            rec["blackbox_files"] = sorted(
+                n for n in os.listdir(pdir) if n.startswith("blackbox_"))
+        except OSError:
+            rec["blackbox_files"] = []
+        rec["trace"] = _trace_digest(pdir)
     tobs.echo(f"kill: completed={summary['completed']}/"
               f"{summary['submitted']} shed={summary['shed']} "
-              f"requeued={st['requeued']} recover={recover_s}s")
+              f"requeued={st['requeued']} recover={recover_s}s"
+              + (f" blackboxes={len(rec['blackbox_files'])}"
+                 if "blackbox_files" in rec else ""))
+    return rec
+
+
+def slowdown_run(args, tobs, pool):
+    """Injected-slowdown SLO demonstration: 2 stub replicas, replica
+    0's solve stalled for a span of consecutive batches mid-run by a
+    deterministic runtime.faults delay plan.  The burn-rate detector
+    must fire while the stall holds the fast-window p99 over target,
+    name replica 0 as the worst per-replica p99 at fire time, and clear
+    once the fleet recovers and the hot window drains."""
+    pdir = _phase_dir(args, "slowdown")
+    service_s = args.stub_service_ms / 1e3
+    delay_s = max(4.0 * service_s, 0.25)
+    spec = sleep_worker_spec(lanes=args.lanes, service_s=service_s)
+    spec["per_replica"] = {0: {"faults": {
+        "delay_stage": "serve_batch", "delay_at": 10,
+        "delay_span": 12, "delay_s": delay_s}}}
+    target_s = (args.slo_p99_ms / 1e3 if args.slo_p99_ms
+                else 2.5 * service_s)
+    slo = obs.SloBurnDetector(p99_target_s=target_s, fast_window_s=2.0,
+                              slow_window_s=6.0, sustain_s=0.5,
+                              clear_sustain_s=2.0, min_samples=5)
+    with _phase_obs(pdir):
+        router = _router(args, 2, metrics_dir=pdir, slo=slo, spec=spec)
+        try:
+            router.start(warm_timeout_s=900.0)
+            rate = args.rate_per_replica * 2
+            summary = _run_load(args, router, pool, rate,
+                                max(10.0, args.duration))
+            # recovery: the supervise thread keeps evaluating after the
+            # load drains — wait for the detector to quiet down
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                snap = slo.snapshot()
+                if snap["transitions"] >= 2 and not snap["firing"]:
+                    break
+                time.sleep(0.1)
+            snap = slo.snapshot()
+        finally:
+            router.stop(timeout=20.0)
+    rec = {"summary": summary, "p99_target_s": target_s,
+           "delay_s": delay_s, "slow_replica": 0,
+           "snapshot": snap}
+    if pdir is not None:
+        from smartcal_tpu.obs import collect
+
+        burns = [e for e in collect.merge_directory(pdir)
+                 if e.get("event") == "slo_burn"]
+        rec["transitions"] = [
+            {k: e.get(k) for k in ("state", "burn_fast", "p99_fast_s",
+                                   "worst_replica", "t_corr")}
+            for e in burns]
+        fired = [e for e in burns if e.get("state") == "firing"]
+        rec["fired"] = bool(fired)
+        rec["localized_replica"] = (fired[0].get("worst_replica")
+                                    if fired else None)
+        rec["cleared"] = any(e.get("state") == "cleared" for e in burns)
+        rec["trace"] = _trace_digest(pdir)
+    tobs.echo(f"slowdown: fired={rec.get('fired')} "
+              f"localized={rec.get('localized_replica')} "
+              f"cleared={rec.get('cleared')} "
+              f"(target p99={target_s * 1e3:.0f}ms, "
+              f"stall={delay_s * 1e3:.0f}ms x12 batches on replica 0)")
     return rec
 
 
@@ -224,28 +382,35 @@ def autoscale_run(args, tobs, pool):
     pol = AutoscalePolicy(min_replicas=1, max_replicas=4,
                           spawn_depth=1.5, spawn_sustain_s=1.0,
                           reap_idle_s=3.0, cooldown_s=2.0)
-    router = _router(args, 1, autoscale=pol)
-    try:
-        router.start(warm_timeout_s=900.0)
-        low = _run_load(args, router, pool, args.rate_per_replica * 0.5,
-                        max(4.0, args.duration / 2))
-        # the step must OVERRUN one replica, not merely busy it: 8x the
-        # per-replica operating point keeps depth/replica past
-        # spawn_depth for the sustain window
-        high = _run_load(args, router, pool, args.rate_per_replica * 8,
-                         max(6.0, args.duration))
-        peak = router.replicas_alive()
-        deadline = time.monotonic() + 30.0
-        while (router.replicas_alive() > pol.min_replicas
-               and time.monotonic() < deadline):
-            time.sleep(0.1)
-        st = router.stats()
-    finally:
-        router.stop(timeout=20.0)
+    pdir = _phase_dir(args, "autoscale")
+    with _phase_obs(pdir):
+        router = _router(args, 1, autoscale=pol, metrics_dir=pdir,
+                         slo=_slo(args))
+        try:
+            router.start(warm_timeout_s=900.0)
+            low = _run_load(args, router, pool,
+                            args.rate_per_replica * 0.5,
+                            max(4.0, args.duration / 2))
+            # the step must OVERRUN one replica, not merely busy it: 8x
+            # the per-replica operating point keeps depth/replica past
+            # spawn_depth for the sustain window
+            high = _run_load(args, router, pool,
+                             args.rate_per_replica * 8,
+                             max(6.0, args.duration))
+            peak = router.replicas_alive()
+            deadline = time.monotonic() + 30.0
+            while (router.replicas_alive() > pol.min_replicas
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+            st = router.stats()
+        finally:
+            router.stop(timeout=20.0)
     rec = {"low": low, "high": high, "policy": pol.__dict__,
            "scale_ups": st["scale_ups"], "scale_downs": st["scale_downs"],
            "peak_replicas": peak,
            "replicas_after_drain": st["replicas_alive"]}
+    if pdir is not None:
+        rec["trace"] = _trace_digest(pdir)
     tobs.echo(f"autoscale: ups={st['scale_ups']} "
               f"downs={st['scale_downs']} peak={peak} "
               f"drained_to={st['replicas_alive']}")
@@ -278,6 +443,7 @@ def main(argv=None):
         "rate_per_replica": args.rate_per_replica,
         "duration_s": args.duration,
         "host_cores": len(os.sched_getaffinity(0)),
+        "trace_dir": args.trace_dir,
         "scaling": [],
     }
     for n, h in parse_points(args.replicas):
@@ -286,6 +452,8 @@ def main(argv=None):
         record["kill"] = kill_run(args, tobs, pool)
     if args.autoscale:
         record["autoscale"] = autoscale_run(args, tobs, pool)
+    if args.slowdown:
+        record["slowdown"] = slowdown_run(args, tobs, pool)
     record["wall_s"] = round(time.time() - t_start, 3)
     obs.flush_counters()
     tobs.close()

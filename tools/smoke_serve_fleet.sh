@@ -17,9 +17,18 @@
 # per-reason shed counts sum to the shed total, and shed + failed +
 # completed == submitted (sheds and deadline misses are DISJOINT).
 #
+# Phase 2 also runs with --trace-dir, so it checks the distributed-
+# tracing chain: >=99% of the warm load phase's completed requests must
+# reconstruct a COMPLETE cross-process span tree (router dispatch ->
+# replica admit -> replica serve -> router result, clock-skew
+# corrected), and the SIGKILL must leave a flushed parent-side
+# blackbox_replica*.jsonl crash dump in the kill phase's trace dir.
+#
 # Then tools/obs_report.py over the fleet RunLog must render the
 # fleet-SLO section (per-replica p50/p99, dispatch balance, replica
-# lifecycle).
+# lifecycle), and over the phase TRACE DIRECTORY must render the
+# critical-path section (per-replica queue/ipc/solve/total percentile
+# breakdown from the merged timeline).
 #
 # The scale-out companion of smoke_serve.sh; the cold export build
 # dominates (~2-4 min on CPU), the warm fleet phase is seconds.
@@ -66,7 +75,8 @@ print("[smoke_serve_fleet] cold OK:", s["completed"], "jobs through",
 EOF
 
 echo "[smoke_serve_fleet] phase 2: WARM 2-replica fleet + kill" >&2
-fleet "$RUN_WARM" --replicas 2 --kill
+TRACES="$WORK/traces"
+fleet "$RUN_WARM" --replicas 2 --kill --trace-dir "$TRACES"
 
 python - "$OUT" <<'EOF'
 import json
@@ -106,14 +116,35 @@ assert ks["completed"] == ks["submitted"] and ks["shed"] == 0, ks
 assert k["replica_restarts"] >= 1, k
 assert k["replicas_alive_after"] == 2, k
 assert k["recover_s"] is not None and k["recover_s"] < 30, k
+
+# 5. distributed tracing stitched across processes: >=99% of the warm
+#    load phase's completed requests rebuilt a full cross-process span
+#    tree from the merged per-process streams
+tr = pt["trace"]
+assert tr is not None and tr["procs"] >= 3, tr   # router + 2 replicas
+comp = tr["completeness"]
+assert comp["n_completed"] > 0, comp
+assert comp["fraction"] >= 0.99, \
+    f"trace stitching below the 99% bar: {comp}"
+
+# 6. the SIGKILLed replica left a crash flight record: the router's
+#    parent-side frame ring dumped a blackbox (the worker itself
+#    cannot flush through a SIGKILL)
+assert k.get("blackbox_files"), \
+    f"kill phase left no blackbox dump: {k.get('blackbox_files')}"
 print("[smoke_serve_fleet] warm fleet OK:", pt["summary"]["completed"],
       "jobs, fleet steady compiles 0; kill:", ks["completed"], "/",
-      ks["submitted"], "completed, recover", k["recover_s"], "s")
+      ks["submitted"], "completed, recover", k["recover_s"], "s;",
+      "traces", comp["n_complete_trees"], "/", comp["n_completed"],
+      "complete, blackboxes", k["blackbox_files"])
 EOF
 
-echo "[smoke_serve_fleet] aggregating the fleet RunLog with obs_report" >&2
-REPORT="$WORK/report.txt"
-python tools/obs_report.py "$RUN_WARM" > "$REPORT"
+# With --trace-dir the router stream is shadowed into the phase dir
+# (next to the replica streams it merges with), so the fleet sections
+# render from the per-phase directories, not the --metrics RunLog.
+echo "[smoke_serve_fleet] fleet SLO + critical path from the warm phase dir" >&2
+REPORT="$WORK/report_traces.txt"
+python tools/obs_report.py "$TRACES/scale2x1" > "$REPORT"
 grep -q "fleet SLO" "$REPORT" || {
     echo "[smoke_serve_fleet] FAIL: no fleet-SLO section in obs_report" >&2
     exit 1
@@ -122,8 +153,45 @@ grep -q "replica 0:" "$REPORT" || {
     echo "[smoke_serve_fleet] FAIL: no per-replica latency line" >&2
     exit 1
 }
-grep -q "replica downs=" "$REPORT" || {
+grep -q "critical path" "$REPORT" || {
+    echo "[smoke_serve_fleet] FAIL: no critical-path section" >&2
+    exit 1
+}
+grep -q "trace completeness" "$REPORT" || {
+    echo "[smoke_serve_fleet] FAIL: no trace-completeness line" >&2
+    exit 1
+}
+
+echo "[smoke_serve_fleet] replica lifecycle from the kill phase dir" >&2
+KILLREPORT="$WORK/report_kill.txt"
+python tools/obs_report.py "$TRACES/kill" > "$KILLREPORT"
+grep -q "replica downs=" "$KILLREPORT" || {
     echo "[smoke_serve_fleet] FAIL: no replica-lifecycle line" >&2
     exit 1
 }
+echo "[smoke_serve_fleet] trace-overhead bench (armed vs disarmed)" >&2
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" JAX_PLATFORMS=cpu \
+    BENCH_TRACE_OVH_DURATION_S="${BENCH_TRACE_OVH_DURATION_S:-4}" \
+    python - "$WORK/trace_overhead.json" <<'EOF'
+import sys
+
+import bench
+
+out = bench.bench_trace_overhead(out_path=sys.argv[1])
+arms = out["results"]
+dis, arm = arms["disarmed"], arms["armed"]
+# the tracing tax must be within run-to-run noise: the armed fleet
+# keeps the disarmed throughput (generous 15% band for a loaded CI
+# host) and does not grow the tail by more than scheduling jitter
+assert out["value"] is not None and abs(out["value"]) <= 0.15, out
+assert arm["p99_s"] <= dis["p99_s"] + 0.05, (arm, dis)
+# and the armed arm's own streams must stitch: completeness >= 99%
+comp = arm["trace_completeness"]
+assert comp["n_completed"] > 0 and comp["fraction"] >= 0.99, comp
+print("[smoke_serve_fleet] trace overhead OK: delta",
+      f"{out['value'] * 100:+.2f}% jobs/s, p99",
+      f"{dis['p99_s']}s -> {arm['p99_s']}s,",
+      f"stitch {comp['fraction'] * 100:.1f}%")
+EOF
+
 echo "[smoke_serve_fleet] PASS (workdir $WORK)" >&2

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Export a merged fleet run as a Chrome/Perfetto ``trace_event`` JSON.
+
+Input: a fleet-run DIRECTORY of per-process RunLog streams (the
+``--metrics-dir`` tree of tools/serve_fleet.py) or a single JSONL
+stream.  The per-process streams are merged onto the router's clock via
+the ``clock_offset`` handshake (smartcal_tpu/obs/collect.py), then:
+
+* every ``span`` event becomes a complete slice (``ph: "X"``) on its
+  process/thread track — span events record at EXIT, so the slice
+  starts at ``t_corr - dur_s``;
+* request lifecycle events (``fleet_dispatch`` / ``serve_admit`` /
+  ``serve_request`` / ``fleet_result`` / ``serve_shed`` /
+  ``ipc_corrupt_payload``) become instants (``ph: "i"``), and each
+  traced request additionally gets a FLOW (``ph: "s"/"t"/"f"``, one id
+  per trace) so the cross-process hop router -> replica -> router is
+  drawn as an arrow in the UI;
+* detector/recorder events (``slo_burn``, ``blackbox_flush``,
+  ``watchdog_trip``, ``fault_injected``) become process-scoped
+  instants — the incident markers on the timeline.
+
+Open the output at ``ui.perfetto.dev`` or ``chrome://tracing``.
+
+Usage:
+    python tools/trace_export.py <fleet-dir | run.jsonl> [-o trace.json]
+
+stdlib only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _collect_mod():
+    try:
+        from smartcal_tpu.obs import collect
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from smartcal_tpu.obs import collect
+    return collect
+
+
+# point events worth a timeline instant, with their display category
+_INSTANTS = {
+    "fleet_dispatch": "request",
+    "serve_admit": "request",
+    "serve_request": "request",
+    "fleet_result": "request",
+    "serve_shed": "incident",
+    "fleet_reclaim": "incident",
+    "ipc_corrupt_payload": "incident",
+    "fleet_replica_down": "incident",
+    "fleet_replica_failed": "incident",
+    "fleet_replica_restart": "incident",
+    "slo_burn": "detector",
+    "blackbox_flush": "detector",
+    "watchdog_trip": "detector",
+    "fault_injected": "detector",
+    "clock_offset": "detector",
+}
+
+# the request-flow phase each lifecycle event plays: s(tart) at the
+# router's dispatch, t (step) at replica-side hops, f(inish) back at
+# the router
+_FLOW_PHASE = {"fleet_dispatch": "s", "serve_admit": "t",
+               "serve_request": "t", "fleet_result": "f"}
+
+_SKIP_ARG_KEYS = frozenset({"t", "t_corr", "proc", "event", "name",
+                            "path", "dur_s", "thread"})
+
+
+def load_events(path):
+    """Merged, proc-tagged events from a directory or a single stream."""
+    collect = _collect_mod()
+    if os.path.isdir(path):
+        return collect.merge_directory(path)
+    proc, events, _bad = collect.read_stream([path])
+    merger = collect.TimelineMerger()
+    merger.add_stream(proc, events)
+    return merger.merge()
+
+
+def to_trace_events(events):
+    """The ``traceEvents`` list (Chrome trace_event format)."""
+    pids = {}
+    tids = {}
+    out = []
+
+    def pid_of(proc):
+        if proc not in pids:
+            pids[proc] = len(pids) + 1
+            out.append({"ph": "M", "name": "process_name",
+                        "pid": pids[proc], "tid": 0,
+                        "args": {"name": proc}})
+        return pids[proc]
+
+    def tid_of(proc, thread):
+        key = (proc, thread)
+        if key not in tids:
+            tids[key] = len(tids) + 1
+            out.append({"ph": "M", "name": "thread_name",
+                        "pid": pid_of(proc), "tid": tids[key],
+                        "args": {"name": thread}})
+        return tids[key]
+
+    t0 = min((e["t_corr"] for e in events if "t_corr" in e),
+             default=0.0)
+
+    def us(t):
+        return round((float(t) - t0) * 1e6, 1)
+
+    def args_of(e):
+        return {k: v for k, v in e.items()
+                if k not in _SKIP_ARG_KEYS and v is not None}
+
+    for e in events:
+        proc = str(e.get("proc", "?"))
+        kind = e.get("event")
+        t = e.get("t_corr", e.get("t"))
+        if t is None:
+            continue
+        if kind == "span":
+            dur = float(e.get("dur_s") or 0.0)
+            out.append({"ph": "X", "name": str(e.get("name", "span")),
+                        "cat": "span", "ts": us(float(t) - dur),
+                        "dur": round(dur * 1e6, 1),
+                        "pid": pid_of(proc),
+                        "tid": tid_of(proc, str(e.get("thread", "main"))),
+                        "args": args_of(e)})
+        elif kind in _INSTANTS:
+            rec = {"ph": "i", "name": str(kind),
+                   "cat": _INSTANTS[kind], "ts": us(t), "s": "p",
+                   "pid": pid_of(proc), "tid": 0, "args": args_of(e)}
+            out.append(rec)
+            tid_str = str(e.get("trace") or "")
+            phase = _FLOW_PHASE.get(str(kind))
+            if phase and tid_str:
+                flow = {"ph": phase, "name": "request",
+                        "cat": "request-flow",
+                        "id": tid_str[:16], "ts": us(t),
+                        "pid": pid_of(proc), "tid": 0}
+                if phase == "f":
+                    flow["bp"] = "e"
+                out.append(flow)
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("path", help="fleet-run directory or one run JSONL")
+    p.add_argument("-o", "--out", default="trace.json",
+                   help="output trace_event JSON path")
+    args = p.parse_args(argv)
+
+    events = load_events(args.path)
+    trace = to_trace_events(events)
+    doc = {"traceEvents": trace, "displayTimeUnit": "ms"}
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh)
+    n_spans = sum(1 for e in trace if e.get("ph") == "X")
+    n_flows = sum(1 for e in trace if e.get("cat") == "request-flow")
+    print(f"wrote {args.out}: {len(trace)} trace events "
+          f"({n_spans} slices, {n_flows} flow points) from "
+          f"{len(events)} run events")
+    return doc
+
+
+if __name__ == "__main__":
+    main()
