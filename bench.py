@@ -55,6 +55,35 @@ _CACHE_DIR = os.environ.get("SMARTCAL_COMPILE_CACHE_DIR",
 _CACHE_WAS_WARM = bool(os.path.isdir(_CACHE_DIR) and os.listdir(_CACHE_DIR))
 enable_compilation_cache(_CACHE_DIR)
 
+def _stamp_fingerprint(payload):
+    """Stamp the full host fingerprint (nproc/platform/jax versions/
+    dtype policy — obs/baselines.py) into a bench payload in place.
+
+    PR 16 recorded only ``host_cores`` and only in one artifact; the
+    2026-08-07 tier-1 budget incident (24-core numbers silently
+    compared on a 1-core container) is why EVERY artifact now carries
+    the identity it is only comparable within.  Idempotent: an extra
+    that already stamped itself is left alone."""
+    if isinstance(payload, dict) and "host_fingerprint" not in payload:
+        from smartcal_tpu.obs import baselines as _bl
+        fp = _bl.host_fingerprint()
+        payload["host_fingerprint"] = fp
+        payload["host_fingerprint_digest"] = _bl.fingerprint_digest(fp)
+    return payload
+
+
+def _write_results_artifact(payload, out_path):
+    """The shared bench artifact writer: fingerprint-stamp, then write.
+    Every ``results/`` JSON produced by a bench extra must go through
+    here (or stamp itself) so no future artifact can be compared
+    cross-host unknowingly."""
+    _stamp_fingerprint(payload)
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+    return payload
+
+
 STEPS_PER_EPISODE = 5
 # per_episode_dispatch extra only (the rounds-1/2/3 primary): 100 timed
 # env steps, matching the tools/measure_reference.py torch measurement.
@@ -919,10 +948,7 @@ def bench_nscale(ns=(62, 128, 256), out_path=None, batch_lanes=2):
                 "null on CPU (no validated peak row) by design — the "
                 "protocol fills it on a chip window.",
     }
-    if out_path:
-        with open(out_path, "w") as fh:
-            json.dump(out, fh, indent=1)
-    return out
+    return _write_results_artifact(out, out_path)
 
 
 def jnp_freqs(ep):
@@ -1151,10 +1177,7 @@ def bench_mesh_compose(ns=(62, 256), lanes=2, out_path=None):
                                "results")
         if os.path.isdir(res_dir):
             out_path = os.path.join(res_dir, "mesh_compose_r16.json")
-    if out_path:
-        with open(out_path, "w") as fh:
-            json.dump(out, fh, indent=1)
-    return out
+    return _write_results_artifact(out, out_path)
 
 
 def _trace_overhead_measure(duration_s=6.0, rate=40.0, service_s=0.005,
@@ -1270,10 +1293,120 @@ def bench_trace_overhead(duration_s=None, out_path=None):
                                "results")
         if os.path.isdir(res_dir):
             out_path = os.path.join(res_dir, "trace_overhead_r17.json")
-    if out_path:
-        with open(out_path, "w") as fh:
-            json.dump(out, fh, indent=1)
-    return out
+    return _write_results_artifact(out, out_path)
+
+
+def _sentinel_overhead_measure(duration_s=6.0, rate=5.0):
+    """Child-process worker for :func:`bench_sentinel_overhead`: one
+    warmed tiny CalibServer per arm under IDENTICAL open-loop load,
+    numerics sentinel off vs sampling every batch.  The sequential
+    parity oracle is pre-warmed in BOTH arms so the comparison measures
+    steady-state sentinel cost, not a one-time compile."""
+    import tempfile
+
+    from smartcal_tpu import obs as _obs
+    from smartcal_tpu.envs.radio import RadioBackend
+    from smartcal_tpu.serve import CalibServer
+    from smartcal_tpu.serve.loadgen import (SERVE_TIERS, OpenLoopLoadGen,
+                                            build_job_pool)
+
+    M, lanes = 3, 3
+    be = RadioBackend(**SERVE_TIERS["tiny"])
+    pool = build_job_pool(be, M, 6, seed=5)
+    cache = tempfile.mkdtemp(prefix="sentinel_ovh_cache_")
+    arms = {}
+    for arm, every in (("off", 0), ("on", 1)):
+        rl_path = os.path.join(tempfile.mkdtemp(prefix="sentinel_ovh_"),
+                               f"{arm}.jsonl")
+        rl = _obs.RunLog(rl_path, run_id=f"sentinel-{arm}",
+                         flush_lines=64)
+        _obs.activate(rl)
+        srv = CalibServer(be, M=M, lanes=lanes, cache_dir=cache,
+                          compile_cache=True, max_wait_s=0.02,
+                          sentinel_every=every)
+        srv.warmup(seed=7)
+        k0, ep0 = pool[0]
+        srv._oracle_result(ep0, np.ones(M, np.float32),
+                           np.ones(M, np.float32),
+                           np.zeros(M, np.float32),
+                           SERVE_TIERS["tiny"]["admm_iters"])
+        srv.start()
+        summary = OpenLoopLoadGen(srv, pool, rate=rate,
+                                  duration_s=duration_s, seed=3).run()
+        srv.stop()
+        stats = srv.stats()
+        while _obs.active() is not None:
+            _obs.deactivate()
+        n_drift_events = 0
+        with open(rl_path) as fh:
+            for line in fh:
+                if '"numerics_drift"' in line:
+                    n_drift_events += 1
+        arms[arm] = {"jobs_s": summary.get("achieved_jobs_s"),
+                     "p99_s": summary.get("latency_p99_s"),
+                     "completed": summary.get("completed"),
+                     "shed": summary.get("shed"),
+                     "sentinel": stats.get("sentinel"),
+                     "numerics_drift_events": n_drift_events}
+    return arms
+
+
+def bench_sentinel_overhead(duration_s=None, out_path=None):
+    """Numerics-sentinel tax on serving (ISSUE 19): sustained jobs/s +
+    p99 of a warmed tiny CalibServer with the sentinel sampling EVERY
+    batch vs disabled, both arms offered the same open-loop load — the
+    trace_overhead protocol applied to the parity-oracle replays.  The
+    claim under test is that the replay (breaker thread, off the hot
+    path) leaves throughput and tail within run-to-run noise.
+
+    Runs in a child process pinned to JAX_PLATFORMS=cpu (same isolation
+    rationale as bench_trace_overhead); the payload also lands in
+    ``results/sentinel_overhead_r18.json`` (or ``out_path``).
+    """
+    import tempfile
+
+    if duration_s is None:
+        try:
+            duration_s = float(os.environ.get(
+                "BENCH_SENTINEL_OVH_DURATION_S", "6"))
+        except ValueError:
+            duration_s = 6.0
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as fh:
+        tmp = fh.name
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    code = ("import json, bench\n"
+            f"arms = bench._sentinel_overhead_measure({float(duration_s)!r})\n"
+            f"json.dump(arms, open({tmp!r}, 'w'))\n")
+    subprocess.run([sys.executable, "-c", code], check=True, env=env,
+                   cwd=os.path.dirname(os.path.abspath(__file__)))
+    with open(tmp) as fh:
+        arms = json.load(fh)
+    os.unlink(tmp)
+    off, on = arms["off"], arms["on"]
+    delta = None
+    if off.get("jobs_s") and on.get("jobs_s"):
+        delta = round((on["jobs_s"] - off["jobs_s"]) / off["jobs_s"], 4)
+    out = {
+        "metric": "sentinel_overhead",
+        "value": delta,
+        "unit": "relative jobs/s delta, sentinel on vs off (0 = free)",
+        "vs_baseline": None,
+        "platform": "cpu (tiny CalibServer, child process)",
+        "duration_s_per_arm": duration_s,
+        "results": arms,
+        "note": "sentinel_every=1 (every batch sampled) is the WORST "
+                "case — production would sample sparsely.  The replay "
+                "runs on the breaker thread; on a 1-core host it still "
+                "competes for the CPU, which is exactly the cost being "
+                "measured.",
+    }
+    if out_path is None:
+        res_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "results")
+        if os.path.isdir(res_dir):
+            out_path = os.path.join(res_dir, "sentinel_overhead_r18.json")
+    return _write_results_artifact(out, out_path)
 
 
 def bench_actor_scaling(arms=None, episodes=16, out_path=None,
@@ -1381,10 +1514,7 @@ def bench_actor_scaling(arms=None, episodes=16, out_path=None,
                 "over 2 simulated hosts on this machine — a topology "
                 "rehearsal, not a second physical host",
     }
-    if out_path:
-        with open(out_path, "w") as fh:
-            json.dump(out, fh, indent=1)
-    return out
+    return _write_results_artifact(out, out_path)
 
 
 def main():
@@ -1564,7 +1694,8 @@ def _measured_main():
                   (bench_actor_scaling, "actor_scaling"),
                   (bench_nscale, "nscale"),
                   (bench_mesh_compose, "mesh_compose"),
-                  (bench_trace_overhead, "trace_overhead")]
+                  (bench_trace_overhead, "trace_overhead"),
+                  (bench_sentinel_overhead, "sentinel_overhead")]
         if os.environ.get("BENCH_SKIP_CALIB"):
             out["extra"].append({"metric": "calib_episode_wall_clock",
                                  "skipped": "BENCH_SKIP_CALIB=1"})
@@ -1604,10 +1735,13 @@ def _measured_main():
                                                 f"({extras_budget:.0f}s) spent"})
                 continue
             try:
-                out["extra"].append(fn())
+                # every extra payload carries the host fingerprint (the
+                # shared-builder backfill: see _stamp_fingerprint)
+                out["extra"].append(_stamp_fingerprint(fn()))
             except Exception as e:  # noqa: BLE001 — report, don't drop
                 out["extra"].append({"metric": name,
                                      "error": f"{type(e).__name__}: {e}"})
+    _stamp_fingerprint(out)
     print(json.dumps(out))
 
 

@@ -67,12 +67,16 @@ SHARED_FIELD_SPECS = [
     {
         "path": "smartcal_tpu/serve/server.py",
         "class": "CalibServer",
-        "fields": ["_programs", "_circuit_open", "_stats"],
+        "fields": ["_programs", "_circuit_open", "_stats",
+                   "_sentinel_pending", "_sentinel_stats"],
         "locks": ["_lock"],
         "why": "latest-executable table swapped by warmup while the "
                "batch worker reads it per batch; breaker flag written "
                "by the supervisor thread and read on every submit; "
-               "stats written by worker + breaker, read by stats()",
+               "stats written by worker + breaker, read by stats(); "
+               "the numerics-sentinel snapshot is handed off "
+               "latest-wins from the batch worker to the supervisor's "
+               "sentinel_poll and its counters are read by stats()",
     },
     {
         "path": "smartcal_tpu/serve/router.py",
@@ -128,6 +132,16 @@ SHARED_FIELD_SPECS = [
                "supervision thread prunes + evaluates them and "
                "snapshot() reads from anywhere — racing the deque "
                "prune corrupts the percentile windows",
+    },
+    {
+        "path": "smartcal_tpu/obs/baselines.py",
+        "class": "BaselineStore",
+        "fields": ["_doc", "_dirty"],
+        "locks": ["_lock"],
+        "why": "the perf-baseline document is read by every gate/test "
+               "thread (get) while record()/save() rewrite entries and "
+               "the dirty flag — a torn swap can bless a half-written "
+               "baseline or drop a recorded stage",
     },
     {
         "path": "smartcal_tpu/obs/collect.py",

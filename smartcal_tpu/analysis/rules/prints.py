@@ -42,6 +42,8 @@ TOOLS_STDOUT_ALLOWLIST = frozenset({
     "measure_reference.py",
     "obs_report.py",
     "obs_tail.py",
+    "perf_gate.py",
+    "results_index.py",
     "serve_calib.py",
     "serve_fleet.py",
     "summarize_demix_curves.py",

@@ -17,7 +17,10 @@ with ``tools/obs_report.py``.  The package imports neither jax nor numpy
 never initialize (or wedge) an accelerator backend.
 """
 
-from . import collect, flightrec, slo, tracectx            # noqa: F401
+from . import (baselines, collect, flightrec, regress,    # noqa: F401
+               slo, tracectx)
+from .baselines import (BF16_REL_BAND, BaselineStore,      # noqa: F401
+                        host_fingerprint)
 from .console import echo, emit_json                       # noqa: F401
 from .costs import (device_peak, log_roofline_peak,        # noqa: F401
                     record_stage_cost, stage_cost)

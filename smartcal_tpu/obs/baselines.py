@@ -1,0 +1,279 @@
+"""Host-fingerprinted performance/numerics baseline store.
+
+The repo's headline numbers (63x influence, 12.7x peak-memory, 14.1x
+warm restart, 8.7x fleet scale-out) were all one-shot r-stamped
+artifacts with nothing watching them afterwards — and the 2026-08-07
+tier-1 budget incident (24-core numbers silently compared on a 1-core
+container) showed cross-host comparisons already bite.  This module is
+the *store* half of the regression radar: a schema'd JSON document of
+per-stage baselines, each keyed on
+
+    stage | statics digest | host fingerprint digest
+
+so a measurement recorded on one host/shape/config can never be
+compared against a measurement from another BY CONSTRUCTION — a lookup
+with a different fingerprint simply finds no baseline (and the
+comparison layer, :mod:`smartcal_tpu.obs.regress`, additionally refuses
+explicit cross-fingerprint compares).
+
+Each entry carries a per-metric noise model: *sampled* metrics (wall
+time) store the K raw samples plus mean/std/cv so the detector can
+bootstrap a confidence interval over the ratio; *deterministic* metrics
+(peak bytes, flops, compile counts, numeric scalars) store a single
+value.  Writes are atomic (``runtime/atomic.py``) and the record path
+mirrors graftlint's ``--update-baseline`` workflow: measure, then
+re-run with the flag to bless the new numbers.
+
+Stdlib only, like the rest of the obs package — jax/jaxlib versions
+are read lazily from ``sys.modules`` so importing this can never
+initialize a backend.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import platform as _platform
+import statistics
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+#: Documented bf16 relative-error band for the mixed-precision kernels
+#: (cal/precision.py; asserted by the tier-1 parity tests since PR 13).
+#: Numeric sentinel verdicts and the perf gate's drift metrics compare
+#: against this unless a caller narrows it.
+BF16_REL_BAND = 2e-2
+
+
+def _nproc() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
+def _lazy_version(modname: str) -> Optional[str]:
+    """Version of an ALREADY-IMPORTED module (obs contract: never
+    trigger a jax import from the observability layer)."""
+    mod = sys.modules.get(modname)
+    if mod is None:
+        return None
+    return getattr(mod, "__version__", None)
+
+
+def host_fingerprint() -> Dict[str, object]:
+    """The identity a measurement is only comparable within.
+
+    nproc is the *effective* core count (sched_getaffinity — a 24-core
+    box running the gate in a 1-core cgroup fingerprints as 1 core,
+    which is exactly the distinction the 2026-08-07 incident needed).
+    jax/jaxlib versions come from sys.modules when loaded, else from
+    importlib.metadata — either way without importing jax here.
+    """
+    jax_v = _lazy_version("jax")
+    jaxlib_v = _lazy_version("jaxlib")
+    if jax_v is None or jaxlib_v is None:
+        try:
+            from importlib import metadata
+            jax_v = jax_v or metadata.version("jax")
+            jaxlib_v = jaxlib_v or metadata.version("jaxlib")
+        except Exception:
+            pass
+    x64 = os.environ.get("JAX_ENABLE_X64", "").lower() in ("1", "true")
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            x64 = bool(jax_mod.config.jax_enable_x64)
+        except Exception:
+            pass
+    return {
+        "nproc": _nproc(),
+        "platform": _platform.system().lower(),
+        "machine": _platform.machine(),
+        "python": _platform.python_version(),
+        "jax": jax_v,
+        "jaxlib": jaxlib_v,
+        "dtype_policy": {"x64": x64, "bf16_rel_band": BF16_REL_BAND},
+    }
+
+
+def _digest(obj: object) -> str:
+    blob = json.dumps(obj, sort_keys=True, separators=(",", ":"),
+                      default=str)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:12]
+
+
+def fingerprint_digest(fp: Dict[str, object]) -> str:
+    return _digest(fp)
+
+
+def statics_digest(statics: Dict[str, object]) -> str:
+    return _digest(statics)
+
+
+def baseline_key(stage: str, statics: Dict[str, object],
+                 fp: Dict[str, object]) -> str:
+    return f"{stage}|{statics_digest(statics)}|{fingerprint_digest(fp)}"
+
+
+def summarize_samples(samples: List[float]) -> Dict[str, object]:
+    """Noise model for a sampled metric: the raw K samples plus
+    mean/std/cv (population std — the samples ARE the distribution the
+    detector resamples from, not a subsample of something larger)."""
+    xs = [float(x) for x in samples]
+    if not xs:
+        raise ValueError("summarize_samples: need at least one sample")
+    mean = statistics.fmean(xs)
+    std = statistics.pstdev(xs) if len(xs) > 1 else 0.0
+    return {
+        "kind": "samples",
+        "samples": xs,
+        "n": len(xs),
+        "mean": mean,
+        "std": std,
+        "cv": (std / mean) if mean else 0.0,
+    }
+
+
+def scalar_metric(value: float) -> Dict[str, object]:
+    return {"kind": "scalar", "value": float(value)}
+
+
+class BaselineSchemaError(ValueError):
+    """The on-disk baseline document doesn't match the schema — the
+    store refuses to silently compare against garbage."""
+
+
+class BaselineStore:
+    """Load/record/save interface over one baseline JSON document.
+
+    The in-memory document cache and dirty flag are shared between the
+    recording caller and any concurrent reader (the serving sentinel
+    polls baselines from the supervisor thread while a gate run
+    records), so every access goes through ``_lock`` — the fields are
+    registered in graftlint's SHARED_FIELD_SPECS.
+    """
+
+    def __init__(self, path: str) -> None:
+        self._path = os.fspath(path)
+        self._lock = threading.Lock()
+        self._doc: Optional[Dict[str, object]] = None
+        self._dirty = False
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    # -- document lifecycle -------------------------------------------
+
+    def _load_locked(self) -> Dict[str, object]:
+        if self._doc is not None:
+            return self._doc
+        if not os.path.exists(self._path):
+            self._doc = {"schema": SCHEMA_VERSION, "entries": {}}
+            return self._doc
+        try:
+            with open(self._path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            raise BaselineSchemaError(
+                f"baseline store {self._path!r} unreadable ({e!r}) — "
+                "delete it or restore from git, then re-record with "
+                "--update-baseline") from e
+        self._validate(doc)
+        self._doc = doc
+        return doc
+
+    @staticmethod
+    def _validate(doc: object) -> None:
+        if not isinstance(doc, dict) or not isinstance(
+                doc.get("entries"), dict):
+            raise BaselineSchemaError(
+                "baseline document must be {schema, entries:{...}}")
+        if doc.get("schema") != SCHEMA_VERSION:
+            raise BaselineSchemaError(
+                f"baseline schema {doc.get('schema')!r} != "
+                f"{SCHEMA_VERSION} — re-record with --update-baseline")
+        for key, ent in doc["entries"].items():
+            for field in ("stage", "statics", "fingerprint", "metrics"):
+                if field not in ent:
+                    raise BaselineSchemaError(
+                        f"baseline entry {key!r} missing {field!r}")
+            for mname, m in ent["metrics"].items():
+                kind = m.get("kind")
+                if kind == "samples":
+                    if not m.get("samples"):
+                        raise BaselineSchemaError(
+                            f"{key}:{mname} sampled metric has no "
+                            "samples")
+                elif kind == "scalar":
+                    if "value" not in m:
+                        raise BaselineSchemaError(
+                            f"{key}:{mname} scalar metric has no value")
+                else:
+                    raise BaselineSchemaError(
+                        f"{key}:{mname} unknown metric kind {kind!r}")
+
+    # -- lookup / record ----------------------------------------------
+
+    def get(self, stage: str, statics: Dict[str, object],
+            fp: Dict[str, object]) -> Optional[Dict[str, object]]:
+        """The baseline entry for exactly this (stage, statics, host)
+        — None when this host/shape has never been blessed.  A
+        different fingerprint CANNOT return another host's entry: the
+        fingerprint digest is part of the key."""
+        key = baseline_key(stage, statics, fp)
+        with self._lock:
+            doc = self._load_locked()
+            ent = doc["entries"].get(key)
+            return json.loads(json.dumps(ent)) if ent else None
+
+    def record(self, stage: str, statics: Dict[str, object],
+               fp: Dict[str, object],
+               metrics: Dict[str, Dict[str, object]]) -> Dict[str, object]:
+        """Bless new numbers for (stage, statics, host), replacing any
+        prior entry under the same key (the --update-baseline path)."""
+        for mname, m in metrics.items():
+            if m.get("kind") not in ("samples", "scalar"):
+                raise BaselineSchemaError(
+                    f"metric {mname!r}: build it with summarize_samples"
+                    "() or scalar_metric()")
+        entry = {
+            "stage": stage,
+            "statics": dict(statics),
+            "statics_digest": statics_digest(statics),
+            "fingerprint": dict(fp),
+            "fingerprint_digest": fingerprint_digest(fp),
+            "recorded_unix": time.time(),
+            "metrics": metrics,
+        }
+        key = baseline_key(stage, statics, fp)
+        with self._lock:
+            doc = self._load_locked()
+            doc["entries"][key] = entry
+            self._dirty = True
+        return entry
+
+    def entries(self) -> List[Dict[str, object]]:
+        with self._lock:
+            doc = self._load_locked()
+            return [json.loads(json.dumps(e))
+                    for e in doc["entries"].values()]
+
+    def save(self) -> bool:
+        """Atomically persist if dirty; returns whether a write
+        happened (readers concurrently see old-or-new, never a torn
+        prefix — runtime/atomic.py)."""
+        from smartcal_tpu.runtime.atomic import atomic_write_text
+        with self._lock:
+            if not self._dirty or self._doc is None:
+                return False
+            text = json.dumps(self._doc, indent=1, sort_keys=True)
+            self._dirty = False
+        atomic_write_text(self._path, text + "\n")
+        return True

@@ -21,6 +21,14 @@ runs are reproducible and a post-recovery retry does NOT re-fire:
   single-shot).  A span > 1 makes the slowdown SUSTAINED — what the
   SLO burn-rate detector needs to see before it may fire (a one-batch
   blip must not trip a multi-window alarm).
+* ``perturb_stage``/``perturb_at``/``perturb_rel``/``perturb_span`` —
+  multiply the scalar passed through :func:`maybe_perturb` at the
+  named stage by ``(1 + perturb_rel)`` for every index in
+  ``[perturb_at, perturb_at + perturb_span)``.  This is the NUMERIC
+  twin of the delay hook: the regression radar (tools/perf_gate.py)
+  and the serving numerics sentinel route their measured values
+  through it, so an out-of-band numeric drift can be rehearsed
+  end-to-end without editing a kernel.
 
 Each firing is recorded once as a ``fault_injected`` RunLog event (when
 a run is recording).  With no plan installed every hook is one ``None``
@@ -51,6 +59,10 @@ class FaultPlan:
     delay_at: Optional[int] = None
     delay_s: float = 0.0
     delay_span: int = 1
+    perturb_stage: Optional[str] = None
+    perturb_at: Optional[int] = None
+    perturb_rel: float = 0.0
+    perturb_span: int = 1
 
 
 _plan: Optional[FaultPlan] = None
@@ -149,3 +161,17 @@ def maybe_delay(stage: str, index: int) -> float:
     _record("delay", stage=stage, index=index, delay_s=p.delay_s)
     time.sleep(p.delay_s)
     return p.delay_s
+
+
+def maybe_perturb(stage: str, index: int, value: float) -> float:
+    """Multiply ``value`` by ``(1 + perturb_rel)`` when (stage, index)
+    falls inside the plan's perturb window; identity otherwise.  Each
+    firing index records its own ``fault_injected`` event."""
+    p = _plan
+    if (p is None or p.perturb_stage != stage or p.perturb_at is None
+            or p.perturb_rel == 0.0):
+        return value
+    if not p.perturb_at <= index < p.perturb_at + max(1, int(p.perturb_span)):
+        return value
+    _record("perturb", stage=stage, index=index, rel=p.perturb_rel)
+    return value * (1.0 + p.perturb_rel)

@@ -30,6 +30,16 @@ Telemetry is the obs stack verbatim: spans ``serve_batch`` /
 ``serve_influence`` (per-stage p50/p99 in tools/obs_report.py), a
 ``serve_request`` event per job (queue wait / service / total), queue-
 depth + batch-fill gauges, shed/admit/compile counters.
+
+Numerics sentinel (``sentinel_every`` > 0): every Nth batch snapshots
+one sampled non-warm lane (inputs + fused outputs, latest-wins) and the
+breaker loop replays it through the sequential parity oracle (the PR 9
+``fused=False`` path behind ``calibrate``/``influence_image``) OFF the
+hot path, emitting a ``numerics_drift`` event with per-stage relative
+error vs the documented bf16 band.  Drift beyond the band feeds a
+dedicated :class:`~smartcal_tpu.obs.slo.SloBurnDetector` (stages as
+"replicas", the band as the p99 target) so numeric drift gets the same
+burn-rate alerting + flight-recorder blackbox as latency.
 """
 
 from __future__ import annotations
@@ -57,6 +67,11 @@ def _event(name: str, **fields) -> None:
         rl.log(name, **fields)
 
 
+#: Sentinel-checked stages, in the SloBurnDetector "replica" index
+#: order used to localize which stage is drifting.
+SENTINEL_STAGES = ("solve", "influence", "sigma")
+
+
 class CalibServer:
     """See module doc.  Lifecycle::
 
@@ -78,7 +93,9 @@ class CalibServer:
                  heartbeat_timeout: float = 300.0, max_restarts: int = 3,
                  backoff: Optional[supervisor.BackoffPolicy] = None,
                  poll_s: float = 0.05, idle_tick_s: float = 0.2,
-                 compile_cache: bool = True):
+                 compile_cache: bool = True, sentinel_every: int = 0,
+                 sentinel_band: Optional[float] = None,
+                 sentinel_slo: Optional[obs.SloBurnDetector] = None):
         self.backend = backend
         self.M = int(M)
         self.lanes = int(lanes)
@@ -107,6 +124,22 @@ class CalibServer:
         self._backoff = backoff
         self._poll_s = float(poll_s)
         self._idle_tick_s = float(idle_tick_s)
+        # numerics sentinel: 0 disables sampling entirely (the default
+        # keeps the non-sentinel server byte-identical in behavior)
+        self.sentinel_every = int(sentinel_every)
+        self.sentinel_band = float(obs.BF16_REL_BAND
+                                   if sentinel_band is None
+                                   else sentinel_band)
+        self._sentinel_pending: Optional[dict] = None  # latest-wins
+        self._sentinel_stats = {"sampled": 0, "replayed": 0, "drift": 0}
+        # stages observe as "replicas" so a burn localizes to the
+        # drifting stage; the band is the p99 target, so burn =
+        # rel_err / band and one out-of-band replay can fire
+        self._sentinel_slo = sentinel_slo or obs.SloBurnDetector(
+            p99_target_s=self.sentinel_band, shed_target=1.0,
+            fast_window_s=30.0, slow_window_s=120.0,
+            burn_threshold=1.0, clear_threshold=1.0, sustain_s=0.0,
+            clear_sustain_s=30.0, min_samples=len(SENTINEL_STAGES))
 
     # -- warmup / AOT ------------------------------------------------------
     def warmup(self, seed: int = 0) -> dict:
@@ -275,18 +308,19 @@ class CalibServer:
                         mapped[lane, M:M + k], lo, hi)
         return rho, mask, alpha, iters
 
-    def _degraded_result(self, job, rho_row, mask_row, alpha_row, it):
-        """Sequential robust re-solve for one non-finite lane: the
-        ``solve_admm_safe`` ladder (rho-boost retries -> host-segmented
-        fallback) behind the per-episode ``calibrate`` route."""
-        r = self.backend.calibrate(job.episode, rho_row, mask=mask_row,
+    def _oracle_result(self, episode, rho_row, mask_row, alpha_row, it):
+        """Sequential re-solve of one lane: the ``solve_admm_safe``
+        ladder (rho-boost retries -> host-segmented fallback) behind the
+        per-episode ``calibrate`` route.  Both the degraded-lane rescue
+        and the numerics sentinel's parity oracle run through here."""
+        r = self.backend.calibrate(episode, rho_row, mask=mask_row,
                                    admm_iters=int(it))
         img = np.asarray(self.backend.influence_image(
-            job.episode, r, rho_row, alpha_row, npix=self.npix))
+            episode, r, rho_row, alpha_row, npix=self.npix))
         sig_d = float(np.std(np.asarray(self.backend.data_image(
-            job.episode, npix=self.npix))))
+            episode, npix=self.npix))))
         sig_r = float(np.std(np.asarray(self.backend.residual_image(
-            job.episode, r, npix=self.npix))))
+            episode, r, npix=self.npix))))
         return (float(np.asarray(r.sigma_res)), sig_d, sig_r,
                 float(np.std(img)))
 
@@ -326,6 +360,9 @@ class CalibServer:
         obs.gauge_set("serve_batch_fill", len(batch) / E)
         n_degraded = 0
         n_missed = 0
+        sentinel_due = (self.sentinel_every > 0
+                        and batch_id % self.sentinel_every == 0)
+        sent_candidates = []
         for lane, job in enumerate(batch):
             degraded = not np.isfinite(sig[lane])
             if degraded:
@@ -333,11 +370,14 @@ class CalibServer:
                 obs.counter_add("serve_degraded")
                 _event("serve_degraded", job_id=job.job_id, lane=lane,
                        batch=batch_id)
-                vals = self._degraded_result(job, rho[lane], mask[lane],
-                                             alpha[lane], iters[lane])
+                vals = self._oracle_result(job.episode, rho[lane],
+                                           mask[lane], alpha[lane],
+                                           iters[lane])
             else:
                 vals = (float(sig[lane]), float(sig_d[lane]),
                         float(sig_r[lane]), float(np.std(imgs[lane])))
+                if sentinel_due and not job.warm:
+                    sent_candidates.append((lane, job, vals))
             total = time.monotonic() - job.t_submit
             missed = (job.deadline_s is not None and total > job.deadline_s)
             if missed:
@@ -361,12 +401,102 @@ class CalibServer:
             obs.counter_add("serve_jobs_warm" if job.warm
                             else "serve_jobs")
             job.future.set_result(result)
+        snap = None
+        if sent_candidates:
+            # deterministic pick, latest-wins: the breaker loop replays
+            # at its own pace; an unpolled snapshot is simply replaced
+            lane, job, vals = sent_candidates[
+                batch_id % len(sent_candidates)]
+            snap = {"batch": batch_id, "lane": lane,
+                    "job_id": job.job_id, "episode": job.episode,
+                    "rho": rho[lane].copy(), "mask": mask[lane].copy(),
+                    "alpha": alpha[lane].copy(),
+                    "iters": int(iters[lane]),
+                    # fused outputs in SENTINEL_STAGES order
+                    "fused": {"solve": vals[0], "influence": vals[3],
+                              "sigma": vals[2]}}
         with self._lock:
             self._stats["batches"] += 1
             self._stats["served"] += len(batch)
             self._stats["degraded"] += n_degraded
             self._stats["deadline_miss"] += n_missed
+            if snap is not None:
+                self._sentinel_pending = snap
+                self._sentinel_stats["sampled"] += 1
         return len(batch)
+
+    # -- numerics sentinel -------------------------------------------------
+    def sentinel_poll(self) -> Optional[dict]:
+        """Replay the pending sampled lane through the sequential parity
+        oracle and judge the fused outputs against the documented band.
+
+        Runs on the breaker/supervisor thread (or a test's thread) —
+        never on the batch worker, so the hot path only pays the
+        latest-wins snapshot copy.  Returns the ``numerics_drift``
+        event dict when a replay happened, else None (still advancing
+        the burn detector's hysteresis so a past alarm can clear)."""
+        with self._lock:
+            snap = self._sentinel_pending
+            self._sentinel_pending = None
+            seq = self._sentinel_stats["replayed"]
+        if snap is None:
+            ev = self._sentinel_slo.evaluate()
+            if ev is not None:
+                self._emit_sentinel_burn(ev)
+            return None
+        with obs.span("serve_sentinel", batch=snap["batch"]):
+            oracle = self._oracle_result(
+                snap["episode"], snap["rho"], snap["mask"],
+                snap["alpha"], snap["iters"])
+        oracle_by = {"solve": oracle[0], "influence": oracle[3],
+                     "sigma": oracle[2]}
+        rels = {}
+        n_drift = 0
+        for idx, stage in enumerate(SENTINEL_STAGES):
+            # chaos hook: a planned perturbation (runtime/faults)
+            # shifts the FUSED value, rehearsing out-of-band drift
+            # without touching a kernel
+            fused = rt_faults.maybe_perturb(
+                f"sentinel_{stage}", seq, snap["fused"][stage])
+            ref = oracle_by[stage]
+            rel = abs(fused - ref) / max(abs(ref), 1e-12)
+            rels[stage] = rel
+            if rel > self.sentinel_band:
+                n_drift += 1
+            self._sentinel_slo.observe(rel, replica=idx)
+        worst = max(rels, key=lambda s: rels[s])
+        event = {"batch": snap["batch"], "lane": snap["lane"],
+                 "job_id": snap["job_id"], "seq": seq,
+                 "band": self.sentinel_band,
+                 "worst_stage": worst, "drift": n_drift > 0,
+                 **{f"rel_err_{s}": round(r, 9)
+                    for s, r in rels.items()}}
+        _event("numerics_drift", **event)
+        obs.counter_add("sentinel_replays")
+        if n_drift:
+            obs.counter_add("sentinel_drift")
+        with self._lock:
+            self._sentinel_stats["replayed"] += 1
+            self._sentinel_stats["drift"] += (1 if n_drift else 0)
+        ev = self._sentinel_slo.evaluate()
+        if ev is not None:
+            self._emit_sentinel_burn(ev)
+        return event
+
+    def _emit_sentinel_burn(self, ev: dict) -> None:
+        """Surface a sentinel burn transition exactly like a latency
+        burn: a structured ``slo_burn`` event (kind="numerics", the
+        drifting STAGE named) plus a flight-recorder dump on firing."""
+        worst = ev.get("worst_replica")
+        stage = (SENTINEL_STAGES[int(worst)]
+                 if worst is not None else None)
+        _event("slo_burn", kind="numerics", stage=stage, **ev)
+        obs.counter_add("sentinel_burn_transitions")
+        if ev.get("state") == "firing":
+            obs.flush_flight_recorder(
+                "numerics_drift",
+                {"stage": stage, "burn_fast": ev.get("burn_fast"),
+                 "band": self.sentinel_band})
 
     def process_once(self, jobs, timeout: float = 0.0) -> int:
         """Synchronously pack+serve up to ``lanes`` queued/given jobs on
@@ -445,6 +575,8 @@ class CalibServer:
                             "circuit_open",
                             {"restarts": fleet.restarts_total()})
                 obs.gauge_set("serve_queue_depth", self.batcher.depth())
+                if self.sentinel_every > 0:
+                    self.sentinel_poll()
             except Exception as e:   # breaker must outlive a bad pass
                 obs.counter_add("serve_breaker_errors")
                 _event("serve_breaker_error", error=repr(e))
@@ -452,8 +584,12 @@ class CalibServer:
     def stats(self) -> dict:
         with self._lock:
             out = dict(self._stats)
+            sent = dict(self._sentinel_stats)
         out.update(self.batcher.stats())
         out["circuit_open"] = self.circuit_open
+        if self.sentinel_every > 0:
+            out["sentinel"] = dict(sent,
+                                   firing=self._sentinel_slo.firing)
         return out
 
     def stop(self, timeout: float = 10.0) -> None:

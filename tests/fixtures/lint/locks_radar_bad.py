@@ -1,0 +1,41 @@
+"""Positive fixture: unlocked writes to the ISSUE 19 regression-radar
+shared state (baseline-store document/dirty flag, the server's
+numerics-sentinel snapshot + counters).
+
+The test registers this file with two specs mirroring the shipped
+SHARED_FIELD_SPECS rows: class BaselineStore, fields {_doc, _dirty},
+lock {_lock}; class CalibServer, fields {_sentinel_pending,
+_sentinel_stats}, lock {_lock}.
+"""
+import threading
+
+
+class BaselineStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._doc = {"entries": {}}    # ok: __init__ runs pre-sharing
+        self._dirty = False
+
+    def record(self, key, entry):
+        self._doc[key] = entry              # BAD: store without lock
+        self._dirty = True                  # BAD: flag without lock
+
+    def save(self):
+        self._doc.update({})                # BAD: mutator, no lock
+        self._dirty = False                 # BAD: flag without lock
+
+
+class CalibServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sentinel_pending = None
+        self._sentinel_stats = {"sampled": 0}
+
+    def sample(self, snap):
+        self._sentinel_pending = snap            # BAD: handoff, no lock
+        self._sentinel_stats["sampled"] += 1     # BAD: subscript store
+
+    def poll(self):
+        snap = self._sentinel_pending
+        self._sentinel_pending = None            # BAD: pop without lock
+        return snap

@@ -1,0 +1,43 @@
+"""Negative fixture: lock-disciplined regression-radar shared state —
+zero findings.  Registered with the same specs as locks_radar_bad.py.
+"""
+import threading
+
+
+class BaselineStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._doc = {"entries": {}}
+        self._dirty = False
+
+    def record(self, key, entry):
+        with self._lock:
+            self._doc["entries"][key] = entry   # ok: annotated lock
+            self._dirty = True
+
+    def save(self):
+        with self._lock:
+            self._doc["entries"].update({})
+            self._dirty = False
+            return dict(self._doc)              # reads unchecked
+
+    def _reload_locked(self):
+        self._doc = {"entries": {}}    # ok: *_locked caller-holds-lock
+
+
+class CalibServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sentinel_pending = None
+        self._sentinel_stats = {"sampled": 0}
+
+    def sample(self, snap):
+        with self._lock:
+            self._sentinel_pending = snap        # latest-wins handoff
+            self._sentinel_stats["sampled"] += 1
+
+    def poll(self):
+        with self._lock:
+            snap = self._sentinel_pending
+            self._sentinel_pending = None
+        return snap

@@ -336,6 +336,49 @@ def test_shipped_shared_specs_cover_obs_fields():
     assert "_frames" in fleet_fields
 
 
+# the ISSUE 19 regression-radar fields: the baseline-store document +
+# dirty flag and the server's numerics-sentinel snapshot handoff +
+# counters — mirrors the shipped SHARED_FIELD_SPECS rows
+def _radar_specs(path):
+    return [
+        {"path": path, "class": "BaselineStore",
+         "fields": ["_doc", "_dirty"],
+         "locks": ["_lock"], "why": "fixture"},
+        {"path": path, "class": "CalibServer",
+         "fields": ["_sentinel_pending", "_sentinel_stats"],
+         "locks": ["_lock"], "why": "fixture"},
+    ]
+
+
+def test_locks_radar_rule_positive():
+    opts = {"shared_specs": _radar_specs("locks_radar_bad.py")}
+    fs = fixture_findings("locks_radar_bad.py", "unlocked-shared-write",
+                          opts)
+    assert lines_of(fs) == [20, 21, 24, 25, 35, 36, 40], fs
+
+
+def test_locks_radar_rule_negative():
+    opts = {"shared_specs": _radar_specs("locks_radar_good.py")}
+    assert fixture_findings("locks_radar_good.py",
+                            "unlocked-shared-write", opts) == []
+
+
+def test_shipped_shared_specs_cover_radar_fields():
+    """The SHIPPED spec table must keep the ISSUE 19 rows: the perf
+    baseline store's document + dirty flag and the serving sentinel's
+    latest-wins snapshot + counters."""
+    from smartcal_tpu.analysis.rules.locks import SHARED_FIELD_SPECS
+
+    store_fields = {f for s in SHARED_FIELD_SPECS
+                    if s["path"].endswith("obs/baselines.py")
+                    for f in s["fields"]}
+    assert {"_doc", "_dirty"} <= store_fields
+    server_fields = {f for s in SHARED_FIELD_SPECS
+                     if s["path"].endswith("serve/server.py")
+                     for f in s["fields"]}
+    assert {"_sentinel_pending", "_sentinel_stats"} <= server_fields
+
+
 def _lint_as_package(tmp_path, *names):
     """Copy fixtures under a fake smartcal_tpu/ so path-scoped rules
     (pickle outside tests/, bare-print) see them as package code."""

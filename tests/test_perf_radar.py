@@ -1,0 +1,297 @@
+"""Regression radar (ISSUE 19): baseline store, noise-aware detector,
+perf-gate judging, results index.
+
+The load-bearing claims, each pinned here:
+
+* KEYED BY HOST — baselines are keyed on stage + statics digest + host
+  fingerprint digest; a lookup from a different host/shape finds NO
+  baseline, and an explicit cross-fingerprint compare RAISES — the
+  2026-08-07 cross-host comparison bug made structurally impossible.
+* SEEDED REGRESSIONS FIRE — a 2x wall slowdown, inflated peak bytes,
+  and out-of-band numeric drift must all produce FIRE verdicts carrying
+  the measured delta and the noise band they were judged against.
+* NOISE DOES NOT FIRE — resamples from the baseline's own distribution
+  must produce zero FIREs across N trials (the false-positive bound the
+  tier-1 gate's greenness rests on).
+* SCHEMA OR REFUSE — a corrupt/mis-versioned store raises
+  BaselineSchemaError instead of silently comparing garbage.
+
+Pure host-side logic (the obs package is stdlib-only) — no JAX, runs
+in milliseconds.  The end-to-end gate (real stages, fault injection,
+--update-baseline round-trip) lives in tools/smoke_perfgate.sh.
+"""
+
+import json
+import random
+
+import pytest
+
+from conftest import load_tool_module
+from smartcal_tpu.obs import baselines as bl
+from smartcal_tpu.obs import regress as rg
+
+FP_A = {"nproc": 1, "platform": "linux", "machine": "x86_64",
+        "python": "3.10.16", "jax": "0.4.37", "jaxlib": "0.4.36",
+        "dtype_policy": {"x64": False, "bf16_rel_band": bl.BF16_REL_BAND}}
+FP_B = dict(FP_A, nproc=24)            # same box, different cgroup
+STATICS = {"stage": "solve", "n_stations": 6, "npix": 32}
+
+
+def _samples(mean, cv, n=5, seed=42):
+    rng = random.Random(seed)
+    return [max(1e-9, rng.gauss(mean, cv * mean)) for _ in range(n)]
+
+
+def _baseline_store(tmp_path, wall_mean=1.0, cv=0.02):
+    store = bl.BaselineStore(str(tmp_path / "base.json"))
+    store.record("solve", STATICS, FP_A, {
+        "wall_s": bl.summarize_samples(_samples(wall_mean, cv)),
+        "peak_bytes": bl.scalar_metric(1.0e6),
+        "flops": bl.scalar_metric(2.0e7),
+        "compile_events": bl.scalar_metric(0.0),
+    })
+    return store
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+class TestBaselineStore:
+    def test_round_trip_through_disk(self, tmp_path):
+        store = _baseline_store(tmp_path)
+        assert store.save() is True
+        assert store.save() is False        # idempotent: not dirty
+        re = bl.BaselineStore(store.path)
+        ent = re.get("solve", STATICS, FP_A)
+        assert ent is not None
+        assert ent["metrics"]["wall_s"]["n"] == 5
+        assert ent["fingerprint_digest"] == bl.fingerprint_digest(FP_A)
+
+    def test_lookup_is_fingerprint_scoped(self, tmp_path):
+        store = _baseline_store(tmp_path)
+        assert store.get("solve", STATICS, FP_B) is None
+        assert store.get("solve", dict(STATICS, npix=64), FP_A) is None
+        assert store.get("influence", STATICS, FP_A) is None
+
+    def test_corrupt_document_refuses(self, tmp_path):
+        p = tmp_path / "base.json"
+        p.write_text("{not json")
+        with pytest.raises(bl.BaselineSchemaError):
+            bl.BaselineStore(str(p)).get("solve", STATICS, FP_A)
+
+    def test_wrong_schema_version_refuses(self, tmp_path):
+        p = tmp_path / "base.json"
+        p.write_text(json.dumps({"schema": 999, "entries": {}}))
+        with pytest.raises(bl.BaselineSchemaError):
+            bl.BaselineStore(str(p)).get("solve", STATICS, FP_A)
+
+    def test_malformed_entry_refuses(self, tmp_path):
+        p = tmp_path / "base.json"
+        p.write_text(json.dumps({"schema": bl.SCHEMA_VERSION, "entries": {
+            "k": {"stage": "s", "statics": {}, "fingerprint": {},
+                  "metrics": {"wall_s": {"kind": "mystery"}}}}}))
+        with pytest.raises(bl.BaselineSchemaError):
+            bl.BaselineStore(str(p)).entries()
+
+    def test_record_rejects_raw_metric_dicts(self, tmp_path):
+        store = bl.BaselineStore(str(tmp_path / "b.json"))
+        with pytest.raises(bl.BaselineSchemaError):
+            store.record("s", {}, FP_A, {"wall_s": {"value": 1.0}})
+
+    def test_fingerprint_digest_stability(self):
+        fp1 = bl.host_fingerprint()
+        fp2 = bl.host_fingerprint()
+        assert bl.fingerprint_digest(fp1) == bl.fingerprint_digest(fp2)
+        assert bl.fingerprint_digest(FP_A) != bl.fingerprint_digest(FP_B)
+        assert "nproc" in fp1 and "dtype_policy" in fp1
+
+
+# ---------------------------------------------------------------------------
+# detector
+# ---------------------------------------------------------------------------
+
+class TestDetector:
+    def test_seeded_regressions_fire_with_delta_and_band(self, tmp_path):
+        """2x slowdown + inflated peak bytes + out-of-band drift: each
+        axis FIREs, each finding names the stage and carries the
+        measured delta and the noise band it was judged against."""
+        store = _baseline_store(tmp_path)
+        measured = {
+            "wall_s": bl.summarize_samples(
+                [2.0 * s for s in _samples(1.0, 0.02, seed=7)]),
+            "peak_bytes": bl.scalar_metric(1.3e6),
+            "flops": bl.scalar_metric(2.0e7),
+            "compile_events": bl.scalar_metric(0.0),
+            "rel_err": bl.scalar_metric(5e-2),
+        }
+        fs = {f.metric: f for f in rg.compare(store, "solve", STATICS,
+                                              FP_A, measured)}
+        assert fs["wall_s"].verdict == rg.FIRE
+        assert fs["wall_s"].delta_rel == pytest.approx(1.0, abs=0.15)
+        assert fs["wall_s"].ci95[0] > 1.15      # CI separated from warn
+        assert fs["peak_bytes"].verdict == rg.FIRE
+        assert fs["peak_bytes"].delta_rel == pytest.approx(0.3, abs=1e-6)
+        assert fs["rel_err"].verdict == rg.FIRE
+        assert fs["flops"].verdict == rg.OK
+        for f in fs.values():
+            text = f.render()
+            assert f.stage == "solve" and "noise" in text
+
+    def test_same_distribution_resamples_never_fire(self, tmp_path):
+        """FP bound: N fresh resamples of the baseline's own noise must
+        produce ZERO FIREs — a green gate stays green."""
+        store = _baseline_store(tmp_path)
+        fired = []
+        for trial in range(40):
+            measured = {
+                "wall_s": bl.summarize_samples(
+                    _samples(1.0, 0.02, seed=1000 + trial)),
+                "peak_bytes": bl.scalar_metric(1.0e6),
+                "compile_events": bl.scalar_metric(0.0),
+            }
+            for f in rg.compare(store, "solve", STATICS, FP_A, measured,
+                                seed=trial):
+                if f.verdict == rg.FIRE:
+                    fired.append((trial, f.render()))
+        assert fired == []
+
+    def test_improvement_never_fires(self, tmp_path):
+        store = _baseline_store(tmp_path)
+        measured = {"wall_s": bl.summarize_samples(
+            [0.5 * s for s in _samples(1.0, 0.02, seed=9)]),
+            "peak_bytes": bl.scalar_metric(0.5e6)}
+        assert all(f.verdict == rg.OK
+                   for f in rg.compare(store, "solve", STATICS, FP_A,
+                                       measured))
+
+    def test_any_recompile_fires(self, tmp_path):
+        store = _baseline_store(tmp_path)
+        fs = rg.compare(store, "solve", STATICS, FP_A,
+                        {"compile_events": bl.scalar_metric(1.0)})
+        assert [f.verdict for f in fs] == [rg.FIRE]
+
+    def test_cross_fingerprint_compare_raises(self, tmp_path):
+        store = _baseline_store(tmp_path)
+        entry = store.get("solve", STATICS, FP_A)
+        with pytest.raises(rg.FingerprintMismatch):
+            rg.compare_entry(entry, "solve", STATICS, FP_B,
+                             {"wall_s": bl.summarize_samples([1.0])})
+
+    def test_changed_statics_compare_raises(self, tmp_path):
+        store = _baseline_store(tmp_path)
+        entry = store.get("solve", STATICS, FP_A)
+        with pytest.raises(rg.FingerprintMismatch):
+            rg.compare_entry(entry, "solve", dict(STATICS, npix=64),
+                             FP_A, {"wall_s": bl.summarize_samples([1.0])})
+
+    def test_fresh_host_is_no_baseline_not_red(self, tmp_path):
+        """Store-level compare from an unblessed host: NO BASELINE
+        verdicts (informative, exit stays green) — except the absolute
+        bf16 band, which applies everywhere."""
+        store = _baseline_store(tmp_path)
+        fs = {f.metric: f for f in rg.compare(
+            store, "solve", STATICS, FP_B,
+            {"wall_s": bl.summarize_samples(_samples(99.0, 0.02)),
+             "rel_err": bl.scalar_metric(5e-2)})}
+        assert fs["wall_s"].verdict == rg.NO_BASELINE
+        assert fs["rel_err"].verdict == rg.FIRE
+        assert rg.worst_verdict(list(fs.values())) == rg.FIRE
+
+    def test_bootstrap_ci_is_deterministic(self):
+        a = _samples(2.0, 0.05, seed=3)
+        b = _samples(1.0, 0.05, seed=4)
+        assert rg.bootstrap_ratio_ci(a, b, seed=5) == \
+            rg.bootstrap_ratio_ci(a, b, seed=5)
+        lo, hi = rg.bootstrap_ratio_ci(a, b, seed=5)
+        assert 1.5 < lo <= hi < 2.5
+
+
+# ---------------------------------------------------------------------------
+# perf_gate judging (host-side half; stages run in smoke_perfgate.sh)
+# ---------------------------------------------------------------------------
+
+class TestPerfGateJudge:
+    def test_numeric_drift_folds_into_band_rel_err(self, tmp_path):
+        gate = load_tool_module("perf_gate")
+        store = bl.BaselineStore(str(tmp_path / "b.json"))
+        statics = {"stage": "solve"}
+        store.record("solve", statics, FP_A, {
+            "wall_s": bl.summarize_samples(_samples(1.0, 0.02)),
+            "numeric": bl.scalar_metric(1.0),
+        })
+        metrics = {"wall_s": bl.summarize_samples(
+            _samples(1.0, 0.02, seed=11)),
+            "numeric": bl.scalar_metric(1.05)}
+        fs = {f.metric: f for f in gate.judge(store, "solve", statics,
+                                              FP_A, metrics)}
+        assert "numeric" not in fs          # never compared directly
+        assert fs["rel_err"].verdict == rg.FIRE
+        assert fs["rel_err"].new_value == pytest.approx(0.05)
+        # in-band drift stays green
+        metrics["numeric"] = bl.scalar_metric(1.0 + 1e-3)
+        fs = {f.metric: f for f in gate.judge(store, "solve", statics,
+                                              FP_A, metrics)}
+        assert fs["rel_err"].verdict == rg.OK
+
+
+# ---------------------------------------------------------------------------
+# results index
+# ---------------------------------------------------------------------------
+
+class TestResultsIndex:
+    @pytest.fixture()
+    def ridx(self):
+        return load_tool_module("results_index")
+
+    def test_round_stamp_extraction(self, ridx):
+        assert ridx.artifact_round("nscale_r13.json") == 13
+        assert ridx.artifact_round("serve_fleet_r15.json") == 15
+        assert ridx.artifact_round("per_bench.json") is None
+        assert ridx.artifact_round("enet_sweep_r2/summary.json") is None
+
+    def test_scan_classifies_and_orders_trajectories(self, ridx,
+                                                     tmp_path):
+        for rnd, val in ((3, 9.0), (12, 4.0), (7, 6.0)):
+            (tmp_path / f"thing_r{rnd}.json").write_text(json.dumps(
+                {"metric": "thing", "value": val, "unit": "s",
+                 "host_fingerprint_digest": "abc"}))
+        (tmp_path / "notes.md").write_text("x")
+        (tmp_path / "suite_r4.json").write_text(json.dumps(
+            {"bench": "suite", "runs": []}))
+        doc = ridx.scan(str(tmp_path))
+        assert doc["problems"] == []
+        by = {r["path"]: r for r in doc["artifacts"]}
+        assert by["thing_r3.json"]["schema"] == "bench"
+        assert by["thing_r3.json"]["fingerprint"] == "digest"
+        assert by["suite_r4.json"]["schema"] == "bench-suite"
+        traj = doc["trajectories"]["thing"]
+        assert [p["round"] for p in traj] == [3, 7, 12]
+        assert [p["value"] for p in traj] == [9.0, 6.0, 4.0]
+        assert doc["other_files"] == ["notes.md"]
+
+    def test_schema_problems_reported_and_strict_exit(self, ridx,
+                                                      tmp_path,
+                                                      capsys):
+        (tmp_path / "bad_r9.json").write_text(
+            json.dumps({"metric": "m", "value": "oops"}))
+        (tmp_path / "broken.json").write_text("{nope")
+        doc = ridx.scan(str(tmp_path))
+        assert len(doc["problems"]) == 3
+        assert ridx.main(["--results", str(tmp_path), "--no-write"]) == 0
+        assert ridx.main(["--results", str(tmp_path), "--no-write",
+                          "--strict"]) == 1
+        capsys.readouterr()
+
+    def test_index_md_written_and_repo_corpus_clean(self, ridx,
+                                                    tmp_path, capsys):
+        (tmp_path / "a_r1.json").write_text(json.dumps(
+            {"metric": "a", "value": 1.0, "unit": "s"}))
+        assert ridx.main(["--results", str(tmp_path), "--strict"]) == 0
+        out = capsys.readouterr().out
+        assert "1 bench payload(s)" in out
+        md = (tmp_path / "INDEX.md").read_text()
+        assert "| a | r1: 1.0 | s |" in md
+        # the shipped results/ corpus must stay schema-clean
+        repo_doc = ridx.scan("results")
+        assert repo_doc["problems"] == []

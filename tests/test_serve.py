@@ -293,3 +293,86 @@ def test_worker_crash_fails_futures_then_opens_circuit(monkeypatch,
         assert srv.stats()["failed"] >= 1
     finally:
         srv.stop()
+
+
+class TestNumericsSentinel:
+    """Production parity sentinels (ISSUE 19): every Nth batch snapshots
+    one sampled lane; the supervisor replays it through the sequential
+    oracle (`_oracle_result`, the fused=False parity path) off the hot
+    path and judges the fused outputs against the documented bf16 band.
+    Out-of-band drift feeds the SLO burn detector, which names the
+    drifting STAGE when it fires."""
+
+    def _events(self, path, start):
+        import json
+        lines = open(path).read().splitlines()[start:]
+        return [json.loads(ln) for ln in lines]
+
+    def test_clean_replay_is_in_band(self, served):
+        be, srv, _, _, path = served
+        n0 = len(open(path).read().splitlines())
+        srv.sentinel_every = 1
+        try:
+            jobs = _jobs(be, [(2, 2), (3, 3)], seed=SEED + 11)
+            assert srv.process_once(jobs, timeout=0.01) == 2
+            ev = srv.sentinel_poll()
+        finally:
+            srv.sentinel_every = 0
+        assert ev is not None and ev["drift"] is False
+        # identical callable both paths: parity is tight, not just in-band
+        for stage in ("solve", "influence", "sigma"):
+            assert ev[f"rel_err_{stage}"] <= obs.BF16_REL_BAND
+        assert ev["worst_stage"] in ("solve", "influence", "sigma")
+        drift_evs = [e for e in self._events(path, n0)
+                     if e.get("event") == "numerics_drift"]
+        assert len(drift_evs) == 1 and drift_evs[0]["drift"] is False
+        # nothing pending afterwards; a bare poll is a hysteresis tick
+        srv.sentinel_every = 1
+        try:
+            assert srv.sentinel_poll() is None
+        finally:
+            srv.sentinel_every = 0
+
+    def test_injected_drift_trips_burn_detector_naming_stage(
+            self, served, tmp_path_factory):
+        """A planned perturbation of the fused solve output (the chaos
+        hook rehearsal for a real numerics regression) must produce
+        drift=True replays and an slo_burn(kind="numerics") transition
+        naming the solve stage — on a FRESH server so the module
+        fixture's detector never latches."""
+        from smartcal_tpu.runtime import faults as rt_faults
+
+        be, _, _, cache, path = served
+        n0 = len(open(path).read().splitlines())
+        srv = CalibServer(be, M=M, lanes=LANES, cache_dir=cache,
+                          compile_cache=False, max_wait_s=0.02,
+                          sentinel_every=1)
+        warm = srv.warmup(seed=SEED)
+        assert warm["sources"]["solve"] == "cache"
+        rt_faults.install(rt_faults.FaultPlan(
+            perturb_stage="sentinel_solve", perturb_at=0,
+            perturb_rel=0.5, perturb_span=100))
+        try:
+            drifted = 0
+            for i in range(4):
+                jobs = _jobs(be, [(2, 2), (3, 2)], seed=SEED + 20 + i)
+                srv.process_once(jobs, timeout=0.01)
+                ev = srv.sentinel_poll()
+                assert ev is not None
+                assert ev["drift"] is True, ev
+                assert ev["worst_stage"] == "solve"
+                assert ev["rel_err_solve"] == pytest.approx(0.5, rel=1e-6)
+                drifted += 1
+                if srv.stats()["sentinel"]["firing"]:
+                    break
+        finally:
+            rt_faults.clear()
+        sent = srv.stats()["sentinel"]
+        assert sent["firing"], sent
+        assert sent["drift"] == drifted == sent["replayed"]
+        assert sent["sampled"] >= drifted
+        burns = [e for e in self._events(path, n0)
+                 if e.get("event") == "slo_burn"
+                 and e.get("kind") == "numerics"]
+        assert burns and burns[0]["stage"] == "solve"
+        assert burns[0]["state"] == "firing"

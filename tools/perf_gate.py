@@ -1,0 +1,407 @@
+#!/usr/bin/env python
+"""perf_gate — the regression radar's tier-1 gate (graftlint for perf).
+
+A deterministic micro-bench tier over the repo's load-bearing stages —
+tiny-scale batched solve, influence chain, factored imager, the
+sharded-replay fused step, and one warmed serve batch — measured in
+minutes, not the 30-minute bench, then judged against the
+host-fingerprinted baseline store (``smartcal_tpu/obs/baselines.py``)
+by the noise-aware detector (``smartcal_tpu/obs/regress.py``).
+
+Usage::
+
+    python tools/perf_gate.py --update-baseline     # bless this host
+    python tools/perf_gate.py                       # gate: 1 on FIRE
+    python tools/perf_gate.py --json --out gate.json
+    python tools/perf_gate.py --stages solve,serve_batch
+
+Per stage the gate measures K wall-clock samples (noise model for the
+bootstrap CI), XLA cost-analysis flops + peak bytes, the compile-event
+count across the timed reps (must stay 0 — a recompile IS a
+regression), and one deterministic numeric scalar whose relative drift
+vs the blessed value is judged against the documented bf16 band.
+Baselines are keyed on stage + statics signature + host fingerprint,
+so a baseline recorded elsewhere is a NO BASELINE (never a bogus
+compare) here.
+
+Fault hooks (``runtime/faults.py``, armed via ``SMARTCAL_FAULTS``):
+``gate_<stage>`` delays inside the timed reps and
+``gate_numeric_<stage>`` perturbs the numeric scalar — how
+``tools/smoke_perfgate.sh`` proves both detector axes end to end.
+
+Exit codes: 0 clean (or baseline updated), 1 at least one FIRE,
+2 internal/usage error.  This file's stdout IS its product — it is on
+the bare-print allowlist deliberately.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    # the sharded-replay stage needs the tests' virtual 8-device mesh
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+DEFAULT_BASELINE = os.path.join("results", "perf_baselines.json")
+DEFAULT_CACHE = os.path.join("/tmp", "smartcal_perfgate_cache")
+
+#: the serving tests' tiny problem shape — small enough that the whole
+#: gate runs in minutes on the 1-core container
+TIER = dict(n_stations=6, n_freqs=2, n_times=4, tdelta=2, admm_iters=2,
+            lbfgs_iters=3, init_iters=5, npix=32)
+M, LANES = 3, 3
+K_SAMPLES = 5
+STAGE_NAMES = ("solve", "influence", "imager", "replay_fused",
+               "serve_batch")
+
+
+def build_stages(names, cache_dir):
+    """Construct the requested stages: each a dict with ``statics``
+    (baseline key material), ``run()`` (one timed rep -> numeric
+    scalar) and optional ``cost()`` (XLA cost analysis)."""
+    import jax
+    import numpy as np
+
+    from smartcal_tpu import obs
+    from smartcal_tpu.envs.radio import RadioBackend
+
+    be = RadioBackend(**TIER)
+    key = jax.random.PRNGKey(0)
+    eps = []
+    for _ in range(LANES):
+        key, k = jax.random.split(key)
+        ep, _ = be.new_calib_episode(k, M, M)
+        eps.append(ep)
+    bep = be.stack_episodes(eps)
+    rho = np.ones((LANES, M), np.float32)
+    mask = np.ones((LANES, M), np.float32)
+    alpha = np.zeros((LANES, M), np.float32)
+    iters = np.full((LANES,), TIER["admm_iters"], np.int32)
+    sig = be.serve_signature(M, LANES, TIER["npix"])
+    stages = {}
+
+    solve_fn = jax.jit(be.batched_solve_callable(M))
+    sops = be.batched_solve_operands(bep, rho, mask, iters)
+
+    def run_solve():
+        r = solve_fn(*sops)
+        jax.block_until_ready(r.sigma_res)
+        return float(np.mean(np.abs(np.asarray(r.sigma_res))))
+
+    stages["solve"] = {
+        "statics": dict(sig, stage="solve"),
+        "run": run_solve,
+        "cost": lambda: obs.stage_cost(solve_fn, *sops),
+    }
+
+    res = solve_fn(*sops)
+    infl_fn = jax.jit(be.batched_influence_callable(M, TIER["npix"]))
+    iops = be.batched_influence_operands(bep, res, rho, alpha)
+
+    def run_influence():
+        imgs = infl_fn(*iops)
+        jax.block_until_ready(imgs)
+        return float(np.std(np.asarray(imgs)))
+
+    stages["influence"] = {
+        "statics": dict(sig, stage="influence"),
+        "run": run_influence,
+        "cost": lambda: obs.stage_cost(infl_fn, *iops),
+    }
+
+    from smartcal_tpu.cal import imager as im
+
+    ep0 = eps[0]
+    cell = im.default_cell(ep0.obs.uvw,
+                           float(np.asarray(ep0.obs.freqs)[-1]))
+    img_fn = jax.jit(lambda uvw, V, freqs: im.multifreq_image_sr(
+        uvw, V, freqs, cell, npix=TIER["npix"]))
+
+    def run_imager():
+        img = img_fn(ep0.obs.uvw, ep0.V, ep0.obs.freqs)
+        jax.block_until_ready(img)
+        return float(np.std(np.asarray(img)))
+
+    stages["imager"] = {
+        "statics": {"stage": "imager", "npix": TIER["npix"],
+                    "n_stations": TIER["n_stations"],
+                    "n_freqs": TIER["n_freqs"],
+                    "n_times": TIER["n_times"]},
+        "run": run_imager,
+        "cost": lambda: obs.stage_cost(
+            img_fn, ep0.obs.uvw, ep0.V, ep0.obs.freqs),
+    }
+
+    if "replay_fused" in names:
+        stages["replay_fused"] = _build_replay_stage()
+    if "serve_batch" in names:
+        stages["serve_batch"] = _build_serve_stage(be, cache_dir)
+    return {n: stages[n] for n in names if n in stages}
+
+
+def _build_replay_stage():
+    """The ISSUE 12 fused store->PER/ERE sample->learn->priority step
+    on the 4-shard virtual mesh (the tests' exact composition)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from smartcal_tpu.rl import replay as rp
+    from smartcal_tpu.rl import replay_sharded as rps
+    from smartcal_tpu.rl import sac
+
+    S, n = 4, 32
+    cfg = sac.SACConfig(obs_dim=6, n_actions=2, prioritized=True,
+                        is_clip=2.0, ere_eta=0.99, batch_size=8,
+                        mem_size=64)
+    mesh = Mesh(np.asarray(jax.devices()[:S]), ("rp",))
+    repl = NamedSharding(mesh, P())
+    spec = rp.versioned_spec(rp.transition_spec(cfg.obs_dim,
+                                                cfg.n_actions))
+    buf = rps.place_on_mesh(rps.replay_init(cfg.mem_size, spec, S), mesh)
+    st = sac.sac_init(jax.random.PRNGKey(7), cfg)
+    k_obs, k_act = jax.random.split(jax.random.PRNGKey(11))
+    obs_b = jax.random.normal(k_obs, (n, cfg.obs_dim))
+    a, lp = sac.choose_action_logp(cfg, st, obs_b, k_act)
+    flat = {"state": obs_b, "new_state": obs_b + 0.1, "action": a,
+            "reward": (jnp.arange(n) % 3).astype(jnp.float32) - 1.0,
+            "done": jnp.zeros((n,), jnp.bool_),
+            "hint": jnp.zeros((n, cfg.n_actions)),
+            "version": jnp.full((n,), 1, jnp.int32),
+            "behavior_logp": lp}
+
+    def fused(st, buf, flat, key, ver):
+        buf = rps.replay_add_batch(buf, flat)
+        return sac.learn(cfg, st, buf, key, learner_version=ver)
+
+    fused_j = jax.jit(fused)
+    st, flat, k0, ver = jax.device_put(
+        (st, flat, jax.random.PRNGKey(3), jnp.asarray(2, jnp.int32)),
+        repl)
+
+    def run():
+        st2, buf2, _ = fused_j(st, buf, flat, k0, ver)
+        jax.block_until_ready((st2, buf2))
+        return float(np.mean(np.asarray(buf2.priority)))
+
+    from smartcal_tpu import obs as _obs
+
+    return {
+        "statics": {"stage": "replay_fused", "shards": S,
+                    "obs_dim": cfg.obs_dim, "batch_size": cfg.batch_size,
+                    "mem_size": cfg.mem_size, "n_store": n},
+        "run": run,
+        "cost": lambda: _obs.stage_cost(fused_j, st, buf, flat, k0, ver),
+    }
+
+
+def _build_serve_stage(be, cache_dir):
+    """One warmed CalibServer batch: pack -> exported solve ->
+    influence -> sigmas on the caller's thread (process_once)."""
+    import jax
+    import numpy as np
+
+    from smartcal_tpu.serve import CalibServer, Job
+
+    srv = CalibServer(be, M=M, lanes=LANES, cache_dir=cache_dir,
+                      compile_cache=False, max_wait_s=0.02)
+    srv.warmup(seed=7)
+    key = jax.random.PRNGKey(9)
+    jeps, ks = [], [2, 3, 2]
+    for k in ks:
+        key, sub = jax.random.split(key)
+        ep, _ = be.new_calib_episode(sub, k, M)
+        jeps.append(ep)
+
+    def run():
+        jobs = [Job(episode=ep, k=k,
+                    rho=np.linspace(0.5 + i, 1.5 + i, k).astype(
+                        np.float32),
+                    maxiter=TIER["admm_iters"])
+                for i, (ep, k) in enumerate(zip(jeps, ks))]
+        srv.process_once(jobs, timeout=0.01)
+        return float(jobs[0].future.result(timeout=5).sigma_res)
+
+    return {
+        "statics": dict(be.serve_signature(M, LANES, TIER["npix"]),
+                        stage="serve_batch", jobs=len(ks)),
+        "run": run,
+        "cost": None,
+    }
+
+
+def measure_stage(name, stage, k_samples):
+    """K timed reps (after one warm rep) + cost analysis + the numeric
+    scalar, as baseline-store metric dicts.  The fault hooks sit INSIDE
+    the timed loop / on the numeric so injected regressions are
+    measured exactly like real ones."""
+    import time as _time
+
+    from smartcal_tpu import obs
+    from smartcal_tpu.obs import baselines as bl
+    from smartcal_tpu.runtime import faults as rt_faults
+
+    stage["run"]()                       # warm: compile outside timing
+    c0 = obs.counters_snapshot().get("jax_compile_events", 0.0)
+    walls, numeric = [], 0.0
+    for i in range(k_samples):
+        t0 = _time.perf_counter()
+        rt_faults.maybe_delay(f"gate_{name}", i)
+        numeric = stage["run"]()
+        walls.append(_time.perf_counter() - t0)
+    c1 = obs.counters_snapshot().get("jax_compile_events", 0.0)
+    numeric = rt_faults.maybe_perturb(f"gate_numeric_{name}", 0,
+                                      float(numeric))
+    metrics = {"wall_s": bl.summarize_samples(walls),
+               "compile_events": bl.scalar_metric(c1 - c0),
+               "numeric": bl.scalar_metric(numeric)}
+    if stage.get("cost") is not None:
+        try:
+            cost = stage["cost"]()
+        except Exception:  # cost analysis is best-effort extra
+            cost = {}
+        for k in ("flops", "peak_bytes"):
+            if cost.get(k):
+                metrics[k] = bl.scalar_metric(cost[k])
+    return metrics
+
+
+def judge(store, name, statics, fp, metrics):
+    """Findings for one stage: wall/bytes/flops/compiles through the
+    regular policies, and the numeric scalar folded into a ``rel_err``
+    vs the blessed value, judged against the documented bf16 band."""
+    from smartcal_tpu.obs import regress as rg
+
+    measured = {k: v for k, v in metrics.items() if k != "numeric"}
+    entry = store.get(name, statics, fp)
+    if entry is not None and "numeric" in entry.get("metrics", {}):
+        base_num = float(entry["metrics"]["numeric"]["value"])
+        new_num = float(metrics["numeric"]["value"])
+        rel = abs(new_num - base_num) / max(abs(base_num), 1e-12)
+        measured["rel_err"] = {"kind": "scalar", "value": rel}
+    return rg.compare(store, name, statics, fp, measured)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="perf_gate.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline store (default: {DEFAULT_BASELINE} "
+                         "at the repo root)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="record this run as the blessed baseline for "
+                         "this host fingerprint")
+    ap.add_argument("--stages", default=None,
+                    help="comma-separated subset of "
+                         f"{','.join(STAGE_NAMES)}")
+    ap.add_argument("--samples", type=int, default=K_SAMPLES,
+                    help="timed reps per stage (noise model size)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--out", default=None,
+                    help="also write the full result document here")
+    ap.add_argument("--cache-dir", default=DEFAULT_CACHE,
+                    help="serve-stage AOT export cache (stable path => "
+                         "warm reruns)")
+    args = ap.parse_args(argv)
+
+    names = list(STAGE_NAMES)
+    if args.stages:
+        names = [s.strip() for s in args.stages.split(",") if s.strip()]
+        unknown = set(names) - set(STAGE_NAMES)
+        if unknown:
+            sys.stderr.write(
+                f"perf_gate: unknown stage(s): {', '.join(sorted(unknown))}"
+                f" (known: {', '.join(STAGE_NAMES)})\n")
+            return 2
+
+    from smartcal_tpu import obs
+    from smartcal_tpu.obs import baselines as bl
+    from smartcal_tpu.obs import regress as rg
+    from smartcal_tpu.runtime import faults as rt_faults
+    from smartcal_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    obs.install_compile_listener()
+    rt_faults.install_from_env()
+
+    t0 = time.time()
+    baseline_path = args.baseline or os.path.join(_ROOT,
+                                                  DEFAULT_BASELINE)
+    store = bl.BaselineStore(baseline_path)
+    fp = bl.host_fingerprint()
+
+    try:
+        stages = build_stages(names, args.cache_dir)
+    except Exception as e:
+        sys.stderr.write(f"perf_gate: stage build failed: {e!r}\n")
+        return 2
+
+    doc = {"fingerprint": fp,
+           "fingerprint_digest": bl.fingerprint_digest(fp),
+           "baseline": os.path.relpath(baseline_path, _ROOT),
+           "samples": args.samples, "stages": {}, "findings": []}
+    n_fire = n_warn = 0
+    for name, stage in stages.items():
+        metrics = measure_stage(name, stage, args.samples)
+        doc["stages"][name] = {"statics": stage["statics"],
+                               "metrics": metrics}
+        if args.update_baseline:
+            store.record(name, stage["statics"], fp, metrics)
+            continue
+        try:
+            findings = judge(store, name, stage["statics"], fp, metrics)
+        except rg.FingerprintMismatch as e:
+            sys.stderr.write(f"perf_gate: {e}\n")
+            return 2
+        for f in findings:
+            doc["findings"].append(dataclass_dict(f))
+            n_fire += f.verdict == rg.FIRE
+            n_warn += f.verdict == rg.WARN
+            if not args.as_json:
+                print(f.render())
+
+    doc["wall_s"] = round(time.time() - t0, 3)
+    if args.update_baseline:
+        store.save()
+        doc["updated"] = True
+        msg = (f"perf_gate: baseline updated for {len(stages)} stage(s) "
+               f"on fingerprint {doc['fingerprint_digest']} -> "
+               f"{doc['baseline']}")
+    else:
+        doc["fires"], doc["warns"] = n_fire, n_warn
+        msg = (f"perf_gate: {n_fire} FIRE / {n_warn} WARN over "
+               f"{len(stages)} stage(s) in {doc['wall_s']}s "
+               f"[fingerprint {doc['fingerprint_digest']}]")
+    if args.as_json:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    else:
+        print(msg)
+    if args.out:
+        from smartcal_tpu.runtime.atomic import atomic_write_text
+        atomic_write_text(args.out,
+                          json.dumps(doc, indent=1, sort_keys=True) + "\n")
+    return 1 if n_fire else 0
+
+
+def dataclass_dict(f):
+    import dataclasses
+    d = dataclasses.asdict(f)
+    if d.get("ci95"):
+        d["ci95"] = list(d["ci95"])
+    return d
+
+
+if __name__ == "__main__":
+    sys.exit(main())

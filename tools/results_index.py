@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Machine-readable index + schema validation over ``results/`` artifacts.
+
+The repo accumulates one round-stamped artifact per measurement PR
+(``nscale_r13.json``, ``trace_overhead_r17.json``, ...).  Reviewers and
+the regression radar both want to answer "how has metric X moved across
+rounds?" without grepping fifteen ad-hoc JSON shapes.  This tool scans
+``results/`` recursively, classifies every ``.json`` artifact against
+the small set of known schemas, extracts the (metric, round, value,
+unit, fingerprint) tuple where one exists, and emits:
+
+- ``results/INDEX.md`` — a human-readable index with per-metric
+  trajectories across rounds (newest last), written atomically;
+- ``--json`` — the same document as machine-readable JSON on stdout.
+
+Schemas recognised (see _classify):
+
+- ``bench``        dict with ``metric``/``value``/``unit`` — the
+                   canonical bench.py payload (validated strictly);
+- ``bench-suite``  dict with a ``bench`` name and ``runs`` (serve_r14,
+                   serve_fleet_r15);
+- ``summary``      any other dict (experiment summaries, decisions);
+- ``table``        a JSON list (host_seg_bench);
+- ``invalid``      unparseable JSON, or a bench payload violating the
+                   schema (missing keys, non-numeric value).
+
+Exit status: 0 when every artifact parses and bench payloads validate;
+1 under ``--strict`` if any problem was found (always listed either
+way).
+
+Usage::
+
+    python tools/results_index.py [--results DIR] [--json] [--strict]
+                                  [--no-write]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from smartcal_tpu.runtime.atomic import atomic_write_text
+
+ROUND_RE = re.compile(r"_r(\d+)(?:\D|$)")
+
+#: bench payload contract (bench.py `_write_results_artifact` and the
+#: per-bench extras): these keys must exist and `value` must be numeric.
+BENCH_REQUIRED = ("metric", "value", "unit")
+
+
+def artifact_round(name: str) -> Optional[int]:
+    """Round stamp from a ``_rN`` filename suffix (None if unstamped)."""
+    m = ROUND_RE.search(os.path.basename(name))
+    return int(m.group(1)) if m else None
+
+
+def fingerprint_kind(doc: Any) -> str:
+    """How well the artifact pins its host: ``digest`` (full
+    host_fingerprint from obs.baselines), ``legacy`` (ad-hoc
+    platform/host_cores tags), or ``none``."""
+    if not isinstance(doc, dict):
+        return "none"
+    if "host_fingerprint_digest" in doc or "host_fingerprint" in doc:
+        return "digest"
+    if "host_cores" in doc or "platform" in doc:
+        return "legacy"
+    return "none"
+
+
+def _classify(doc: Any, problems: List[str], rel: str) -> Dict[str, Any]:
+    """Classify one parsed artifact; append schema violations to
+    ``problems``.  Returns the per-artifact index row."""
+    row: Dict[str, Any] = {"schema": "summary", "metric": None,
+                           "value": None, "unit": None}
+    if isinstance(doc, list):
+        row["schema"] = "table"
+        return row
+    if not isinstance(doc, dict):
+        problems.append(f"{rel}: top-level JSON is {type(doc).__name__}, "
+                        "expected object or array")
+        row["schema"] = "invalid"
+        return row
+    if "metric" in doc:
+        row["schema"] = "bench"
+        missing = [k for k in BENCH_REQUIRED if k not in doc]
+        if missing:
+            problems.append(f"{rel}: bench payload missing {missing}")
+            row["schema"] = "invalid"
+        row["metric"] = doc.get("metric")
+        row["unit"] = doc.get("unit")
+        val = doc.get("value")
+        if val is not None and not isinstance(val, (int, float)):
+            problems.append(f"{rel}: bench value is "
+                            f"{type(val).__name__}, expected number")
+            row["schema"] = "invalid"
+        else:
+            row["value"] = val
+        vsb = doc.get("vs_baseline")
+        if vsb is not None and not isinstance(vsb, (str, int, float)):
+            problems.append(f"{rel}: vs_baseline must be a string or "
+                            "number")
+    elif "bench" in doc and "runs" in doc:
+        row["schema"] = "bench-suite"
+        row["metric"] = doc.get("bench")
+    elif "stages" in doc and "findings" in doc:
+        row["schema"] = "perf-gate"
+    elif "schema_version" in doc and "entries" in doc:
+        row["schema"] = "baseline-store"
+    return row
+
+
+def scan(results_dir: str) -> Dict[str, Any]:
+    """Walk ``results_dir`` and build the full index document."""
+    rows: List[Dict[str, Any]] = []
+    problems: List[str] = []
+    other: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(results_dir):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, results_dir)
+            if not fn.endswith(".json"):
+                if os.path.dirname(rel) == "":
+                    other.append(rel)
+                continue
+            try:
+                with open(path) as fh:
+                    doc = json.load(fh)
+            except (OSError, ValueError) as exc:
+                problems.append(f"{rel}: unreadable JSON ({exc})")
+                rows.append({"path": rel, "round": artifact_round(fn),
+                             "schema": "invalid", "metric": None,
+                             "value": None, "unit": None,
+                             "fingerprint": "none"})
+                continue
+            row = _classify(doc, problems, rel)
+            row.update(path=rel, round=artifact_round(fn),
+                       fingerprint=fingerprint_kind(doc))
+            rows.append(row)
+    rows.sort(key=lambda r: r["path"])
+    return {"results_dir": results_dir, "artifacts": rows,
+            "other_files": other, "problems": problems,
+            "trajectories": _trajectories(rows)}
+
+
+def _trajectories(rows: List[Dict[str, Any]]) -> Dict[str, List[dict]]:
+    """Per-metric value trajectory across rounds (bench payloads only,
+    top-level artifacts only, ordered by round with unstamped first)."""
+    by_metric: Dict[str, List[dict]] = {}
+    for r in rows:
+        if r["schema"] != "bench" or r["metric"] is None:
+            continue
+        if os.path.dirname(r["path"]):
+            continue  # nested summaries aren't round-over-round series
+        by_metric.setdefault(r["metric"], []).append(
+            {"round": r["round"], "value": r["value"], "unit": r["unit"],
+             "path": r["path"]})
+    for pts in by_metric.values():
+        pts.sort(key=lambda p: (p["round"] is not None, p["round"] or 0))
+    return by_metric
+
+
+def render_markdown(doc: Dict[str, Any]) -> str:
+    """INDEX.md body from a scan document."""
+    lines = ["# results/ index", "",
+             "Generated by `python tools/results_index.py` — do not edit;",
+             "regenerate after adding an artifact.", ""]
+    lines += ["## Metric trajectories", ""]
+    traj = doc["trajectories"]
+    if traj:
+        lines += ["| metric | trajectory (by round) | unit |",
+                  "|---|---|---|"]
+        for metric in sorted(traj):
+            pts = traj[metric]
+            steps = " → ".join(
+                f"r{p['round']}: {p['value']}" if p["round"] is not None
+                else f"{p['value']}" for p in pts)
+            unit = pts[-1]["unit"] or ""
+            lines.append(f"| {metric} | {steps} | {unit} |")
+    else:
+        lines.append("(no bench-schema artifacts found)")
+    lines += ["", "## Artifacts", "",
+              "| path | round | schema | metric | fingerprint |",
+              "|---|---|---|---|---|"]
+    for r in doc["artifacts"]:
+        rnd = f"r{r['round']}" if r["round"] is not None else "—"
+        lines.append(f"| {r['path']} | {rnd} | {r['schema']} | "
+                     f"{r['metric'] or '—'} | {r['fingerprint']} |")
+    if doc["other_files"]:
+        lines += ["", "## Non-JSON artifacts", ""]
+        lines += [f"- {p}" for p in doc["other_files"]]
+    if doc["problems"]:
+        lines += ["", "## Schema problems", ""]
+        lines += [f"- {p}" for p in doc["problems"]]
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--results", default="results",
+                    help="results directory to scan")
+    ap.add_argument("--json", action="store_true",
+                    help="print the index document as JSON on stdout")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 if any schema problem was found")
+    ap.add_argument("--no-write", action="store_true",
+                    help="do not write INDEX.md (scan/report only)")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.results):
+        print(f"results_index: no such directory: {args.results}",
+              file=sys.stderr)
+        return 2
+    doc = scan(args.results)
+    if not args.no_write:
+        out = os.path.join(args.results, "INDEX.md")
+        atomic_write_text(out, render_markdown(doc))
+        doc["index_md"] = out
+    if args.json:
+        print(json.dumps(doc, indent=1))
+    else:
+        n_bench = sum(1 for r in doc["artifacts"] if r["schema"] == "bench")
+        print(f"results_index: {len(doc['artifacts'])} JSON artifact(s), "
+              f"{n_bench} bench payload(s), {len(doc['trajectories'])} "
+              f"metric trajectories, {len(doc['problems'])} problem(s)"
+              + ("" if args.no_write else f" -> {doc['index_md']}"))
+        for p in doc["problems"]:
+            print(f"  problem: {p}")
+    return 1 if (args.strict and doc["problems"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+#!/bin/bash
+# Regression-radar smoke: the perf gate's whole workflow end to end.
+#
+# 1. RECORD — perf_gate --update-baseline blesses this host's numbers
+#    into a fresh baseline store (fingerprinted keys).
+# 2. CLEAN  — an immediate rerun against the recorded baseline must be
+#    green (exit 0, zero FIREs): same host, same tree, only noise.
+# 3. SLOW   — a planned delay inside the solve stage's timed reps
+#    (runtime/faults.py via SMARTCAL_FAULTS — the same chaos hook the
+#    serve smoke uses) must be caught: exit 1 with a FIRE naming
+#    solve.wall_s and carrying the measured delta + noise band.
+# 4. DRIFT  — a planned numeric perturbation beyond the documented bf16
+#    band must be caught the same way (influence.rel_err FIRE).
+# 5. ROUND-TRIP — --update-baseline re-blesses, and the rerun is green
+#    again: the graftlint workflow applied to performance.
+#
+# CI companion of smoke_lint.sh / smoke_serve.sh; ~3 min on the 1-core
+# container (warm XLA cache).
+#
+#   bash tools/smoke_perfgate.sh [workdir]
+#
+# Exits non-zero on any broken link in the chain.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+WORK="${1:-$(mktemp -d /tmp/smoke_perfgate.XXXXXX)}"
+BASE="$WORK/perf_baselines.json"
+CACHE="$WORK/cache"
+mkdir -p "$WORK"
+
+gate() {  # gate <extra args...> — stdout to $WORK/last.txt, pass exit up
+    JAX_PLATFORMS=cpu python tools/perf_gate.py \
+        --baseline "$BASE" --cache-dir "$CACHE" "$@" \
+        > "$WORK/last.txt"
+}
+
+echo "[smoke_perfgate] 1: RECORD baseline ($BASE)" >&2
+gate --update-baseline
+grep -q "baseline updated for 5 stage(s)" "$WORK/last.txt" || {
+    echo "[smoke_perfgate] FAIL: record did not bless all stages" >&2
+    cat "$WORK/last.txt" >&2; exit 1
+}
+
+echo "[smoke_perfgate] 2: CLEAN rerun must be green" >&2
+gate || {
+    echo "[smoke_perfgate] FAIL: clean rerun fired" >&2
+    cat "$WORK/last.txt" >&2; exit 1
+}
+grep -q "0 FIRE" "$WORK/last.txt"
+
+echo "[smoke_perfgate] 3: injected slowdown must FIRE (exit 1)" >&2
+if SMARTCAL_FAULTS='{"delay_stage":"gate_solve","delay_at":0,"delay_s":0.05,"delay_span":10}' \
+        gate --stages solve; then
+    echo "[smoke_perfgate] FAIL: 6x solve slowdown not caught" >&2
+    cat "$WORK/last.txt" >&2; exit 1
+fi
+grep -q "FIRE] solve.wall_s" "$WORK/last.txt" || {
+    echo "[smoke_perfgate] FAIL: no FIRE naming solve.wall_s" >&2
+    cat "$WORK/last.txt" >&2; exit 1
+}
+
+echo "[smoke_perfgate] 4: injected numeric drift must FIRE (exit 1)" >&2
+if SMARTCAL_FAULTS='{"perturb_stage":"gate_numeric_influence","perturb_at":0,"perturb_rel":0.1}' \
+        gate --stages influence; then
+    echo "[smoke_perfgate] FAIL: out-of-band numeric drift not caught" >&2
+    cat "$WORK/last.txt" >&2; exit 1
+fi
+grep -q "FIRE] influence.rel_err" "$WORK/last.txt" || {
+    echo "[smoke_perfgate] FAIL: no FIRE naming influence.rel_err" >&2
+    cat "$WORK/last.txt" >&2; exit 1
+}
+
+echo "[smoke_perfgate] 5: --update-baseline round-trip" >&2
+gate --update-baseline
+gate || {
+    echo "[smoke_perfgate] FAIL: rerun after re-bless fired" >&2
+    cat "$WORK/last.txt" >&2; exit 1
+}
+echo "[smoke_perfgate] PASS (workdir $WORK)" >&2
