@@ -68,7 +68,8 @@ SHARED_FIELD_SPECS = [
         "path": "smartcal_tpu/serve/server.py",
         "class": "CalibServer",
         "fields": ["_programs", "_circuit_open", "_stats",
-                   "_sentinel_pending", "_sentinel_stats"],
+                   "_sentinel_pending", "_sentinel_stats",
+                   "_policy", "_policy_version"],
         "locks": ["_lock"],
         "why": "latest-executable table swapped by warmup while the "
                "batch worker reads it per batch; breaker flag written "
@@ -76,7 +77,21 @@ SHARED_FIELD_SPECS = [
                "stats written by worker + breaker, read by stats(); "
                "the numerics-sentinel snapshot is handed off "
                "latest-wins from the batch worker to the supervisor's "
-               "sentinel_poll and its counters are read by stats()",
+               "sentinel_poll and its counters are read by stats(); "
+               "the policy (params, version) pair is hot-swapped by "
+               "the publisher thread (swap_policy) while the batch "
+               "worker snapshots it per batch — a torn write serves a "
+               "request on mismatched params/version",
+    },
+    {
+        "path": "smartcal_tpu/serve/lifecycle.py",
+        "class": "TransitionStage",
+        "fields": ["_items", "_dropped", "_staged"],
+        "locks": ["_lock"],
+        "why": "replay-tee staging ring written by the server's batch "
+               "worker (transition_sink) while the learner loop drains "
+               "it — an unlocked extend/clear race loses or duplicates "
+               "served transitions",
     },
     {
         "path": "smartcal_tpu/serve/router.py",
@@ -109,6 +124,16 @@ SHARED_FIELD_SPECS = [
                "dispatcher; the received-frame ring (parent-side black "
                "box) written by the pump and dumped by the supervision "
                "thread on replica death",
+    },
+    {
+        "path": "smartcal_tpu/serve/fleet.py",
+        "class": "_WeightsPublisher",
+        "fields": ["_slot"],
+        "locks": ["_lock"],
+        "why": "latest-wins policy-snapshot slot written by the "
+               "replica's frame-dispatch loop (offer) and drained by "
+               "the swap worker — an unlocked write can tear the "
+               "(version, params) pair and swap mismatched weights",
     },
     {
         "path": "smartcal_tpu/obs/flightrec.py",

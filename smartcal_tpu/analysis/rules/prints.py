@@ -46,6 +46,7 @@ TOOLS_STDOUT_ALLOWLIST = frozenset({
     "results_index.py",
     "serve_calib.py",
     "serve_fleet.py",
+    "serve_learn.py",
     "summarize_demix_curves.py",
     "sweep_calib.py",
     "sweep_demix.py",

@@ -340,6 +340,29 @@ def gaussian_sample(mu, logsigma, key):
     return a, jnp.sum(log_probs, axis=-1, keepdims=True)
 
 
+def tanh_gaussian_log_prob_np(mu, logsigma, actions):
+    """Host-numpy port of :func:`tanh_gaussian_log_prob`, term for term.
+
+    The serving batch worker evaluates ``behavior_logp`` per completed
+    request from the policy heads it already holds on host (the exported
+    program returns ``(action, mu, logsigma)``) — paying a jax dispatch
+    per lane just to score a log-density would put device round-trips on
+    the hot path.  Parity with the jax version is pinned by
+    tests/test_lifecycle.py.
+    """
+    import numpy as np
+
+    mu = np.asarray(mu, np.float64)
+    logsigma = np.asarray(logsigma, np.float64)
+    a = np.clip(np.asarray(actions, np.float64), -1.0 + 1e-6, 1.0 - 1e-6)
+    z = np.arctanh(a)
+    sigma = np.exp(logsigma)
+    log_probs = (-0.5 * ((z - mu) / sigma) ** 2 - logsigma
+                 - 0.5 * np.log(2.0 * np.pi))
+    log_probs = log_probs - np.log(1.0 - a ** 2 + 1e-6)
+    return np.sum(log_probs, axis=-1)
+
+
 def tanh_gaussian_log_prob(mu, logsigma, actions):
     """log pi(a|s) of an ALREADY-SQUASHED action under a tanh-gaussian
     policy head — the evaluation counterpart of :func:`gaussian_sample`.

@@ -398,6 +398,32 @@ def shard_occupancy(cntr: int, n_shards: int, local_size: int) -> list:
             for s in range(n_shards)]
 
 
+def version_staleness(buf: ShardedReplayState, learner_version: int) -> dict:
+    """Host-side staleness profile of a VERSIONED buffer (one built from
+    ``replay.versioned_spec``): how far behind the learner the stored
+    behavior snapshots are, over the filled prefix.
+
+    This is the lifecycle run's staleness gauge source — the learner
+    publishes, versions in the ring age, and the IMPACT clip
+    (``replay.staleness_clip_weights``) starts biting; this summary is
+    what obs_report's lifecycle section plots next to the clip-saturation
+    aux.  Returns zeros when the buffer is empty or unversioned."""
+    if "version" not in buf.data:
+        return {"filled": 0, "staleness_mean": 0.0, "staleness_max": 0,
+                "stale_frac": 0.0}
+    S, L = buf.priority.shape
+    ver = np.asarray(jax.device_get(buf.data["version"])).T.reshape(-1)
+    filled = min(int(jax.device_get(buf.cntr)), S * L)
+    if filled <= 0:
+        return {"filled": 0, "staleness_mean": 0.0, "staleness_max": 0,
+                "stale_frac": 0.0}
+    stale = np.maximum(0, int(learner_version) - ver[:filled].astype(np.int64))
+    return {"filled": filled,
+            "staleness_mean": round(float(stale.mean()), 4),
+            "staleness_max": int(stale.max()),
+            "stale_frac": round(float((stale > 0).mean()), 4)}
+
+
 def replay_health(buf: ShardedReplayState) -> dict:
     """Host-side health summary — the flat ring reconstructed from the
     interleave (slot ``g = j*S + s``), run through the shared

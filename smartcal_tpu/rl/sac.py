@@ -191,6 +191,23 @@ def policy_apply(cfg: SACConfig, actor_params, obs):
     return jnp.tanh(mu)
 
 
+def policy_heads(cfg: SACConfig, actor_params, obs):
+    """:func:`policy_apply` that ALSO returns the distribution heads:
+    ``(tanh(mu), mu, logsigma)``.
+
+    The lifecycle server exports THIS forward so the batch worker can
+    score ``behavior_logp`` of whatever action was actually taken
+    (policy or pinned rho) under the snapshot that acted — host-side via
+    :func:`~smartcal_tpu.rl.networks.tanh_gaussian_log_prob_np` — without
+    a second device dispatch.  Same export contract as ``policy_apply``:
+    no sampling key, nothing closed over but the net shape, and
+    ``actor_params`` is a traced operand, so ONE exported executable
+    serves every weight version (the zero-compile hot-swap hinge)."""
+    actor, _ = _nets(cfg)
+    mu, logsigma = actor.apply({"params": actor_params}, obs)
+    return jnp.tanh(mu), mu, logsigma
+
+
 def choose_action_logp(cfg: SACConfig, st: SACState, obs, key):
     """:func:`choose_action` that ALSO returns ``log pi(a|s)`` (shape
     ``obs.shape[:-1]``) — the behavior log-prob the fleet actors store

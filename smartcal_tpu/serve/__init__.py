@@ -14,11 +14,17 @@ substrate.
 * :mod:`~smartcal_tpu.serve.fleet` — horizontal scale-out: replicated
   ``CalibServer`` processes (shared AOT + XLA cache, so replica N
   warm-starts) behind the deadline-aware least-loaded ``FleetRouter``
-  front door, with per-replica circuits and load-driven autoscale.
+  front door, with per-replica circuits and load-driven autoscale;
+* :mod:`~smartcal_tpu.serve.lifecycle` — the closed loop: tee served
+  transitions into the sharded versioned replay, learn beside the
+  server, publish zero-compile policy hot-swaps through the export
+  cache (``TransitionStage`` / ``ServingLearner`` / ``PolicyPublisher``).
 
 Entry points: ``tools/serve_calib.py`` (one server),
-``tools/serve_fleet.py`` (replica topology sweep); smokes:
-``tools/smoke_serve.sh``, ``tools/smoke_serve_fleet.sh``.
+``tools/serve_fleet.py`` (replica topology sweep),
+``tools/serve_learn.py`` (online learning lifecycle); smokes:
+``tools/smoke_serve.sh``, ``tools/smoke_serve_fleet.sh``,
+``tools/smoke_lifecycle.sh``.
 
 Exports resolve LAZILY (PEP 562): a spawned replica process imports
 this package on its way to :mod:`~smartcal_tpu.serve.fleet`'s worker
@@ -39,6 +45,9 @@ _EXPORTS = {
     "Job": ".router", "JobResult": ".router", "MicroBatcher": ".router",
     "ShedError": ".router",
     "CalibServer": ".server",
+    "PolicyPublisher": ".lifecycle", "ServingLearner": ".lifecycle",
+    "TransitionStage": ".lifecycle", "build_obs_pool": ".lifecycle",
+    "job_obs_vec": ".lifecycle",
 }
 
 __all__ = sorted(_EXPORTS)

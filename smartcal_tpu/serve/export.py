@@ -162,6 +162,49 @@ class ExportCache:
             prog = self.build(sig, fn, abstract_args)
         return prog
 
+    def publish(self, sig: dict, program: ServeProgram) -> ServeProgram:
+        """Persist an ALREADY-EXPORTED program under a new signature and
+        return it rebadged (``source="publish"``).
+
+        The hot-swap publication path: the serving policy program takes
+        ``actor_params`` as a traced operand, so a weight update needs
+        no re-trace/re-lower — identical StableHLO, one executable for
+        every version.  Publication is therefore a re-serialization
+        keyed on the NEW ``(version, serve_signature)`` (provenance + a
+        restartable per-version artifact) with zero compile events, not
+        a rebuild.  Skips the write when the versioned entry already
+        exists (idempotent republish)."""
+        path = self._base(sig) + ".jaxexp"
+        if not os.path.exists(path):
+            self.store(sig, program.exported)
+        return ServeProgram(program.exported, sig, source="publish")
+
+    def prune(self, kind: str, keep: int) -> int:
+        """Drop all but the ``keep`` most-recent entries of ``kind``
+        (mtime order) — the per-version publication stream would
+        otherwise grow the cache without bound.  Returns the number of
+        entries removed; never raises on a concurrent unlink."""
+        base = []
+        for name in os.listdir(self.dir):
+            if name.startswith(f"{kind}-") and name.endswith(".jaxexp"):
+                p = os.path.join(self.dir, name)
+                try:
+                    base.append((os.path.getmtime(p), p))
+                except OSError:
+                    continue
+        base.sort(reverse=True)
+        removed = 0
+        for _, p in base[max(0, int(keep)):]:
+            for victim in (p, p[:-len(".jaxexp")] + ".json"):
+                try:
+                    os.remove(victim)
+                except OSError:
+                    continue
+            removed += 1
+        if removed:
+            obs.counter_add("export_cache_pruned", removed)
+        return removed
+
     def _log(self, action: str, sig: dict, path: str, **extra) -> None:
         rl = obs.active()
         if rl is not None:

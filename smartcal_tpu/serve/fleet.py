@@ -30,7 +30,9 @@ Failure domains are per-replica, never fleet-wide:
 Message vocabulary (framed via :mod:`~smartcal_tpu.runtime.ipc`;
 tuples, kind first):
 
-* router -> replica: ``("job", payload_dict)``, ``("stop",)``
+* router -> replica: ``("job", payload_dict)``, ``("weights",
+  {"version", "params"})`` (policy hot-swap publication, latest-wins
+  per replica — see :meth:`FleetRouter.publish_policy`), ``("stop",)``
 * replica -> router: ``("ready", warmup_summary)``,
   ``("beat", gauges)``, ``("result", job_id, result_dict)``,
   ``("job_shed", job_id, reason)``, ``("job_failed", job_id, repr)``,
@@ -259,6 +261,10 @@ def _server_gauges(server) -> dict:
         "degraded": int(st.get("degraded", 0)),
         "deadline_miss": int(st.get("deadline_miss", 0)),
         "compile_events": float(c.get("jax_compile_events", 0.0)),
+        # which policy version this replica is serving (-1: no policy /
+        # stub server) — the lifecycle driver's convergence signal that
+        # a fleet-wide publication actually landed everywhere
+        "policy_version": int(getattr(server, "policy_version", -1)),
     }
 
 
@@ -302,6 +308,55 @@ def _submit_remote(server, payload: dict, send,
         send(("result", jid, dataclasses.asdict(r)), trace=job.trace)
 
     fut.add_done_callback(_done)
+
+
+class _WeightsPublisher(threading.Thread):
+    """Replica-side policy-swap worker: weight frames land LATEST-WINS
+    in a single slot and the swap (warm forward + locked pointer flip
+    via ``CalibServer.swap_policy``) runs on this thread — never on the
+    replica's frame-dispatch loop, so a beat or a job frame is never
+    delayed because a snapshot arrived.  A burst of publications
+    collapses to the newest version; each replica swaps independently
+    (the fleet is never barriered on a publication)."""
+
+    def __init__(self, server, replica_id: int):
+        super().__init__(name=f"replica{replica_id}-weights", daemon=True)
+        self.server = server
+        self.replica_id = int(replica_id)
+        self._lock = threading.Lock()
+        self._slot = None                # latest-wins (version, params)
+        self._wake = threading.Event()
+        # NOT "_stop": threading.Thread.join(timeout=...) calls its own
+        # private _stop() and an Event there makes any timed join raise
+        self._stop_ev = threading.Event()
+        self.swaps = 0
+
+    def offer(self, version: int, params) -> None:
+        with self._lock:
+            self._slot = (int(version), params)
+        self._wake.set()
+
+    def request_stop(self) -> None:
+        self._stop_ev.set()
+        self._wake.set()
+
+    def run(self) -> None:
+        while not self._stop_ev.is_set():
+            self._wake.wait(timeout=0.2)
+            self._wake.clear()
+            with self._lock:
+                item, self._slot = self._slot, None
+            if item is None:
+                continue
+            version, params = item
+            try:
+                self.server.swap_policy(params, version)
+                self.swaps += 1
+            except Exception as e:       # a bad frame must not kill the
+                obs.counter_add("fleet_weights_swap_errors")  # replica
+                _event("fleet_weights_swap_error",
+                       replica=self.replica_id, version=version,
+                       error=repr(e))
 
 
 def replica_worker_main(conn, replica_id: int, spec: dict) -> None:
@@ -369,6 +424,7 @@ def replica_worker_main(conn, replica_id: int, spec: dict) -> None:
         return
     beat_s = float(spec.get("beat_s", 0.1))
     last_beat = 0.0
+    weights_pub: Optional[_WeightsPublisher] = None
     try:
         while True:
             if conn.poll(beat_s):
@@ -385,6 +441,20 @@ def replica_worker_main(conn, replica_id: int, spec: dict) -> None:
                     break
                 if msg[0] == "job":
                     _submit_remote(server, msg[1], send, replica_id)
+                elif msg[0] == "weights":
+                    # policy hot-swap publication: hand the snapshot to
+                    # the latest-wins swap worker (servers without a
+                    # policy — stubs — ignore the frame, counted)
+                    if weights_pub is None \
+                            and hasattr(server, "swap_policy"):
+                        weights_pub = _WeightsPublisher(server,
+                                                        replica_id)
+                        weights_pub.start()
+                    if weights_pub is not None:
+                        weights_pub.offer(msg[1]["version"],
+                                          msg[1]["params"])
+                    else:
+                        obs.counter_add("fleet_weights_ignored")
             now = time.monotonic()
             if now - last_beat >= beat_s:
                 last_beat = now
@@ -392,6 +462,8 @@ def replica_worker_main(conn, replica_id: int, spec: dict) -> None:
     except (EOFError, OSError, BrokenPipeError):
         pass                             # router gone: nothing to report
     finally:
+        if weights_pub is not None:
+            weights_pub.request_stop()
         try:
             server.stop()
         except Exception:
@@ -536,6 +608,17 @@ class _Replica(threading.Thread):
         except queue.Full:
             with self._lock:
                 self._pending.pop(job.job_id, None)
+            return False
+        return True
+
+    def publish(self, blob: bytes) -> bool:
+        """Stage a pre-framed weights frame toward the worker; False
+        when the outbox is full — the frame is DROPPED, never retried:
+        the next publication supersedes it, and a weight frame must
+        never occupy outbox capacity a job dispatch needs."""
+        try:
+            self._outbox.put_nowait(blob)
+        except queue.Full:
             return False
         return True
 
@@ -998,6 +1081,36 @@ class FleetRouter:
         self._shed_record(job, reason)
         if not job.future.done():
             job.future.set_exception(ShedError(reason))
+
+    # -- policy publication ------------------------------------------------
+    def publish_policy(self, actor_params, version: int) -> int:
+        """Fan one versioned weight frame out to every live warm
+        replica (the fleet half of a policy hot-swap publication).
+
+        The pytree is pulled to host and framed ONCE; each replica's
+        swap then proceeds independently on its own ``_WeightsPublisher``
+        thread — no fleet-wide barrier, and a replica mid-restart just
+        misses this version and catches the next.  A full dispatch
+        outbox drops the FRAME (counted, superseded by the next
+        publication), never a job.  Returns the number of replicas
+        reached."""
+        blob = ipc.frame_payload(("weights",
+                                  {"version": int(version),
+                                   "params": _to_host(actor_params)}))
+        reached = dropped = 0
+        for r in self._live():
+            if not r.ready.is_set():
+                continue
+            if r.publish(blob):
+                reached += 1
+            else:
+                dropped += 1
+        obs.counter_add("fleet_policy_publishes")
+        if dropped:
+            obs.counter_add("fleet_weights_dropped", dropped)
+        _event("fleet_publish_policy", version=int(version),
+               reached=reached, dropped=dropped)
+        return reached
 
     # -- pump-thread callbacks ---------------------------------------------
     def _note_result(self, rid: int, job: Optional[Job], d: dict) -> None:

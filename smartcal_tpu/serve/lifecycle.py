@@ -1,0 +1,342 @@
+"""Online lifecycle: learn from served traffic, publish zero-downtime
+policy hot-swaps (ISSUE 20 — ROADMAP open item #1).
+
+Closes the train/serve loop around :class:`~smartcal_tpu.serve.server
+.CalibServer`:
+
+* **tee** — the server's ``transition_sink`` hook feeds every completed
+  non-warm, obs-bearing request into a :class:`TransitionStage` (a
+  bounded host staging ring: the batch worker pays one locked append,
+  nothing else);
+* **learn** — :class:`ServingLearner` drains the stage into the
+  mesh-sharded VERSIONED replay (``rl/replay_sharded`` over
+  ``replay.versioned_spec``) and runs the fused SAC step beside the
+  server, IMPACT staleness-clipped IS weighting (arXiv:1912.00167) +
+  ERE recency bias (arXiv:1906.04009) armed — served traffic is
+  off-policy and ages across swaps, which is exactly the regime those
+  knobs exist for;
+* **publish** — :class:`PolicyPublisher` AOT-publishes each new
+  snapshot keyed on ``(version, serve_signature)`` through the
+  :class:`~smartcal_tpu.serve.export.ExportCache` and atomically swaps
+  it into the server between micro-batch flushes
+  (``CalibServer.swap_policy``; fleet-wide via
+  ``FleetRouter.publish_policy`` weight frames).
+
+The zero-compile hinge: the exported policy program takes
+``actor_params`` as a TRACED OPERAND, so its StableHLO is identical for
+every weight version — publication re-serializes the program under the
+new versioned key (``ExportCache.publish``: provenance + a restartable
+per-version artifact) and warms the installed executable with the new
+params; it never re-traces, re-lowers, or re-compiles.  A policy update
+therefore never drops a request, never pays a foreground compile, and
+never blocks the batch worker (the export/warm run on the publisher's
+thread; the swap itself is a locked pointer flip).
+
+Driver: ``tools/serve_learn.py``; smoke: ``tools/smoke_lifecycle.sh``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from smartcal_tpu import obs
+from smartcal_tpu.envs import calib as calib_env
+
+
+def _event(name: str, **fields) -> None:
+    rl = obs.active()
+    if rl is not None:
+        rl.log(name, **fields)
+
+
+# ---------------------------------------------------------------------------
+# observation construction (the serving side of the CalibEnv contract)
+# ---------------------------------------------------------------------------
+
+def job_obs_vec(backend, episode, k: int, M: int,
+                npix: Optional[int] = None,
+                probe_iters: Optional[int] = None) -> np.ndarray:
+    """Flattened policy observation for a serving job, in the CalibEnv
+    convention (:mod:`smartcal_tpu.envs.calib`): influence image of a
+    unit-rho probe calibration x ``INF_SCALE``, then an (M+1)x7
+    sky/meta table x ``META_SCALE`` with the unit-rho columns (5/6) and
+    the live-direction fraction in the spare last row.
+
+    Built OFFLINE at pool-construction time (one probe calibrate +
+    influence per entry) — the serving hot path never computes
+    observations, it carries them."""
+    npix = int(npix or backend.npix)
+    rho = np.ones(M, np.float32)
+    alpha = np.zeros(M, np.float32)
+    mask = np.zeros(M, np.float32)
+    mask[:k] = 1.0
+    iters = int(probe_iters or backend.admm_iters)
+    r = backend.calibrate(episode, rho, mask=mask, admm_iters=iters)
+    img = np.asarray(backend.influence_image(episode, r, rho, alpha,
+                                             npix=npix), np.float32)
+    sky = np.zeros((M + 1, 7), np.float32)
+    sky[:k, 5] = calib_env._to_unit(rho[:k])
+    sky[:k, 6] = calib_env._to_unit(alpha[:k])
+    sky[M, 0] = k / max(1, M)
+    return np.concatenate([
+        (img * calib_env.INF_SCALE).ravel(),
+        (sky * calib_env.META_SCALE).ravel()]).astype(np.float32)
+
+
+def build_obs_pool(backend, M: int, n: int, seed: int = 0,
+                   heterogeneous: bool = True,
+                   diffuse_frac: float = 0.25,
+                   npix: Optional[int] = None
+                   ) -> List[Tuple[int, object, np.ndarray]]:
+    """A :func:`~smartcal_tpu.serve.loadgen.build_job_pool` pool with
+    the flattened observation attached per entry — ``(k, episode,
+    obs_vec)`` triples the lifecycle load generator submits, so every
+    job can ride the policy forward AND the replay tee."""
+    from .loadgen import build_job_pool
+
+    pool = build_job_pool(backend, M, n, seed=seed,
+                          heterogeneous=heterogeneous,
+                          diffuse_frac=diffuse_frac)
+    return [(k, ep, job_obs_vec(backend, ep, k, M, npix=npix))
+            for k, ep in pool]
+
+
+# ---------------------------------------------------------------------------
+# the tee: batch worker -> learner staging
+# ---------------------------------------------------------------------------
+
+class TransitionStage:
+    """Bounded thread-safe staging ring between the batch worker (the
+    server's ``transition_sink``) and the learner's ingest loop.
+
+    The worker-side cost is one locked list-extend per batch; overflow
+    drops the OLDEST staged transitions (the learner is behind — recent
+    traffic is worth more than stale, same bias ERE encodes) and counts
+    them, never blocks."""
+
+    def __init__(self, cap: int = 4096):
+        self.cap = int(cap)
+        self._lock = threading.Lock()
+        self._items: list = []
+        self._dropped = 0
+        self._staged = 0
+
+    def __call__(self, transitions: list) -> None:
+        """The ``CalibServer(transition_sink=...)`` hook."""
+        with self._lock:
+            self._items.extend(transitions)
+            self._staged += len(transitions)
+            over = len(self._items) - self.cap
+            if over > 0:
+                del self._items[:over]
+                self._dropped += over
+        if transitions:
+            obs.counter_add("lifecycle_staged", len(transitions))
+
+    def drain(self) -> list:
+        with self._lock:
+            items, self._items = self._items, []
+        return items
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"staged": self._staged, "dropped": self._dropped,
+                    "pending": len(self._items)}
+
+
+# ---------------------------------------------------------------------------
+# publication: versioned re-export + atomic swap
+# ---------------------------------------------------------------------------
+
+class PolicyPublisher:
+    """Publish a new policy snapshot to a warmed server (and optionally
+    a replica fleet): ExportCache entry keyed on (version,
+    serve_signature) -> warm forward with the new params -> atomic
+    ``swap_policy`` between micro-batch flushes.
+
+    Runs on the CALLER's thread (the learner loop / a dedicated
+    publisher thread) — never the batch worker's: the worker only ever
+    sees the locked pointer flip inside ``swap_policy``."""
+
+    def __init__(self, server, fleet=None, keep_versions: int = 8):
+        self.server = server
+        self.fleet = fleet
+        self.keep_versions = int(keep_versions)
+        self._lock = threading.Lock()
+        self._stats = {"publishes": 0, "last_publish_s": 0.0,
+                       "last_version": 0}
+
+    def publish(self, actor_params, version: int) -> dict:
+        """Synchronous publication; returns the timing record."""
+        srv = self.server
+        if srv._base_sig is None:
+            raise RuntimeError("publish before server warmup() — no "
+                               "serve signature to key the export on")
+        t0 = time.monotonic()
+        with obs.span("serve_publish", version=int(version)):
+            sig = srv._policy_sig(srv._base_sig, version)
+            t_exp = time.monotonic()
+            prog = srv.cache.publish(sig, srv._program("policy"))
+            export_s = time.monotonic() - t_exp
+            swap = srv.swap_policy(actor_params, version, program=prog)
+            srv.cache.prune("policy", self.keep_versions)
+            reached = 0
+            if self.fleet is not None:
+                reached = self.fleet.publish_policy(actor_params, version)
+        publish_s = time.monotonic() - t0
+        with self._lock:
+            self._stats["publishes"] += 1
+            self._stats["last_publish_s"] = publish_s
+            self._stats["last_version"] = int(version)
+        obs.counter_add("policy_publishes")
+        _event("policy_publish", version=int(version),
+               export_s=round(export_s, 6),
+               swap_s=round(swap["swap_s"], 6),
+               publish_s=round(publish_s, 6), fleet_reached=reached)
+        return {"version": int(version), "export_s": export_s,
+                "swap_s": swap["swap_s"], "publish_s": publish_s,
+                "fleet_reached": reached}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats)
+
+
+# ---------------------------------------------------------------------------
+# the learner beside the server
+# ---------------------------------------------------------------------------
+
+class ServingLearner:
+    """SAC learner over the mesh-sharded versioned replay, fed by the
+    server tee and publishing through a :class:`PolicyPublisher`.
+
+    ``version`` is the learner's LAST PUBLISHED version: transitions
+    teed from the current serving snapshot carry it and get IMPACT
+    weight exactly 1.0; transitions from older snapshots are stale and
+    get the clipped importance ratio.  ``cfg.is_clip``/``cfg.ere_eta``
+    should be armed for the lifecycle regime (the driver's defaults)."""
+
+    def __init__(self, cfg, seed: int = 0, n_shards: int = 4,
+                 publisher: Optional[PolicyPublisher] = None,
+                 publish_every: int = 8, ingest_chunk: int = 16):
+        import jax
+
+        from smartcal_tpu.rl import replay as rp
+        from smartcal_tpu.rl import replay_sharded as rps
+        from smartcal_tpu.rl import sac
+
+        self.cfg = cfg
+        self.publisher = publisher
+        self.publish_every = int(publish_every)
+        self.ingest_chunk = int(ingest_chunk)
+        self.key = jax.random.PRNGKey(seed)
+        self.key, k0 = jax.random.split(self.key)
+        self.state = sac.sac_init(k0, cfg)
+        self._spec = rp.versioned_spec(
+            rp.transition_spec(cfg.obs_dim, cfg.n_actions))
+        self.buffer = rps.place_on_mesh(
+            rps.replay_init(cfg.mem_size, self._spec, n_shards))
+        self._rps = rps
+        self._add = jax.jit(lambda buf, tr: rps.replay_add_batch(buf, tr))
+        self._learn = jax.jit(
+            lambda st, buf, key, ver: sac.learn(cfg, st, buf, key,
+                                                learner_version=ver))
+        self._pending: list = []
+        self.version = 0
+        self.learns = 0
+        self.ingested = 0
+        self.last_metrics: dict = {}
+
+    @property
+    def actor_params(self):
+        return self.state.actor_params
+
+    def warm(self) -> None:
+        """Compile the ingest and learn programs BEFORE the serving
+        window opens, so the steady state stays at zero compile events:
+        one fixed-chunk store against a discarded buffer copy, then TWO
+        real (empty-ring no-op) steps, then a warm re-publish of the
+        current version.
+
+        Two steps, not one: the first learn's inputs are the uncommitted
+        init state + mesh-placed ring, but its OUTPUTS come back
+        mesh-sharded (GSPMD propagates the ring's NamedSharding to every
+        output), so the second call sees a different argument mapping
+        and compiles a second executable — the sharding fixed point.
+        Both executables must exist before the window or the second one
+        compiles mid-serving.  ``lax.cond`` compiles both the learn and
+        no-learn branches either way, and the no-learn branch returns
+        state/ring bitwise unchanged, so warming through the REAL step
+        path is a value-level no-op.  The warm publish (when a publisher
+        is wired) re-publishes the current version so the exported
+        policy's ``call_exported`` dispatch is also compiled against the
+        learner's mesh-sharded params — the first real hot-swap would
+        otherwise pay that compile in-window."""
+        import jax
+
+        fake = {k: np.zeros((self.ingest_chunk,) + tuple(shape), dtype)
+                for k, (shape, dtype) in self._spec.items()}
+        jax.block_until_ready(self._add(self.buffer, fake))  # discarded
+        for _ in range(2):                   # sharding fixed point
+            self.step()
+        jax.block_until_ready((self.state, self.buffer))
+        self.learns = 0                      # warm steps don't count
+        if self.publisher is not None:
+            self.publisher.publish(self.actor_params, self.version)
+
+    def ingest(self, transitions: list) -> int:
+        """Stage transition dicts and store them in FIXED-SIZE chunks
+        (round-robin across the replay shards).  The fixed chunk keeps
+        the jitted store at one compiled shape — a variable-size drain
+        would re-trace per new batch size, breaking the zero-compile
+        serving window.  Leftovers below a chunk stay pending for the
+        next call; returns the number actually stored."""
+        self._pending.extend(transitions)
+        stored = 0
+        while len(self._pending) >= self.ingest_chunk:
+            batch = self._pending[:self.ingest_chunk]
+            del self._pending[:self.ingest_chunk]
+            flat = {k: np.stack([np.asarray(t[k]) for t in batch])
+                    for k in batch[0]}
+            self.buffer = self._add(self.buffer, flat)
+            stored += len(batch)
+        self.ingested += stored
+        return stored
+
+    def step(self, pull_metrics: bool = False) -> Optional[dict]:
+        """One fused learn step at the current learner version (a no-op
+        inside the jitted cond until the buffer holds a batch)."""
+        import jax
+
+        self.key, k = jax.random.split(self.key)
+        self.state, self.buffer, metrics = self._learn(
+            self.state, self.buffer, k,
+            np.int32(self.version))
+        self.learns += 1
+        if pull_metrics:
+            host = {k_: float(v) for k_, v in
+                    jax.device_get(metrics).items()
+                    if np.ndim(v) == 0}
+            self.last_metrics = host
+            return host
+        return None
+
+    def maybe_publish(self) -> Optional[dict]:
+        """Publish version N+1 every ``publish_every`` learns (once the
+        buffer has actually learned something)."""
+        if (self.publisher is None or self.learns == 0
+                or self.learns % self.publish_every != 0):
+            return None
+        if int(self.buffer.cntr) < self.cfg.batch_size:
+            return None                  # nothing learned yet: hold fire
+        self.version += 1
+        return self.publisher.publish(self.actor_params, self.version)
+
+    def staleness(self) -> dict:
+        """Host staleness profile of the ring vs the published version
+        (the lifecycle gauge source)."""
+        return self._rps.version_staleness(self.buffer, self.version)

@@ -19,6 +19,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from smartcal_tpu.obs import tracectx
+
 from .router import Job, ShedError
 
 # Serving backend scale presets (the "tier" kwargs a RadioBackend takes);
@@ -36,26 +38,31 @@ SERVE_TIERS = {
 
 
 def build_job_pool(backend, M: int, n: int, seed: int = 0,
-                   key0=None, mixed: bool = True,
-                   diffuse_frac: float = 0.25
+                   key0=None, heterogeneous: bool = True,
+                   diffuse_frac: float = 0.25, mixed=None
                    ) -> List[Tuple[int, object]]:
     """``n`` pre-built (k, episode) pairs padded to M directions (the
     server's contract).
 
-    ``mixed`` (the default since ISSUE 16) draws a HETEROGENEOUS pool:
-    K uniform over [2, M] and a ``diffuse_frac`` fraction of diffuse-sky
-    episodes per draw, instead of the old deterministic K cycle over
-    point-source skies — ROADMAP #3 flags every serving number measured
-    against the homogeneous pool as optimistic.  ``mixed=False`` keeps
-    the PR 15 pool bit-for-bit for comparability."""
+    ``heterogeneous`` (the default — since ISSUE 20 for EVERY driver,
+    not just the fleet's) draws a mixed pool: K uniform over [2, M] and
+    a ``diffuse_frac`` fraction of diffuse-sky episodes per draw,
+    instead of the old deterministic K cycle over point-source skies —
+    ROADMAP #3 flags every serving number measured against the
+    homogeneous pool as optimistic.  ``heterogeneous=False`` keeps the
+    PR 15 uniform pool bit-for-bit for comparability.  ``mixed`` is the
+    pre-ISSUE-20 name for the same knob; when given it wins (caller
+    compatibility)."""
     import jax
 
+    if mixed is not None:
+        heterogeneous = bool(mixed)
     key = jax.random.PRNGKey(seed) if key0 is None else key0
     rng = np.random.default_rng(seed)
     pool = []
     for i in range(n):
         key, k = jax.random.split(key)
-        if mixed:
+        if heterogeneous:
             kdirs = int(rng.integers(2, M + 1))
             diffuse = bool(rng.random() < diffuse_frac)
         else:
@@ -118,13 +125,19 @@ class OpenLoopLoadGen:
             else:
                 idx = i % len(self.pool)
                 mi = self.maxiter_choices[i % len(self.maxiter_choices)]
-            kdirs, ep = self.pool[idx]
+            entry = self.pool[idx]
+            # lifecycle pools carry a third element: the pre-computed
+            # flattened observation (serve.lifecycle.build_obs_pool) the
+            # policy forward / replay tee consume
+            kdirs, ep = entry[0], entry[1]
+            obs_vec = entry[2] if len(entry) > 2 else None
             rho = None
             if rng.random() < 0.5:       # half pinned-rho, half default/policy
                 rho = np.exp(rng.uniform(np.log(0.1), np.log(10.0),
                                          kdirs)).astype(np.float32)
             job = Job(episode=ep, k=kdirs, rho=rho, maxiter=mi,
-                      deadline_s=self.deadline_s)
+                      deadline_s=self.deadline_s, obs_vec=obs_vec,
+                      trace=tracectx.new_root_carrier())
             submitted += 1
             i += 1
             try:

@@ -78,6 +78,12 @@ class Job:
     # fleet admission (obs/tracectx.py): crosses the process boundary
     # in the job payload so replica-side events join the request's tree
     trace: Optional[dict] = None
+    # policy version the server held when this job was ADMITTED
+    # (stamped in CalibServer.submit).  A hot-swap can land between
+    # admission and execution, so the serve_request event reports this
+    # alongside the version that actually acted — never silently just
+    # the new one.  None until a versioned server stamps it.
+    version_admitted: Optional[int] = None
 
 
 @dataclasses.dataclass

@@ -95,7 +95,8 @@ class CalibServer:
                  poll_s: float = 0.05, idle_tick_s: float = 0.2,
                  compile_cache: bool = True, sentinel_every: int = 0,
                  sentinel_band: Optional[float] = None,
-                 sentinel_slo: Optional[obs.SloBurnDetector] = None):
+                 sentinel_slo: Optional[obs.SloBurnDetector] = None,
+                 transition_sink=None):
         self.backend = backend
         self.M = int(M)
         self.lanes = int(lanes)
@@ -109,11 +110,21 @@ class CalibServer:
         self.batcher = MicroBatcher(lanes, max_wait_s=max_wait_s,
                                     max_queue=max_queue)
         self._policy = policy
+        # monotone policy snapshot version: 0 = the warmup export;
+        # swap_policy bumps it atomically with the params/program under
+        # _lock, so the batch worker's per-batch snapshot is consistent
+        self._policy_version = 0
+        # optional lifecycle tee: callable(list[transition dict]) invoked
+        # per batch (batch-worker thread, AFTER futures resolve) with the
+        # one-step transitions of every non-warm obs_vec-bearing job —
+        # the online learner's ingestion hook.  Immutable after init.
+        self._transition_sink = transition_sink
+        self._base_sig = None           # serve_signature, set at warmup
         self._lock = threading.Lock()
         self._programs: dict = {}       # latest-executable table
         self._circuit_open = False
         self._stats = {"batches": 0, "served": 0, "degraded": 0,
-                       "failed": 0, "deadline_miss": 0}
+                       "failed": 0, "deadline_miss": 0, "swaps": 0}
         self._bep = None                # worker-owned serving buffer
         self._batch_id = 0
         self._fleet: Optional[supervisor.Fleet] = None
@@ -161,6 +172,7 @@ class CalibServer:
             rho = np.ones((E, M), np.float32)
             alpha = np.zeros((E, M), np.float32)
             base = self.backend.serve_signature(M, E, self.npix)
+            self._base_sig = dict(base)   # swap_policy's re-export key
 
             ops = self.backend.batched_solve_operands(self._bep, rho)
             solve = self.cache.get_or_build(
@@ -206,21 +218,29 @@ class CalibServer:
         _event("serve_warmup", **summary)
         return summary
 
-    def _export_policy(self, base_sig: dict):
+    def _policy_sig(self, base_sig: dict, version: int) -> dict:
+        """The policy program's cache signature, keyed on (version,
+        serve_signature): every published version is a distinct,
+        restartable ExportCache entry."""
         import hashlib
 
+        cfg, _ = self._policy
+        obs_dim = self.npix * self.npix + (self.M + 1) * 7
+        return dict(base_sig, kind="policy", obs_dim=obs_dim,
+                    act_dim=2 * self.M, heads=True, version=int(version),
+                    cfg_digest=hashlib.sha256(
+                        repr(cfg).encode()).hexdigest()[:12])
+
+    def _export_policy(self, base_sig: dict):
         from smartcal_tpu.rl import sac
 
         cfg, actor_params = self._policy
         obs_dim = self.npix * self.npix + (self.M + 1) * 7
-        sig = dict(base_sig, kind="policy", obs_dim=obs_dim,
-                   act_dim=2 * self.M,
-                   cfg_digest=hashlib.sha256(
-                       repr(cfg).encode()).hexdigest()[:12])
+        sig = self._policy_sig(base_sig, self._policy_version)
         aargs = (abstract_like(actor_params),
                  jax.ShapeDtypeStruct((self.lanes, obs_dim), np.float32))
         prog = self.cache.get_or_build(
-            sig, lambda ap, o: sac.policy_apply(cfg, ap, o), aargs)
+            sig, lambda ap, o: sac.policy_heads(cfg, ap, o), aargs)
         # warm the backend compile of the deserialized module
         zeros = np.zeros((self.lanes, obs_dim), np.float32)
         jax.block_until_ready(prog(actor_params, zeros))
@@ -232,6 +252,63 @@ class CalibServer:
         if prog is None:
             raise RuntimeError(f"no {kind!r} program — call warmup() first")
         return prog
+
+    # -- zero-downtime policy hot-swap -------------------------------------
+    @property
+    def policy_version(self) -> int:
+        with self._lock:
+            return self._policy_version
+
+    def swap_policy(self, actor_params, version: int, program=None) -> dict:
+        """Atomically install a new policy snapshot between micro-batch
+        flushes.
+
+        The batch worker reads ONE consistent (params, program, version)
+        snapshot per batch under ``_lock`` (see ``_process_batch``), so
+        the swap here — a few dict/ref assignments under the same lock —
+        never tears a batch: every request completes on exactly one
+        policy version, and requests admitted under version V that
+        execute after the swap report both versions in their
+        ``serve_request`` event.
+
+        ``program=None`` (the common case) keeps the installed
+        executable: the exported policy takes ``actor_params`` as a
+        traced operand, so one program serves every weight version —
+        the swap costs one warm forward (first dispatch with the new
+        params, paid HERE rather than on the serving path) plus the
+        locked pointer flip.  Publication through the ExportCache
+        (the per-version re-export) is the publisher's job
+        (:class:`~smartcal_tpu.serve.lifecycle.PolicyPublisher`).
+        """
+        if self._policy is None:
+            raise RuntimeError("swap_policy on a server with no policy "
+                               "armed")
+        t0 = time.monotonic()
+        cfg, _ = self._policy
+        if program is None:
+            with self._lock:
+                program = self._programs.get("policy")
+            if program is None:
+                raise RuntimeError("no policy program — call warmup() "
+                                   "first")
+        # warm OUTSIDE the lock: the first dispatch with the new params
+        # must not run on the batch worker's clock
+        obs_dim = self.npix * self.npix + (self.M + 1) * 7
+        zeros = np.zeros((self.lanes, obs_dim), np.float32)
+        jax.block_until_ready(program(actor_params, zeros))
+        with self._lock:
+            old = self._policy_version
+            self._policy = (cfg, actor_params)
+            self._policy_version = int(version)
+            self._programs["policy"] = program
+            self._stats["swaps"] += 1
+        swap_s = time.monotonic() - t0
+        obs.counter_add("policy_swaps")
+        obs.gauge_set("policy_version", int(version))
+        _event("policy_swap", version=int(version), version_prev=old,
+               swap_s=round(swap_s, 6))
+        return {"version": int(version), "version_prev": old,
+                "swap_s": swap_s}
 
     # -- request path ------------------------------------------------------
     @property
@@ -259,14 +336,30 @@ class CalibServer:
                              f"directions, server expects M={self.M}")
         if not 1 <= job.k <= self.M:
             raise ValueError(f"job.k={job.k} outside [1, M={self.M}]")
+        if self._policy is not None and job.version_admitted is None:
+            # the stale-version contract: remember which snapshot was
+            # live at ADMISSION — a hot-swap can land before execution
+            job.version_admitted = self.policy_version
         return self.batcher.submit(job)
 
     # -- batch execution ---------------------------------------------------
-    def _lane_params(self, batch, batch_id: int = 0):
-        """(rho, mask, alpha, iters) lane arrays for this batch.  Idle
-        lanes re-run their stale (valid) episode under the default rho —
-        the program shape is fixed at ``lanes``.  Jobs with rho=None and
-        an armed policy get theirs from the exported actor forward."""
+    def _lane_params(self, batch, batch_id: int = 0, policy=None,
+                     policy_prog=None):
+        """(rho, mask, alpha, iters, heads) lane arrays for this batch.
+        Idle lanes re-run their stale (valid) episode under the default
+        rho — the program shape is fixed at ``lanes``.  Jobs with
+        rho=None and an armed policy get theirs from the exported actor
+        forward.
+
+        ``policy``/``policy_prog`` are the per-batch ACTING snapshot
+        captured under ``_lock`` by ``_process_batch`` (never read live
+        here — a hot-swap mid-batch must not tear the lane params).
+        ``heads`` is the host ``(act, mu, logsigma)`` triple of the
+        exported forward (None when it didn't run): the behavior-logp
+        source for the replay tee.  With a transition sink armed, the
+        forward also runs for PINNED-rho lanes carrying an obs_vec so
+        their off-policy actions can be scored under the same snapshot.
+        """
         E, M = self.lanes, self.M
         rho = np.ones((E, M), np.float32)
         mask = np.zeros((E, M), np.float32)
@@ -274,6 +367,7 @@ class CalibServer:
         iters = np.full((E,), self.backend.admm_iters, np.int32)
         mask[:, :2] = 1.0               # idle lanes: 2 live dirs, rho=1
         want_policy = []
+        want_heads = []
         for lane, job in enumerate(batch):
             mask[lane] = 0.0
             mask[lane, :job.k] = 1.0
@@ -285,20 +379,27 @@ class CalibServer:
                 if job.rho_spatial is not None:
                     alpha[lane, :job.k] = np.asarray(job.rho_spatial,
                                                      np.float32)[:job.k]
-            elif self._policy is not None:
+                if (policy is not None and self._transition_sink is not None
+                        and not job.warm and job.obs_vec is not None):
+                    want_heads.append(lane)
+            elif policy is not None:
                 want_policy.append(lane)
-        if want_policy:
+        heads = None
+        if want_policy or want_heads:
             with obs.span("serve_policy", lanes=len(want_policy),
                           batch=batch_id):
                 obs_dim = self.npix * self.npix + (self.M + 1) * 7
                 ovec = np.zeros((E, obs_dim), np.float32)
-                for lane in want_policy:
+                for lane in want_policy + want_heads:
                     if batch[lane].obs_vec is not None:
                         ovec[lane] = np.asarray(batch[lane].obs_vec,
                                                 np.float32)
-                _, actor_params = self._policy
-                act = np.asarray(self._program("policy")(
-                    actor_params, ovec))
+                _, actor_params = policy
+                prog = (policy_prog if policy_prog is not None
+                        else self._program("policy"))
+                act, mu, logsigma = (np.asarray(a) for a in
+                                     prog(actor_params, ovec))
+                heads = (act, mu, logsigma)
                 lo, hi = calib_env.LOW, calib_env.HIGH
                 mapped = act * (hi - lo) / 2 + (hi + lo) / 2
                 for lane in want_policy:
@@ -306,7 +407,29 @@ class CalibServer:
                     rho[lane, :k] = np.clip(mapped[lane, :k], lo, hi)
                     alpha[lane, :k] = np.clip(
                         mapped[lane, M:M + k], lo, hi)
-        return rho, mask, alpha, iters
+        return rho, mask, alpha, iters, heads
+
+    def _behavior_logp(self, job, lane, rho, alpha, heads):
+        """(log pi(a|s), action) of the action actually SERVED on
+        ``lane``, under the acting snapshot's distribution heads.
+
+        Policy lanes score their own emitted action; pinned-rho lanes
+        score the pinned values mapped back to unit coordinates
+        (``calib_env._to_unit``) — off-policy data the learner's IMPACT
+        ratio corrects for.  Dead entries (beyond ``job.k``) keep the
+        policy's own output so they contribute the same density mass a
+        pure policy action would — ratio-neutral padding."""
+        from smartcal_tpu.rl.networks import tanh_gaussian_log_prob_np
+
+        act_row, mu_row, ls_row = (h[lane] for h in heads)
+        action = np.asarray(act_row, np.float32).copy()
+        if job.rho is not None:
+            k, M = job.k, self.M
+            action[:k] = calib_env._to_unit(rho[lane, :k])
+            action[M:M + k] = calib_env._to_unit(alpha[lane, :k])
+            np.clip(action, -1.0, 1.0, out=action)
+        lp = float(tanh_gaussian_log_prob_np(mu_row, ls_row, action))
+        return lp, action
 
     def _oracle_result(self, episode, rho_row, mask_row, alpha_row, it):
         """Sequential re-solve of one lane: the ``solve_admm_safe``
@@ -330,6 +453,12 @@ class CalibServer:
         with self._lock:
             self._batch_id += 1
             batch_id = self._batch_id
+            # ONE consistent acting snapshot per batch: params, program
+            # and version move together under the lock, so a concurrent
+            # swap_policy lands between batches, never inside one
+            policy = self._policy
+            ver_acted = self._policy_version
+            policy_prog = self._programs.get("policy")
         with obs.span("serve_batch", jobs=len(batch), batch=batch_id):
             # chaos hook: a planned serve_batch delay (runtime/faults)
             # inflates this replica's service time — the injected-
@@ -339,8 +468,8 @@ class CalibServer:
                 for lane, job in enumerate(batch):
                     self._bep = self.backend.splice_episode(
                         self._bep, lane, job.episode)
-                rho, mask, alpha, iters = self._lane_params(batch,
-                                                            batch_id)
+                rho, mask, alpha, iters, heads = self._lane_params(
+                    batch, batch_id, policy, policy_prog)
             ops = self.backend.batched_solve_operands(
                 self._bep, rho, mask, iters)
             with obs.span("serve_solve", lanes=E, batch=batch_id):
@@ -363,6 +492,7 @@ class CalibServer:
         sentinel_due = (self.sentinel_every > 0
                         and batch_id % self.sentinel_every == 0)
         sent_candidates = []
+        transitions = []
         for lane, job in enumerate(batch):
             degraded = not np.isfinite(sig[lane])
             if degraded:
@@ -383,6 +513,23 @@ class CalibServer:
             if missed:
                 n_missed += 1
                 obs.counter_add("serve_deadline_miss")
+            version_fields = {}
+            behavior_logp = None
+            if policy is not None:
+                # stale-version contract: BOTH the admission-time and
+                # acting versions ride the event — a swap between them
+                # is visible, never silently the new version alone
+                version_fields = {
+                    "version": ver_acted,
+                    "version_admitted": (job.version_admitted
+                                         if job.version_admitted is not None
+                                         else ver_acted)}
+                if heads is not None and job.obs_vec is not None \
+                        and not job.warm:
+                    behavior_logp = self._behavior_logp(
+                        job, lane, rho, alpha, heads)
+                    version_fields["behavior_logp"] = round(
+                        behavior_logp[0], 6)
             result = JobResult(
                 job_id=job.job_id, lane=lane, batch_id=batch_id,
                 sigma_res=vals[0], sigma_data_img=vals[1],
@@ -395,12 +542,32 @@ class CalibServer:
                    degraded=degraded, deadline_miss=missed,
                    queue_wait_s=result.queue_wait_s,
                    service_s=result.service_s, total_s=result.total_s,
-                   sigma_res=vals[0],
+                   sigma_res=vals[0], **version_fields,
                    **tracectx.child_fields(job.trace),
                    **({"warm": True} if job.warm else {}))
             obs.counter_add("serve_jobs_warm" if job.warm
                             else "serve_jobs")
+            if (behavior_logp is not None
+                    and self._transition_sink is not None):
+                lp, action = behavior_logp
+                ov = np.asarray(job.obs_vec, np.float32)
+                reward = (vals[1] / max(vals[2], 1e-12)
+                          + 1e-4 / (vals[3] + calib_env.EPS))
+                transitions.append({
+                    "state": ov, "action": action,
+                    "reward": np.float32(reward), "new_state": ov,
+                    "done": True,
+                    "hint": np.zeros(2 * self.M, np.float32),
+                    "version": np.int32(ver_acted),
+                    "behavior_logp": np.float32(lp)})
             job.future.set_result(result)
+        if transitions:
+            try:
+                self._transition_sink(transitions)
+                obs.counter_add("serve_teed", len(transitions))
+            except Exception as e:   # tee must never fail the batch
+                obs.counter_add("serve_tee_errors")
+                _event("serve_tee_error", batch=batch_id, error=repr(e))
         snap = None
         if sent_candidates:
             # deterministic pick, latest-wins: the breaker loop replays
@@ -506,6 +673,8 @@ class CalibServer:
             raise RuntimeError("process_once with a running fleet would "
                                "race the batch worker")
         for job in jobs:
+            if self._policy is not None and job.version_admitted is None:
+                job.version_admitted = self.policy_version
             self.batcher.submit(job)
         batch = self.batcher.next_batch(timeout=max(timeout, 0.001))
         return self._process_batch(batch) if batch else 0
@@ -585,8 +754,11 @@ class CalibServer:
         with self._lock:
             out = dict(self._stats)
             sent = dict(self._sentinel_stats)
+            ver = self._policy_version
         out.update(self.batcher.stats())
         out["circuit_open"] = self.circuit_open
+        if self._policy is not None:
+            out["policy_version"] = ver
         if self.sentinel_every > 0:
             out["sentinel"] = dict(sent,
                                    firing=self._sentinel_slo.firing)

@@ -1,0 +1,364 @@
+"""Online lifecycle (ISSUE 20): replay tee, behavior-logp scoring,
+zero-compile hot-swap publication, fleet weight frames.
+
+The load-bearing claims, each pinned here:
+
+* LOGP PARITY — the batch worker's host-numpy ``behavior_logp`` scorer
+  is term-for-term identical to the learner's jax density (the IMPACT
+  ratio's numerator and denominator must come from the same measure).
+* SWAP PARITY — swapping in bit-identical params under queued load
+  changes nothing but the version bookkeeping: results match the
+  no-swap run exactly, and requests admitted under version V that
+  execute after the swap report BOTH versions.
+* TEE FIDELITY — every teed transition is derivable from its request:
+  state == the job's obs_vec, action == the pinned rho in unit
+  coordinates, reward == the documented sigma composite, version ==
+  the acting snapshot; and offline-storing the same transitions
+  reproduces the learner's ring bitwise.
+* ZERO-COMPILE PUBLICATION — after the warm publish, N more publishes
+  through the ExportCache move the compile counter by exactly zero.
+* FLEET INDEPENDENCE — one publication frames the pytree once and
+  reaches every ready replica; a non-ready replica just misses it; the
+  replica-side ``_WeightsPublisher`` collapses a burst latest-wins.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from smartcal_tpu import obs
+from smartcal_tpu.envs import calib as calib_env
+from smartcal_tpu.envs.radio import RadioBackend
+from smartcal_tpu.serve import (CalibServer, Job, PolicyPublisher,
+                                ServingLearner, TransitionStage,
+                                build_obs_pool)
+
+M = 3
+LANES = 3
+SEED = 7
+NPIX = 32
+OBS_DIM = NPIX * NPIX + (M + 1) * 7
+
+
+def tiny_backend(**kw):
+    args = dict(n_stations=6, n_freqs=2, n_times=4, tdelta=2,
+                admm_iters=2, lbfgs_iters=3, init_iters=5, npix=NPIX)
+    args.update(kw)
+    return RadioBackend(**args)
+
+
+@pytest.fixture(scope="module")
+def lifecycle(tmp_path_factory):
+    """One warmed policy-armed server with the replay tee + its learner
+    and a small obs-bearing pool, shared by the whole module (the
+    export build and the probe calibrations run ONCE)."""
+    from smartcal_tpu.rl import sac
+
+    obs.install_compile_listener()
+    path = tmp_path_factory.mktemp("lifecycle") / "run.jsonl"
+    rl = obs.RunLog(str(path), run_id="lifecycle-test", flush_lines=1)
+    obs.activate(rl)
+    be = tiny_backend()
+    cfg = sac.SACConfig(obs_dim=OBS_DIM, n_actions=2 * M,
+                        mem_size=64, batch_size=16,
+                        is_clip=2.0, ere_eta=0.996)
+    learner = ServingLearner(cfg, seed=SEED, n_shards=4,
+                             publish_every=2, ingest_chunk=4)
+    stage = TransitionStage(cap=256)
+    cache = str(tmp_path_factory.mktemp("lifecycle_cache"))
+    srv = CalibServer(be, M=M, lanes=LANES, cache_dir=cache,
+                      compile_cache=False,
+                      policy=(cfg, learner.actor_params),
+                      transition_sink=stage, max_wait_s=0.02)
+    srv.warmup(seed=SEED)
+    learner.publisher = PolicyPublisher(srv, keep_versions=4)
+    learner.warm()                       # includes the warm publish
+    pool = build_obs_pool(be, M, 3, seed=SEED + 1)
+    yield be, srv, learner, stage, pool, str(path)
+    while obs.active() is not None:
+        obs.deactivate()
+
+
+def _events(path, name, start=0):
+    out = []
+    with open(path) as fh:
+        for line in fh.readlines()[start:]:
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if ev.get("event") == name:
+                out.append(ev)
+    return out
+
+
+def _lines(path):
+    with open(path) as fh:
+        return len(fh.readlines())
+
+
+# ---------------------------------------------------------------------------
+# behavior_logp: host scorer == jax density
+# ---------------------------------------------------------------------------
+
+def test_behavior_logp_np_matches_jax_density():
+    from smartcal_tpu.rl.networks import (tanh_gaussian_log_prob,
+                                          tanh_gaussian_log_prob_np)
+
+    rng = np.random.default_rng(3)
+    mu = rng.normal(size=(8, 2 * M)).astype(np.float32)
+    logsigma = rng.uniform(-2.0, 0.5, (8, 2 * M)).astype(np.float32)
+    act = np.tanh(rng.normal(size=(8, 2 * M))).astype(np.float32)
+    want = np.asarray(tanh_gaussian_log_prob(mu, logsigma, act))
+    got = np.array([tanh_gaussian_log_prob_np(mu[i], logsigma[i], act[i])
+                    for i in range(len(mu))])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    # saturated actions (the pinned-rho clip boundary) stay finite
+    edge = np.full((1, 2 * M), 1.0, np.float32)
+    assert np.isfinite(tanh_gaussian_log_prob_np(mu[0], logsigma[0],
+                                                 edge[0]))
+
+
+# ---------------------------------------------------------------------------
+# the tee: fidelity of served transitions
+# ---------------------------------------------------------------------------
+
+def test_teed_transitions_derivable_from_their_requests(lifecycle):
+    be, srv, learner, stage, pool, path = lifecycle
+    stage.drain()                        # isolate this wave
+    ver = srv.policy_version
+    jobs = []
+    for i, (k, ep, ov) in enumerate(pool):
+        rho = np.linspace(0.5 + i, 1.5 + i, k).astype(np.float32)
+        jobs.append(Job(episode=ep, k=k, rho=rho, obs_vec=ov))
+    srv.process_once(jobs, timeout=0.05)
+    results = [j.future.result(timeout=60) for j in jobs]
+    trs = stage.drain()
+    assert len(trs) == len(jobs)
+    spec_keys = {"state", "new_state", "action", "reward", "done",
+                 "hint", "version", "behavior_logp"}
+    for job, r, tr in zip(jobs, results, trs):
+        assert set(tr) == spec_keys
+        np.testing.assert_array_equal(tr["state"],
+                                      np.asarray(job.obs_vec, np.float32))
+        np.testing.assert_array_equal(tr["state"], tr["new_state"])
+        # pinned-rho lanes: the served action IS the pinned rho in unit
+        # coordinates (the off-policy stream the IMPACT ratio corrects)
+        np.testing.assert_allclose(
+            tr["action"][:job.k],
+            np.clip(calib_env._to_unit(job.rho), -1.0, 1.0), rtol=1e-6)
+        want_reward = (r.sigma_data_img / max(r.sigma_res_img, 1e-12)
+                       + 1e-4 / (r.img_std + calib_env.EPS))
+        np.testing.assert_allclose(float(tr["reward"]), want_reward,
+                                   rtol=1e-5)
+        assert bool(tr["done"]) is True
+        assert int(tr["version"]) == ver
+        assert np.isfinite(float(tr["behavior_logp"]))
+
+
+def test_tee_ingest_matches_offline_filled_buffer():
+    """Storing the same transitions through ``ServingLearner.ingest``
+    and through a direct offline ``replay_add_batch`` yields bitwise
+    identical rings (the tee adds no transformation of its own)."""
+    import jax
+
+    from smartcal_tpu.rl import replay as rp
+    from smartcal_tpu.rl import replay_sharded as rps
+    from smartcal_tpu.rl import sac
+
+    cfg = sac.SACConfig(obs_dim=6, n_actions=4, mem_size=32,
+                        batch_size=8)
+    rng = np.random.default_rng(11)
+    trs = [{"state": rng.normal(size=6).astype(np.float32),
+            "new_state": rng.normal(size=6).astype(np.float32),
+            "action": rng.uniform(-1, 1, 4).astype(np.float32),
+            "reward": np.float32(rng.normal()),
+            "done": True,
+            "hint": np.zeros(4, np.float32),
+            "version": np.int32(i % 3),
+            "behavior_logp": np.float32(-abs(rng.normal()))}
+           for i in range(8)]
+    ln = ServingLearner(cfg, seed=1, n_shards=4, ingest_chunk=4)
+    assert ln.ingest(list(trs)) == len(trs)
+    spec = rp.versioned_spec(rp.transition_spec(cfg.obs_dim,
+                                                cfg.n_actions))
+    buf = rps.place_on_mesh(rps.replay_init(cfg.mem_size, spec, 4))
+    for lo in range(0, len(trs), 4):     # same fixed-chunk granularity
+        flat = {k: np.stack([np.asarray(t[k]) for t in trs[lo:lo + 4]])
+                for k in trs[0]}
+        buf = rps.replay_add_batch(buf, flat)
+    for k in spec:
+        np.testing.assert_array_equal(
+            np.asarray(ln.buffer.data[k]), np.asarray(buf.data[k]),
+            err_msg=f"ring field {k!r} diverged")
+    assert int(ln.buffer.cntr) == int(buf.cntr) == len(trs)
+
+
+# ---------------------------------------------------------------------------
+# hot-swap: parity, stale-version contract, zero-compile publication
+# ---------------------------------------------------------------------------
+
+def test_swap_identical_params_is_bit_identical(lifecycle):
+    be, srv, learner, stage, pool, path = lifecycle
+    stage.drain()
+    cfg, params0 = srv._policy           # the installed snapshot
+
+    def wave():
+        jobs = [Job(episode=ep, k=k, rho=None, obs_vec=ov)
+                for k, ep, ov in pool]
+        srv.process_once(jobs, timeout=0.05)
+        return [j.future.result(timeout=60) for j in jobs]
+
+    r0 = wave()
+    v = srv.policy_version
+    swap = srv.swap_policy(params0, v + 1)
+    assert swap["version"] == v + 1 and swap["version_prev"] == v
+    r1 = wave()
+    for a, b in zip(r0, r1):
+        assert a.sigma_res == b.sigma_res
+        assert a.sigma_data_img == b.sigma_data_img
+        assert a.sigma_res_img == b.sigma_res_img
+        assert a.img_std == b.img_std
+    # the teed actions are identical too — same policy, same obs
+    trs = stage.drain()
+    half = len(trs) // 2
+    for t0, t1 in zip(trs[:half], trs[half:]):
+        np.testing.assert_array_equal(t0["action"], t1["action"])
+        assert int(t1["version"]) == int(t0["version"]) + 1
+
+
+def test_jobs_admitted_before_swap_carry_both_versions(lifecycle):
+    be, srv, learner, stage, pool, path = lifecycle
+    stage.drain()
+    start = _lines(path)
+    v = srv.policy_version
+    k, ep, ov = pool[0]
+    futs = [srv.submit(Job(episode=ep, k=k, rho=None, obs_vec=ov))
+            for _ in range(2)]           # admitted under v
+    cfg, params0 = srv._policy
+    srv.swap_policy(params0, v + 1)      # lands before execution
+    srv.process_once([], timeout=0.05)
+    for f in futs:
+        f.result(timeout=60)
+    evs = [e for e in _events(path, "serve_request", start)
+           if not e.get("warm")]
+    assert len(evs) >= 2
+    for e in evs[:2]:
+        assert e["version_admitted"] == v
+        assert e["version"] == v + 1
+        assert "behavior_logp" in e
+
+
+def test_republish_stream_compiles_nothing(lifecycle):
+    """After the warm publish, every further publication (versioned
+    ExportCache entry + swap + warm forward) is compile-free — the
+    ISSUE 20 zero-compile serving-window contract."""
+    be, srv, learner, stage, pool, path = lifecycle
+    pub = learner.publisher
+    v = srv.policy_version
+    c0 = obs.counters_snapshot().get("jax_compile_events", 0.0)
+    recs = [pub.publish(learner.actor_params, v + 1 + i)
+            for i in range(3)]
+    c1 = obs.counters_snapshot().get("jax_compile_events", 0.0)
+    assert c1 - c0 == 0.0
+    assert [r["version"] for r in recs] == [v + 1, v + 2, v + 3]
+    assert srv.policy_version == v + 3
+    assert all(r["publish_s"] < 30.0 for r in recs)
+    # and the server still serves on the new version
+    k, ep, ov = pool[0]
+    job = Job(episode=ep, k=k, rho=None, obs_vec=ov)
+    srv.process_once([job], timeout=0.05)
+    assert np.isfinite(job.future.result(timeout=60).sigma_res)
+
+
+# ---------------------------------------------------------------------------
+# fleet: weight frames, replica independence
+# ---------------------------------------------------------------------------
+
+class _SwapRecorder:
+    """Stands in for a replica's CalibServer in _WeightsPublisher."""
+
+    def __init__(self):
+        self.swaps = []
+        self.seen = threading.Event()
+
+    def swap_policy(self, params, version, program=None):
+        self.swaps.append(int(version))
+        self.seen.set()
+        return {"version": int(version), "version_prev": 0,
+                "swap_s": 0.0}
+
+
+def test_weights_publisher_collapses_burst_latest_wins():
+    from smartcal_tpu.serve.fleet import _WeightsPublisher
+
+    rec = _SwapRecorder()
+    wp = _WeightsPublisher(rec, replica_id=0)
+    for v in (1, 2, 3):                  # burst lands before the thread
+        wp.offer(v, {"w": np.zeros(2)})
+    wp.start()
+    assert rec.seen.wait(timeout=5.0)
+    wp.request_stop()
+    wp.join(timeout=5.0)
+    assert rec.swaps == [3]              # intermediate versions skipped
+    assert wp.swaps == 1
+
+
+def test_publish_policy_reaches_ready_replicas_independently():
+    from smartcal_tpu.serve import fleet as serve_fleet
+
+    class _PubReplica:
+        def __init__(self, ready=True, accept=True):
+            self.ready = threading.Event()
+            if ready:
+                self.ready.set()
+            self.accept = accept
+            self.frames = []
+
+        def publish(self, blob):
+            if not self.accept:
+                return False
+            self.frames.append(blob)
+            return True
+
+    router = serve_fleet.FleetRouter.__new__(serve_fleet.FleetRouter)
+    reps = [_PubReplica(), _PubReplica(ready=False), _PubReplica()]
+    router._live = lambda: reps
+    reached = serve_fleet.FleetRouter.publish_policy(
+        router, {"w": np.arange(3, dtype=np.float32)}, version=4)
+    assert reached == 2
+    assert not reps[1].frames            # not-ready replica just misses
+    # one frame, byte-identical to every replica — framed once
+    assert reps[0].frames == reps[2].frames
+    from smartcal_tpu.runtime import ipc
+    kind, payload = ipc.unframe_payload(reps[0].frames[0])
+    assert (kind, payload["version"]) == ("weights", 4)
+    np.testing.assert_array_equal(payload["params"]["w"],
+                                  np.arange(3, dtype=np.float32))
+
+
+def test_server_gauges_carry_policy_version():
+    from smartcal_tpu.serve.fleet import _server_gauges
+
+    class _Srv:
+        policy_version = 5
+        lanes = 2
+
+        def stats(self):
+            return {}
+
+        class batcher:
+            @staticmethod
+            def depth():
+                return 0
+
+            @staticmethod
+            def service_estimate_s():
+                return 0.0
+
+    g = _server_gauges(_Srv())
+    assert g["policy_version"] == 5
+    assert g["queue_depth"] == 0

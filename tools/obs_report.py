@@ -35,6 +35,10 @@ given.  Output sections:
 * **SLO burn** — ``slo_burn`` detector transitions (obs/slo.py):
   firing/cleared with the fast/slow burn rates and the localized worst
   replica.
+* **Lifecycle** (``tools/serve_learn.py`` runs) — policy publications
+  and hot-swaps with their latency percentiles, the per-serving-version
+  ``sigma_res`` table (learning measured on live traffic), stale-version
+  serve counts, and the learner's staleness / IS-clip gauge quarters.
 * **Training health** (``--diag`` runs) — grad-norm trajectory over the
   learning updates (quarter means, so a ramp or a blowup is visible at a
   glance), non-finite counts, watchdog trips with their reasons, and the
@@ -552,6 +556,108 @@ def render_serving(sv, out):
                    + (f" ({per} per request)" if per is not None else "")
                    + ("  <-- steady state must be 0"
                       if sv["compiles_in_serving"] else ""))
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle (online learning beside serving: policy_publish / policy_swap
+# events, per-version sigma_res trajectory, staleness + IS-clip gauges)
+# ---------------------------------------------------------------------------
+
+def lifecycle_summary(events):
+    """Aggregate the continuous-learning telemetry (tools/serve_learn.py
+    runs), or None for a run that never published a policy.
+
+    The per-version ``sigma_res`` table is the section's point: each
+    hot-swap opens a new version bucket, so an improving learner shows
+    falling residuals ACROSS versions — improvement measured on live
+    traffic, not on a held-out eval.  Requests whose acting version
+    differs from their admitted version (``stale_serves``) are the
+    swap-landed-mid-queue cases the dual-version event contract exists
+    for."""
+    pubs = [e for e in events if e.get("event") == "policy_publish"]
+    swaps = [e for e in events if e.get("event") == "policy_swap"]
+    if not (pubs or swaps):
+        return None
+    out = {"publishes": len(pubs), "swaps": len(swaps)}
+    if pubs:
+        for k in ("publish_s", "export_s", "swap_s"):
+            d = _pctiles([e.get(k) for e in pubs])
+            if d:
+                out[k] = d
+        out["versions_published"] = [int(e["version"]) for e in pubs
+                                     if e.get("version") is not None]
+        reached = [int(e.get("fleet_reached") or 0) for e in pubs]
+        if any(reached):
+            out["fleet_reached_total"] = sum(reached)
+    live = [e for e in events if e.get("event") == "serve_request"
+            and not e.get("warm")]
+    scored = [e for e in live if e.get("behavior_logp") is not None]
+    if live:
+        out["requests"] = len(live)
+        out["teed_fraction"] = round(len(scored) / len(live), 4)
+        out["stale_serves"] = sum(
+            1 for e in live
+            if e.get("version") is not None
+            and e.get("version_admitted") is not None
+            and e["version"] != e["version_admitted"])
+    by_ver = {}
+    for e in live:
+        v, s = e.get("version"), e.get("sigma_res")
+        if v is None or s is None or not np.isfinite(s):
+            continue
+        by_ver.setdefault(int(v), []).append(float(s))
+    if by_ver:
+        out["sigma_res_by_version"] = {
+            str(v): {"n": len(vals),
+                     "mean": round(float(np.mean(vals)), 4)}
+            for v, vals in sorted(by_ver.items())}
+        vs = sorted(by_ver)
+        if len(vs) > 1:
+            first = float(np.mean(by_ver[vs[0]]))
+            last = float(np.mean(by_ver[vs[-1]]))
+            out["sigma_res_improvement"] = round(
+                (first - last) / first, 4) if first else 0.0
+    # learner-side staleness / IS-clip trajectories (gauge stream from
+    # the serving learner, same names as the training fleet's)
+    for g in ("transition_staleness_mean", "is_clip_mean",
+              "is_clip_saturation", "policy_version"):
+        vals = [v for _, v in _gauge_series(events, g)]
+        if vals:
+            st = _series_stats(vals)
+            st["quarters"] = _quarter_means(vals)
+            out[g] = st
+    return out
+
+
+def render_lifecycle(lc, out):
+    head = f"  publishes={lc['publishes']}  swaps={lc['swaps']}"
+    if "requests" in lc:
+        head += (f"  requests={lc['requests']} "
+                 f"(teed {100 * lc['teed_fraction']:.1f}%, "
+                 f"{lc['stale_serves']} stale-version)")
+    out.append(head)
+    for k, label in (("publish_s", "publish"), ("export_s", "export"),
+                     ("swap_s", "swap")):
+        if k in lc:
+            d = lc[k]
+            out.append(f"  {label:8s} p50={d['p50']}s max={d['max']}s "
+                       f"(n={d['n']})")
+    if "fleet_reached_total" in lc:
+        out.append(f"  fleet replicas reached (total): "
+                   f"{lc['fleet_reached_total']}")
+    if "sigma_res_by_version" in lc:
+        out.append("  sigma_res by serving version:")
+        for v, d in lc["sigma_res_by_version"].items():
+            out.append(f"    v{v}: mean={d['mean']} (n={d['n']})")
+        if "sigma_res_improvement" in lc:
+            out.append(f"  improvement first->last version: "
+                       f"{100 * lc['sigma_res_improvement']:.2f}%")
+    for g in ("transition_staleness_mean", "is_clip_mean",
+              "is_clip_saturation"):
+        if g in lc:
+            st = lc[g]
+            out.append(f"  {g}: mean={st['mean']} max={st['max']} "
+                       f"quarters={st['quarters']}")
 
 
 # ---------------------------------------------------------------------------
@@ -1073,6 +1179,7 @@ def build_report(runs, n_boot=1000, seed=0):
              "fleet": fleet_summary(ev),
              "serve_fleet": serve_fleet_summary(ev),
              "serving": serving_summary(ev),
+             "lifecycle": lifecycle_summary(ev),
              "critical_path": (critical_path_summary(ev)
                                if run.get("fleet_dir") else None),
              "slo": slo_summary(ev),
@@ -1126,6 +1233,9 @@ def render(report):
         if r.get("serving"):
             out.append("-- serving SLO")
             render_serving(r["serving"], out)
+        if r.get("lifecycle"):
+            out.append("-- lifecycle (online learning + hot-swap)")
+            render_lifecycle(r["lifecycle"], out)
         if r.get("serve_fleet"):
             out.append("-- fleet SLO (serving scale-out)")
             render_serve_fleet(r["serve_fleet"], out)

@@ -61,7 +61,7 @@ TIER = dict(n_stations=6, n_freqs=2, n_times=4, tdelta=2, admm_iters=2,
 M, LANES = 3, 3
 K_SAMPLES = 5
 STAGE_NAMES = ("solve", "influence", "imager", "replay_fused",
-               "serve_batch")
+               "serve_batch", "publish")
 
 
 def build_stages(names, cache_dir):
@@ -145,6 +145,8 @@ def build_stages(names, cache_dir):
         stages["replay_fused"] = _build_replay_stage()
     if "serve_batch" in names:
         stages["serve_batch"] = _build_serve_stage(be, cache_dir)
+    if "publish" in names:
+        stages["publish"] = _build_publish_stage(be, cache_dir)
     return {n: stages[n] for n in names if n in stages}
 
 
@@ -235,6 +237,46 @@ def _build_serve_stage(be, cache_dir):
     return {
         "statics": dict(be.serve_signature(M, LANES, TIER["npix"]),
                         stage="serve_batch", jobs=len(ks)),
+        "run": run,
+        "cost": None,
+    }
+
+
+def _build_publish_stage(be, cache_dir):
+    """Warm hot-swap publication latency (the ISSUE 20 serving-side
+    half): one versioned ``ExportCache.publish`` + atomic
+    ``swap_policy`` against a warmed, policy-armed server per rep.  The
+    compile-event metric is the whole point here — the exported policy
+    takes the weights as a traced operand, so a publication that
+    compiles ANYTHING is a regression of the zero-compile hot-swap
+    contract."""
+    import jax
+    import numpy as np
+
+    from smartcal_tpu.rl import sac
+    from smartcal_tpu.serve import CalibServer, PolicyPublisher
+
+    obs_dim = TIER["npix"] * TIER["npix"] + (M + 1) * 7
+    cfg = sac.SACConfig(obs_dim=obs_dim, n_actions=2 * M)
+    st = sac.sac_init(jax.random.PRNGKey(7), cfg)
+    srv = CalibServer(be, M=M, lanes=LANES, cache_dir=cache_dir,
+                      compile_cache=False,
+                      policy=(cfg, st.actor_params), max_wait_s=0.02)
+    srv.warmup(seed=7)
+    pub = PolicyPublisher(srv, keep_versions=4)
+    heads = jax.jit(lambda p, o: sac.policy_heads(cfg, p, o))
+    probe = np.linspace(-0.5, 0.5, obs_dim).astype(np.float32)[None, :]
+    ver = [0]
+
+    def run():
+        ver[0] += 1
+        pub.publish(st.actor_params, ver[0])
+        act, _, _ = heads(st.actor_params, probe)
+        return float(np.mean(np.abs(np.asarray(act))))
+
+    return {
+        "statics": dict(be.serve_signature(M, LANES, TIER["npix"]),
+                        stage="publish", obs_dim=obs_dim),
         "run": run,
         "cost": None,
     }

@@ -19,6 +19,8 @@ Schemas recognised (see _classify):
                    canonical bench.py payload (validated strictly);
 - ``bench-suite``  dict with a ``bench`` name and ``runs`` (serve_r14,
                    serve_fleet_r15);
+- ``lifecycle``    dict with a ``bench`` name and a ``lifecycle``
+                   section (serve_learn artifacts, lifecycle_r19);
 - ``summary``      any other dict (experiment summaries, decisions);
 - ``table``        a JSON list (host_seg_bench);
 - ``invalid``      unparseable JSON, or a bench payload violating the
@@ -107,6 +109,15 @@ def _classify(doc: Any, problems: List[str], rel: str) -> Dict[str, Any]:
     elif "bench" in doc and "runs" in doc:
         row["schema"] = "bench-suite"
         row["metric"] = doc.get("bench")
+    elif "bench" in doc and "lifecycle" in doc:
+        # tools/serve_learn.py artifact (lifecycle_rN.json): the
+        # headline is sigma_res improvement measured on live traffic
+        row["schema"] = "lifecycle"
+        row["metric"] = f"{doc.get('bench')}_sigma_res_improvement"
+        imp = (doc.get("lifecycle") or {}).get("sigma_res_improvement")
+        if isinstance(imp, (int, float)):
+            row["value"] = imp
+            row["unit"] = "fraction"
     elif "stages" in doc and "findings" in doc:
         row["schema"] = "perf-gate"
     elif "schema_version" in doc and "entries" in doc:

@@ -109,9 +109,9 @@ def main(argv=None):
     tobs.echo(f"server up in {boot_s}s (warmup {warm['wall_s']}s, "
               f"programs {warm['sources']})")
 
-    pool = loadgen.build_job_pool(backend, args.M, args.pool,
-                                  seed=args.seed + 1,
-                                  mixed=(args.pool_mode == "mixed"))
+    pool = loadgen.build_job_pool(
+        backend, args.M, args.pool, seed=args.seed + 1,
+        heterogeneous=(args.pool_mode == "mixed"))
     srv.start()
     rates_out = []
     c_steady0 = obs.counters_snapshot()

@@ -1,0 +1,92 @@
+#!/bin/bash
+# Continuous-learning smoke, the serve->learn->hot-swap loop end to end:
+#
+# One tools/serve_learn.py window (CPU tiny tier): the server serves an
+# open-loop Poisson stream, every completion tees into the sharded
+# replay, the learner steps beside the server and publishes through the
+# ExportCache — and the run must show
+#
+#   1. at least one policy hot-swap LANDED during the window,
+#   2. ZERO compile events in the serving window (the learner's warmup
+#      reached the sharding fixed point and pre-published, so neither
+#      the learn step nor call_exported re-traces in steady state),
+#   3. zero sheds attributable to a publication (swaps never push the
+#      admission queue over),
+#   4. unbroken trace continuity (no request lost its span tree), and
+#   5. the learner actually learned from served traffic (ingested > 0,
+#      learn steps > 0).
+#
+# Then tools/obs_report.py over the RunLog must render the lifecycle
+# section (publishes/swaps + the per-version sigma_res table).
+#
+# The CI companion of smoke_serve.sh; the cold export build dominates
+# (~2-4 min on CPU), the serving window itself is ~25 s.
+#
+#   bash tools/smoke_lifecycle.sh [workdir]
+#
+# Exits non-zero on any broken link in the chain.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+REPO="$PWD"
+WORK="${1:-$(mktemp -d /tmp/smoke_lifecycle.XXXXXX)}"
+CACHE="$WORK/cache"
+OUT="$WORK/lifecycle.json"
+RUN="$WORK/lifecycle.jsonl"
+mkdir -p "$WORK"
+
+echo "[smoke_lifecycle] serve+learn window (cache $CACHE)" >&2
+(cd "$WORK" && PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+    JAX_PLATFORMS=cpu \
+    python "$REPO/tools/serve_learn.py" \
+    --tier tiny --M 3 --lanes 3 --rate 3 --duration 25 --pool 6 \
+    --eval-pool 3 --eval-every-s 8 --publish-every 2 \
+    --cache-dir "$CACHE" --metrics "$RUN" --out "$OUT" \
+    > /dev/null)
+
+python - "$OUT" <<'EOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+srv, lc = doc["serving"], doc["lifecycle"]
+
+assert lc["swaps"] >= 1, f"no hot-swap landed in the window: {lc['swaps']}"
+assert srv["steady_compile_events"] == 0, \
+    f"{srv['steady_compile_events']} compiles in the serving window"
+assert lc["publication_sheds"] == 0, \
+    f"{lc['publication_sheds']} sheds within 1 s of a swap"
+assert srv["completed"] > 0, f"no jobs completed: {srv}"
+
+tc = lc["trace_continuity"]
+assert tc["continuous"], f"trace continuity broken: {tc}"
+
+ln = lc["learner"]
+assert ln["ingested"] > 0, f"tee fed the learner nothing: {ln}"
+assert ln["learns"] > 0, f"learner never stepped: {ln}"
+assert lc["p99_flat_across_swaps"], \
+    f"p99 spiked across a swap: {lc['swap_p99_windows']}"
+
+print("[smoke_lifecycle] OK:", srv["completed"], "jobs,",
+      lc["swaps"], "swaps,", ln["ingested"], "transitions teed,",
+      ln["learns"], "learn steps, publish p99",
+      lc["publish_ms_p99"], "ms, steady compiles 0")
+EOF
+
+echo "[smoke_lifecycle] aggregating the RunLog with obs_report" >&2
+REPORT="$WORK/report.txt"
+python tools/obs_report.py "$RUN" > "$REPORT"
+grep -q "lifecycle (online learning + hot-swap)" "$REPORT" || {
+    echo "[smoke_lifecycle] FAIL: no lifecycle section in obs_report" >&2
+    exit 1
+}
+grep -q "sigma_res by serving version" "$REPORT" || {
+    echo "[smoke_lifecycle] FAIL: no per-version sigma_res table" >&2
+    exit 1
+}
+grep -q "compiles in serving window: 0" "$REPORT" || {
+    echo "[smoke_lifecycle] FAIL: compiles-in-serving-window not zero" >&2
+    grep "compiles in serving" "$REPORT" >&2 || true
+    exit 1
+}
+echo "[smoke_lifecycle] PASS (workdir $WORK)" >&2
